@@ -1,0 +1,48 @@
+"""instaslice_tpu — TPU-native dynamic accelerator-slicing framework.
+
+A Kubernetes operator that carves TPU sub-slices on demand for individual
+pods, the TPU-native re-design of project-codeflare/instaslice (reference:
+/root/reference, see SURVEY.md). Where the reference partitions NVIDIA GPUs
+into MIG slices via NVML, this framework partitions TPU chip meshes into
+contiguous ICI-connected rectangles and hands them to pods via
+``TPU_WORKER_ID`` / ``TPU_VISIBLE_CHIPS`` / mesh-bounds environment so
+jax/XLA workloads shard correctly inside their granted sub-slice.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
+
+1. ``topology``   — pure chip-grid model, profile catalog, torus placement
+                    engine (generalizes the reference's 1-D 8-slot scanner,
+                    ``instaslice_controller.go:303-384``, to 2/3-D).
+2. ``api``        — the ``TpuSlice`` CR data model + state machine
+                    (``api/v1alpha1/instaslice_types.go:23-102`` analog).
+3. ``device``     — device layer: fake TPU backend for CI, C++ libtpuslice
+                    via ctypes, sysfs/Cloud-TPU backends (go-nvml analog).
+4. ``agent``      — per-node agent realizing allocations on hardware
+                    (``instaslice_daemonset.go`` analog).
+5. ``controller`` — cluster controller gating/allocating/ungating pods
+                    (``instaslice_controller.go`` analog).
+6. ``deviceplugin`` — kubelet gRPC device plugin advertising google.com/tpu.
+7. ``parallel``/``models``/``ops``/``serving`` — the workload side: mesh
+   construction from granted-slice env, a JAX Llama family + pallas
+   kernels, and a serving engine (the samples/vllm_dep.yaml analog).
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "tpu.instaslice.dev"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "TpuSlice"
+PLURAL = "tpuslices"
+
+# Scheduling gate + finalizer (reference: "org.instaslice/accelarator",
+# samples/test-pod.yaml:1-19 — typo deliberately not replicated).
+GATE_NAME = f"{GROUP}/accelerator"
+FINALIZER = f"{GROUP}/accelerator"
+
+# Per-pod extended resource prefix (reference: "org.instaslice/<podname>").
+POD_RESOURCE_PREFIX = f"{GROUP}/"
+
+# Extended resource advertised by the device plugin (reference:
+# "nvidia.com/mig-*" via the NVIDIA GPU operator).
+TPU_RESOURCE = "google.com/tpu"

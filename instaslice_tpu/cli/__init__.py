@@ -1,0 +1,2 @@
+"""Process entry points (reference analog: ``cmd/controller/main.go``,
+``cmd/daemonset/main.go``)."""

@@ -1,0 +1,38 @@
+"""Per-node agent entry point (reference: ``cmd/daemonset/main.go:55-168``)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuslice-agent",
+        description="instaslice_tpu node agent: discovers TPU chips, "
+        "realizes allocations, injects slice env.",
+    )
+    p.add_argument("--node-name", default=os.environ.get("NODE_NAME", ""),
+                   help="this node's name (downward API NODE_NAME)")
+    p.add_argument("--namespace", default="instaslice-tpu-system")
+    p.add_argument("--backend", default="auto",
+                   help="device backend: auto|fake|native|sysfs")
+    p.add_argument("--metrics-bind-address", default=":8084")
+    p.add_argument("--health-probe-bind-address", default=":8085")
+    p.add_argument("--kubeconfig", default="")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.node_name:
+        print("error: --node-name or NODE_NAME env required", file=sys.stderr)
+        return 2
+    from instaslice_tpu.cli.runtime import run_agent
+
+    return run_agent(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""Cluster-controller entry point (reference: ``cmd/controller/main.go:55-168``)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuslice-controller",
+        description="instaslice_tpu cluster controller: watches gated pods, "
+        "allocates TPU sub-slices, ungates.",
+    )
+    p.add_argument("--namespace", default="instaslice-tpu-system",
+                   help="namespace for operator-owned objects")
+    p.add_argument("--policy", default="first-fit",
+                   help="allocation policy (first-fit|best-fit|packed-fit)")
+    p.add_argument("--metrics-bind-address", default=":8080")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--kubeconfig", default="")
+    p.add_argument("--deletion-grace-seconds", type=float, default=30.0)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from instaslice_tpu.cli.runtime import run_controller
+
+    return run_controller(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""Kubelet device-plugin entry point advertising ``google.com/tpu``.
+
+The reference outsources this to the NVIDIA GPU operator and kicks it via a
+node-label toggle (``instaslice_daemonset.go:474-497``); here it is a real
+in-tree component (SURVEY.md §2a row 3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpuslice-deviceplugin",
+        description="kubelet device plugin advertising google.com/tpu",
+    )
+    p.add_argument("--plugin-dir", default="/var/lib/kubelet/device-plugins")
+    p.add_argument("--backend", default="auto")
+    p.add_argument("--resource", default="google.com/tpu")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from instaslice_tpu.cli.runtime import run_deviceplugin
+
+    return run_deviceplugin(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

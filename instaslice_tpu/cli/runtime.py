@@ -1,0 +1,31 @@
+"""Runtime wiring for the CLI entry points.
+
+Builds the kube client, device backend, and reconcilers, then runs the
+watch loops. Populated as layers land; each runner degrades with a clear
+error instead of a traceback when its layer is unavailable.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def run_controller(args) -> int:
+    from instaslice_tpu.controller.runner import ControllerRunner
+
+    return ControllerRunner.from_args(args).run()
+
+
+def run_agent(args) -> int:
+    from instaslice_tpu.agent.runner import AgentRunner
+
+    return AgentRunner.from_args(args).run()
+
+
+def run_deviceplugin(args) -> int:
+    try:
+        from instaslice_tpu.deviceplugin.server import serve
+    except ImportError as e:
+        print(f"device plugin unavailable: {e}", file=sys.stderr)
+        return 1
+    return serve(args)

@@ -1,0 +1,83 @@
+"""``tpuslice`` operator CLI: inspect catalogs, simulate placement, demo."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpuslice", description="instaslice_tpu operator CLI"
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    cat = sub.add_parser("catalog", help="print the profile catalog")
+    cat.add_argument("generation", help="TPU generation, e.g. v5e")
+    cat.add_argument("--max-chips", type=int, default=None)
+
+    place = sub.add_parser("plan", help="simulate placing profiles on a mesh")
+    place.add_argument("generation")
+    place.add_argument("profiles", nargs="+", help="e.g. v5e-2x2 v5e-1x1")
+    place.add_argument("--hosts", type=int, default=1)
+    place.add_argument("--policy", default="best-fit")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "catalog":
+        from instaslice_tpu.topology import profile_catalog
+
+        for prof in profile_catalog(args.generation, args.max_chips):
+            print(json.dumps({"name": prof.name, **prof.attributes()}))
+        return 0
+
+    if args.cmd == "plan":
+        from instaslice_tpu.topology import (
+            NodeGrid,
+            Occupancy,
+            TorusGroup,
+            get_policy,
+            parse_profile_name,
+        )
+        from instaslice_tpu.topology.grid import get_generation
+
+        gen = get_generation(args.generation)
+        hb = gen.host_bounds
+        hosts = {
+            f"host-{i}": NodeGrid(gen, host_offset=(i * hb[0], 0, 0))
+            for i in range(args.hosts)
+        }
+        group = TorusGroup(
+            "plan", gen, (hb[0] * args.hosts, hb[1], hb[2]), hosts
+        )
+        occ = Occupancy(group)
+        pol = get_policy(args.policy)
+        ok = True
+        for i, name in enumerate(args.profiles):
+            pl = pol.choose(group, parse_profile_name(name), occ)
+            if pl is None:
+                print(json.dumps({"request": name, "placed": False}))
+                ok = False
+                continue
+            occ.occupy(pl.box, owner=str(i))
+            print(
+                json.dumps(
+                    {
+                        "request": name,
+                        "placed": True,
+                        "box": pl.box.key(),
+                        "hosts": {
+                            pt.node_name: pt.local_chip_ids(hb)
+                            for pt in pl.parts
+                        },
+                    }
+                )
+            )
+        return 0 if ok else 1
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""Topology core: pure chip-grid model, profile catalog, placement engine.
+
+No Kubernetes, no device access — everything here is deterministic and
+unit-testable. This layer is the TPU generalization of the reference's MIG
+placement machinery: where InstaSlice scans a 1-D 8-slot occupancy array per
+GPU against a profile's legal start indexes
+(``/root/reference/internal/controller/instaslice_controller.go:303-384``),
+we place axis-aligned contiguous boxes on a 2/3-D chip mesh so every granted
+sub-slice has full internal ICI connectivity.
+"""
+
+from instaslice_tpu.topology.grid import (
+    Generation,
+    GENERATIONS,
+    NodeGrid,
+    TorusGroup,
+)
+from instaslice_tpu.topology.profiles import (
+    TopologyProfile,
+    parse_profile_name,
+    profile_catalog,
+)
+from instaslice_tpu.topology.placement import (
+    Box,
+    Placement,
+    Occupancy,
+    legal_placements,
+)
+from instaslice_tpu.topology.policy import (
+    AllocationPolicy,
+    FirstFitPolicy,
+    BestFitPolicy,
+    get_policy,
+)

@@ -1,0 +1,17 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; all sharding-aware tests run
+against ``--xla_force_host_platform_device_count=8`` CPU devices, and the
+driver separately dry-run-compiles the multi-chip path via
+``__graft_entry__.dryrun_multichip``. Must run before the first jax import,
+hence module-level in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

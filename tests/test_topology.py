@@ -1,0 +1,324 @@
+"""Topology core tests: grids, profiles, placement, policies.
+
+Covers the property obligations from SURVEY.md §7 layer 1: no overlap,
+ICI contiguity (axis-aligned boxes only), alignment, and the BASELINE
+bin-packing stress mix on a v5e-16 mesh.
+"""
+
+import random
+
+import pytest
+
+from instaslice_tpu.topology import (
+    BestFitPolicy,
+    Box,
+    FirstFitPolicy,
+    GENERATIONS,
+    NodeGrid,
+    Occupancy,
+    TorusGroup,
+    get_policy,
+    legal_placements,
+    parse_profile_name,
+    profile_catalog,
+)
+from instaslice_tpu.topology.grid import (
+    coord_to_id,
+    get_generation,
+    id_to_coord,
+    iter_coords,
+)
+from instaslice_tpu.topology.placement import find_placements, legal_anchors
+from instaslice_tpu.topology.profiles import parse_shape
+
+
+def v5e_single(node="node-a"):
+    return TorusGroup.single_host(node, get_generation("v5e"))
+
+
+def v5e_16(prefix="node"):
+    """Two v5e hosts forming a 4x4 mesh (the v5e-16 machine shape)."""
+    gen = get_generation("v5e")
+    hosts = {
+        f"{prefix}-0": NodeGrid(gen, host_offset=(0, 0, 0), torus_group="g"),
+        f"{prefix}-1": NodeGrid(gen, host_offset=(2, 0, 0), torus_group="g"),
+    }
+    return TorusGroup("g", gen, (4, 4, 1), hosts)
+
+
+class TestGrid:
+    def test_generations_present(self):
+        assert {"v4", "v5e", "v5p", "v6e"} <= set(GENERATIONS)
+        assert GENERATIONS["v5e"].chips_per_host == 8
+        assert GENERATIONS["v4"].chips_per_host == 4
+
+    def test_coord_id_roundtrip(self):
+        bounds = (2, 4, 1)
+        ids = set()
+        for c in iter_coords(bounds):
+            i = coord_to_id(c, bounds)
+            assert id_to_coord(i, bounds) == c
+            ids.add(i)
+        assert ids == set(range(8))
+
+    def test_single_host_group(self):
+        g = v5e_single()
+        assert g.chip_count == 8
+        assert g.host_at((1, 3, 0)) == "node-a"
+        assert g.host_at((2, 0, 0)) is None
+
+    def test_multi_host_group(self):
+        g = v5e_16()
+        assert g.chip_count == 16
+        assert g.host_at((1, 1, 0)) == "node-0"
+        assert g.host_at((3, 1, 0)) == "node-1"
+        assert g.host_grid_shape() == (2, 1, 1)
+
+    def test_misaligned_host_offset_rejected(self):
+        gen = get_generation("v5e")
+        with pytest.raises(ValueError):
+            TorusGroup(
+                "g", gen, (4, 4, 1),
+                {"n": NodeGrid(gen, host_offset=(1, 0, 0))},
+            )
+
+
+class TestProfiles:
+    def test_parse_and_render(self):
+        p = parse_profile_name("v5e-2x2")
+        assert p.shape == (2, 2, 1)
+        assert p.name == "v5e-2x2"
+        assert p.chip_count == 4
+        p3 = parse_profile_name("v4-2x2x2")
+        assert p3.shape == (2, 2, 2)
+        assert p3.chip_count == 8
+
+    def test_parse_rejects_garbage(self):
+        for bad in ["", "v5e", "v5e-", "v5e-2x", "v9z-2x2", "v5e-3x2", "mig-1g.5gb"]:
+            with pytest.raises((ValueError, KeyError)):
+                parse_profile_name(bad)
+
+    def test_catalog_v5e(self):
+        names = {p.name for p in profile_catalog("v5e")}
+        for want in ["v5e-1x1", "v5e-2x1", "v5e-2x2", "v5e-4x2", "v5e-4x4",
+                     "v5e-8x4", "v5e-8x8", "v5e-16x16"]:
+            assert want in names, f"{want} missing from {sorted(names)}"
+
+    def test_catalog_capped(self):
+        cat = profile_catalog("v5e", max_chips=8)
+        assert all(p.chip_count <= 8 for p in cat)
+        assert any(p.chip_count == 8 for p in cat)
+
+    def test_hosts_needed(self):
+        assert parse_profile_name("v5e-2x2").hosts_needed() == 1
+        assert parse_profile_name("v5e-4x4").hosts_needed() == 2
+        assert parse_profile_name("v5e-8x8").hosts_needed() == 8
+
+    def test_attributes(self):
+        a = parse_profile_name("v5e-2x2").attributes()
+        assert a["chips"] == 4 and a["hosts"] == 1 and a["hbmGiB"] == 64
+
+    def test_parse_shape(self):
+        assert parse_shape("v5e", "2x2").name == "v5e-2x2"
+
+
+class TestPlacement:
+    def test_anchors_aligned(self):
+        anchors = legal_anchors((4, 4, 1), (2, 2, 1))
+        assert anchors == [(0, 0, 0), (2, 0, 0), (0, 2, 0), (2, 2, 0)]
+
+    def test_1x1_fills_host(self):
+        g = v5e_single()
+        pls = legal_placements(g, parse_profile_name("v5e-1x1"))
+        assert len(pls) == 8
+
+    def test_2x2_on_host(self):
+        g = v5e_single()
+        pls = legal_placements(g, parse_profile_name("v5e-2x2"))
+        assert len(pls) == 2  # bounds 2x4: anchors y in {0, 2}
+        for p in pls:
+            assert len(p.parts) == 1 and p.parts[0].node_name == "node-a"
+
+    def test_2x1_orientations(self):
+        g = v5e_single()
+        pls = legal_placements(g, parse_profile_name("v5e-2x1"))
+        # (2,1): 4 anchors; (1,2): 2x2 anchor grid = 4 → 8 total
+        assert len(pls) == 8
+
+    def test_multi_host_4x4(self):
+        g = v5e_16()
+        pls = legal_placements(g, parse_profile_name("v5e-4x4"))
+        assert len(pls) == 1
+        p = pls[0]
+        assert p.box.chip_count == 16
+        assert [pt.node_name for pt in p.parts] == ["node-0", "node-1"]
+        assert [pt.worker_id for pt in p.parts] == [0, 1]
+        hb = g.generation.host_bounds
+        for pt in p.parts:
+            assert pt.local_box.shape == (2, 4, 1)
+            assert pt.local_chip_ids(hb) == list(range(8))
+
+    def test_sparse_group_skips_missing_host(self):
+        gen = get_generation("v5e")
+        # 4x4 bounds but only one host present → no 4x4 placement.
+        g = TorusGroup(
+            "g", gen, (4, 4, 1),
+            {"n0": NodeGrid(gen, host_offset=(0, 0, 0))},
+        )
+        assert legal_placements(g, parse_profile_name("v5e-4x4")) == []
+        # but sub-host profiles still place on the live host
+        assert len(legal_placements(g, parse_profile_name("v5e-2x2"))) == 2
+
+    def test_occupancy_overlap_rejected(self):
+        g = v5e_single()
+        occ = Occupancy(g)
+        occ.occupy(Box((0, 0, 0), (2, 2, 1)), owner="a")
+        with pytest.raises(ValueError):
+            occ.occupy(Box((0, 1, 0), (1, 1, 1)), owner="b")
+        occ.release(Box((0, 0, 0), (2, 2, 1)), owner="a")
+        occ.occupy(Box((0, 1, 0), (1, 1, 1)), owner="b")
+
+    def test_occupancy_out_of_bounds(self):
+        occ = Occupancy(v5e_single())
+        with pytest.raises(ValueError):
+            occ.occupy(Box((0, 3, 0), (2, 2, 1)))
+
+    def test_box_key_roundtrip(self):
+        b = Box((2, 0, 0), (2, 2, 1))
+        assert Box.from_key(b.key()) == b
+
+
+class TestPolicies:
+    def test_first_fit_fills_then_exhausts(self):
+        g = v5e_single()
+        occ = Occupancy(g)
+        pol = FirstFitPolicy()
+        prof = parse_profile_name("v5e-1x1")
+        got = []
+        for i in range(8):
+            pl = pol.choose(g, prof, occ)
+            assert pl is not None
+            occ.occupy(pl.box, owner=str(i))
+            got.append(pl.box.anchor)
+        assert len(set(got)) == 8
+        assert pol.choose(g, prof, occ) is None
+
+    def test_tail_placement_not_rejected(self):
+        """Reference bug: `<` vs `<=` made the full-size profile
+        unplaceable (instaslice_controller.go:351,360,370). The full-host
+        profile must place on an empty host."""
+        g = v5e_single()
+        pl = FirstFitPolicy().choose(
+            g, parse_shape("v5e", "4x2"), Occupancy(g)
+        )
+        assert pl is not None and pl.box.chip_count == 8
+
+    def test_best_fit_preserves_big_slots(self):
+        g = v5e_16()
+        occ = Occupancy(g)
+        bf = BestFitPolicy()
+        # Place a 2x2; best-fit should leave at least one more 2x2 and as
+        # many 2x1s as possible intact.
+        pl = bf.choose(g, parse_profile_name("v5e-2x2"), occ)
+        assert pl is not None
+        occ.occupy(pl.box)
+        pl2 = bf.choose(g, parse_profile_name("v5e-2x2"), occ)
+        assert pl2 is not None
+        occ.occupy(pl2.box)
+        # Two more 2x2s must still fit on a 4x4 with two taken.
+        pl3 = bf.choose(g, parse_profile_name("v5e-2x2"), occ)
+        assert pl3 is not None
+
+    def test_registry(self):
+        assert get_policy("first-fit").name == "first-fit"
+        with pytest.raises(KeyError):
+            get_policy("nope")
+
+    def test_stress_mix_8_pods_v5e16(self):
+        """BASELINE bin-packing stress: 8 concurrent pods, mixed profiles
+        on one v5e-16 mesh (16 chips): 1x 2x2 + 3x 2x1 + 4x 1x1 = 14 chips
+        must all place with zero overlap."""
+        g = v5e_16()
+        occ = Occupancy(g)
+        pol = BestFitPolicy()
+        mix = (["v5e-2x2"] + ["v5e-2x1"] * 3 + ["v5e-1x1"] * 4)
+        boxes = []
+        for i, name in enumerate(mix):
+            pl = pol.choose(g, parse_profile_name(name), occ)
+            assert pl is not None, f"pod {i} ({name}) unplaceable"
+            occ.occupy(pl.box, owner=str(i))
+            boxes.append(pl.box)
+        for i in range(len(boxes)):
+            for j in range(i + 1, len(boxes)):
+                assert not boxes[i].overlaps(boxes[j])
+
+    def test_property_random_alloc_free(self):
+        """Random alloc/free churn: no overlap ever, all placements
+        aligned, occupancy returns to empty."""
+        rng = random.Random(1234)
+        g = v5e_16()
+        occ = Occupancy(g)
+        live = {}
+        names = ["v5e-1x1", "v5e-2x1", "v5e-2x2", "v5e-4x2"]
+        pol = FirstFitPolicy()
+        for step in range(300):
+            if live and (rng.random() < 0.4 or occ.free_chips() == 0):
+                k = rng.choice(list(live))
+                occ.release(live.pop(k), owner=k)
+            else:
+                prof = parse_profile_name(rng.choice(names))
+                pl = pol.choose(g, prof, occ)
+                if pl is None:
+                    continue
+                for b in live.values():
+                    assert not b.overlaps(pl.box)
+                for i in range(3):
+                    assert pl.box.anchor[i] % pl.box.shape[i] == 0
+                k = f"o{step}"
+                occ.occupy(pl.box, owner=k)
+                live[k] = pl.box
+        for k in list(live):
+            occ.release(live.pop(k), owner=k)
+        assert occ.free_chips() == g.chip_count
+
+
+class TestReviewRegressions:
+    """Fixes from the first code review."""
+
+    def test_parse_canonicalizes_spellings(self):
+        from instaslice_tpu.topology import profile_catalog
+        a = parse_profile_name("v5e-1x4")
+        b = parse_profile_name("v5e-4x1")
+        assert a == b
+        names = {p.name for p in profile_catalog("v5e")}
+        assert a.name in names
+
+    def test_duplicate_host_offsets_rejected(self):
+        gen = get_generation("v5e")
+        with pytest.raises(ValueError, match="both claim"):
+            TorusGroup(
+                "g", gen, (2, 4, 1),
+                {"a": NodeGrid(gen, host_offset=(0, 0, 0)),
+                 "b": NodeGrid(gen, host_offset=(0, 0, 0))},
+            )
+
+    def test_non_multiple_bounds_rejected(self):
+        gen = get_generation("v5e")
+        with pytest.raises(ValueError, match="whole multiple"):
+            TorusGroup(
+                "g", gen, (3, 4, 1),
+                {"a": NodeGrid(gen, host_offset=(0, 0, 0))},
+            )
+
+    def test_release_mismatched_box_refused(self):
+        g = v5e_single()
+        occ = Occupancy(g)
+        a = Box((0, 0, 0), (2, 2, 1))
+        b = Box((0, 2, 0), (2, 2, 1))
+        occ.occupy(a, owner="a")
+        occ.occupy(b, owner="b")
+        with pytest.raises(ValueError, match="mismatched"):
+            occ.release(b, owner="a")
+        occ.release(a, owner="a")
+        occ.release(b, owner="b")
+        assert occ.free_chips() == 8
