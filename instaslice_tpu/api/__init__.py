@@ -1,0 +1,20 @@
+"""``TpuSlice`` CR data model — reference analog: ``api/v1alpha1/``.
+
+The reference defines a per-node ``Instaslice`` CR holding GPU inventory,
+a MIG profile/placement catalog, desired allocations, and realized slices
+(``/root/reference/api/v1alpha1/instaslice_types.go:23-102``). This package
+defines the TPU equivalent with two reference weaknesses fixed (SURVEY.md
+§7 quirks): statuses are typed enums with a validated transition graph, and
+the operator namespace is configurable instead of hardcoded ``"default"``.
+"""
+
+from instaslice_tpu.api.types import (
+    AllocationDetails,
+    AllocationStatus,
+    PreparedDetails,
+    PreparedPart,
+    TpuSlice,
+    TpuSliceSpec,
+    TpuSliceStatus,
+)
+from instaslice_tpu.api.crd import crd_manifest

@@ -1,0 +1,368 @@
+"""Typed data model for the ``TpuSlice`` custom resource.
+
+Field-by-field mapping to the reference
+(``/root/reference/api/v1alpha1/instaslice_types.go``):
+
+================================  ======================================
+reference (Instaslice)            this framework (TpuSlice)
+================================  ======================================
+``Spec.MigGPUUUID`` (:66)         ``spec.chips`` — chip id → device path
+``Spec.Migplacement`` (:71)       ``spec.profiles`` — profile catalog
+``Spec.Allocations`` (:68)        ``spec.allocations`` — desired slices
+``Spec.Prepared`` (:70)           ``spec.prepared`` — realized slices
+``Status.Processed`` (:97)        ``status.processed``
+(absent)                          ``spec.generation/hostOffset/torusGroup``
+                                  — multi-host placement inputs
+================================  ======================================
+
+Objects serialize to/from plain camelCase dicts shaped like K8s manifests;
+the kube layer moves dicts, reconcilers work with these types.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Dict, List, Optional, Tuple
+
+from instaslice_tpu import API_VERSION, KIND
+from instaslice_tpu.topology.grid import Coord, NodeGrid, Shape, get_generation
+from instaslice_tpu.topology.placement import Box, HostPart, Placement
+from instaslice_tpu.topology.profiles import TopologyProfile, parse_profile_name
+
+
+class AllocationStatus(str, enum.Enum):
+    """Allocation lifecycle — typed, unlike the reference's bare strings
+    (``instaslice_controller.go:164-182`` flips ``"creating"/"created"/
+    "ungated"/"deleted"`` literals inline).
+
+    ``FAILED`` is new: the reference logs device errors and carries on
+    (``instaslice_daemonset.go:172-189``, flagged in SURVEY.md §5); here a
+    failed realization is a first-class state the controller can retry or
+    surface.
+    """
+
+    CREATING = "creating"   # controller chose a placement, agent(s) must realize
+    CREATED = "created"     # all host parts realized on hardware
+    UNGATED = "ungated"     # scheduling gate removed, pod may bind
+    DELETED = "deleted"     # teardown requested; agents must release chips
+    FAILED = "failed"       # realization failed; controller decides retry
+
+
+# Legal transitions (from → {to}). Anything else is a programming error.
+_TRANSITIONS = {
+    AllocationStatus.CREATING: {
+        AllocationStatus.CREATED,
+        AllocationStatus.FAILED,
+        AllocationStatus.DELETED,
+    },
+    AllocationStatus.CREATED: {
+        AllocationStatus.UNGATED,
+        AllocationStatus.DELETED,
+        AllocationStatus.FAILED,
+    },
+    AllocationStatus.UNGATED: {AllocationStatus.DELETED},
+    AllocationStatus.FAILED: {
+        AllocationStatus.CREATING,
+        AllocationStatus.DELETED,
+    },
+    AllocationStatus.DELETED: set(),
+}
+
+
+def check_transition(old: AllocationStatus, new: AllocationStatus) -> None:
+    if new == old:
+        return
+    if new not in _TRANSITIONS[old]:
+        raise ValueError(f"illegal allocation transition {old.value} -> {new.value}")
+
+
+@dataclasses.dataclass
+class AllocationDetails:
+    """Desired slice for one pod (reference: ``AllocationDetails``,
+    instaslice_types.go:74-87 — pod identity, GPU UUID, start/size,
+    status). The TPU version stores the global box plus the per-host
+    decomposition so one allocation can fan out to several node agents
+    (multi-host profiles — new capability, SURVEY.md §7)."""
+
+    pod_uuid: str
+    pod_name: str
+    namespace: str
+    profile: str                     # canonical profile name, e.g. v5e-2x2
+    torus_group: str
+    box: str                         # Box.key() in global mesh coords
+    # node name → (worker_id, local Box.key())
+    parts: Dict[str, Tuple[int, str]]
+    status: AllocationStatus = AllocationStatus.CREATING
+    # nodes that have realized their part (subset of parts.keys())
+    realized_on: List[str] = dataclasses.field(default_factory=list)
+    message: str = ""                # last error for FAILED
+    created_at: float = 0.0          # unix secs; grant-latency metric input
+    deletion_requested_at: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "podUUID": self.pod_uuid,
+            "podName": self.pod_name,
+            "namespace": self.namespace,
+            "profile": self.profile,
+            "torusGroup": self.torus_group,
+            "box": self.box,
+            "parts": {
+                n: {"workerId": wid, "localBox": lb}
+                for n, (wid, lb) in sorted(self.parts.items())
+            },
+            "status": self.status.value,
+            "realizedOn": sorted(self.realized_on),
+            "message": self.message,
+            "createdAt": self.created_at,
+            "deletionRequestedAt": self.deletion_requested_at,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "AllocationDetails":
+        return AllocationDetails(
+            pod_uuid=d["podUUID"],
+            pod_name=d["podName"],
+            namespace=d["namespace"],
+            profile=d["profile"],
+            torus_group=d.get("torusGroup", ""),
+            box=d["box"],
+            parts={
+                n: (p["workerId"], p["localBox"])
+                for n, p in d.get("parts", {}).items()
+            },
+            status=AllocationStatus(d.get("status", "creating")),
+            realized_on=list(d.get("realizedOn", [])),
+            message=d.get("message", ""),
+            created_at=float(d.get("createdAt", 0.0)),
+            deletion_requested_at=float(d.get("deletionRequestedAt", 0.0)),
+        )
+
+    def global_box(self) -> Box:
+        return Box.from_key(self.box)
+
+    def set_status(self, new: AllocationStatus, message: str = "") -> None:
+        check_transition(self.status, new)
+        self.status = new
+        if message:
+            self.message = message
+
+    @staticmethod
+    def from_placement(
+        placement: Placement,
+        pod_uuid: str,
+        pod_name: str,
+        namespace: str,
+        now: Optional[float] = None,
+    ) -> "AllocationDetails":
+        return AllocationDetails(
+            pod_uuid=pod_uuid,
+            pod_name=pod_name,
+            namespace=namespace,
+            profile=placement.profile.name,
+            torus_group=placement.group_id,
+            box=placement.box.key(),
+            parts={
+                p.node_name: (p.worker_id, p.local_box.key())
+                for p in placement.parts
+            },
+            status=AllocationStatus.CREATING,
+            created_at=time.time() if now is None else now,
+        )
+
+
+@dataclasses.dataclass
+class PreparedPart:
+    """One node's realized share of a slice (reference:
+    ``PreparedDetails`` carries parent/gi/ci ids per MIG UUID,
+    instaslice_types.go:89-95; ours carries local chip ids + the device
+    handle returned by the device layer)."""
+
+    node_name: str
+    worker_id: int
+    local_box: str                  # Box.key() in host-local coords
+    chip_ids: List[int]             # local chip ids (TPU_VISIBLE_CHIPS)
+    device_handle: str = ""         # backend-specific reservation handle
+
+    def to_dict(self) -> dict:
+        return {
+            "nodeName": self.node_name,
+            "workerId": self.worker_id,
+            "localBox": self.local_box,
+            "chipIds": list(self.chip_ids),
+            "deviceHandle": self.device_handle,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "PreparedPart":
+        return PreparedPart(
+            node_name=d["nodeName"],
+            worker_id=d["workerId"],
+            local_box=d["localBox"],
+            chip_ids=list(d["chipIds"]),
+            device_handle=d.get("deviceHandle", ""),
+        )
+
+
+@dataclasses.dataclass
+class PreparedDetails:
+    """A realized slice, keyed by slice UUID in ``spec.prepared``.
+
+    ``pod_uuid == ""`` marks a dangling slice adopted at boot discovery —
+    same convention as the reference (``discoverDanglingSlices`` records
+    ``PodUUID: ""``, instaslice_daemonset.go:666-748, and the placement
+    engine counts those as occupied, instaslice_controller.go:312-320).
+    """
+
+    slice_uuid: str
+    pod_uuid: str
+    profile: str
+    box: str                        # global Box.key()
+    parts: Dict[str, PreparedPart]  # node name → part
+
+    def to_dict(self) -> dict:
+        return {
+            "sliceUUID": self.slice_uuid,
+            "podUUID": self.pod_uuid,
+            "profile": self.profile,
+            "box": self.box,
+            "parts": {n: p.to_dict() for n, p in sorted(self.parts.items())},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "PreparedDetails":
+        return PreparedDetails(
+            slice_uuid=d["sliceUUID"],
+            pod_uuid=d.get("podUUID", ""),
+            profile=d["profile"],
+            box=d["box"],
+            parts={
+                n: PreparedPart.from_dict(p)
+                for n, p in d.get("parts", {}).items()
+            },
+        )
+
+
+@dataclasses.dataclass
+class TpuSliceSpec:
+    """Per-node spec (reference: ``InstasliceSpec``,
+    instaslice_types.go:64-72)."""
+
+    generation: str = ""             # e.g. "v5e"
+    host_offset: Coord = (0, 0, 0)   # this host's corner in its torus group
+    torus_group: str = ""            # hosts sharing a physical mesh
+    chips: Dict[str, str] = dataclasses.field(default_factory=dict)
+    #   local chip id (str for k8s map keys) → device path ("/dev/accel0")
+    profiles: List[dict] = dataclasses.field(default_factory=list)
+    #   published catalog entries: {"name": ..., attrs...}
+    allocations: Dict[str, AllocationDetails] = dataclasses.field(
+        default_factory=dict
+    )                                # pod UUID → desired
+    prepared: Dict[str, PreparedDetails] = dataclasses.field(
+        default_factory=dict
+    )                                # slice UUID → realized
+
+    def to_dict(self) -> dict:
+        return {
+            "generation": self.generation,
+            "hostOffset": list(self.host_offset),
+            "torusGroup": self.torus_group,
+            "chips": dict(sorted(self.chips.items())),
+            "profiles": list(self.profiles),
+            "allocations": {
+                k: v.to_dict() for k, v in sorted(self.allocations.items())
+            },
+            "prepared": {
+                k: v.to_dict() for k, v in sorted(self.prepared.items())
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "TpuSliceSpec":
+        off = d.get("hostOffset", [0, 0, 0])
+        return TpuSliceSpec(
+            generation=d.get("generation", ""),
+            host_offset=(int(off[0]), int(off[1]), int(off[2])),
+            torus_group=d.get("torusGroup", ""),
+            chips=dict(d.get("chips", {})),
+            profiles=list(d.get("profiles", [])),
+            allocations={
+                k: AllocationDetails.from_dict(v)
+                for k, v in d.get("allocations", {}).items()
+            },
+            prepared={
+                k: PreparedDetails.from_dict(v)
+                for k, v in d.get("prepared", {}).items()
+            },
+        )
+
+    def node_grid(self) -> NodeGrid:
+        return NodeGrid(
+            generation=get_generation(self.generation),
+            host_offset=self.host_offset,
+            torus_group=self.torus_group,
+        )
+
+
+@dataclasses.dataclass
+class TpuSliceStatus:
+    """Reference: ``InstasliceStatus.Processed`` (instaslice_types.go:97)
+    — a string "true"; here a bool plus an observability surface."""
+
+    processed: bool = False
+    conditions: List[dict] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"processed": self.processed, "conditions": list(self.conditions)}
+
+    @staticmethod
+    def from_dict(d: dict) -> "TpuSliceStatus":
+        return TpuSliceStatus(
+            processed=bool(d.get("processed", False)),
+            conditions=list(d.get("conditions", [])),
+        )
+
+
+@dataclasses.dataclass
+class TpuSlice:
+    """The full CR: one per node, named after the node (reference creates
+    the CR named ``$NODE_NAME``, instaslice_daemonset.go:567-582)."""
+
+    name: str
+    namespace: str
+    spec: TpuSliceSpec = dataclasses.field(default_factory=TpuSliceSpec)
+    status: TpuSliceStatus = dataclasses.field(default_factory=TpuSliceStatus)
+    resource_version: str = ""
+
+    def to_manifest(self) -> dict:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": KIND,
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                **(
+                    {"resourceVersion": self.resource_version}
+                    if self.resource_version
+                    else {}
+                ),
+            },
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @staticmethod
+    def from_manifest(m: dict) -> "TpuSlice":
+        md = m.get("metadata", {})
+        return TpuSlice(
+            name=md.get("name", ""),
+            namespace=md.get("namespace", ""),
+            spec=TpuSliceSpec.from_dict(m.get("spec", {})),
+            status=TpuSliceStatus.from_dict(m.get("status", {})),
+            resource_version=md.get("resourceVersion", ""),
+        )
+
+    def profile_objects(self) -> List[TopologyProfile]:
+        return [parse_profile_name(p["name"]) for p in self.spec.profiles]

@@ -11,14 +11,20 @@ import sys
 
 
 def run_controller(args) -> int:
-    from instaslice_tpu.controller.runner import ControllerRunner
-
+    try:
+        from instaslice_tpu.controller.runner import ControllerRunner
+    except ImportError as e:
+        print(f"controller unavailable: {e}", file=sys.stderr)
+        return 1
     return ControllerRunner.from_args(args).run()
 
 
 def run_agent(args) -> int:
-    from instaslice_tpu.agent.runner import AgentRunner
-
+    try:
+        from instaslice_tpu.agent.runner import AgentRunner
+    except ImportError as e:
+        print(f"agent unavailable: {e}", file=sys.stderr)
+        return 1
     return AgentRunner.from_args(args).run()
 
 

@@ -1,0 +1,131 @@
+"""Client interface + error model + conflict-retry discipline.
+
+The reference handles write races between controller and daemonset on one
+CR with blind get-latest-then-``Update`` plus a 1 s requeue on conflict
+(``instaslice_controller.go:93,201``; ``instaslice_daemonset.go:123,200``
+— SURVEY.md §7 calls this out as a hard part). Here every reconciler
+mutates shared objects through :func:`update_with_retry`, which re-reads
+and re-applies the mutation on ``Conflict`` — bounded, jittered, and
+tested under real concurrency in the fake.
+"""
+
+from __future__ import annotations
+
+import abc
+import random
+import time
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+
+class ApiError(Exception):
+    """Base for API errors; carries an HTTP-ish status code."""
+
+    code = 500
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.__class__.__name__)
+
+
+class NotFound(ApiError):
+    code = 404
+
+
+class AlreadyExists(ApiError):
+    code = 409
+
+
+class Conflict(ApiError):
+    """resourceVersion mismatch on update (optimistic concurrency)."""
+
+    code = 409
+
+
+class BadRequest(ApiError):
+    code = 400
+
+
+#: A watch event: ("ADDED" | "MODIFIED" | "DELETED", manifest-dict)
+WatchEvent = Tuple[str, dict]
+
+
+class KubeClient(abc.ABC):
+    """Minimal typed-dict client. ``kind`` is the manifest Kind string
+    ("Pod", "Node", "ConfigMap", "TpuSlice"); objects are manifest-shaped
+    dicts with ``metadata.name`` / ``metadata.namespace`` /
+    ``metadata.resourceVersion``."""
+
+    @abc.abstractmethod
+    def create(self, kind: str, obj: dict) -> dict: ...
+
+    @abc.abstractmethod
+    def get(self, kind: str, namespace: str, name: str) -> dict: ...
+
+    @abc.abstractmethod
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[dict]: ...
+
+    @abc.abstractmethod
+    def update(self, kind: str, obj: dict) -> dict:
+        """Replace; raises :class:`Conflict` if ``metadata.resourceVersion``
+        does not match the stored object."""
+
+    @abc.abstractmethod
+    def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
+        """Merge-patch (RFC 7386 semantics: dicts deep-merge, ``None``
+        deletes a key, lists replace)."""
+
+    @abc.abstractmethod
+    def patch_status(
+        self, kind: str, namespace: str, name: str, patch: dict
+    ) -> dict:
+        """Merge-patch restricted to the status subresource."""
+
+    @abc.abstractmethod
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        """Finalizer-aware: sets ``deletionTimestamp`` if finalizers are
+        present, removes the object otherwise."""
+
+    @abc.abstractmethod
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        replay: bool = True,
+        timeout: Optional[float] = None,
+    ) -> Iterator[WatchEvent]:
+        """Stream events. ``replay=True`` first yields current objects as
+        synthetic ADDED events (the informer list+watch pattern)."""
+
+
+def update_with_retry(
+    client: KubeClient,
+    kind: str,
+    namespace: str,
+    name: str,
+    mutate: Callable[[dict], Optional[dict]],
+    attempts: int = 8,
+) -> Optional[dict]:
+    """Get-mutate-update with conflict retry.
+
+    ``mutate`` receives the latest manifest and returns the mutated
+    manifest (may be the same object) or ``None`` to abort (e.g. the state
+    it wanted to change is already gone — makes reconcilers idempotent).
+    Returns the stored result, or ``None`` if aborted.
+    """
+    last: Optional[ApiError] = None
+    for attempt in range(attempts):
+        obj = client.get(kind, namespace, name)
+        mutated = mutate(obj)
+        if mutated is None:
+            return None
+        try:
+            return client.update(kind, mutated)
+        except Conflict as e:
+            last = e
+            # Full jitter keeps N agents hammering one CR from lockstep.
+            time.sleep(random.uniform(0, 0.01 * (2**attempt)))
+    raise last if last is not None else Conflict("update_with_retry exhausted")

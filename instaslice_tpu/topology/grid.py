@@ -187,6 +187,11 @@ class TorusGroup:
             )
         seen_offsets: Dict[Coord, str] = {}
         for name, ng in self.hosts.items():
+            if ng.generation.name != self.generation.name:
+                raise ValueError(
+                    f"host {name} is {ng.generation.name} but group is "
+                    f"{self.generation.name}"
+                )
             off = ng.host_offset
             if any(off[i] % hb[i] != 0 for i in range(3)):
                 raise ValueError(

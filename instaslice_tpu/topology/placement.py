@@ -159,11 +159,18 @@ class Occupancy:
             self._boxes[owner] = box
 
     def release(self, box: Box, owner: str = "") -> None:
-        if owner and owner in self._boxes and self._boxes[owner] != box:
-            raise ValueError(
-                f"owner {owner!r} holds box {self._boxes[owner].key()}, "
-                f"refusing to release mismatched box {box.key()}"
-            )
+        if owner:
+            held = self._boxes.get(owner)
+            if held is None:
+                raise ValueError(
+                    f"owner {owner!r} holds no box, refusing to release "
+                    f"{box.key()} (stale/duplicate release?)"
+                )
+            if held != box:
+                raise ValueError(
+                    f"owner {owner!r} holds box {held.key()}, refusing to "
+                    f"release mismatched box {box.key()}"
+                )
         for c in box.coords():
             self._taken.discard(c)
         if owner:

@@ -1,0 +1,126 @@
+"""CR data-model tests: round-trips, state machine, CRD schema."""
+
+import pytest
+
+from instaslice_tpu.api import (
+    AllocationDetails,
+    AllocationStatus,
+    PreparedDetails,
+    PreparedPart,
+    TpuSlice,
+    TpuSliceSpec,
+    crd_manifest,
+)
+from instaslice_tpu.api.types import check_transition
+from instaslice_tpu.topology import FirstFitPolicy, Occupancy, TorusGroup, parse_profile_name
+from instaslice_tpu.topology.grid import get_generation
+
+
+def make_allocation() -> AllocationDetails:
+    g = TorusGroup.single_host("node-a", get_generation("v5e"))
+    pl = FirstFitPolicy().choose(g, parse_profile_name("v5e-2x2"), Occupancy(g))
+    return AllocationDetails.from_placement(
+        pl, pod_uuid="pu-1", pod_name="demo", namespace="default", now=123.0
+    )
+
+
+class TestStateMachine:
+    def test_legal_path(self):
+        a = make_allocation()
+        assert a.status == AllocationStatus.CREATING
+        a.set_status(AllocationStatus.CREATED)
+        a.set_status(AllocationStatus.UNGATED)
+        a.set_status(AllocationStatus.DELETED)
+
+    def test_illegal_transitions(self):
+        with pytest.raises(ValueError):
+            check_transition(AllocationStatus.UNGATED, AllocationStatus.CREATING)
+        with pytest.raises(ValueError):
+            check_transition(AllocationStatus.DELETED, AllocationStatus.CREATING)
+        with pytest.raises(ValueError):
+            check_transition(AllocationStatus.CREATING, AllocationStatus.UNGATED)
+
+    def test_failure_and_retry(self):
+        a = make_allocation()
+        a.set_status(AllocationStatus.FAILED, "chip reservation failed")
+        assert a.message == "chip reservation failed"
+        a.set_status(AllocationStatus.CREATING)  # controller retries
+        a.set_status(AllocationStatus.CREATED)
+
+
+class TestRoundTrips:
+    def test_allocation_roundtrip(self):
+        a = make_allocation()
+        d = a.to_dict()
+        b = AllocationDetails.from_dict(d)
+        assert b == a
+        assert d["profile"] == "v5e-2x2"
+        assert "node-a" in d["parts"]
+
+    def test_prepared_roundtrip(self):
+        p = PreparedDetails(
+            slice_uuid="su-1",
+            pod_uuid="pu-1",
+            profile="v5e-2x2",
+            box="0,0,0+2x2x1",
+            parts={
+                "node-a": PreparedPart(
+                    node_name="node-a",
+                    worker_id=0,
+                    local_box="0,0,0+2x2x1",
+                    chip_ids=[0, 1, 2, 3],
+                    device_handle="fake-0",
+                )
+            },
+        )
+        assert PreparedDetails.from_dict(p.to_dict()) == p
+
+    def test_tpuslice_manifest_roundtrip(self):
+        ts = TpuSlice(
+            name="node-a",
+            namespace="instaslice-tpu-system",
+            spec=TpuSliceSpec(
+                generation="v5e",
+                host_offset=(2, 0, 0),
+                torus_group="g0",
+                chips={"0": "/dev/accel0", "1": "/dev/accel1"},
+                profiles=[{"name": "v5e-1x1", "chips": 1}],
+                allocations={"pu-1": make_allocation()},
+            ),
+        )
+        m = ts.to_manifest()
+        assert m["apiVersion"] == "tpu.instaslice.dev/v1alpha1"
+        assert m["kind"] == "TpuSlice"
+        back = TpuSlice.from_manifest(m)
+        assert back.spec == ts.spec
+        assert back.name == "node-a"
+        ng = back.spec.node_grid()
+        assert ng.host_offset == (2, 0, 0)
+
+    def test_dangling_prepared_convention(self):
+        p = PreparedDetails.from_dict(
+            {"sliceUUID": "s", "profile": "v5e-1x1", "box": "0,0,0+1x1x1"}
+        )
+        assert p.pod_uuid == ""  # dangling/adopted
+
+
+class TestCrd:
+    def test_crd_shape(self):
+        crd = crd_manifest()
+        assert crd["metadata"]["name"] == "tpuslices.tpu.instaslice.dev"
+        v = crd["spec"]["versions"][0]
+        assert v["storage"] is True
+        schema = v["schema"]["openAPIV3Schema"]
+        spec_props = schema["properties"]["spec"]["properties"]
+        for field in ["generation", "hostOffset", "torusGroup", "chips",
+                      "profiles", "allocations", "prepared"]:
+            assert field in spec_props
+        statuses = spec_props["allocations"]["additionalProperties"][
+            "properties"]["status"]["enum"]
+        assert set(statuses) == {s.value for s in AllocationStatus}
+
+    def test_crd_serializes_to_yaml(self):
+        import yaml
+
+        text = yaml.safe_dump(crd_manifest())
+        assert "tpuslices.tpu.instaslice.dev" in text

@@ -322,3 +322,19 @@ class TestReviewRegressions:
         occ.release(a, owner="a")
         occ.release(b, owner="b")
         assert occ.free_chips() == 8
+
+    def test_release_unknown_owner_refused(self):
+        g = v5e_single()
+        occ = Occupancy(g)
+        a = Box((0, 0, 0), (2, 2, 1))
+        occ.occupy(a, owner="a")
+        with pytest.raises(ValueError, match="holds no box"):
+            occ.release(a, owner="b")
+        occ.release(a, owner="a")
+
+    def test_mixed_generation_group_rejected(self):
+        with pytest.raises(ValueError, match="but group is"):
+            TorusGroup(
+                "g", get_generation("v5e"), (2, 4, 1),
+                {"n": NodeGrid(get_generation("v4"))},
+            )
