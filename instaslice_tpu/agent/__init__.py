@@ -1,0 +1,12 @@
+"""Per-node agent — reference analog: the privileged daemonset
+(``/root/reference/internal/controller/instaslice_daemonset.go``).
+
+Watches this node's ``TpuSlice`` CR, realizes ``creating`` allocations on
+the device backend (exclusive chip reservation + ConfigMap env handoff +
+node-capacity patch), tears down ``deleted`` ones, and performs boot-time
+discovery (chip inventory, profile catalog, dangling-slice adoption).
+"""
+
+from instaslice_tpu.agent.handoff import slice_env, configmap_manifest
+from instaslice_tpu.agent.discovery import discover_node
+from instaslice_tpu.agent.reconciler import NodeAgent

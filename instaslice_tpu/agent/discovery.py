@@ -1,0 +1,138 @@
+"""Boot-time node discovery — reference analog:
+``discoverMigEnabledGpuWithSlices`` / ``discoverAvailableProfilesOnGpus`` /
+``discoverDanglingSlices`` (``instaslice_daemonset.go:555-748``), which run
+once per node (guarded by ``Status.Processed``) and create the per-node CR
+named ``$NODE_NAME``.
+
+Differences by design:
+- the profile catalog is computed from generation topology constants, not
+  queried per-device, so identical on every healthy node;
+- dangling-slice adoption ALSO runs on every boot (not just first), so an
+  agent restart re-syncs ``spec.prepared`` with the device registry — the
+  reference's in-memory cache forgets (SURVEY.md §5 restart recovery).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from instaslice_tpu.api import (
+    PreparedDetails,
+    PreparedPart,
+    TpuSlice,
+    TpuSliceSpec,
+)
+from instaslice_tpu.device.backend import DeviceBackend, NodeInventory
+from instaslice_tpu.kube.client import KubeClient, NotFound, update_with_retry
+from instaslice_tpu.topology.grid import coord_to_id, get_generation, id_to_coord
+from instaslice_tpu.topology.placement import Box
+from instaslice_tpu.topology.profiles import profile_catalog
+
+log = logging.getLogger("instaslice_tpu.agent")
+
+
+def _dangling_box(chip_ids, host_bounds, offset=(0, 0, 0)) -> str:
+    """Bounding box of an adopted reservation's chips. ``offset`` shifts
+    host-local coords into global torus coords (PreparedDetails.box and
+    AllocationDetails.box are always global; PreparedPart.local_box is
+    host-local)."""
+    coords = [id_to_coord(c, host_bounds) for c in chip_ids]
+    lo = tuple(min(c[i] for c in coords) + offset[i] for i in range(3))
+    hi = tuple(max(c[i] for c in coords) + 1 + offset[i] for i in range(3))
+    return Box(lo, tuple(hi[i] - lo[i] for i in range(3))).key()  # type: ignore[arg-type]
+
+
+def build_tpuslice(
+    node_name: str,
+    namespace: str,
+    inv: NodeInventory,
+    backend: DeviceBackend,
+) -> TpuSlice:
+    """Fresh CR content from a device inventory."""
+    gen = get_generation(inv.generation)
+    spec = TpuSliceSpec(
+        generation=inv.generation,
+        host_offset=inv.host_offset,
+        torus_group=inv.torus_group or node_name,
+        chips={str(i): p for i, p in sorted(inv.chip_paths.items())},
+        profiles=[
+            {"name": p.name, **p.attributes()}
+            for p in profile_catalog(inv.generation)
+        ],
+    )
+    ts = TpuSlice(name=node_name, namespace=namespace, spec=spec)
+    _adopt_dangling(ts, backend, gen.host_bounds, node_name, inv.host_offset)
+    ts.status.processed = True
+    return ts
+
+
+def _adopt_dangling(ts, backend, host_bounds, node_name,
+                    host_offset=(0, 0, 0)) -> None:
+    """Device reservations with no prepared record become dangling
+    prepared entries (podUUID="") so the placement engine counts their
+    chips as occupied (reference: instaslice_controller.go:312-320)."""
+    known = {
+        part.device_handle or uid
+        for uid, p in ts.spec.prepared.items()
+        for part in p.parts.values()
+    } | set(ts.spec.prepared)
+    for r in backend.list_reservations():
+        if r.slice_uuid in known:
+            continue
+        ts.spec.prepared[r.slice_uuid] = PreparedDetails(
+            slice_uuid=r.slice_uuid,
+            pod_uuid="",
+            profile="",
+            box=_dangling_box(r.chip_ids, host_bounds, host_offset),
+            parts={
+                node_name: PreparedPart(
+                    node_name=node_name,
+                    worker_id=0,
+                    local_box=_dangling_box(r.chip_ids, host_bounds),
+                    chip_ids=list(r.chip_ids),
+                    device_handle=r.slice_uuid,
+                )
+            },
+        )
+        log.info(
+            "adopted dangling reservation %s (chips %s)",
+            r.slice_uuid, list(r.chip_ids),
+        )
+
+
+def discover_node(
+    client: KubeClient,
+    backend: DeviceBackend,
+    node_name: str,
+    namespace: str,
+) -> TpuSlice:
+    """Create or refresh this node's CR. Safe to run on every boot."""
+    inv = backend.discover()
+    fresh = build_tpuslice(node_name, namespace, inv, backend)
+    try:
+        existing = client.get("TpuSlice", namespace, node_name)
+    except NotFound:
+        created = client.create("TpuSlice", fresh.to_manifest())
+        log.info(
+            "created TpuSlice %s/%s: %d chips, %d profiles",
+            namespace, node_name, inv.chip_count, len(fresh.spec.profiles),
+        )
+        return TpuSlice.from_manifest(created)
+
+    def refresh(obj: dict) -> dict:
+        ts = TpuSlice.from_manifest(obj)
+        # inventory/catalog/topology refresh; allocations/prepared are the
+        # controller's + steady-state reconciler's business
+        ts.spec.generation = fresh.spec.generation
+        ts.spec.host_offset = fresh.spec.host_offset
+        ts.spec.torus_group = fresh.spec.torus_group
+        ts.spec.chips = fresh.spec.chips
+        ts.spec.profiles = fresh.spec.profiles
+        hb = get_generation(inv.generation).host_bounds
+        _adopt_dangling(ts, backend, hb, node_name, inv.host_offset)
+        ts.status.processed = True
+        return ts.to_manifest()
+
+    out = update_with_retry(client, "TpuSlice", namespace, node_name, refresh)
+    return TpuSlice.from_manifest(out)

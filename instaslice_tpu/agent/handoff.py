@@ -1,0 +1,105 @@
+"""Device handoff: the env a granted pod consumes via ``envFrom``.
+
+Reference analog: ``createConfigMap`` publishing ``NVIDIA_VISIBLE_DEVICES``
+/ ``CUDA_VISIBLE_DEVICES`` in a ConfigMap named after the pod
+(``instaslice_daemonset.go:796-818``; consumer side
+``samples/test-pod.yaml:17-19``). The TPU equivalent is the libtpu/JAX
+topology environment: which local chips the pod may open, where its host
+sits in the slice mesh, and who its peer workers are — exactly the
+variables a GKE TPU node pool would set for a static slice, computed here
+for a dynamic one (SURVEY.md §2b row 1).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from instaslice_tpu.api.types import AllocationDetails, PodRef
+from instaslice_tpu.topology.grid import Shape, get_generation
+from instaslice_tpu.topology.placement import Box
+
+
+def _csv(vals) -> str:
+    return ",".join(str(v) for v in vals)
+
+
+def slice_env(
+    alloc: AllocationDetails,
+    pod: PodRef,
+    node_name: str,
+    generation: str,
+) -> Dict[str, str]:
+    """Env for ``pod`` (worker ``pod.worker_id``) of ``alloc``.
+
+    Multi-host note: peer addressing uses pod names; multi-host sample
+    manifests set ``hostname:`` + ``subdomain:`` with a headless Service so
+    these resolve over DCN (see samples/).
+    """
+    gen = get_generation(generation)
+    node = alloc.node_for_worker(pod.worker_id)
+    if node is None:
+        raise ValueError(
+            f"allocation {alloc.alloc_id} has no part serving worker "
+            f"{pod.worker_id}"
+        )
+    wid, local_key = alloc.parts[node]
+    local_box = Box.from_key(local_key)
+    global_box = alloc.global_box()
+    part_shape = local_box.shape
+    # All parts share one shape (alignment guarantees whole-tile splits):
+    # hosts along each axis = global extent / per-host extent.
+    host_bounds: Shape = tuple(
+        global_box.shape[i] // part_shape[i] for i in range(3)
+    )  # type: ignore[assignment]
+    chip_ids = _local_ids(local_box, gen.host_bounds)
+    workers = sorted(alloc.pods, key=lambda p: p.worker_id)
+    hostnames = _csv(p.pod_name for p in workers)
+
+    env = {
+        # --- libtpu topology (what jax.distributed / libtpu read) ---
+        "TPU_WORKER_ID": str(pod.worker_id),
+        "TPU_WORKER_HOSTNAMES": hostnames,
+        "TPU_VISIBLE_CHIPS": _csv(chip_ids),
+        "TPU_CHIPS_PER_HOST_BOUNDS": _csv(part_shape),
+        "TPU_HOST_BOUNDS": _csv(host_bounds),
+        # newer libtpu spellings of the same facts
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": _csv(part_shape),
+        "TPU_PROCESS_BOUNDS": _csv(host_bounds),
+        "CLOUD_TPU_TASK_ID": str(pod.worker_id),
+        "TPU_SKIP_MDS_QUERY": "true",
+        "TPU_ACCELERATOR_TYPE": f"{generation}-{alloc.profile.split('-', 1)[1]}"
+        if "-" in alloc.profile
+        else alloc.profile,
+        # --- slice identity (observability + tpuslicectl) ---
+        "TPU_SLICE_NAME": alloc.alloc_id,
+        "TPU_SLICE_PROFILE": alloc.profile,
+        "TPU_SLICE_BOX": alloc.box,
+        "TPU_SLICE_NODE": node_name,
+    }
+    return env
+
+
+def _local_ids(local_box: Box, host_bounds: Shape) -> List[int]:
+    from instaslice_tpu.topology.grid import coord_to_id
+
+    return sorted(coord_to_id(c, host_bounds) for c in local_box.coords())
+
+
+def configmap_manifest(
+    name: str, namespace: str, env: Dict[str, str], owner_pod_uid: str = ""
+) -> dict:
+    """ConfigMap named after the pod (reference convention), labeled for
+    garbage collection and discovery."""
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": {
+                "app.kubernetes.io/managed-by": "instaslice-tpu",
+                "tpu.instaslice.dev/pod-uid": owner_pod_uid,
+            },
+        },
+        "data": dict(env),
+    }

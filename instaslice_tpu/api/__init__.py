@@ -11,6 +11,7 @@ the operator namespace is configurable instead of hardcoded ``"default"``.
 from instaslice_tpu.api.types import (
     AllocationDetails,
     AllocationStatus,
+    PodRef,
     PreparedDetails,
     PreparedPart,
     TpuSlice,

@@ -12,9 +12,20 @@ from __future__ import annotations
 from instaslice_tpu import GROUP, KIND, PLURAL, VERSION
 
 _ALLOCATION_PROPS = {
-    "podUUID": {"type": "string"},
-    "podName": {"type": "string"},
-    "namespace": {"type": "string"},
+    "allocId": {"type": "string"},
+    "pods": {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "properties": {
+                "podUUID": {"type": "string"},
+                "podName": {"type": "string"},
+                "namespace": {"type": "string"},
+                "workerId": {"type": "integer"},
+            },
+            "required": ["podUUID", "podName"],
+        },
+    },
     "profile": {"type": "string"},
     "torusGroup": {"type": "string"},
     "box": {"type": "string"},
@@ -80,7 +91,7 @@ _SPEC_SCHEMA = {
             "additionalProperties": {
                 "type": "object",
                 "properties": _ALLOCATION_PROPS,
-                "required": ["podUUID", "podName", "profile", "box", "status"],
+                "required": ["allocId", "pods", "profile", "box", "status"],
             },
         },
         "prepared": {

@@ -79,16 +79,45 @@ def check_transition(old: AllocationStatus, new: AllocationStatus) -> None:
 
 
 @dataclasses.dataclass
-class AllocationDetails:
-    """Desired slice for one pod (reference: ``AllocationDetails``,
-    instaslice_types.go:74-87 — pod identity, GPU UUID, start/size,
-    status). The TPU version stores the global box plus the per-host
-    decomposition so one allocation can fan out to several node agents
-    (multi-host profiles — new capability, SURVEY.md §7)."""
+class PodRef:
+    """One consumer pod of an allocation. Single-host slices have exactly
+    one; multi-host slices have one pod per host, each bound to a worker id
+    (and through it to the host serving that worker)."""
 
     pod_uuid: str
     pod_name: str
     namespace: str
+    worker_id: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "podUUID": self.pod_uuid,
+            "podName": self.pod_name,
+            "namespace": self.namespace,
+            "workerId": self.worker_id,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "PodRef":
+        return PodRef(
+            pod_uuid=d["podUUID"],
+            pod_name=d["podName"],
+            namespace=d.get("namespace", ""),
+            worker_id=int(d.get("workerId", 0)),
+        )
+
+
+@dataclasses.dataclass
+class AllocationDetails:
+    """Desired slice for one pod or pod group (reference:
+    ``AllocationDetails``, instaslice_types.go:74-87 — pod identity, GPU
+    UUID, start/size, status). The TPU version stores the global box plus
+    the per-host decomposition so one allocation can fan out to several
+    node agents, and a pod list so multi-host slices (one pod per host)
+    are a single allocation — new capability, SURVEY.md §7."""
+
+    alloc_id: str                    # pod UUID for singletons, group id else
+    pods: List[PodRef]
     profile: str                     # canonical profile name, e.g. v5e-2x2
     torus_group: str
     box: str                         # Box.key() in global mesh coords
@@ -103,9 +132,8 @@ class AllocationDetails:
 
     def to_dict(self) -> dict:
         return {
-            "podUUID": self.pod_uuid,
-            "podName": self.pod_name,
-            "namespace": self.namespace,
+            "allocId": self.alloc_id,
+            "pods": [p.to_dict() for p in self.pods],
             "profile": self.profile,
             "torusGroup": self.torus_group,
             "box": self.box,
@@ -123,9 +151,8 @@ class AllocationDetails:
     @staticmethod
     def from_dict(d: dict) -> "AllocationDetails":
         return AllocationDetails(
-            pod_uuid=d["podUUID"],
-            pod_name=d["podName"],
-            namespace=d["namespace"],
+            alloc_id=d["allocId"],
+            pods=[PodRef.from_dict(p) for p in d.get("pods", [])],
             profile=d["profile"],
             torus_group=d.get("torusGroup", ""),
             box=d["box"],
@@ -149,18 +176,34 @@ class AllocationDetails:
         if message:
             self.message = message
 
+    def node_for_worker(self, worker_id: int) -> Optional[str]:
+        for n, (wid, _) in self.parts.items():
+            if wid == worker_id:
+                return n
+        return None
+
+    def pods_on_node(self, node_name: str) -> List[PodRef]:
+        part = self.parts.get(node_name)
+        if part is None:
+            return []
+        wid = part[0]
+        return [p for p in self.pods if p.worker_id == wid]
+
+    def fully_realized(self) -> bool:
+        return set(self.realized_on) >= set(self.parts)
+
     @staticmethod
     def from_placement(
         placement: Placement,
-        pod_uuid: str,
-        pod_name: str,
-        namespace: str,
+        pods: List[PodRef],
+        alloc_id: str = "",
         now: Optional[float] = None,
     ) -> "AllocationDetails":
+        if not pods:
+            raise ValueError("allocation needs at least one pod")
         return AllocationDetails(
-            pod_uuid=pod_uuid,
-            pod_name=pod_name,
-            namespace=namespace,
+            alloc_id=alloc_id or pods[0].pod_uuid,
+            pods=list(pods),
             profile=placement.profile.name,
             torus_group=placement.group_id,
             box=placement.box.key(),

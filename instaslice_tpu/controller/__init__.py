@@ -1,0 +1,15 @@
+"""Cluster controller — reference analog:
+``/root/reference/internal/controller/instaslice_controller.go``.
+
+Watches scheduling-gated pods, chooses a placement on some torus group,
+writes allocation records into the involved nodes' ``TpuSlice`` CRs,
+ungates pods once agents realize the slice, and drives graceful teardown
+on pod deletion.
+"""
+
+from instaslice_tpu.controller.gates import (
+    extract_profile,
+    is_pod_gated,
+    pod_group,
+)
+from instaslice_tpu.controller.reconciler import Controller

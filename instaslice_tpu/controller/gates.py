@@ -1,0 +1,85 @@
+"""Pod inspection: gate detection, profile extraction, group membership.
+
+Reference analogs:
+- ``checkIfPodGated`` (``instaslice_controller.go:386-395``) — which
+  indexes ``pod.Status.Conditions[0]`` unguarded (SURVEY.md §7 quirk);
+  guarded here.
+- ``extractProfileName`` (``:265-280``) — regex ``(\\d+g\\.\\d+gb)`` over
+  limits keys containing "nvidia"; silently returns "" on no match. Here
+  malformed profile requests raise, and the error lands on the pod as an
+  event/annotation rather than being swallowed.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+from instaslice_tpu import GATE_NAME, GROUP
+from instaslice_tpu.topology.profiles import TopologyProfile, parse_profile_name
+
+PROFILE_ANNOTATION = f"{GROUP}/profile"
+GROUP_ANNOTATION = f"{GROUP}/group"
+GROUP_SIZE_ANNOTATION = f"{GROUP}/group-size"
+
+_RESOURCE_RE = re.compile(r"tpu-(v\d+[a-z]*-\d+x\d+(?:x\d+)?)$")
+
+
+def is_pod_gated(pod: dict) -> bool:
+    """True when the pod carries our scheduling gate and is not yet
+    scheduled. Phase may be missing entirely on a just-created pod —
+    everything is .get-guarded (the reference crashes on pods with empty
+    Conditions)."""
+    if pod.get("metadata", {}).get("deletionTimestamp"):
+        return False
+    gates = pod.get("spec", {}).get("schedulingGates", []) or []
+    if not any(g.get("name") == GATE_NAME for g in gates):
+        return False
+    phase = pod.get("status", {}).get("phase", "Pending")
+    return phase in ("", "Pending")
+
+
+def extract_profile(pod: dict) -> Optional[TopologyProfile]:
+    """Profile from (in priority order):
+
+    1. annotation ``tpu.instaslice.dev/profile: v5e-2x2``
+    2. a resource limit key like ``google.com/tpu-v5e-2x2``
+
+    Returns None when the pod requests no TPU profile; raises ValueError
+    for a malformed one.
+    """
+    meta = pod.get("metadata", {})
+    ann = (meta.get("annotations") or {}).get(PROFILE_ANNOTATION)
+    if ann:
+        return parse_profile_name(ann)
+    for ctr in pod.get("spec", {}).get("containers", []) or []:
+        limits = (ctr.get("resources") or {}).get("limits") or {}
+        for key in limits:
+            if "tpu" not in key:
+                continue
+            m = _RESOURCE_RE.search(key)
+            if m:
+                return parse_profile_name(m.group(1))
+    return None
+
+
+def pod_group(pod: dict) -> Tuple[str, int]:
+    """(group id, expected size) for multi-host pod groups; ("", 1) for
+    singletons. Group pods share one allocation: one pod per host of a
+    multi-host slice, worker ids assigned by sorted pod name."""
+    ann = pod.get("metadata", {}).get("annotations") or {}
+    gid = ann.get(GROUP_ANNOTATION, "")
+    if not gid:
+        return "", 1
+    try:
+        size = int(ann.get(GROUP_SIZE_ANNOTATION, "0"))
+    except ValueError:
+        raise ValueError(
+            f"pod {pod['metadata'].get('name')}: malformed "
+            f"{GROUP_SIZE_ANNOTATION}"
+        )
+    if size < 1:
+        raise ValueError(
+            f"pod group {gid!r} needs {GROUP_SIZE_ANNOTATION} >= 1"
+        )
+    return gid, size

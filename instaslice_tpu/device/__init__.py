@@ -1,0 +1,25 @@
+"""Device layer: how the node agent touches (or fakes) TPU hardware.
+
+Reference analog: the go-nvml / go-nvlib CGo layer
+(``/root/reference/internal/controller/instaslice_daemonset.go:62-65``,
+SURVEY.md §2a). Backends implement one interface so the agent is
+unit-testable against the fake and identical in production:
+
+- :class:`FakeTpuBackend` — the dgxa100-mock analog: synthetic chip
+  inventory, failure injection, dangling-slice seeding.
+- :class:`NativeBackend`  — ctypes over the C++ ``libtpuslice.so``:
+  real chip enumeration plus a crash-safe flock'd reservation registry.
+- ``auto`` selection: native when the library and chips are present,
+  fake otherwise.
+"""
+
+from instaslice_tpu.device.backend import (
+    DeviceBackend,
+    DeviceError,
+    ChipsBusy,
+    NodeInventory,
+    Reservation,
+)
+from instaslice_tpu.device.fake import FakeTpuBackend
+from instaslice_tpu.device.native import NativeBackend, find_library
+from instaslice_tpu.device.select import select_backend

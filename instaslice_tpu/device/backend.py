@@ -1,0 +1,114 @@
+"""Backend interface + inventory/reservation models.
+
+The reference's device API surface, reduced to what a TPU host actually
+needs (SURVEY.md §2a): NVML's ``DeviceGetCount`` / profile enumeration /
+``CreateGpuInstanceWithPlacement`` / ``CreateComputeInstance`` /
+``Destroy`` become ``discover`` / ``reserve`` / ``release`` /
+``list_reservations`` — on TPU the "create" step is an exclusive chip
+reservation plus env computation, not a hardware partition call.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from instaslice_tpu.topology.grid import Coord
+
+
+class DeviceError(Exception):
+    """Device-layer failure. The agent turns these into allocation
+    status=failed (the reference logged and carried on —
+    instaslice_daemonset.go:172-189, flagged in SURVEY.md §5)."""
+
+
+class ChipsBusy(DeviceError):
+    """Requested chips overlap a live reservation."""
+
+
+class SliceExists(DeviceError):
+    """Slice uuid already reserved (idempotent-create signal)."""
+
+
+class SliceNotFound(DeviceError):
+    """Release of an unknown slice uuid."""
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeInventory:
+    """What discovery reports about this host (reference:
+    ``discoverAvailableProfilesOnGpus`` building MigGPUUUID + Migplacement,
+    instaslice_daemonset.go:588-664)."""
+
+    generation: str                 # "v5e" ...
+    chip_paths: Dict[int, str]      # local chip id → device path
+    host_offset: Coord = (0, 0, 0)  # this host's corner in its torus group
+    torus_group: str = ""           # shared physical-mesh id ("" = alone)
+    source: str = "fake"            # "accel" | "vfio" | "fake" | ...
+
+    @property
+    def chip_count(self) -> int:
+        return len(self.chip_paths)
+
+
+@dataclasses.dataclass(frozen=True)
+class Reservation:
+    slice_uuid: str
+    chip_ids: tuple                 # sorted local chip ids
+
+
+class DeviceBackend(abc.ABC):
+    """One node's device access. Implementations must be idempotent and
+    restart-safe: ``list_reservations`` after a process restart must still
+    report every live reservation (the reference's in-memory
+    ``cachedPreparedMig`` map loses this — instaslice_daemonset.go:87-93)."""
+
+    name: str = ""
+
+    @abc.abstractmethod
+    def discover(self) -> NodeInventory: ...
+
+    @abc.abstractmethod
+    def reserve(self, slice_uuid: str, chip_ids: List[int]) -> Reservation:
+        """Exclusively reserve chips. Raises :class:`ChipsBusy` on overlap,
+        :class:`SliceExists` if the uuid is already reserved."""
+
+    @abc.abstractmethod
+    def release(self, slice_uuid: str) -> None:
+        """Raises :class:`SliceNotFound` for unknown uuids."""
+
+    @abc.abstractmethod
+    def list_reservations(self) -> List[Reservation]: ...
+
+    def healthy(self) -> bool:
+        try:
+            self.list_reservations()
+            return True
+        except DeviceError:
+            return False
+
+
+def env_overrides() -> dict:
+    """Topology hints the platform provides via env (GKE TPU node pools
+    set these; tests set them explicitly):
+
+    - ``TPUSLICE_GENERATION``: e.g. "v5e"
+    - ``TPUSLICE_TORUS_GROUP``: physical-mesh id shared by co-torus hosts
+    - ``TPUSLICE_HOST_OFFSET``: "x,y,z" of this host's corner
+    """
+    out: dict = {}
+    if os.environ.get("TPUSLICE_GENERATION"):
+        out["generation"] = os.environ["TPUSLICE_GENERATION"]
+    if os.environ.get("TPUSLICE_TORUS_GROUP"):
+        out["torus_group"] = os.environ["TPUSLICE_TORUS_GROUP"]
+    off = os.environ.get("TPUSLICE_HOST_OFFSET")
+    if off:
+        parts = [int(v) for v in off.split(",")]
+        if len(parts) != 3:
+            raise DeviceError(
+                f"TPUSLICE_HOST_OFFSET must be 'x,y,z', got {off!r}"
+            )
+        out["host_offset"] = tuple(parts)
+    return out

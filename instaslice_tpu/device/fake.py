@@ -1,0 +1,123 @@
+"""Fake TPU backend — the dgxa100 mock-server analog (SURVEY.md §4 tier 1:
+go-nvml ships a mock DGX-A100 and the reference's only real unit test
+monkeypatches nvml onto it). This fake is richer: failure injection per
+operation, dangling-slice seeding for adoption tests, call counting, and
+optional persistence to survive simulated restarts.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from instaslice_tpu.device.backend import (
+    ChipsBusy,
+    DeviceBackend,
+    DeviceError,
+    NodeInventory,
+    Reservation,
+    SliceExists,
+    SliceNotFound,
+)
+from instaslice_tpu.topology.grid import Coord, get_generation
+
+
+class FakeTpuBackend(DeviceBackend):
+    name = "fake"
+
+    def __init__(
+        self,
+        generation: str = "v5e",
+        host_offset: Coord = (0, 0, 0),
+        torus_group: str = "",
+        chip_count: Optional[int] = None,
+    ) -> None:
+        gen = get_generation(generation)
+        n = gen.chips_per_host if chip_count is None else chip_count
+        self._inventory = NodeInventory(
+            generation=generation,
+            chip_paths={i: f"/dev/accel{i}" for i in range(n)},
+            host_offset=host_offset,
+            torus_group=torus_group,
+            source="fake",
+        )
+        self._lock = threading.Lock()
+        self._reservations: Dict[str, Tuple[int, ...]] = {}
+        # failure injection: op name → remaining failures to inject
+        self._fail: Dict[str, int] = {}
+        self.calls: Dict[str, int] = {
+            "discover": 0, "reserve": 0, "release": 0, "list": 0,
+        }
+
+    # ------------------------------------------------------------ test API
+
+    def inject_failures(self, op: str, count: int = 1) -> None:
+        """Make the next ``count`` calls of ``op`` raise DeviceError
+        (op in discover|reserve|release|list)."""
+        self._fail[op] = self._fail.get(op, 0) + count
+
+    def seed_dangling(self, slice_uuid: str, chip_ids: List[int]) -> None:
+        """Pre-existing slice for adoption tests (reference:
+        ``discoverDanglingSlices``, instaslice_daemonset.go:666-748)."""
+        with self._lock:
+            self._reservations[slice_uuid] = tuple(sorted(chip_ids))
+
+    def snapshot(self) -> Dict[str, Tuple[int, ...]]:
+        with self._lock:
+            return dict(self._reservations)
+
+    def restore(self, snap: Dict[str, Tuple[int, ...]]) -> None:
+        """Simulate agent restart against persisted device state."""
+        with self._lock:
+            self._reservations = dict(snap)
+
+    def _maybe_fail(self, op: str) -> None:
+        if self._fail.get(op, 0) > 0:
+            self._fail[op] -= 1
+            raise DeviceError(f"injected {op} failure")
+
+    # ------------------------------------------------------------- backend
+
+    def discover(self) -> NodeInventory:
+        with self._lock:
+            self.calls["discover"] += 1
+            self._maybe_fail("discover")
+            return self._inventory
+
+    def reserve(self, slice_uuid: str, chip_ids: List[int]) -> Reservation:
+        with self._lock:
+            self.calls["reserve"] += 1
+            self._maybe_fail("reserve")
+            if not slice_uuid or not chip_ids:
+                raise DeviceError("empty slice uuid or chip list")
+            ids = tuple(sorted(chip_ids))
+            if len(set(ids)) != len(ids):
+                raise DeviceError(f"duplicate chip ids in {chip_ids}")
+            for c in ids:
+                if c not in self._inventory.chip_paths:
+                    raise DeviceError(f"chip {c} not on this host")
+            if slice_uuid in self._reservations:
+                raise SliceExists(f"slice {slice_uuid} already reserved")
+            taken = {c for r in self._reservations.values() for c in r}
+            clash = [c for c in ids if c in taken]
+            if clash:
+                raise ChipsBusy(f"chips {clash} already reserved")
+            self._reservations[slice_uuid] = ids
+            return Reservation(slice_uuid=slice_uuid, chip_ids=ids)
+
+    def release(self, slice_uuid: str) -> None:
+        with self._lock:
+            self.calls["release"] += 1
+            self._maybe_fail("release")
+            if slice_uuid not in self._reservations:
+                raise SliceNotFound(f"slice {slice_uuid} not reserved")
+            del self._reservations[slice_uuid]
+
+    def list_reservations(self) -> List[Reservation]:
+        with self._lock:
+            self.calls["list"] += 1
+            self._maybe_fail("list")
+            return [
+                Reservation(slice_uuid=u, chip_ids=c)
+                for u, c in sorted(self._reservations.items())
+            ]

@@ -1,0 +1,150 @@
+"""ctypes binding over the C++ ``libtpuslice.so`` (see native/tpuslice/).
+
+The production device path: real chip enumeration from /dev plus the
+crash-safe flock'd reservation registry. Generation/topology metadata
+comes from env (:func:`instaslice_tpu.device.backend.env_overrides`) since
+the kernel driver does not expose ICI coordinates; on GKE the node pool
+sets these.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from typing import List, Optional
+
+from instaslice_tpu.device.backend import (
+    ChipsBusy,
+    DeviceBackend,
+    DeviceError,
+    NodeInventory,
+    Reservation,
+    SliceExists,
+    SliceNotFound,
+    env_overrides,
+)
+
+_ERR = {
+    -1: DeviceError,
+    -2: DeviceError,
+    -3: ChipsBusy,
+    -4: SliceExists,
+    -5: SliceNotFound,
+    -6: DeviceError,
+    -7: DeviceError,
+}
+
+_SEARCH_PATHS = [
+    os.path.join(os.path.dirname(__file__), "libtpuslice.so"),
+    os.path.join(
+        os.path.dirname(__file__), "..", "..", "native", "build",
+        "libtpuslice.so",
+    ),
+    "/usr/local/lib/libtpuslice.so",
+    "libtpuslice.so",
+]
+
+
+def find_library() -> Optional[str]:
+    env = os.environ.get("TPUSLICE_LIBRARY")
+    if env:
+        return env if os.path.exists(env) else None
+    for p in _SEARCH_PATHS:
+        p = os.path.abspath(p)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+class NativeBackend(DeviceBackend):
+    name = "native"
+
+    def __init__(
+        self,
+        library_path: Optional[str] = None,
+        root: str = "",
+        registry_dir: str = "",
+        generation: str = "",
+    ) -> None:
+        path = library_path or find_library()
+        if not path:
+            raise DeviceError(
+                "libtpuslice.so not found (build with `make -C native` or "
+                "set TPUSLICE_LIBRARY)"
+            )
+        self._lib = ctypes.CDLL(path)
+        self._lib.tpuslice_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        self._lib.tpuslice_discover.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        self._lib.tpuslice_reserve.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        self._lib.tpuslice_release.argtypes = [ctypes.c_char_p]
+        self._lib.tpuslice_list.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        self._lib.tpuslice_strerror.argtypes = [ctypes.c_int]
+        self._lib.tpuslice_strerror.restype = ctypes.c_char_p
+        self._lib.tpuslice_version.restype = ctypes.c_char_p
+        self._generation = generation
+        self._check(
+            self._lib.tpuslice_init(
+                root.encode() or None, registry_dir.encode() or None
+            ),
+            "init",
+        )
+
+    def _check(self, rc: int, op: str) -> None:
+        if rc == 0:
+            return
+        msg = self._lib.tpuslice_strerror(rc).decode()
+        raise _ERR.get(rc, DeviceError)(f"tpuslice {op}: {msg}")
+
+    def _call_json(self, fn, op: str, bufsize: int = 1 << 16) -> dict:
+        buf = ctypes.create_string_buffer(bufsize)
+        rc = fn(buf, len(buf))
+        if rc == -7 and bufsize < (1 << 24):  # ERANGE: grow and retry
+            return self._call_json(fn, op, bufsize * 8)
+        self._check(rc, op)
+        return json.loads(buf.value.decode())
+
+    @property
+    def version(self) -> str:
+        return self._lib.tpuslice_version().decode()
+
+    def discover(self) -> NodeInventory:
+        d = self._call_json(self._lib.tpuslice_discover, "discover")
+        hints = env_overrides()
+        generation = self._generation or hints.get("generation", "")
+        if not generation:
+            raise DeviceError(
+                "TPU generation unknown: set TPUSLICE_GENERATION or pass "
+                "generation= (the kernel driver does not expose it)"
+            )
+        return NodeInventory(
+            generation=generation,
+            chip_paths={c["id"]: c["path"] for c in d["chips"]},
+            host_offset=hints.get("host_offset", (0, 0, 0)),
+            torus_group=hints.get("torus_group", ""),
+            source=d["source"],
+        )
+
+    def reserve(self, slice_uuid: str, chip_ids: List[int]) -> Reservation:
+        if not slice_uuid or not chip_ids:
+            raise DeviceError("empty slice uuid or chip list")
+        arr = (ctypes.c_int * len(chip_ids))(*chip_ids)
+        self._check(
+            self._lib.tpuslice_reserve(slice_uuid.encode(), arr, len(chip_ids)),
+            "reserve",
+        )
+        return Reservation(
+            slice_uuid=slice_uuid, chip_ids=tuple(sorted(chip_ids))
+        )
+
+    def release(self, slice_uuid: str) -> None:
+        self._check(self._lib.tpuslice_release(slice_uuid.encode()), "release")
+
+    def list_reservations(self) -> List[Reservation]:
+        d = self._call_json(self._lib.tpuslice_list, "list")
+        return [
+            Reservation(slice_uuid=r["uuid"], chip_ids=tuple(r["chips"]))
+            for r in d["reservations"]
+        ]

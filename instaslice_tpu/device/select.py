@@ -1,0 +1,40 @@
+"""Backend auto-selection for the node agent and device plugin."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from instaslice_tpu.device.backend import DeviceBackend, DeviceError, env_overrides
+from instaslice_tpu.device.fake import FakeTpuBackend
+from instaslice_tpu.device.native import NativeBackend, find_library
+
+
+def _chips_present(root: str = "") -> bool:
+    return bool(
+        glob.glob(os.path.join(root or "/", "dev", "accel[0-9]*"))
+        or glob.glob(os.path.join(root or "/", "dev", "vfio", "[0-9]*"))
+    )
+
+
+def select_backend(kind: str = "auto", **kwargs) -> DeviceBackend:
+    """``kind``: auto | fake | native.
+
+    ``auto`` picks native when libtpuslice.so and TPU device nodes are both
+    present, else fake (generation from TPUSLICE_GENERATION, default v5e) —
+    so the same agent image runs on TPU nodes and in CI unchanged.
+    """
+    if kind == "native":
+        return NativeBackend(**kwargs)
+    if kind == "fake":
+        hints = env_overrides()
+        kwargs.setdefault("generation", hints.get("generation", "v5e"))
+        kwargs.setdefault("host_offset", hints.get("host_offset", (0, 0, 0)))
+        kwargs.setdefault("torus_group", hints.get("torus_group", ""))
+        return FakeTpuBackend(**kwargs)
+    if kind == "auto":
+        root = kwargs.pop("root", "")
+        if find_library() and _chips_present(root):
+            return NativeBackend(root=root, **kwargs)
+        return select_backend("fake", **kwargs)
+    raise DeviceError(f"unknown backend kind {kind!r} (auto|fake|native)")

@@ -151,12 +151,25 @@ class FakeKube(KubeClient):
             return self._commit(key, kind, merged)
 
     def _commit(self, key: _Key, kind: str, obj: dict) -> dict:
-        """Store + emit, honoring finalizer-gated deletion."""
+        """Store + emit, honoring finalizer-gated deletion. No-op writes
+        (content identical to stored) do not bump resourceVersion and emit
+        no event — matching the real API server, and required so a
+        reconciler re-applying its own annotation can't feed itself an
+        endless MODIFIED stream."""
         md = obj["metadata"]
         if md.get("deletionTimestamp") and not md.get("finalizers"):
             del self._objects[key]
             self._emit("DELETED", kind, obj)
             return copy.deepcopy(obj)
+        stored = self._objects.get(key)
+        if stored is not None:
+            a = {k: v for k, v in stored.items() if k != "metadata"}
+            b = {k: v for k, v in obj.items() if k != "metadata"}
+            ma = {k: v for k, v in stored["metadata"].items()
+                  if k != "resourceVersion"}
+            mb = {k: v for k, v in md.items() if k != "resourceVersion"}
+            if a == b and ma == mb:
+                return copy.deepcopy(stored)
         md["resourceVersion"] = self._next_rv()
         self._objects[key] = obj
         self._emit("MODIFIED", kind, obj)

@@ -1,0 +1,9 @@
+"""Custom operator metrics.
+
+The reference exposes only controller-runtime's built-in registry with
+zero custom metrics, and its north-star number (slice-grant latency) is
+not instrumented at all (SURVEY.md §5 observability). Here the grant path
+is instrumented end to end.
+"""
+
+from instaslice_tpu.metrics.metrics import OperatorMetrics, start_metrics_server

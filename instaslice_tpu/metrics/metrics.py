@@ -1,0 +1,105 @@
+"""Prometheus metrics for the controller and node agent.
+
+Served from the addresses the reference reserves for the same purpose
+(controller ``:8080``, daemonset ``:8084`` — ``cmd/controller/main.go:61``,
+``cmd/daemonset/main.go:61``), scrape-compatible with its ServiceMonitor
+(``config/prometheus/monitor.yaml``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+try:
+    from prometheus_client import (
+        Counter,
+        Gauge,
+        Histogram,
+        CollectorRegistry,
+        start_http_server,
+    )
+
+    _PROM = True
+except ImportError:  # pragma: no cover - prometheus_client is in the image
+    _PROM = False
+
+
+class _NoopMetric:
+    def labels(self, *a, **k):
+        return self
+
+    def inc(self, *a, **k):
+        pass
+
+    def dec(self, *a, **k):
+        pass
+
+    def set(self, *a, **k):
+        pass
+
+    def observe(self, *a, **k):
+        pass
+
+
+class OperatorMetrics:
+    """One instance per process; inject into Controller / NodeAgent."""
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None):
+        if not _PROM:
+            self.slice_grant_seconds = _NoopMetric()
+            self.reserve_seconds = _NoopMetric()
+            self.device_errors = _NoopMetric()
+            self.allocations = _NoopMetric()
+            self.pending_pods = _NoopMetric()
+            self.reconciles = _NoopMetric()
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        # The north-star metric: request (allocation write) → pod ungated.
+        self.slice_grant_seconds = Histogram(
+            "tpuslice_grant_seconds",
+            "Latency from allocation creation to pod ungate",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
+            registry=self.registry,
+        )
+        self.reserve_seconds = Histogram(
+            "tpuslice_device_reserve_seconds",
+            "Device-backend chip reservation latency",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+            registry=self.registry,
+        )
+        self.device_errors = Counter(
+            "tpuslice_device_errors_total",
+            "Device-backend operation failures",
+            registry=self.registry,
+        )
+        self.allocations = Counter(
+            "tpuslice_allocations_total",
+            "Allocation state transitions",
+            ["status"],
+            registry=self.registry,
+        )
+        self.pending_pods = Gauge(
+            "tpuslice_pending_pods",
+            "Gated pods awaiting a slice",
+            registry=self.registry,
+        )
+        self.reconciles = Counter(
+            "tpuslice_reconciles_total",
+            "Reconcile invocations",
+            ["component"],
+            registry=self.registry,
+        )
+
+
+_server_started = threading.Lock()
+
+
+def start_metrics_server(metrics: OperatorMetrics, port: int) -> bool:
+    """Serve ``metrics.registry`` on ``port``; False if unavailable."""
+    if not _PROM or metrics.registry is None or port <= 0:
+        return False
+    with _server_started:
+        start_http_server(port, registry=metrics.registry)
+    return True

@@ -1,0 +1,1 @@
+"""Shared runtime utilities (reconcile loop, logging, ids)."""

@@ -1,0 +1,313 @@
+/* libtpuslice implementation. See tpuslice.h for the contract and the
+ * mapping to the reference's NVML usage. No external dependencies: C++17 +
+ * POSIX (flock, O_EXCL, rename). */
+
+#include "tpuslice.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::mutex g_mu;
+std::string g_root;          // filesystem root prefix ("" = real "/")
+std::string g_registry;      // reservation registry dir
+bool g_inited = false;
+
+std::string path_join(const std::string& a, const std::string& b) {
+  if (a.empty()) return b;
+  if (!a.empty() && a.back() == '/') return a + b.substr(b.front() == '/' ? 1 : 0);
+  if (!b.empty() && b.front() == '/') return a + b;
+  return a + "/" + b;
+}
+
+bool is_dir(const std::string& p) {
+  struct stat st;
+  return stat(p.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+bool exists(const std::string& p) {
+  struct stat st;
+  return stat(p.c_str(), &st) == 0;
+}
+
+int mkdir_p(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i < path.size(); ++i) {
+    cur += path[i];
+    if ((path[i] == '/' && i > 0) || i + 1 == path.size()) {
+      if (cur == "/") continue;
+      std::string d = cur;
+      while (!d.empty() && d.back() == '/') d.pop_back();
+      if (d.empty() || is_dir(d)) continue;
+      if (mkdir(d.c_str(), 0755) != 0 && errno != EEXIST) return -1;
+    }
+  }
+  return 0;
+}
+
+struct Chip {
+  int id;
+  std::string path;
+};
+
+/* Scan for TPU chip device nodes under <root>/dev.
+ * Order of preference matches how libtpu finds chips:
+ *   1. /dev/accel<N>      (Google TPU kernel driver, v4+)
+ *   2. /dev/vfio/<N>      (vfio-passthrough deployments)
+ * Chip id = the numeric suffix for accel; for vfio, ids are assigned in
+ * sorted order since group numbers are not chip ids. */
+std::string scan_chips(std::vector<Chip>* chips) {
+  chips->clear();
+  std::string devdir = path_join(g_root, "/dev");
+  DIR* d = opendir(devdir.c_str());
+  if (d) {
+    struct dirent* e;
+    while ((e = readdir(d)) != nullptr) {
+      const char* n = e->d_name;
+      if (strncmp(n, "accel", 5) == 0 && isdigit(n[5])) {
+        Chip c;
+        c.id = atoi(n + 5);
+        c.path = std::string("/dev/") + n;
+        chips->push_back(c);
+      }
+    }
+    closedir(d);
+  }
+  if (!chips->empty()) {
+    std::sort(chips->begin(), chips->end(),
+              [](const Chip& a, const Chip& b) { return a.id < b.id; });
+    return "accel";
+  }
+  std::string vfiodir = path_join(g_root, "/dev/vfio");
+  d = opendir(vfiodir.c_str());
+  if (d) {
+    std::vector<std::string> groups;
+    struct dirent* e;
+    while ((e = readdir(d)) != nullptr) {
+      if (isdigit(e->d_name[0])) groups.push_back(e->d_name);
+    }
+    closedir(d);
+    std::sort(groups.begin(), groups.end(),
+              [](const std::string& a, const std::string& b) {
+                return atoi(a.c_str()) < atoi(b.c_str());
+              });
+    for (size_t i = 0; i < groups.size(); ++i) {
+      Chip c;
+      c.id = static_cast<int>(i);
+      c.path = "/dev/vfio/" + groups[i];
+      chips->push_back(c);
+    }
+    if (!chips->empty()) return "vfio";
+  }
+  return "none";
+}
+
+/* ---- registry: one file per reservation, "<uuid>.res", containing a
+ * newline-separated chip-id list. Writes are tmp+rename under an exclusive
+ * flock on <registry>/.lock so concurrent agents/plugins serialize. ---- */
+
+class RegistryLock {
+ public:
+  explicit RegistryLock(const std::string& registry) : fd_(-1) {
+    std::string lockpath = path_join(registry, ".lock");
+    fd_ = open(lockpath.c_str(), O_CREAT | O_RDWR, 0644);
+    if (fd_ >= 0) flock(fd_, LOCK_EX);
+  }
+  ~RegistryLock() {
+    if (fd_ >= 0) {
+      flock(fd_, LOCK_UN);
+      close(fd_);
+    }
+  }
+  bool ok() const { return fd_ >= 0; }
+
+ private:
+  int fd_;
+};
+
+bool valid_uuid(const char* u) {
+  if (!u || !*u) return false;
+  for (const char* p = u; *p; ++p) {
+    if (!isalnum(*p) && *p != '-' && *p != '_' && *p != '.') return false;
+    if (p - u > 128) return false;
+  }
+  return true;
+}
+
+struct Reservation {
+  std::string uuid;
+  std::vector<int> chips;
+};
+
+int load_reservations(std::vector<Reservation>* out) {
+  out->clear();
+  DIR* d = opendir(g_registry.c_str());
+  if (!d) return TPUSLICE_EIO;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    std::string name = e->d_name;
+    const std::string suffix = ".res";
+    if (name.size() <= suffix.size() ||
+        name.compare(name.size() - suffix.size(), suffix.size(), suffix) != 0)
+      continue;
+    Reservation r;
+    r.uuid = name.substr(0, name.size() - suffix.size());
+    FILE* f = fopen(path_join(g_registry, name).c_str(), "r");
+    if (!f) continue;
+    int id;
+    while (fscanf(f, "%d", &id) == 1) r.chips.push_back(id);
+    fclose(f);
+    out->push_back(r);
+  }
+  closedir(d);
+  std::sort(out->begin(), out->end(),
+            [](const Reservation& a, const Reservation& b) {
+              return a.uuid < b.uuid;
+            });
+  return TPUSLICE_OK;
+}
+
+int write_json(char* buf, size_t buflen, const std::string& s) {
+  if (!buf) return TPUSLICE_EINVAL;
+  if (s.size() + 1 > buflen) return TPUSLICE_ERANGE;
+  memcpy(buf, s.c_str(), s.size() + 1);
+  return TPUSLICE_OK;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuslice_init(const char* root, const char* registry_dir) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_root = root ? root : "";
+  if (g_root == "/") g_root = "";
+  g_registry = registry_dir && *registry_dir
+                   ? registry_dir
+                   : path_join(g_root, "/run/tpuslice");
+  if (mkdir_p(g_registry) != 0) return TPUSLICE_EIO;
+  g_inited = true;
+  return TPUSLICE_OK;
+}
+
+int tpuslice_discover(char* buf, size_t buflen) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_inited) return TPUSLICE_EINVAL;
+  std::vector<Chip> chips;
+  std::string source = scan_chips(&chips);
+  std::string j = "{\"chip_count\":" + std::to_string(chips.size()) +
+                  ",\"source\":\"" + source + "\",\"chips\":[";
+  for (size_t i = 0; i < chips.size(); ++i) {
+    if (i) j += ",";
+    j += "{\"id\":" + std::to_string(chips[i].id) + ",\"path\":\"" +
+         chips[i].path + "\"}";
+  }
+  j += "]}";
+  return write_json(buf, buflen, j);
+}
+
+int tpuslice_reserve(const char* slice_uuid, const int* chip_ids, int n) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_inited || !valid_uuid(slice_uuid) || !chip_ids || n <= 0)
+    return TPUSLICE_EINVAL;
+  RegistryLock lock(g_registry);
+  if (!lock.ok()) return TPUSLICE_EIO;
+
+  std::vector<Reservation> live;
+  int rc = load_reservations(&live);
+  if (rc != TPUSLICE_OK) return rc;
+
+  std::set<int> wanted;
+  for (int i = 0; i < n; ++i) {
+    if (chip_ids[i] < 0) return TPUSLICE_EINVAL;
+    if (!wanted.insert(chip_ids[i]).second) return TPUSLICE_EINVAL;
+  }
+  for (const auto& r : live) {
+    if (r.uuid == slice_uuid) return TPUSLICE_EEXIST;
+    for (int c : r.chips)
+      if (wanted.count(c)) return TPUSLICE_EBUSY;
+  }
+
+  std::string final_path =
+      path_join(g_registry, std::string(slice_uuid) + ".res");
+  std::string tmp_path = final_path + ".tmp";
+  FILE* f = fopen(tmp_path.c_str(), "w");
+  if (!f) return TPUSLICE_EIO;
+  for (int c : wanted) fprintf(f, "%d\n", c);
+  if (fflush(f) != 0 || fsync(fileno(f)) != 0) {
+    fclose(f);
+    unlink(tmp_path.c_str());
+    return TPUSLICE_EIO;
+  }
+  fclose(f);
+  if (rename(tmp_path.c_str(), final_path.c_str()) != 0) {
+    unlink(tmp_path.c_str());
+    return TPUSLICE_EIO;
+  }
+  return TPUSLICE_OK;
+}
+
+int tpuslice_release(const char* slice_uuid) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_inited || !valid_uuid(slice_uuid)) return TPUSLICE_EINVAL;
+  RegistryLock lock(g_registry);
+  if (!lock.ok()) return TPUSLICE_EIO;
+  std::string p = path_join(g_registry, std::string(slice_uuid) + ".res");
+  if (!exists(p)) return TPUSLICE_ENOENT;
+  if (unlink(p.c_str()) != 0) return TPUSLICE_EIO;
+  return TPUSLICE_OK;
+}
+
+int tpuslice_list(char* buf, size_t buflen) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_inited) return TPUSLICE_EINVAL;
+  RegistryLock lock(g_registry);
+  if (!lock.ok()) return TPUSLICE_EIO;
+  std::vector<Reservation> live;
+  int rc = load_reservations(&live);
+  if (rc != TPUSLICE_OK) return rc;
+  std::string j = "{\"reservations\":[";
+  for (size_t i = 0; i < live.size(); ++i) {
+    if (i) j += ",";
+    j += "{\"uuid\":\"" + live[i].uuid + "\",\"chips\":[";
+    for (size_t k = 0; k < live[i].chips.size(); ++k) {
+      if (k) j += ",";
+      j += std::to_string(live[i].chips[k]);
+    }
+    j += "]}";
+  }
+  j += "]}";
+  return write_json(buf, buflen, j);
+}
+
+const char* tpuslice_strerror(int code) {
+  switch (code) {
+    case TPUSLICE_OK: return "ok";
+    case TPUSLICE_EINVAL: return "invalid argument";
+    case TPUSLICE_ENODEV: return "no TPU devices found";
+    case TPUSLICE_EBUSY: return "chips overlap a live reservation";
+    case TPUSLICE_EEXIST: return "slice uuid already reserved";
+    case TPUSLICE_ENOENT: return "no such slice";
+    case TPUSLICE_EIO: return "registry I/O failure";
+    case TPUSLICE_ERANGE: return "output buffer too small";
+    default: return "unknown error";
+  }
+}
+
+const char* tpuslice_version(void) { return "0.1.0"; }
+
+}  // extern "C"

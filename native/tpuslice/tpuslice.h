@@ -1,0 +1,76 @@
+/* libtpuslice — TPU-native device layer for instaslice_tpu.
+ *
+ * The reference reaches its accelerator through CGo bindings over
+ * libnvidia-ml.so (go-nvml: device enumeration, MIG GI/CI create/destroy —
+ * /root/reference/internal/controller/instaslice_daemonset.go:112-193,
+ * 377-413, 588-664). A TPU host has no MIG-style hardware partitioner: a
+ * "slice" is a subset of the host's chips made visible to one container via
+ * device nodes + TPU_VISIBLE_CHIPS env. What the native layer must therefore
+ * provide, and what this library implements:
+ *
+ *  - chip enumeration: scan /dev (accel nodes, vfio groups) and
+ *    /sys/class/accel for the host's TPU chips and their device paths;
+ *  - an exclusive, crash-safe reservation registry: chips are granted to at
+ *    most one slice at a time, enforced across processes with a flock'd
+ *    on-disk registry that survives agent restarts (the reference's
+ *    in-memory cachedPreparedMig cache loses this on restart — SURVEY.md §5);
+ *  - slice handles: create/list/release with overlap rejection.
+ *
+ * All functions return 0 on success or a negative TPUSLICE_E* code. String
+ * outputs are JSON written into caller-provided buffers. The library is
+ * thread-safe and multi-process-safe. A root prefix (tpuslice_init) points
+ * the scanner at an alternate filesystem root so tests exercise the real
+ * native path against a synthetic /dev//sys tree.
+ */
+
+#ifndef TPUSLICE_H
+#define TPUSLICE_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUSLICE_OK 0
+#define TPUSLICE_EINVAL -1      /* bad arguments / malformed JSON */
+#define TPUSLICE_ENODEV -2      /* no TPU chips found */
+#define TPUSLICE_EBUSY -3       /* requested chips overlap a reservation */
+#define TPUSLICE_EEXIST -4      /* slice uuid already reserved */
+#define TPUSLICE_ENOENT -5      /* no such slice uuid */
+#define TPUSLICE_EIO -6         /* registry I/O failure */
+#define TPUSLICE_ERANGE -7      /* output buffer too small */
+
+/* Initialize with a filesystem root prefix ("" or NULL for "/") and a
+ * registry directory (NULL for "<root>/run/tpuslice"). Idempotent. */
+int tpuslice_init(const char* root, const char* registry_dir);
+
+/* Write a JSON inventory into buf:
+ * {"chip_count":N,"chips":[{"id":0,"path":"/dev/accel0"},...],
+ *  "source":"accel|vfio|none"} */
+int tpuslice_discover(char* buf, size_t buflen);
+
+/* Reserve chips for a slice. chip_ids: array of local ids; n: count.
+ * Rejects overlap with any live reservation (TPUSLICE_EBUSY) and duplicate
+ * uuids (TPUSLICE_EEXIST). Crash-safe: registry write is atomic
+ * (tmp+rename) under an exclusive flock. */
+int tpuslice_reserve(const char* slice_uuid, const int* chip_ids, int n);
+
+/* Release a reservation. Returns TPUSLICE_ENOENT if unknown. */
+int tpuslice_release(const char* slice_uuid);
+
+/* JSON list of live reservations:
+ * {"reservations":[{"uuid":"...","chips":[0,1]},...]} */
+int tpuslice_list(char* buf, size_t buflen);
+
+/* Human-readable error string for a TPUSLICE_E* code. */
+const char* tpuslice_strerror(int code);
+
+/* Library version, e.g. "0.1.0". */
+const char* tpuslice_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUSLICE_H */

@@ -1,0 +1,67 @@
+/* Native smoke test for libtpuslice against a synthetic /dev tree.
+ * Run via `make -C native test`. The heavier behavioral matrix (overlap,
+ * crash-recovery, concurrency) lives in tests/test_device.py through the
+ * ctypes binding — one behavioral suite over both backends. */
+
+#include "tpuslice.h"
+
+#include <assert.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+
+static void make_fake_dev(const char* root, int nchips) {
+  char p[512];
+  snprintf(p, sizeof p, "%s/dev", root);
+  mkdir(root, 0755);
+  mkdir(p, 0755);
+  for (int i = 0; i < nchips; ++i) {
+    snprintf(p, sizeof p, "%s/dev/accel%d", root, i);
+    FILE* f = fopen(p, "w");
+    fclose(f);
+  }
+}
+
+int main(void) {
+  char root[] = "/tmp/tpuslice_ctest_XXXXXX";
+  assert(mkdtemp(root) != NULL);
+  make_fake_dev(root, 4);
+
+  assert(tpuslice_init(root, NULL) == TPUSLICE_OK);
+
+  char buf[4096];
+  assert(tpuslice_discover(buf, sizeof buf) == TPUSLICE_OK);
+  assert(strstr(buf, "\"chip_count\":4") != NULL);
+  assert(strstr(buf, "/dev/accel0") != NULL);
+
+  int chips01[] = {0, 1};
+  int chips12[] = {1, 2};
+  int chips23[] = {2, 3};
+  assert(tpuslice_reserve("slice-a", chips01, 2) == TPUSLICE_OK);
+  assert(tpuslice_reserve("slice-a", chips23, 2) == TPUSLICE_EEXIST);
+  assert(tpuslice_reserve("slice-b", chips12, 2) == TPUSLICE_EBUSY);
+  assert(tpuslice_reserve("slice-b", chips23, 2) == TPUSLICE_OK);
+
+  assert(tpuslice_list(buf, sizeof buf) == TPUSLICE_OK);
+  assert(strstr(buf, "slice-a") != NULL && strstr(buf, "slice-b") != NULL);
+
+  assert(tpuslice_release("slice-a") == TPUSLICE_OK);
+  assert(tpuslice_release("slice-a") == TPUSLICE_ENOENT);
+  assert(tpuslice_reserve("slice-c", chips01, 2) == TPUSLICE_OK);
+
+  /* re-init simulates agent restart: registry must persist */
+  assert(tpuslice_init(root, NULL) == TPUSLICE_OK);
+  assert(tpuslice_list(buf, sizeof buf) == TPUSLICE_OK);
+  assert(strstr(buf, "slice-b") != NULL && strstr(buf, "slice-c") != NULL);
+
+  /* tiny buffer → ERANGE, not overflow */
+  char tiny[4];
+  assert(tpuslice_list(tiny, sizeof tiny) == TPUSLICE_ERANGE);
+
+  assert(strcmp(tpuslice_strerror(TPUSLICE_EBUSY),
+                "chips overlap a live reservation") == 0);
+
+  printf("tpuslice_test: all assertions passed\n");
+  return 0;
+}

@@ -5,6 +5,7 @@ import pytest
 from instaslice_tpu.api import (
     AllocationDetails,
     AllocationStatus,
+    PodRef,
     PreparedDetails,
     PreparedPart,
     TpuSlice,
@@ -20,7 +21,7 @@ def make_allocation() -> AllocationDetails:
     g = TorusGroup.single_host("node-a", get_generation("v5e"))
     pl = FirstFitPolicy().choose(g, parse_profile_name("v5e-2x2"), Occupancy(g))
     return AllocationDetails.from_placement(
-        pl, pod_uuid="pu-1", pod_name="demo", namespace="default", now=123.0
+        pl, [PodRef("pu-1", "demo", "default", 0)], now=123.0
     )
 
 

@@ -1,0 +1,187 @@
+"""Unit tests for controller gate/profile logic and agent handoff env —
+the pieces with reference-bug history (SURVEY.md §7 quirks)."""
+
+import pytest
+
+from instaslice_tpu import GATE_NAME
+from instaslice_tpu.agent.handoff import slice_env
+from instaslice_tpu.api import AllocationDetails, PodRef
+from instaslice_tpu.controller.gates import (
+    extract_profile,
+    is_pod_gated,
+    pod_group,
+)
+from instaslice_tpu.topology import (
+    FirstFitPolicy,
+    NodeGrid,
+    Occupancy,
+    TorusGroup,
+    parse_profile_name,
+)
+from instaslice_tpu.topology.grid import get_generation
+
+
+def gated_pod(**kw):
+    p = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default", "uid": "u1"},
+        "spec": {"schedulingGates": [{"name": GATE_NAME}], "containers": []},
+        "status": {"phase": "Pending"},
+    }
+    p.update(kw)
+    return p
+
+
+class TestGateDetection:
+    def test_gated(self):
+        assert is_pod_gated(gated_pod())
+
+    def test_no_status_at_all(self):
+        """Reference crashes on pods with empty Conditions
+        (instaslice_controller.go:389); we must not."""
+        p = gated_pod()
+        del p["status"]
+        assert is_pod_gated(p)
+
+    def test_other_gate(self):
+        p = gated_pod()
+        p["spec"]["schedulingGates"] = [{"name": "someone-else"}]
+        assert not is_pod_gated(p)
+
+    def test_running_not_gated(self):
+        p = gated_pod()
+        p["status"]["phase"] = "Running"
+        assert not is_pod_gated(p)
+
+    def test_deleting_not_gated(self):
+        p = gated_pod()
+        p["metadata"]["deletionTimestamp"] = 123.0
+        assert not is_pod_gated(p)
+
+
+class TestProfileExtraction:
+    def test_annotation(self):
+        p = gated_pod()
+        p["metadata"]["annotations"] = {
+            "tpu.instaslice.dev/profile": "v5e-2x2"
+        }
+        assert extract_profile(p).name == "v5e-2x2"
+
+    def test_resource_limit(self):
+        p = gated_pod()
+        p["spec"]["containers"] = [
+            {"resources": {"limits": {"google.com/tpu-v5e-2x1": "1"}}}
+        ]
+        assert extract_profile(p).name == "v5e-2x1"
+
+    def test_3d_resource_limit(self):
+        p = gated_pod()
+        p["spec"]["containers"] = [
+            {"resources": {"limits": {"google.com/tpu-v4-2x2x2": "1"}}}
+        ]
+        assert extract_profile(p).name == "v4-2x2x2"
+
+    def test_no_tpu(self):
+        p = gated_pod()
+        p["spec"]["containers"] = [
+            {"resources": {"limits": {"cpu": "1"}}}
+        ]
+        assert extract_profile(p) is None
+
+    def test_malformed_raises(self):
+        p = gated_pod()
+        p["metadata"]["annotations"] = {
+            "tpu.instaslice.dev/profile": "v5e-3x3"
+        }
+        with pytest.raises(ValueError):
+            extract_profile(p)
+
+    def test_group_parsing(self):
+        p = gated_pod()
+        assert pod_group(p) == ("", 1)
+        p["metadata"]["annotations"] = {
+            "tpu.instaslice.dev/group": "job",
+            "tpu.instaslice.dev/group-size": "2",
+        }
+        assert pod_group(p) == ("job", 2)
+        p["metadata"]["annotations"]["tpu.instaslice.dev/group-size"] = "x"
+        with pytest.raises(ValueError):
+            pod_group(p)
+
+
+class TestSliceEnv:
+    def make_alloc(self, profile="v5e-2x2", n_pods=1):
+        gen = get_generation("v5e")
+        if profile == "v5e-4x4":
+            g = TorusGroup(
+                "g", gen, (4, 4, 1),
+                {"node-0": NodeGrid(gen, host_offset=(0, 0, 0)),
+                 "node-1": NodeGrid(gen, host_offset=(2, 0, 0))},
+            )
+        else:
+            g = TorusGroup.single_host("node-0", gen)
+        pl = FirstFitPolicy().choose(
+            g, parse_profile_name(profile), Occupancy(g)
+        )
+        pods = [PodRef(f"u{i}", f"w-{i}", "default", i) for i in range(n_pods)]
+        return AllocationDetails.from_placement(pl, pods, alloc_id="a1")
+
+    def test_single_host_env(self):
+        alloc = self.make_alloc()
+        env = slice_env(alloc, alloc.pods[0], "node-0", "v5e")
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["TPU_HOST_BOUNDS"] == "1,1,1"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5e-2x2"
+
+    def test_multi_host_env(self):
+        alloc = self.make_alloc("v5e-4x4", n_pods=2)
+        env0 = slice_env(alloc, alloc.pods[0], "node-0", "v5e")
+        env1 = slice_env(alloc, alloc.pods[1], "node-1", "v5e")
+        assert env0["TPU_HOST_BOUNDS"] == env1["TPU_HOST_BOUNDS"] == "2,1,1"
+        assert env0["TPU_WORKER_HOSTNAMES"] == "w-0,w-1"
+        assert env0["TPU_VISIBLE_CHIPS"] == env1["TPU_VISIBLE_CHIPS"] == \
+            "0,1,2,3,4,5,6,7"
+
+    def test_unknown_worker_raises(self):
+        alloc = self.make_alloc()
+        ghost = PodRef("ux", "ghost", "default", 7)
+        with pytest.raises(ValueError, match="no part serving worker"):
+            slice_env(alloc, ghost, "node-0", "v5e")
+
+
+class TestDiscovery:
+    def test_boot_creates_cr_and_adopts_dangling(self):
+        from instaslice_tpu.agent.discovery import discover_node
+        from instaslice_tpu.device import FakeTpuBackend
+        from instaslice_tpu.kube import FakeKube
+
+        kube = FakeKube()
+        backend = FakeTpuBackend(generation="v5e")
+        backend.seed_dangling("zombie", [6, 7])
+        ts = discover_node(kube, backend, "node-0", "sys")
+        assert ts.status.processed
+        assert len(ts.spec.chips) == 8
+        assert any(p["name"] == "v5e-2x2" for p in ts.spec.profiles)
+        assert "zombie" in ts.spec.prepared
+        assert ts.spec.prepared["zombie"].pod_uuid == ""
+        # second boot: idempotent, no duplicate adoption
+        ts2 = discover_node(kube, backend, "node-0", "sys")
+        assert list(ts2.spec.prepared) == ["zombie"]
+
+    def test_dangling_blocks_placement_e2e(self):
+        """An adopted zombie slice's chips must be unplaceable."""
+        import time
+        from instaslice_tpu.sim import SimCluster
+
+        c = SimCluster(n_nodes=1, deletion_grace_seconds=0.2)
+        c.backends["node-0"].seed_dangling("zombie", list(range(8)))
+        c.start()
+        try:
+            c.submit("p", "v5e-1x1")
+            time.sleep(0.6)
+            assert c.pod_phase("p") == "Pending"
+        finally:
+            c.stop()

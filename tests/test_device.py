@@ -1,0 +1,193 @@
+"""Device layer tests: one behavioral suite run over BOTH backends (fake
+and native-C++-via-ctypes against a synthetic /dev tree), so the fake can
+never drift from the real device semantics — the fidelity requirement from
+SURVEY.md §7 ("Fake-TPU fidelity so e2e means something without hardware").
+"""
+
+import os
+import subprocess
+import threading
+
+import pytest
+
+from instaslice_tpu.device import (
+    ChipsBusy,
+    DeviceError,
+    FakeTpuBackend,
+    NativeBackend,
+    select_backend,
+)
+from instaslice_tpu.device.backend import SliceExists, SliceNotFound
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "native", "build", "libtpuslice.so")
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    if not os.path.exists(LIB):
+        subprocess.run(
+            ["make", "-C", os.path.join(REPO, "native")],
+            check=True, capture_output=True,
+        )
+    return LIB
+
+
+@pytest.fixture
+def sim_root(tmp_path):
+    (tmp_path / "dev").mkdir()
+    for i in range(8):
+        (tmp_path / "dev" / f"accel{i}").touch()
+    return str(tmp_path)
+
+
+def make_backend(kind, native_lib, sim_root):
+    if kind == "fake":
+        return FakeTpuBackend(generation="v5e")
+    return NativeBackend(
+        library_path=native_lib, root=sim_root, generation="v5e"
+    )
+
+
+@pytest.fixture(params=["fake", "native"])
+def backend(request, native_lib, sim_root):
+    return make_backend(request.param, native_lib, sim_root)
+
+
+class TestBackendContract:
+    def test_discover(self, backend):
+        inv = backend.discover()
+        assert inv.generation == "v5e"
+        assert inv.chip_count == 8
+        assert inv.chip_paths[0].endswith("accel0")
+
+    def test_reserve_release_cycle(self, backend):
+        r = backend.reserve("s-1", [0, 1, 2, 3])
+        assert r.chip_ids == (0, 1, 2, 3)
+        assert [x.slice_uuid for x in backend.list_reservations()] == ["s-1"]
+        backend.release("s-1")
+        assert backend.list_reservations() == []
+
+    def test_overlap_rejected(self, backend):
+        backend.reserve("s-1", [0, 1])
+        with pytest.raises(ChipsBusy):
+            backend.reserve("s-2", [1, 2])
+        backend.reserve("s-2", [2, 3])  # disjoint is fine
+
+    def test_duplicate_uuid_rejected(self, backend):
+        backend.reserve("s-1", [0])
+        with pytest.raises(SliceExists):
+            backend.reserve("s-1", [4])
+
+    def test_release_unknown(self, backend):
+        with pytest.raises(SliceNotFound):
+            backend.release("nope")
+
+    def test_empty_args_rejected(self, backend):
+        with pytest.raises(DeviceError):
+            backend.reserve("", [0])
+        with pytest.raises(DeviceError):
+            backend.reserve("s", [])
+
+    def test_concurrent_reserves_no_double_grant(self, backend):
+        """8 threads race for single chips; every chip granted once."""
+        granted, errs = [], []
+
+        def worker(i):
+            try:
+                granted.append(backend.reserve(f"c-{i}", [i]).chip_ids)
+            except DeviceError as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        flat = [c for ids in granted for c in ids]
+        assert sorted(flat) == list(range(8))
+
+
+class TestNativeSpecifics:
+    def test_registry_survives_restart(self, native_lib, sim_root):
+        b1 = NativeBackend(library_path=native_lib, root=sim_root,
+                           generation="v5e")
+        b1.reserve("s-1", [0, 1])
+        # "restart": a brand-new binding against the same root
+        b2 = NativeBackend(library_path=native_lib, root=sim_root,
+                           generation="v5e")
+        live = b2.list_reservations()
+        assert [(r.slice_uuid, r.chip_ids) for r in live] == [("s-1", (0, 1))]
+        with pytest.raises(ChipsBusy):
+            b2.reserve("s-2", [1])
+
+    def test_discover_no_generation_fails_clearly(self, native_lib, sim_root,
+                                                  monkeypatch):
+        monkeypatch.delenv("TPUSLICE_GENERATION", raising=False)
+        b = NativeBackend(library_path=native_lib, root=sim_root)
+        with pytest.raises(DeviceError, match="TPUSLICE_GENERATION"):
+            b.discover()
+
+    def test_env_hints(self, native_lib, sim_root, monkeypatch):
+        monkeypatch.setenv("TPUSLICE_GENERATION", "v5e")
+        monkeypatch.setenv("TPUSLICE_TORUS_GROUP", "pod-7")
+        monkeypatch.setenv("TPUSLICE_HOST_OFFSET", "2,0,0")
+        b = NativeBackend(library_path=native_lib, root=sim_root)
+        inv = b.discover()
+        assert inv.torus_group == "pod-7"
+        assert inv.host_offset == (2, 0, 0)
+
+    def test_missing_library(self, monkeypatch):
+        monkeypatch.setenv("TPUSLICE_LIBRARY", "/nonexistent/lib.so")
+        with pytest.raises(DeviceError, match="libtpuslice"):
+            NativeBackend()
+
+    def test_empty_dev_tree(self, native_lib, tmp_path):
+        (tmp_path / "dev").mkdir()
+        b = NativeBackend(library_path=native_lib, root=str(tmp_path),
+                          generation="v5e")
+        inv = b.discover()
+        assert inv.chip_count == 0 and inv.source == "none"
+
+
+class TestFakeSpecifics:
+    def test_failure_injection(self):
+        b = FakeTpuBackend()
+        b.inject_failures("reserve", 2)
+        for _ in range(2):
+            with pytest.raises(DeviceError, match="injected"):
+                b.reserve("s", [0])
+        b.reserve("s", [0])  # third attempt succeeds
+
+    def test_dangling_seed_and_restart(self):
+        b = FakeTpuBackend()
+        b.seed_dangling("zombie", [4, 5])
+        with pytest.raises(ChipsBusy):
+            b.reserve("s", [5])
+        snap = b.snapshot()
+        b2 = FakeTpuBackend()
+        b2.restore(snap)
+        assert b2.list_reservations()[0].slice_uuid == "zombie"
+
+    def test_unknown_chip_rejected(self):
+        b = FakeTpuBackend()
+        with pytest.raises(DeviceError, match="not on this host"):
+            b.reserve("s", [99])
+
+
+class TestSelect:
+    def test_select_fake(self, monkeypatch):
+        monkeypatch.setenv("TPUSLICE_GENERATION", "v4")
+        b = select_backend("fake")
+        assert b.discover().generation == "v4"
+        assert b.discover().chip_count == 4
+
+    def test_select_unknown(self):
+        with pytest.raises(DeviceError):
+            select_backend("bogus")
+
+    def test_select_native(self, native_lib, sim_root):
+        b = select_backend("native", library_path=native_lib, root=sim_root,
+                           generation="v5e")
+        assert b.name == "native"

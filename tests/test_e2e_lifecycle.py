@@ -1,0 +1,283 @@
+"""End-to-end lifecycle tests on the simulated cluster: every state
+transition of SURVEY.md §3.1–3.3 plus the BASELINE stress and reshard
+configs — controller + agents + fake scheduler all running threaded
+against the fake kube API.
+"""
+
+import time
+
+import pytest
+
+from instaslice_tpu import GATE_NAME, POD_RESOURCE_PREFIX
+from instaslice_tpu.sim import SimCluster
+
+
+@pytest.fixture
+def cluster():
+    c = SimCluster(n_nodes=1, generation="v5e",
+                   deletion_grace_seconds=0.3).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def cluster2():
+    c = SimCluster(n_nodes=2, generation="v5e", shared_torus=True,
+                   deletion_grace_seconds=0.3).start()
+    yield c
+    c.stop()
+
+
+class TestGrantLifecycle:
+    def test_gated_pod_reaches_running(self, cluster):
+        cluster.submit("demo", "v5e-2x2")
+        assert cluster.wait_phase("demo", "Running", timeout=10)
+        pod = cluster.pod("demo")
+        assert pod["spec"].get("schedulingGates") == []
+        assert pod["spec"].get("nodeName") == "node-0"
+        # allocation reached ungated
+        allocs = cluster.allocations()
+        assert len(allocs) == 1
+        a = next(iter(allocs.values()))
+        assert a["status"] == "ungated"
+        assert a["profile"] == "v5e-2x2"
+
+    def test_configmap_env_handoff(self, cluster):
+        cluster.submit("demo", "v5e-2x2")
+        assert cluster.wait_phase("demo", "Running", timeout=10)
+        cm = cluster.configmap("demo")
+        assert cm is not None
+        env = cm["data"]
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        assert env["TPU_HOST_BOUNDS"] == "1,1,1"
+        chips = [int(c) for c in env["TPU_VISIBLE_CHIPS"].split(",")]
+        assert len(chips) == 4 and len(set(chips)) == 4
+        assert env["TPU_SLICE_PROFILE"] == "v5e-2x2"
+
+    def test_device_reservation_made(self, cluster):
+        cluster.submit("demo", "v5e-1x1")
+        assert cluster.wait_phase("demo", "Running", timeout=10)
+        res = cluster.backends["node-0"].list_reservations()
+        assert len(res) == 1 and len(res[0].chip_ids) == 1
+
+    def test_node_capacity_patched(self, cluster):
+        cluster.submit("demo", "v5e-1x1")
+        assert cluster.wait_phase("demo", "Running", timeout=10)
+        node = cluster.kube.get("Node", "", "node-0")
+        assert node["status"]["capacity"][f"{POD_RESOURCE_PREFIX}demo"] == "1"
+
+    def test_non_tpu_pod_ignored(self, cluster):
+        pod = cluster.pod_manifest("plain", "v5e-1x1")
+        del pod["metadata"]["annotations"]
+        pod["spec"]["containers"][0]["resources"] = {}
+        cluster.kube.create("Pod", pod)
+        time.sleep(0.5)
+        # stays gated forever: not our pod, no allocation written
+        assert cluster.allocations() == {}
+
+    def test_resource_limit_profile_extraction(self, cluster):
+        pod = cluster.pod_manifest("via-limits", "v5e-2x1")
+        del pod["metadata"]["annotations"]
+        pod["spec"]["containers"][0]["resources"]["limits"][
+            "google.com/tpu-v5e-2x1"
+        ] = "1"
+        cluster.kube.create("Pod", pod)
+        assert cluster.wait_phase("via-limits", "Running", timeout=10)
+        a = next(iter(cluster.allocations().values()))
+        assert a["profile"] == "v5e-2x1"
+
+
+class TestTeardown:
+    def test_delete_releases_everything(self, cluster):
+        cluster.submit("demo", "v5e-2x2")
+        assert cluster.wait_phase("demo", "Running", timeout=10)
+        cluster.delete_pod("demo")
+        assert cluster.wait_gone("demo", timeout=10)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if (
+                not cluster.allocations()
+                and not cluster.backends["node-0"].list_reservations()
+                and cluster.configmap("demo") is None
+            ):
+                break
+            time.sleep(0.05)
+        assert cluster.allocations() == {}
+        assert cluster.backends["node-0"].list_reservations() == []
+        assert cluster.configmap("demo") is None
+        node = cluster.kube.get("Node", "", "node-0")
+        assert f"{POD_RESOURCE_PREFIX}demo" not in node["status"]["capacity"]
+
+    def test_deletion_grace_respected(self):
+        c = SimCluster(n_nodes=1, deletion_grace_seconds=1.0).start()
+        try:
+            c.submit("demo", "v5e-1x1")
+            assert c.wait_phase("demo", "Running", timeout=10)
+            t0 = time.monotonic()
+            c.delete_pod("demo")
+            assert c.wait_gone("demo", timeout=10)
+            assert time.monotonic() - t0 >= 0.9
+        finally:
+            c.stop()
+
+    def test_chips_reusable_after_teardown(self, cluster):
+        """Full host, delete, full host again — elasticity smoke."""
+        cluster.submit("a", "v5e-4x2")  # 8 chips = whole host
+        assert cluster.wait_phase("a", "Running", timeout=10)
+        cluster.submit("b", "v5e-4x2")
+        time.sleep(0.3)
+        assert cluster.pod_phase("b") == "Pending"  # no capacity
+        cluster.delete_pod("a")
+        assert cluster.wait_gone("a", timeout=10)
+        assert cluster.wait_phase("b", "Running", timeout=10)
+
+
+class TestFailureHandling:
+    def test_device_failure_marks_failed_then_retries(self, cluster):
+        cluster.backends["node-0"].inject_failures("reserve", 1)
+        cluster.submit("demo", "v5e-1x1")
+        # failed → torn down → retried → eventually Running
+        assert cluster.wait_phase("demo", "Running", timeout=15)
+
+    def test_force_deleted_pod_reaped(self, cluster):
+        cluster.submit("demo", "v5e-2x2")
+        assert cluster.wait_phase("demo", "Running", timeout=10)
+        # force-delete: rip the finalizer out and delete in one shot
+        pod = cluster.pod("demo")
+        pod["metadata"]["finalizers"] = []
+        cluster.kube.update("Pod", pod)
+        cluster.kube.delete("Pod", "default", "demo")
+        deadline = time.monotonic() + 8
+        while time.monotonic() < deadline:
+            if not cluster.allocations():
+                break
+            time.sleep(0.05)
+        assert cluster.allocations() == {}
+        assert cluster.backends["node-0"].list_reservations() == []
+
+
+class TestStressAndPacking:
+    def test_baseline_stress_8_pods(self, cluster2):
+        """BASELINE configs[3]: 8 concurrent mixed pods on a v5e-16."""
+        mix = [("p0", "v5e-2x2"), ("p1", "v5e-2x1"), ("p2", "v5e-2x1"),
+               ("p3", "v5e-2x1"), ("p4", "v5e-1x1"), ("p5", "v5e-1x1"),
+               ("p6", "v5e-1x1"), ("p7", "v5e-1x1")]
+        for name, prof in mix:
+            cluster2.submit(name, prof)
+        for name, _ in mix:
+            assert cluster2.wait_phase(name, "Running", timeout=20), name
+        # no double-grant on the devices
+        for node, backend in cluster2.backends.items():
+            claimed = [c for r in backend.list_reservations()
+                       for c in r.chip_ids]
+            assert len(claimed) == len(set(claimed))
+        total = sum(
+            len(r.chip_ids)
+            for b in cluster2.backends.values()
+            for r in b.list_reservations()
+        )
+        assert total == 4 + 2 * 3 + 1 * 4
+
+    def test_elastic_reshard(self, cluster):
+        """BASELINE configs[4]: preempt a 2x2, re-grant as 4x 1x1 without
+        agent restart."""
+        cluster.submit("big", "v5e-2x2")
+        cluster.submit("fill", "v5e-2x2")  # host is 2x4: both fit
+        assert cluster.wait_phase("big", "Running", timeout=10)
+        assert cluster.wait_phase("fill", "Running", timeout=10)
+        smalls = [f"small-{i}" for i in range(4)]
+        for s in smalls:
+            cluster.submit(s, "v5e-1x1")
+        time.sleep(0.4)
+        for s in smalls:
+            assert cluster.pod_phase(s) == "Pending"
+        cluster.delete_pod("big")
+        assert cluster.wait_gone("big", timeout=10)
+        for s in smalls:
+            assert cluster.wait_phase(s, "Running", timeout=15), s
+        assert cluster.pod_phase("fill") == "Running"  # undisturbed
+
+
+class TestMultiHost:
+    def test_4x4_group_spans_two_hosts(self, cluster2):
+        """A v5e-4x4 slice needs both hosts: two pods in one group, one
+        per host, consistent worker env."""
+        cluster2.submit("w-0", "v5e-4x4", group="job-a", group_size=2)
+        cluster2.submit("w-1", "v5e-4x4", group="job-a", group_size=2)
+        assert cluster2.wait_phase("w-0", "Running", timeout=20)
+        assert cluster2.wait_phase("w-1", "Running", timeout=20)
+        allocs = cluster2.allocations()
+        assert len(allocs) == 1
+        a = next(iter(allocs.values()))
+        assert a["status"] == "ungated"
+        assert set(a["parts"]) == {"node-0", "node-1"}
+        cm0 = cluster2.configmap("w-0")["data"]
+        cm1 = cluster2.configmap("w-1")["data"]
+        assert {cm0["TPU_WORKER_ID"], cm1["TPU_WORKER_ID"]} == {"0", "1"}
+        assert cm0["TPU_WORKER_HOSTNAMES"] == "w-0,w-1"
+        assert cm0["TPU_HOST_BOUNDS"] == "2,1,1"
+        assert cm0["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,4,1"
+        # both hosts fully reserved
+        for b in cluster2.backends.values():
+            assert sum(len(r.chip_ids) for r in b.list_reservations()) == 8
+        # pods landed on *different* nodes
+        n0 = cluster2.pod("w-0")["spec"]["nodeName"]
+        n1 = cluster2.pod("w-1")["spec"]["nodeName"]
+        assert {n0, n1} == {"node-0", "node-1"}
+
+    def test_group_teardown_releases_both_hosts(self, cluster2):
+        cluster2.submit("w-0", "v5e-4x4", group="job-a", group_size=2)
+        cluster2.submit("w-1", "v5e-4x4", group="job-a", group_size=2)
+        assert cluster2.wait_phase("w-0", "Running", timeout=20)
+        assert cluster2.wait_phase("w-1", "Running", timeout=20)
+        cluster2.delete_pod("w-0")
+        cluster2.delete_pod("w-1")
+        assert cluster2.wait_gone("w-0", timeout=10)
+        assert cluster2.wait_gone("w-1", timeout=10)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if not cluster2.allocations() and all(
+                not b.list_reservations()
+                for b in cluster2.backends.values()
+            ):
+                break
+            time.sleep(0.05)
+        assert cluster2.allocations() == {}
+        for b in cluster2.backends.values():
+            assert b.list_reservations() == []
+
+
+class TestReviewRegressions:
+    def test_surplus_group_pod_annotated(self, cluster2):
+        """A 3rd pod beyond group-size=2 must get an error annotation,
+        not a silent livelock."""
+        cluster2.submit("w-0", "v5e-4x4", group="job-a", group_size=2)
+        cluster2.submit("w-1", "v5e-4x4", group="job-a", group_size=2)
+        cluster2.submit("w-2", "v5e-4x4", group="job-a", group_size=2)
+        assert cluster2.wait_phase("w-0", "Running", timeout=20)
+        assert cluster2.wait_phase("w-1", "Running", timeout=20)
+        deadline = time.monotonic() + 8
+        ann = {}
+        while time.monotonic() < deadline:
+            ann = cluster2.pod("w-2")["metadata"].get("annotations", {})
+            if "tpu.instaslice.dev/error" in ann:
+                break
+            time.sleep(0.05)
+        assert "surplus" in ann.get("tpu.instaslice.dev/error", "")
+
+    def test_raced_reserve_released_on_teardown(self, cluster2):
+        """Reserve succeeds on node B while node A's failure marks the
+        allocation FAILED->DELETED: B's reservation must not leak."""
+        cluster2.backends["node-0"].inject_failures("reserve", 1)
+        cluster2.submit("w-0", "v5e-4x4", group="j", group_size=2)
+        cluster2.submit("w-1", "v5e-4x4", group="j", group_size=2)
+        # retry loop should eventually land both pods
+        assert cluster2.wait_phase("w-0", "Running", timeout=20)
+        assert cluster2.wait_phase("w-1", "Running", timeout=20)
+        total = sum(
+            len(r.chip_ids)
+            for b in cluster2.backends.values()
+            for r in b.list_reservations()
+        )
+        assert total == 16  # exactly one 4x4, no leaked duplicates

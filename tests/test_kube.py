@@ -178,3 +178,17 @@ class TestWatch:
         k.update("Pod", obj)
         events = [e for e, _ in it]
         assert "DELETED" in events
+
+
+class TestNoopWrites:
+    def test_noop_update_no_event_no_rv_bump(self):
+        k = FakeKube()
+        k.create("Pod", pod("a"))
+        obj = k.get("Pod", "default", "a")
+        rv = obj["metadata"]["resourceVersion"]
+        it = k.watch("Pod", replay=False, timeout=0.2)
+        out = k.update("Pod", obj)
+        assert out["metadata"]["resourceVersion"] == rv
+        out2 = k.patch("Pod", "default", "a", {"spec": {}})
+        assert out2["metadata"]["resourceVersion"] == rv
+        assert list(it) == []
