@@ -27,6 +27,7 @@ from instaslice_tpu.api import (
     PreparedDetails,
     PreparedPart,
     TpuSlice,
+    slice_uuid_for,
 )
 from instaslice_tpu.device.backend import (
     ChipsBusy,
@@ -48,10 +49,8 @@ from instaslice_tpu.utils.reconcile import Manager
 log = logging.getLogger("instaslice_tpu.agent")
 
 
-def slice_uuid_for(alloc_id: str) -> str:
-    """Deterministic per-allocation slice uuid — every agent serving a
-    multi-host allocation derives the same id with no rendezvous."""
-    return f"sl-{alloc_id}"
+# slice_uuid_for moved to api.types (shared with the controller's
+# occupancy computation); re-exported via the import above.
 
 
 class NodeAgent:

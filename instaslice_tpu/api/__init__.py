@@ -17,5 +17,6 @@ from instaslice_tpu.api.types import (
     TpuSlice,
     TpuSliceSpec,
     TpuSliceStatus,
+    slice_uuid_for,
 )
 from instaslice_tpu.api.crd import crd_manifest

@@ -249,6 +249,13 @@ class PreparedPart:
         )
 
 
+def slice_uuid_for(alloc_id: str) -> str:
+    """Deterministic per-allocation slice uuid — every agent serving a
+    multi-host allocation derives the same id with no rendezvous, and the
+    controller uses it to match ``prepared`` entries to allocations."""
+    return f"sl-{alloc_id}"
+
+
 @dataclasses.dataclass
 class PreparedDetails:
     """A realized slice, keyed by slice UUID in ``spec.prepared``.

@@ -15,6 +15,7 @@ Reference analog: ``InstasliceReconciler.Reconcile``
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import threading
 import time
@@ -26,6 +27,7 @@ from instaslice_tpu.api import (
     AllocationStatus,
     PodRef,
     TpuSlice,
+    slice_uuid_for,
 )
 from instaslice_tpu.controller.gates import (
     GROUP_SIZE_ANNOTATION,
@@ -45,6 +47,29 @@ from instaslice_tpu.topology.profiles import TopologyProfile
 from instaslice_tpu.utils.reconcile import Manager
 
 log = logging.getLogger("instaslice_tpu.controller")
+
+
+def _parse_timestamp(val) -> float:
+    """Epoch seconds from either a numeric value (FakeKube) or a real API
+    server's RFC3339 string ('2026-07-29T08:00:00Z')."""
+    if val is None:
+        return 0.0
+    try:
+        return float(val)
+    except (TypeError, ValueError):
+        pass
+    import datetime
+
+    try:
+        # 'Z' suffix only parses from 3.11; normalize for 3.10
+        return datetime.datetime.fromisoformat(
+            str(val).replace("Z", "+00:00")
+        ).timestamp()
+    except ValueError:
+        # epoch 0 = "grace long expired": proceed with teardown rather
+        # than restarting the grace window on every reconcile
+        log.warning("unparseable timestamp %r; treating as epoch", val)
+        return 0.0
 
 
 class Controller:
@@ -165,7 +190,8 @@ class Controller:
                 occ.occupy(Box.from_key(alloc.box), owner=f"a-{alloc.alloc_id}")
             for suid, prep in ts.spec.prepared.items():
                 covered = any(
-                    suid == f"sl-{aid}" for aid in ts.spec.allocations
+                    suid == slice_uuid_for(aid)
+                    for aid in ts.spec.allocations
                 )
                 if covered or seen.get(f"p-{suid}"):
                     continue
@@ -252,7 +278,15 @@ class Controller:
 
         if existing is not None:
             alloc, holders = existing
-            self._repair_fanout(alloc, slices)
+            if alloc.status in (
+                AllocationStatus.CREATING,
+                AllocationStatus.CREATED,
+                AllocationStatus.UNGATED,
+            ):
+                # never "repair" DELETED/FAILED fan-out: a missing copy
+                # there means the agent already finished teardown and
+                # re-writing the record would re-trigger it
+                self._repair_fanout(alloc, slices)
             if (
                 alloc.status == AllocationStatus.CREATING
                 and alloc.fully_realized()
@@ -335,8 +369,19 @@ class Controller:
                 sorted(pods, key=lambda p: p["metadata"]["name"])
             )
         ]
+        # group ids are only unique per namespace; qualify them so two
+        # namespaces using the same group name can't collide on alloc_id
+        # (and thus on the derived slice uuid at the device layer). A
+        # separator alone is ambiguous ('team--a'+'x' vs 'team'+'a--x'),
+        # so disambiguate with a short digest of the exact (ns, gid) pair.
+        if gid:
+            ns = pod_refs[0].namespace
+            h = hashlib.sha1(f"{ns}\x00{gid}".encode()).hexdigest()[:10]
+            aid = f"{gid}-{h}"
+        else:
+            aid = pod_refs[0].pod_uuid
         alloc = AllocationDetails.from_placement(
-            placement, pod_refs, alloc_id=(gid or pod_refs[0].pod_uuid)
+            placement, pod_refs, alloc_id=aid
         )
         for p in pods:
             self._ensure_finalizer(p)
@@ -507,10 +552,13 @@ class Controller:
         """Finalizer + 30 s grace teardown (reference:
         instaslice_controller.go:89-142; SURVEY.md §3.3)."""
         md = pod["metadata"]
+        self._set_pending(self._pod_key(pod), False)
         finalizers = md.get("finalizers", []) or []
         if FINALIZER not in finalizers:
             return None
-        elapsed = time.time() - float(md.get("deletionTimestamp", 0))
+        elapsed = time.time() - _parse_timestamp(
+            md.get("deletionTimestamp", 0)
+        )
         if elapsed < self.grace:
             return max(0.05, self.grace - elapsed)
 
@@ -540,6 +588,7 @@ class Controller:
 
     def _reap_orphan(self, pod_key: str) -> Optional[float]:
         """Pod vanished (force-delete): reap its allocation."""
+        self._set_pending(pod_key, False)
         slices = self._load_slices()
         found = self._find_allocation(slices, pod_key=pod_key)
         if found is None:

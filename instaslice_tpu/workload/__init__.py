@@ -1,0 +1,30 @@
+"""Slice-consumer SDK: what runs *inside* a granted pod.
+
+The reference ships workloads only as sample YAML (cuda vectoradd, TF
+notebook, vLLM — ``/root/reference/samples/``, SURVEY.md §1 "Workloads ...
+are *consumers* ... not part of the framework"). For a TPU slice that is
+not enough: a slice is defined by its ICI mesh, so the consumer needs real
+library support to (a) reconstruct the mesh from the handoff env the node
+agent publishes (``agent/handoff.py``) and (b) shard its computation over
+it with jax/pjit. This package provides both, plus a flagship sharded
+transformer LM used by the samples, the benchmarks, and
+``__graft_entry__.py``.
+"""
+
+from instaslice_tpu.workload.meshenv import (
+    SliceTopology,
+    initialize_distributed,
+    slice_mesh,
+)
+from instaslice_tpu.workload.model import ModelConfig, TpuLM
+from instaslice_tpu.workload.train import TrainState, make_train_step
+
+__all__ = [
+    "SliceTopology",
+    "initialize_distributed",
+    "slice_mesh",
+    "ModelConfig",
+    "TpuLM",
+    "TrainState",
+    "make_train_step",
+]

@@ -1,0 +1,182 @@
+"""Rebuild the slice's device mesh from the agent's handoff env.
+
+The node agent hands a granted pod the libtpu topology env
+(``TPU_WORKER_ID`` / ``TPU_VISIBLE_CHIPS`` / ``TPU_CHIPS_PER_HOST_BOUNDS``
+/ ``TPU_HOST_BOUNDS`` / ``TPU_WORKER_HOSTNAMES`` — ``agent/handoff.py``,
+the TPU analog of the reference's ``NVIDIA_VISIBLE_DEVICES`` ConfigMap,
+``/root/reference/internal/controller/instaslice_daemonset.go:796-818``).
+libtpu itself consumes those to bring up the chips; this module consumes
+them *again* at the JAX level to answer the question the workload actually
+has: "what logical mesh am I, and how do I lay dp/sp/tp axes onto it so
+collectives ride ICI?"
+
+Axis-ordering rule baked in here (the scaling-book recipe): the *last*
+mesh axis is the one XLA maps onto the most tightly coupled devices, so we
+always put ``model`` (tensor parallel — latency-critical all-reduces)
+innermost, ``data`` (bandwidth-tolerant gradient reductions) outermost,
+and ``seq`` (ring/context parallelism — neighbor ppermutes) in between.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+Shape3 = Tuple[int, int, int]
+
+#: Canonical logical axes, outermost → innermost.
+DEFAULT_AXES = ("data", "seq", "model")
+
+
+def _parse_bounds(val: str, default: Shape3) -> Shape3:
+    if not val:
+        return default
+    parts = [int(p) for p in val.split(",") if p.strip()]
+    parts += [1] * (3 - len(parts))
+    return (parts[0], parts[1], parts[2])
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """The granted slice as seen from inside one worker pod."""
+
+    worker_id: int
+    num_workers: int
+    chips_per_host: Shape3  # TPU_CHIPS_PER_HOST_BOUNDS
+    host_bounds: Shape3  # TPU_HOST_BOUNDS (hosts along each axis)
+    hostnames: Tuple[str, ...]
+    profile: str = ""
+
+    @property
+    def slice_shape(self) -> Shape3:
+        """Global chip-grid shape of the slice."""
+        return (
+            self.chips_per_host[0] * self.host_bounds[0],
+            self.chips_per_host[1] * self.host_bounds[1],
+            self.chips_per_host[2] * self.host_bounds[2],
+        )
+
+    @property
+    def num_chips(self) -> int:
+        x, y, z = self.slice_shape
+        return x * y * z
+
+    @property
+    def chips_per_worker(self) -> int:
+        x, y, z = self.chips_per_host
+        return x * y * z
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "SliceTopology":
+        e = os.environ if env is None else env
+        hostnames = tuple(
+            h for h in e.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+        )
+        chips = _parse_bounds(
+            e.get("TPU_CHIPS_PER_HOST_BOUNDS", ""), (1, 1, 1)
+        )
+        hosts = _parse_bounds(e.get("TPU_HOST_BOUNDS", ""), (1, 1, 1))
+        return SliceTopology(
+            worker_id=int(e.get("TPU_WORKER_ID", "0")),
+            num_workers=max(1, len(hostnames))
+            if hostnames
+            else hosts[0] * hosts[1] * hosts[2],
+            chips_per_host=chips,
+            host_bounds=hosts,
+            hostnames=hostnames,
+            profile=e.get("TPU_SLICE_PROFILE", ""),
+        )
+
+
+def initialize_distributed(
+    topo: Optional[SliceTopology] = None, port: int = 8476
+) -> None:
+    """``jax.distributed.initialize`` for a multi-host slice.
+
+    Worker 0's pod name (resolvable over the headless Service the sample
+    manifests create) is the coordinator — the DCN-side rendezvous the
+    reference never needed because MIG slices are single-host by
+    construction (SURVEY.md §7 "Multi-host slices ... is new design").
+    No-op for single-worker slices.
+    """
+    topo = topo or SliceTopology.from_env()
+    if topo.num_workers <= 1:
+        return
+    if not topo.hostnames:
+        raise ValueError(
+            f"slice spans {topo.num_workers} workers but "
+            "TPU_WORKER_HOSTNAMES is empty — cannot pick a coordinator"
+        )
+    coordinator = f"{topo.hostnames[0]}:{port}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=topo.num_workers,
+        process_id=topo.worker_id,
+    )
+
+
+def _factor(n: int, want: Sequence[int]) -> Tuple[int, ...]:
+    """Scale the requested per-axis parallelism ``want`` (with -1 wildcards)
+    to exactly ``n`` devices, preserving ratios where possible."""
+    sizes = list(want)
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    fixed = math.prod(s for s in sizes if s != -1)
+    if n % fixed != 0:
+        raise ValueError(
+            f"{n} devices not divisible by fixed axis product {fixed} "
+            f"(requested {want})"
+        )
+    rest = n // fixed
+    if not wild:
+        if rest != 1:
+            raise ValueError(
+                f"axis product {fixed} != device count {n}; add a -1 axis"
+            )
+    else:
+        # Spread `rest` over wildcards: last wildcard absorbs the remainder
+        # so the innermost (model) axis stays densest.
+        for i in wild[:-1]:
+            sizes[i] = 1
+        sizes[wild[-1]] = rest
+    return tuple(sizes)
+
+
+def slice_mesh(
+    axes: Sequence[str] = DEFAULT_AXES,
+    axis_sizes: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    topo: Optional[SliceTopology] = None,
+) -> Mesh:
+    """Build the slice's :class:`jax.sharding.Mesh`.
+
+    ``axis_sizes`` may use ``-1`` for "whatever is left" (at most the last
+    wildcard absorbs the remainder). Defaults to all parallelism on the
+    innermost axis for tiny slices and a balanced split otherwise.
+
+    Device order: ``jax.devices()`` on a TPU slice already enumerates in
+    torus-major order (libtpu guarantees neighbor ids are ICI neighbors
+    within a host), so a row-major reshape keeps the innermost mesh axis on
+    physically adjacent chips — the property the placement engine's
+    contiguous-rectangle guarantee exists to preserve.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if axis_sizes is None:
+        axis_sizes = [-1 if a == "data" else 1 for a in axes]
+        if n > 1 and "model" in axes:
+            # give model the largest power-of-two factor ≤ chips-per-host
+            topo = topo or SliceTopology.from_env()
+            m = math.gcd(n, topo.chips_per_worker) or 1
+            sizes = list(axis_sizes)
+            sizes[list(axes).index("model")] = m
+            axis_sizes = sizes
+    sizes = _factor(n, axis_sizes)
+    arr = np.array(devs).reshape(sizes)
+    return Mesh(arr, tuple(axes))

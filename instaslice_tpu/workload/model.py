@@ -1,0 +1,289 @@
+"""Flagship workload: a TPU-first sharded transformer LM.
+
+This is the reference workload the samples/benchmarks run inside a granted
+slice (the role ``samples/vllm_dep.yaml`` / ``tf-notebook.yaml`` play for
+the reference, SURVEY.md §1) — but built as a tested library, because on
+TPU the workload must actively cooperate with the slice's mesh.
+
+TPU-first choices, per the design brief:
+- **MXU**: all matmuls in bfloat16 with fp32 accumulation
+  (``preferred_element_type``), shapes static, feature dims multiples of
+  128 in the default configs so XLA tiles cleanly onto the systolic array.
+- **HBM**: residual stream stays bf16; ``jax.checkpoint`` on each block so
+  long sequences trade FLOPs for activation memory.
+- **ICI**: parameters/activations carry ``PartitionSpec`` s over the
+  ``("data", "seq", "model")`` mesh from :mod:`meshenv`; XLA inserts the
+  all-reduces/all-gathers. Sequence parallelism uses ring attention
+  (:mod:`instaslice_tpu.workload.ring`) — neighbor ``ppermute`` s over ICI.
+- **XLA semantics**: the layer stack is a ``lax.scan`` over stacked
+  params — one trace, one compiled block body, no Python-loop unrolling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    # sequence parallelism: shard the sequence axis over the "seq" mesh
+    # axis and run ring attention instead of plain attention.
+    ring_attention: bool = False
+    # mixture-of-experts: 0 = dense MLP; >0 = that many experts, sharded
+    # over the "model" axis (expert parallelism).
+    n_experts: int = 0
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules: logical param tree → PartitionSpec tree.
+# data = batch, seq = sequence, model = heads / ff hidden / experts.
+# ---------------------------------------------------------------------------
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpecs mirroring :func:`init_params`' tree structure."""
+    # specs below describe one layer's (unstacked) param shapes
+    block = {
+        "ln1": {"scale": P(None)},
+        "ln2": {"scale": P(None)},
+        # attention: shard heads over "model"
+        "wq": P(None, "model"),
+        "wk": P(None, "model"),
+        "wv": P(None, "model"),
+        "wo": P("model", None),
+    }
+    if cfg.n_experts:
+        block.update(
+            {
+                "router": P(None, None),
+                # experts sharded over "model": expert parallelism
+                "w_in": P("model", None, None),
+                "w_out": P("model", None, None),
+            }
+        )
+    else:
+        block.update(
+            {
+                # MLP: shard hidden dim over "model"
+                "w_in": P(None, "model"),
+                "w_out": P("model", None),
+            }
+        )
+    # scan-stacked: leading layer axis is unsharded
+    stacked = jax.tree.map(
+        lambda spec: P(None, *spec), block,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return {
+        "embed": P("model", None),  # vocab sharded over model axis
+        "blocks": stacked,
+        "ln_f": {"scale": P(None)},
+    }
+
+
+def batch_spec(cfg: ModelConfig) -> P:
+    """Sharding for (batch, seq) int32 token arrays."""
+    return P("data", "seq" if cfg.ring_attention else None)
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+def _dense_init(key, shape, dtype, scale=None):
+    fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+    scale = scale if scale is not None else fan_in ** -0.5
+    return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dtype)
+
+
+def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
+    dt = cfg.dtype
+    L, D, H, F = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.d_ff
+    hd = cfg.head_dim
+    keys = jax.random.split(key, 8)
+    block: Params = {
+        "ln1": {"scale": jnp.ones((L, D), jnp.float32)},
+        "ln2": {"scale": jnp.ones((L, D), jnp.float32)},
+        "wq": _dense_init(keys[0], (L, D, H * hd), dt),
+        "wk": _dense_init(keys[1], (L, D, H * hd), dt),
+        "wv": _dense_init(keys[2], (L, D, H * hd), dt),
+        "wo": _dense_init(keys[3], (L, H * hd, D), dt),
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        block["router"] = _dense_init(keys[4], (L, D, E), jnp.float32)
+        block["w_in"] = _dense_init(keys[5], (L, E, D, F), dt)
+        block["w_out"] = _dense_init(keys[6], (L, E, F, D), dt)
+    else:
+        block["w_in"] = _dense_init(keys[5], (L, D, F), dt)
+        block["w_out"] = _dense_init(keys[6], (L, F, D), dt)
+    return {
+        "embed": _dense_init(keys[7], (cfg.vocab_size, D), dt, scale=1.0),
+        "blocks": block,
+        "ln_f": {"scale": jnp.ones((D,), jnp.float32)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * rms * scale).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotary embeddings; x: (B, S, H, hd), positions: (S,)."""
+    hd = x.shape[-1]
+    freqs = 10000.0 ** (-jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Plain softmax attention; q/k/v: (B, S, H, hd), fp32 logits."""
+    hd = q.shape[-1]
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+        logits = jnp.where(mask[None, None], logits, -1e9)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _moe_mlp(x, router_w, w_in, w_out):
+    """Soft-routed MoE (top-1 via straight-through softmax weighting kept
+    dense — compiler-friendly: no gather/scatter, no dynamic shapes).
+    x: (B,S,D); w_in: (E,D,F); w_out: (E,F,D)."""
+    gates = jax.nn.softmax(
+        jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router_w), -1
+    )
+    h = jnp.einsum("bsd,edf->bsef", x, w_in,
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.gelu(h).astype(x.dtype)
+    y = jnp.einsum("bsef,efd->bsed", h, w_out,
+                   preferred_element_type=jnp.float32)
+    return jnp.einsum("bsed,bse->bsd", y, gates).astype(x.dtype)
+
+
+class TpuLM:
+    """Functional model bundle: ``init`` + ``apply`` (no mutable state)."""
+
+    def __init__(self, cfg: ModelConfig):
+        self.cfg = cfg
+
+    def init(self, key: jax.Array) -> Params:
+        return init_params(key, self.cfg)
+
+    def apply(
+        self,
+        params: Params,
+        tokens: jax.Array,
+        *,
+        mesh: Optional[Mesh] = None,
+    ) -> jax.Array:
+        """Logits for ``tokens`` (B, S) → (B, S, vocab).
+
+        With ``cfg.ring_attention`` and a ``mesh``, the sequence dim stays
+        sharded over the ``"seq"`` axis end to end: activations carry a
+        ``with_sharding_constraint`` and attention runs as ring attention
+        under a partial-manual ``jax.shard_map`` (manual over ``seq`` only;
+        ``data``/``model`` stay GSPMD-auto, so tensor parallelism still
+        comes from XLA's sharding propagation).
+        """
+        cfg = self.cfg
+        ring = cfg.ring_attention and mesh is not None
+        B, S = tokens.shape
+        x = params["embed"][tokens]  # (B, S, D) bf16
+        if ring:
+            from jax.sharding import NamedSharding
+
+            x = lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("data", "seq", None))
+            )
+        positions = jnp.arange(S, dtype=jnp.int32)
+
+        def block(x, layer):
+            h = _rmsnorm(x, layer["ln1"]["scale"])
+            q = jnp.einsum("bsd,dk->bsk", h, layer["wq"],
+                           preferred_element_type=jnp.float32)
+            k = jnp.einsum("bsd,dk->bsk", h, layer["wk"],
+                           preferred_element_type=jnp.float32)
+            v = jnp.einsum("bsd,dk->bsk", h, layer["wv"],
+                           preferred_element_type=jnp.float32)
+            q, k, v = (
+                t.astype(cfg.dtype).reshape(B, S, cfg.n_heads, cfg.head_dim)
+                for t in (q, k, v)
+            )
+            q = _rope(q, positions)
+            k = _rope(k, positions)
+            if ring:
+                from instaslice_tpu.workload.ring import ring_attention
+
+                attn = jax.shard_map(
+                    functools.partial(ring_attention, axis_name="seq"),
+                    mesh=mesh,
+                    in_specs=(P(None, "seq", None, None),) * 3,
+                    out_specs=P(None, "seq", None, None),
+                    axis_names={"seq"},
+                )(q, k, v)
+            else:
+                attn = _attention(q, k, v)
+            attn = attn.reshape(B, S, cfg.n_heads * cfg.head_dim)
+            x = x + jnp.einsum(
+                "bsk,kd->bsd", attn, layer["wo"],
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
+            h = _rmsnorm(x, layer["ln2"]["scale"])
+            if cfg.n_experts:
+                y = _moe_mlp(h, layer["router"], layer["w_in"],
+                             layer["w_out"])
+            else:
+                y = jnp.einsum("bsd,df->bsf", h, layer["w_in"],
+                               preferred_element_type=jnp.float32)
+                y = jax.nn.gelu(y).astype(cfg.dtype)
+                y = jnp.einsum("bsf,fd->bsd", y, layer["w_out"],
+                               preferred_element_type=jnp.float32
+                               ).astype(cfg.dtype)
+            return x + y, None
+
+        body = block
+        if cfg.remat:
+            body = jax.checkpoint(block)
+        x, _ = lax.scan(body, x, params["blocks"])
+        x = _rmsnorm(x, params["ln_f"]["scale"])
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+        return logits

@@ -1,0 +1,89 @@
+"""Ring attention: sequence/context parallelism over the slice's ICI ring.
+
+Long-context support for workloads running inside a granted slice: the
+sequence axis is sharded over the ``"seq"`` mesh axis, each device holds a
+contiguous block of tokens, and K/V blocks rotate around the ring with
+``lax.ppermute`` (neighbor hops — exactly what the placement engine's
+contiguous-rectangle guarantee makes cheap on ICI) while a flash-style
+online softmax accumulates the output. Memory per device is O(S/n) instead
+of O(S); communication overlaps with the per-block attention matmuls.
+
+Pattern follows the public ring-attention formulation (see PAPERS.md);
+implementation is original and compiler-friendly: static shapes, a
+``lax.scan`` over ring steps, fp32 accumulators, bf16 flows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = jnp.float32(-1e9)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    q/k/v: (B, S_local, H, hd) — this device's sequence block. Returns the
+    (B, S_local, H, hd) output block, numerically identical (up to fp
+    accumulation order) to full attention over the gathered sequence.
+    """
+    n = lax.psum(1, axis_name)  # static axis size
+    my = lax.axis_index(axis_name)
+    B, S, H, hd = q.shape
+    q32 = q.astype(jnp.float32) * (hd ** -0.5)
+    q_pos = my * S + jnp.arange(S)
+
+    o0 = jnp.zeros((B, H, S, hd), jnp.float32)
+    m0 = jnp.full((B, H, S), _NEG)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    # mark accumulators device-varying over the ring axis so the scan
+    # carry's varying-manual-axes annotation is consistent from step 0
+    _vary = getattr(lax, "pcast", None)
+    if _vary is not None:
+        o0, m0, l0 = (
+            _vary(t, axis_name, to="varying") for t in (o0, m0, l0)
+        )
+    else:  # pragma: no cover - older jax
+        o0, m0, l0 = (lax.pvary(t, (axis_name,)) for t in (o0, m0, l0))
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # after i rotations this device holds block (my - i) mod n
+        kv_idx = (my - i) % n
+        k_pos = kv_idx * S + jnp.arange(S)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        )
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, _NEG)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        if causal:
+            # re-zero fully-masked entries (exp(-1e9 - m) underflows to 0
+            # anyway once m is real, but the first blocks need it exact)
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m_new, l, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(n)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,151 @@
+"""Sharded training step for the flagship LM.
+
+One ``jit``-compiled step over the slice mesh: params live in the
+``param_specs`` layout (tensor-parallel weights sharded over ``model``),
+the batch is sharded over ``data`` (and ``seq`` for ring attention), and
+XLA inserts the gradient all-reduces. fp32 master weights + optimizer
+state, bf16 compute — the standard TPU mixed-precision recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from instaslice_tpu.workload.model import (
+    ModelConfig,
+    TpuLM,
+    batch_spec,
+    param_specs,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class TrainState:
+    step: jax.Array
+    params: Params
+    opt_state: Any
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.step, s.params, s.opt_state), None),
+    lambda _, c: TrainState(*c),
+)
+
+
+def loss_fn(
+    model: TpuLM,
+    params: Params,
+    tokens: jax.Array,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Next-token cross-entropy; tokens (B, S) predict tokens[:, 1:]."""
+    logits = model.apply(params, tokens, mesh=mesh)  # (B, S, V) fp32
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # last position has no target
+    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
+    return (nll * mask).sum() / mask.sum()
+
+
+def state_shardings(
+    mesh: Mesh, cfg: ModelConfig, opt_state_shape: Any
+) -> TrainState:
+    """NamedShardings for a TrainState (optimizer state follows params)."""
+    pspecs = param_specs(cfg)
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    params_sh = jax.tree.map(ns, pspecs, is_leaf=lambda x: isinstance(x, P))
+    # adamw state: (ScaleByAdamState(count, mu, nu), EmptyState) — mu/nu
+    # mirror the param tree, so reuse params_sh where shapes match.
+    flat_p, _ = jax.tree.flatten(params_sh)
+
+    def match(leaf):
+        shape = getattr(leaf, "shape", ())
+        if not shape:
+            return ns(P())
+        return None
+
+    opt_sh = jax.tree.map(
+        lambda leaf: match(leaf), opt_state_shape
+    )
+    # Replace None entries (param-shaped) positionally: mu and nu each have
+    # exactly the param tree's structure.
+    flat_o, tdef = jax.tree.flatten(opt_sh, is_leaf=lambda x: x is None)
+    pi = 0
+    out = []
+    for leaf in flat_o:
+        if leaf is None:
+            out.append(flat_p[pi % len(flat_p)])
+            pi += 1
+        else:
+            out.append(leaf)
+    if pi % len(flat_p) != 0:
+        raise ValueError(
+            f"optimizer state has {pi} param-shaped leaves, not a whole "
+            f"multiple of the {len(flat_p)} params — positional sharding "
+            "match would be wrong; adjust state_shardings for this optax "
+            "transform"
+        )
+    opt_sh = jax.tree.unflatten(tdef, out)
+    return TrainState(step=ns(P()), params=params_sh, opt_state=opt_sh)
+
+
+def make_train_step(
+    model: TpuLM,
+    mesh: Mesh,
+    learning_rate: float = 3e-4,
+) -> Tuple[Callable, Callable]:
+    """Returns ``(init_fn, step_fn)``, both jitted over ``mesh``.
+
+    ``init_fn(rng) -> TrainState`` materializes params *already sharded*
+    (out_shardings on the jit — no host-side full copy).
+    ``step_fn(state, tokens) -> (state, loss)``.
+    """
+    cfg = model.cfg
+    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.01)
+
+    def init(rng):
+        params = model.init(rng)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+    # shape-evaluate to build shardings for outputs
+    state_shape = jax.eval_shape(init, jax.random.key(0))
+    sh = state_shardings(mesh, cfg, state_shape.opt_state)
+    tok_sharding = NamedSharding(mesh, batch_spec(cfg))
+
+    init_fn = jax.jit(init, out_shardings=sh)
+
+    def step(state: TrainState, tokens: jax.Array):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, tokens, mesh)
+        )(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(state.step + 1, new_params, new_opt),
+            loss,
+        )
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(sh, tok_sharding),
+        out_shardings=(sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    return init_fn, step_fn

@@ -236,11 +236,24 @@ int tpuslice_reserve(const char* slice_uuid, const int* chip_ids, int n) {
     if (chip_ids[i] < 0) return TPUSLICE_EINVAL;
     if (!wanted.insert(chip_ids[i]).second) return TPUSLICE_EINVAL;
   }
-  for (const auto& r : live) {
+  // Same-uuid check FIRST: a retried reserve of an existing slice must
+  // report EEXIST (the agent's idempotency signal) even if device nodes
+  // are transiently absent (driver reload).
+  for (const auto& r : live)
     if (r.uuid == slice_uuid) return TPUSLICE_EEXIST;
+  // Requested ids must name chips that actually exist on this host — the
+  // same check the fake backend enforces; without it a misconfigured
+  // host_offset would "reserve" phantom chips and the failure would only
+  // surface when libtpu opens devices inside the workload pod.
+  std::vector<Chip> present;
+  scan_chips(&present);
+  std::set<int> have;
+  for (const auto& c : present) have.insert(c.id);
+  for (int w : wanted)
+    if (!have.count(w)) return TPUSLICE_ENODEV;
+  for (const auto& r : live)
     for (int c : r.chips)
       if (wanted.count(c)) return TPUSLICE_EBUSY;
-  }
 
   std::string final_path =
       path_join(g_registry, std::string(slice_uuid) + ".res");
@@ -298,7 +311,7 @@ const char* tpuslice_strerror(int code) {
   switch (code) {
     case TPUSLICE_OK: return "ok";
     case TPUSLICE_EINVAL: return "invalid argument";
-    case TPUSLICE_ENODEV: return "no TPU devices found";
+    case TPUSLICE_ENODEV: return "chip not on this host (no such TPU device)";
     case TPUSLICE_EBUSY: return "chips overlap a live reservation";
     case TPUSLICE_EEXIST: return "slice uuid already reserved";
     case TPUSLICE_ENOENT: return "no such slice";

@@ -9,9 +9,18 @@ hence module-level in conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the session may export JAX_PLATFORMS=axon (one
+# real chip via tunnel) — tests must still run on the virtual 8-CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Pytest plugins (jaxtyping) import jax before this conftest runs, so the
+# env vars above are snapshotted too late for jax.config — set it directly.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

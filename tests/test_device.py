@@ -89,6 +89,10 @@ class TestBackendContract:
         with pytest.raises(DeviceError):
             backend.reserve("s", [])
 
+    def test_unknown_chip_rejected(self, backend):
+        with pytest.raises(DeviceError, match="not on this host"):
+            backend.reserve("s", [99])
+
     def test_concurrent_reserves_no_double_grant(self, backend):
         """8 threads race for single chips; every chip granted once."""
         granted, errs = [], []
@@ -169,12 +173,6 @@ class TestFakeSpecifics:
         b2 = FakeTpuBackend()
         b2.restore(snap)
         assert b2.list_reservations()[0].slice_uuid == "zombie"
-
-    def test_unknown_chip_rejected(self):
-        b = FakeTpuBackend()
-        with pytest.raises(DeviceError, match="not on this host"):
-            b.reserve("s", [99])
-
 
 class TestSelect:
     def test_select_fake(self, monkeypatch):

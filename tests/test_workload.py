@@ -1,0 +1,190 @@
+"""Workload layer: mesh-from-env, sharded model, ring attention, train step.
+
+Runs on the virtual 8-device CPU mesh from conftest.py — the CI stand-in
+for a granted multi-chip slice (SURVEY.md §4 "BASELINE.json configs[0]
+... CPU emulator OK").
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from instaslice_tpu.workload.meshenv import (
+    SliceTopology,
+    slice_mesh,
+)
+from instaslice_tpu.workload.model import (
+    ModelConfig,
+    TpuLM,
+    _attention,
+    param_specs,
+)
+from instaslice_tpu.workload.ring import ring_attention
+from instaslice_tpu.workload.train import make_train_step
+
+
+def tiny(ring=False, experts=0):
+    return ModelConfig(
+        vocab_size=128,
+        d_model=32,
+        n_heads=4,
+        n_layers=2,
+        d_ff=64,
+        dtype=jnp.float32,  # exactness for CPU tests
+        ring_attention=ring,
+        n_experts=experts,
+        remat=False,
+    )
+
+
+class TestSliceTopology:
+    def test_from_env_single_host(self):
+        env = {
+            "TPU_WORKER_ID": "0",
+            "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+            "TPU_HOST_BOUNDS": "1,1,1",
+            "TPU_WORKER_HOSTNAMES": "pod-a",
+        }
+        t = SliceTopology.from_env(env)
+        assert t.num_chips == 4
+        assert t.num_workers == 1
+        assert t.slice_shape == (2, 2, 1)
+
+    def test_from_env_multi_host(self):
+        env = {
+            "TPU_WORKER_ID": "1",
+            "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+            "TPU_HOST_BOUNDS": "1,2,1",
+            "TPU_WORKER_HOSTNAMES": "w0,w1",
+        }
+        t = SliceTopology.from_env(env)
+        assert t.num_workers == 2
+        assert t.slice_shape == (2, 4, 1)
+        assert t.chips_per_worker == 4
+
+    def test_slice_mesh_respects_axis_sizes(self):
+        mesh = slice_mesh(
+            axes=("data", "seq", "model"), axis_sizes=(-1, 2, 2)
+        )
+        assert mesh.shape == {"data": 2, "seq": 2, "model": 2}
+
+    def test_slice_mesh_wildcard_errors(self):
+        with pytest.raises(ValueError):
+            slice_mesh(axes=("data",), axis_sizes=(3,))
+
+
+class TestRingAttention:
+    def test_matches_full_attention(self):
+        """Ring output == plain attention on the gathered sequence."""
+        n_seq = 4
+        devs = jax.devices()[:n_seq]
+        mesh = Mesh(np.array(devs).reshape(1, n_seq), ("data", "seq"))
+        B, S, H, hd = 2, 32, 2, 8
+        k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(k1, (B, S, H, hd), jnp.float32)
+        k = jax.random.normal(k2, (B, S, H, hd), jnp.float32)
+        v = jax.random.normal(k3, (B, S, H, hd), jnp.float32)
+
+        want = _attention(q, k, v, causal=True)
+
+        import functools
+
+        ring = jax.jit(
+            jax.shard_map(
+                functools.partial(ring_attention, axis_name="seq"),
+                mesh=mesh,
+                in_specs=(P(None, "seq", None, None),) * 3,
+                out_specs=P(None, "seq", None, None),
+            )
+        )
+        got = ring(q, k, v)
+        np.testing.assert_allclose(np.array(got), np.array(want),
+                                   rtol=2e-4, atol=2e-5)
+
+
+class TestModel:
+    def test_forward_shapes(self):
+        model = TpuLM(tiny())
+        params = model.init(jax.random.key(0))
+        logits = jax.jit(model.apply)(params, jnp.ones((2, 16), jnp.int32))
+        assert logits.shape == (2, 16, 128)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_moe_forward(self):
+        model = TpuLM(tiny(experts=4))
+        params = model.init(jax.random.key(0))
+        logits = jax.jit(model.apply)(params, jnp.ones((2, 8), jnp.int32))
+        assert logits.shape == (2, 8, 128)
+        assert bool(jnp.isfinite(logits).all())
+
+    def test_param_specs_cover_params(self):
+        cfg = tiny(experts=2)
+        model = TpuLM(cfg)
+        params = model.init(jax.random.key(0))
+        specs = param_specs(cfg)
+        # identical tree structure
+        jax.tree.map(
+            lambda p, s: None,
+            params,
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def test_causality(self):
+        """Changing a future token must not change past logits."""
+        model = TpuLM(tiny())
+        params = model.init(jax.random.key(1))
+        t1 = jnp.zeros((1, 16), jnp.int32)
+        t2 = t1.at[0, 10].set(5)
+        l1 = model.apply(params, t1)
+        l2 = model.apply(params, t2)
+        np.testing.assert_allclose(
+            np.array(l1[0, :10]), np.array(l2[0, :10]), atol=1e-5
+        )
+
+
+class TestTrainStep:
+    def test_sharded_train_step_runs(self):
+        devs = jax.devices()[:8]
+        mesh = Mesh(np.array(devs).reshape(2, 2, 2),
+                    ("data", "seq", "model"))
+        model = TpuLM(tiny(ring=True, experts=2))
+        init_fn, step_fn = make_train_step(model, mesh)
+        state = init_fn(jax.random.key(0))
+        tokens = jax.random.randint(
+            jax.random.key(1), (4, 64), 0, 128, jnp.int32
+        )
+        state, loss = step_fn(state, tokens)
+        state, loss2 = step_fn(state, tokens)
+        assert float(loss2) < float(loss) + 1.0
+        assert int(state.step) == 2
+        assert np.isfinite(float(loss))
+
+    def test_params_actually_sharded(self):
+        devs = jax.devices()[:8]
+        mesh = Mesh(np.array(devs).reshape(2, 1, 4),
+                    ("data", "seq", "model"))
+        model = TpuLM(tiny())
+        init_fn, _ = make_train_step(model, mesh)
+        state = init_fn(jax.random.key(0))
+        # tp weights sharded over 4 model-axis devices
+        wq = state.params["blocks"]["wq"]
+        shards = {s.device for s in wq.addressable_shards}
+        assert len(shards) == 8 or len(shards) == 4
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import __graft_entry__ as ge
+
+        fn, args = ge.entry()
+        out = jax.jit(fn)(*args)
+        assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
+
+    def test_dryrun_multichip(self):
+        import __graft_entry__ as ge
+
+        ge.dryrun_multichip(8)
