@@ -1,0 +1,7 @@
+"""Kubelet device plugin for ``google.com/tpu`` (SURVEY.md §2a row 3).
+
+The reference relies on the NVIDIA GPU operator's external plugin and only
+kicks it via a node-label toggle; here the plugin is in-tree: generated
+v1beta1 protobuf messages (``deviceplugin_pb2``), hand-rolled gRPC wiring
+(:mod:`wire`), and the plugin lifecycle (:mod:`server`).
+"""

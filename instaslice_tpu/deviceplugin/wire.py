@@ -1,0 +1,164 @@
+"""gRPC wiring for the kubelet device-plugin API, without grpcio-tools.
+
+The image ships ``grpcio`` + ``protoc`` but not the ``grpc_tools`` codegen
+plugin, so the protobuf *messages* are generated (``deviceplugin_pb2``) and
+the *service* surface — method routing, serializer pairs, client stubs —
+is declared here by hand against the stable v1beta1 method names
+(``/v1beta1.Registration/Register``, ``/v1beta1.DevicePlugin/...``).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from instaslice_tpu.deviceplugin import deviceplugin_pb2 as pb
+
+DEVICE_PLUGIN_SERVICE = "v1beta1.DevicePlugin"
+REGISTRATION_SERVICE = "v1beta1.Registration"
+API_VERSION = "v1beta1"
+KUBELET_SOCKET = "kubelet.sock"
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+def device_plugin_handler(servicer) -> grpc.GenericRpcHandler:
+    """Generic handler exposing ``servicer`` as v1beta1.DevicePlugin.
+
+    ``servicer`` provides GetDevicePluginOptions / ListAndWatch /
+    GetPreferredAllocation / Allocate / PreStartContainer with the usual
+    ``(request, context)`` signatures (ListAndWatch is a generator).
+    """
+    rpcs = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb.PreferredAllocationRequest.FromString,
+            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb.AllocateRequest.FromString,
+            response_serializer=pb.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb.PreStartContainerRequest.FromString,
+            response_serializer=pb.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(DEVICE_PLUGIN_SERVICE, rpcs)
+
+
+def registration_handler(servicer) -> grpc.GenericRpcHandler:
+    """v1beta1.Registration handler — served by kubelet; used here only by
+    the fake kubelet in tests."""
+    rpcs = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+    }
+    return grpc.method_handlers_generic_handler(REGISTRATION_SERVICE, rpcs)
+
+
+class RegistrationClient:
+    """Client stub for kubelet's Registration service."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        self._register = channel.unary_unary(
+            f"/{REGISTRATION_SERVICE}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )
+
+    def register(
+        self, endpoint: str, resource_name: str, *,
+        preferred_allocation: bool = True, timeout: float = 5.0,
+    ) -> None:
+        req = pb.RegisterRequest(
+            version=API_VERSION,
+            endpoint=endpoint,
+            resource_name=resource_name,
+            options=pb.DevicePluginOptions(
+                pre_start_required=False,
+                get_preferred_allocation_available=preferred_allocation,
+            ),
+        )
+        self._register(req, timeout=timeout)
+
+
+class DevicePluginClient:
+    """Client stub for a plugin's DevicePlugin service (kubelet's side of
+    the wire — used by tests and ``tpuslicectl`` diagnostics)."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        mk = channel.unary_unary
+        self._options = mk(
+            f"/{DEVICE_PLUGIN_SERVICE}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self._list_and_watch = channel.unary_stream(
+            f"/{DEVICE_PLUGIN_SERVICE}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self._preferred = mk(
+            f"/{DEVICE_PLUGIN_SERVICE}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self._allocate = mk(
+            f"/{DEVICE_PLUGIN_SERVICE}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self._pre_start = mk(
+            f"/{DEVICE_PLUGIN_SERVICE}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
+
+    def options(self, timeout: float = 5.0) -> "pb.DevicePluginOptions":
+        return self._options(pb.Empty(), timeout=timeout)
+
+    def list_and_watch(self):
+        """Yields ListAndWatchResponse until the stream is cancelled."""
+        return self._list_and_watch(pb.Empty())
+
+    def preferred(self, available, size, must_include=(), timeout=5.0):
+        req = pb.PreferredAllocationRequest(
+            container_requests=[
+                pb.ContainerPreferredAllocationRequest(
+                    available_deviceIDs=list(available),
+                    must_include_deviceIDs=list(must_include),
+                    allocation_size=size,
+                )
+            ]
+        )
+        return self._preferred(req, timeout=timeout)
+
+    def allocate(self, device_ids, timeout: float = 5.0):
+        req = pb.AllocateRequest(
+            container_requests=[
+                pb.ContainerAllocateRequest(devicesIDs=list(device_ids))
+            ]
+        )
+        return self._allocate(req, timeout=timeout)
+
+    def pre_start(self, device_ids, timeout: float = 5.0):
+        return self._pre_start(
+            pb.PreStartContainerRequest(devicesIDs=list(device_ids)),
+            timeout=timeout,
+        )

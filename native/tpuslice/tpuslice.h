@@ -34,7 +34,7 @@ extern "C" {
 
 #define TPUSLICE_OK 0
 #define TPUSLICE_EINVAL -1      /* bad arguments / malformed JSON */
-#define TPUSLICE_ENODEV -2      /* no TPU chips found */
+#define TPUSLICE_ENODEV -2      /* a requested chip id is not on this host */
 #define TPUSLICE_EBUSY -3       /* requested chips overlap a reservation */
 #define TPUSLICE_EEXIST -4      /* slice uuid already reserved */
 #define TPUSLICE_ENOENT -5      /* no such slice uuid */
