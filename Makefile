@@ -1,0 +1,78 @@
+# Build plane (reference analog: Makefile:79-174 — build / docker-build /
+# install / deploy / test / test-e2e via kustomize + controller-gen; here
+# the manifests are generated from Python and the native lib via make).
+
+PY ?= python
+IMG_PREFIX ?= instaslice-tpu
+TAG ?= latest
+KUBECTL ?= kubectl
+PROTOC ?= protoc
+
+.PHONY: all
+all: native manifests test
+
+# ---------------------------------------------------------------- codegen
+
+.PHONY: manifests
+manifests:  ## Regenerate config/crd/bases from instaslice_tpu.api.crd
+	$(PY) tools/gen_manifests.py
+
+.PHONY: proto
+proto:  ## Regenerate device-plugin protobuf messages
+	$(PROTOC) -I instaslice_tpu/deviceplugin/proto \
+	  --python_out=instaslice_tpu/deviceplugin \
+	  instaslice_tpu/deviceplugin/proto/deviceplugin.proto
+
+# ----------------------------------------------------------------- native
+
+.PHONY: native
+native:  ## Build libtpuslice.so + its C++ test binary
+	$(MAKE) -C native
+
+.PHONY: native-test
+native-test: native
+	native/build/tpuslice_test
+
+# ------------------------------------------------------------------ tests
+
+.PHONY: test
+test:  ## Unit + integration tests (fake kube, fake TPU, virtual CPU mesh)
+	$(PY) -m pytest tests/ -x -q
+
+.PHONY: test-e2e
+test-e2e:  ## Full in-process cluster lifecycle tier
+	$(PY) -m pytest tests/test_e2e_lifecycle.py -q
+
+.PHONY: bench
+bench:  ## Headline benchmark: slice-grant p50 latency (one JSON line)
+	$(PY) bench.py
+
+.PHONY: verify-manifests
+verify-manifests:  ## Fail if checked-in CRD yaml drifted from the code
+	$(PY) tools/gen_manifests.py --check
+
+# ----------------------------------------------------------------- images
+
+.PHONY: docker-build
+docker-build:  ## Controller, agent, and device-plugin images
+	docker build -f Dockerfile.controller -t $(IMG_PREFIX)-controller:$(TAG) .
+	docker build -f Dockerfile.agent -t $(IMG_PREFIX)-agent:$(TAG) .
+	docker build -f Dockerfile.deviceplugin -t $(IMG_PREFIX)-deviceplugin:$(TAG) .
+
+# ----------------------------------------------------------------- deploy
+
+.PHONY: install
+install: manifests  ## Install the TpuSlice CRD
+	$(KUBECTL) apply -f config/crd/bases/
+
+.PHONY: uninstall
+uninstall:
+	$(KUBECTL) delete -f config/crd/bases/ --ignore-not-found
+
+.PHONY: deploy
+deploy: install  ## CRD + RBAC + controller/agent/device-plugin workloads
+	$(KUBECTL) apply -k config/default
+
+.PHONY: undeploy
+undeploy:
+	$(KUBECTL) delete -k config/default --ignore-not-found

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Cluster bootstrap (reference analog: deploy/setup.sh — KinD + GPU
+# operator + MIG all-balanced labels). TPU variant: a KinD cluster with
+# fake-TPU nodes for e2e, or label pass-through on a real TPU node pool.
+#
+#   ./deploy/setup.sh kind   — KinD cluster, nodes labeled as fake v5e hosts
+#   ./deploy/setup.sh real   — label real TPU nodes for the agent DaemonSet
+set -euo pipefail
+
+MODE="${1:-kind}"
+CLUSTER="${CLUSTER:-instaslice-tpu}"
+
+case "$MODE" in
+  kind)
+    command -v kind >/dev/null || { echo "kind not installed"; exit 1; }
+    kind get clusters 2>/dev/null | grep -qx "$CLUSTER" || \
+      kind create cluster --name "$CLUSTER"
+    # Label every worker as a fake v5e host; the agent's backend=auto
+    # falls back to the fake backend when no /dev/accel* exists, so the
+    # full allocation lifecycle runs without TPU hardware
+    # (SURVEY.md §4: the reference's e2e never touches a GPU either).
+    for n in $(kubectl get nodes -o name); do
+      kubectl label --overwrite "$n" tpu.instaslice.dev/tpu-node=true
+    done
+    make docker-build
+    kind load docker-image --name "$CLUSTER" \
+      instaslice-tpu-controller:latest \
+      instaslice-tpu-agent:latest \
+      instaslice-tpu-deviceplugin:latest
+    make deploy
+    kubectl -n instaslice-tpu-system rollout status \
+      deploy/instaslice-tpu-controller-manager --timeout=120s
+    ;;
+  real)
+    # GKE TPU node pools carry cloud.google.com/gke-tpu-topology etc.;
+    # mark them for the agent + device-plugin DaemonSets.
+    kubectl get nodes -l cloud.google.com/gke-tpu-accelerator -o name | \
+      while read -r n; do
+        kubectl label --overwrite "$n" tpu.instaslice.dev/tpu-node=true
+      done
+    make deploy
+    ;;
+  *)
+    echo "usage: $0 [kind|real]"; exit 2;;
+esac

@@ -153,16 +153,16 @@ class NodeAgent:
         for pod in alloc.pods_on_node(self.node_name):
             env = slice_env(alloc, pod, self.node_name, ts.spec.generation)
             cm = configmap_manifest(
-                pod.pod_name, pod.namespace, env, owner_pod_uid=pod.pod_uuid
+                pod.handoff, pod.namespace, env, owner_pod_uid=pod.pod_uuid
             )
             try:
                 self.client.create("ConfigMap", cm)
             except AlreadyExists:
                 self.client.patch(
-                    "ConfigMap", pod.namespace, pod.pod_name,
+                    "ConfigMap", pod.namespace, pod.handoff,
                     {"data": env},
                 )
-            self._patch_node_capacity(pod.pod_name, add=True)
+            self._patch_node_capacity(pod.handoff, add=True)
 
         wid, local_key = alloc.parts[self.node_name]
         part = PreparedPart(
@@ -245,10 +245,10 @@ class NodeAgent:
             return
         for pod in alloc.pods_on_node(self.node_name):
             try:
-                self.client.delete("ConfigMap", pod.namespace, pod.pod_name)
+                self.client.delete("ConfigMap", pod.namespace, pod.handoff)
             except NotFound:
                 pass
-            self._patch_node_capacity(pod.pod_name, add=False)
+            self._patch_node_capacity(pod.handoff, add=False)
 
         def mut(obj: dict) -> Optional[dict]:
             cur = TpuSlice.from_manifest(obj)
@@ -275,13 +275,14 @@ class NodeAgent:
 
     # ---------------------------------------------------------------- node
 
-    def _patch_node_capacity(self, pod_name: str, add: bool) -> None:
+    def _patch_node_capacity(self, handoff_name: str, add: bool) -> None:
         """Advertise/remove the per-pod extended resource on the Node
         (reference: ``createInstaSliceResource`` /
         ``cleanUpInstaSliceResource``, instaslice_daemonset.go:277-300,
         415-440). The per-pod resource is what pins the pod to the node
-        that realized its slice."""
-        res = f"{POD_RESOURCE_PREFIX}{pod_name}"
+        that realized its slice; named by the pod's handoff name (pod name,
+        or the stable handoff-name annotation for template-managed pods)."""
+        res = f"{POD_RESOURCE_PREFIX}{handoff_name}"
         val = "1" if add else None
         try:
             self.client.patch_status(

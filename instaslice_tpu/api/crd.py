@@ -22,6 +22,7 @@ _ALLOCATION_PROPS = {
                 "podName": {"type": "string"},
                 "namespace": {"type": "string"},
                 "workerId": {"type": "integer"},
+                "handoffName": {"type": "string"},
             },
             "required": ["podUUID", "podName"],
         },

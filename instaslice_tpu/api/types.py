@@ -88,14 +88,26 @@ class PodRef:
     pod_name: str
     namespace: str
     worker_id: int = 0
+    # Stable name for the handoff ConfigMap + per-pod extended resource
+    # when the pod is template-managed (Deployment pods get generated
+    # names, so a fixed ``envFrom`` / resource limit in the template can't
+    # reference the real pod name). "" = use pod_name.
+    handoff_name: str = ""
+
+    @property
+    def handoff(self) -> str:
+        return self.handoff_name or self.pod_name
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "podUUID": self.pod_uuid,
             "podName": self.pod_name,
             "namespace": self.namespace,
             "workerId": self.worker_id,
         }
+        if self.handoff_name:
+            d["handoffName"] = self.handoff_name
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "PodRef":
@@ -104,6 +116,7 @@ class PodRef:
             pod_name=d["podName"],
             namespace=d.get("namespace", ""),
             worker_id=int(d.get("workerId", 0)),
+            handoff_name=d.get("handoffName", ""),
         )
 
 

@@ -21,6 +21,10 @@ from instaslice_tpu.topology.profiles import TopologyProfile, parse_profile_name
 PROFILE_ANNOTATION = f"{GROUP}/profile"
 GROUP_ANNOTATION = f"{GROUP}/group"
 GROUP_SIZE_ANNOTATION = f"{GROUP}/group-size"
+# Stable handoff name for template-managed pods (Deployment/Job pods get
+# generated names; their template's envFrom + per-pod resource limit need
+# a fixed name to reference — see samples/vllm-tpu.yaml).
+HANDOFF_ANNOTATION = f"{GROUP}/handoff-name"
 
 _RESOURCE_RE = re.compile(r"tpu-(v\d+[a-z]*-\d+x\d+(?:x\d+)?)$")
 

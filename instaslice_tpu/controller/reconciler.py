@@ -31,6 +31,7 @@ from instaslice_tpu.api import (
 )
 from instaslice_tpu.controller.gates import (
     GROUP_SIZE_ANNOTATION,
+    HANDOFF_ANNOTATION,
     extract_profile,
     is_pod_gated,
     pod_group,
@@ -332,6 +333,25 @@ class Controller:
             if len(peers) < size:
                 return 1.0  # wait for the rest of the group
             pods = peers[:size]
+            # A stable handoff name is per-POD state (ConfigMap + node
+            # resource); a template-stamped identical name across a
+            # multi-pod group would make agents overwrite each other's
+            # worker env and tear down the survivor's ConfigMap. Refuse it.
+            handoffs = [
+                (p["metadata"].get("annotations") or {}).get(
+                    HANDOFF_ANNOTATION, ""
+                )
+                for p in pods
+            ]
+            named = [h for h in handoffs if h]
+            if named and len(set(named)) < len(pods):
+                self._annotate_error(
+                    pod,
+                    f"pod group {gid!r}: {HANDOFF_ANNOTATION} must be "
+                    "unique per pod (or omitted) in a multi-host group — "
+                    "grouped pods each need their own handoff ConfigMap",
+                )
+                return None
             if not any(
                 p["metadata"].get("uid") == md.get("uid") for p in pods
             ):
@@ -364,6 +384,9 @@ class Controller:
                 pod_name=p["metadata"]["name"],
                 namespace=p["metadata"].get("namespace", ""),
                 worker_id=i,
+                handoff_name=(p["metadata"].get("annotations") or {}).get(
+                    HANDOFF_ANNOTATION, ""
+                ),
             )
             for i, p in enumerate(
                 sorted(pods, key=lambda p: p["metadata"]["name"])

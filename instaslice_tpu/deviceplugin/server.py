@@ -35,6 +35,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 import grpc
 
+from instaslice_tpu import GROUP
 from instaslice_tpu.device.backend import DeviceBackend, DeviceError
 from instaslice_tpu.deviceplugin import deviceplugin_pb2 as pb
 from instaslice_tpu.deviceplugin.wire import (
@@ -53,6 +54,7 @@ DEFAULT_RESOURCE = "google.com/tpu"
 DEFAULT_PLUGIN_DIR = "/var/lib/kubelet/device-plugins"
 SOCKET_NAME = "tpuslice.sock"
 DEVICE_ID_PREFIX = "tpu-"
+CHIPS_ANNOTATION = f"{GROUP}/chips"
 
 
 def device_id(chip_id: int) -> str:
@@ -177,7 +179,7 @@ class TpuDevicePluginServicer:
                 str(c) for c in chips
             )
             cresp.envs["TPU_PLATFORM"] = self._p.generation
-            cresp.annotations["tpu.instaslice.dev/chips"] = ",".join(
+            cresp.annotations[CHIPS_ANNOTATION] = ",".join(
                 str(c) for c in chips
             )
             resp.container_responses.append(cresp)
@@ -323,7 +325,10 @@ class TpuDevicePlugin:
 
     def _watch_kubelet(self) -> None:
         """Kubelet restart wipes the plugin dir: when our socket vanishes,
-        re-serve and re-register (the standard plugin liveness dance)."""
+        re-serve and re-register (the standard plugin liveness dance).
+        Keeps retrying while kubelet is down — a node upgrade can exceed
+        any single registration timeout, and giving up would leave the
+        node without google.com/tpu capacity until a manual restart."""
         while self.running:
             if not os.path.exists(self.socket_path):
                 log.warning("plugin socket removed (kubelet restart?); "
@@ -331,9 +336,11 @@ class TpuDevicePlugin:
                 try:
                     self.stop(keep_running_flag=True)
                     self.start()
+                    return  # start() spawned a fresh watcher
                 except (DeviceError, OSError) as e:
-                    log.error("re-registration failed: %s", e)
-                return  # start() spawned a fresh watcher
+                    log.error("re-registration failed (will retry): %s", e)
+                    time.sleep(self.health_poll_seconds)
+                    continue
             time.sleep(self.health_poll_seconds)
 
     def stop(self, keep_running_flag: bool = False) -> None:

@@ -116,6 +116,7 @@ class SimCluster:
         namespace: str = "default",
         group: str = "",
         group_size: int = 0,
+        annotations: Optional[dict] = None,
     ) -> dict:
         """The samples/test-pod.yaml analog: scheduling-gated, finalized,
         profile annotation + per-pod extended resource request + envFrom
@@ -124,6 +125,8 @@ class SimCluster:
         if group:
             ann[GROUP_ANNOTATION] = group
             ann[GROUP_SIZE_ANNOTATION] = str(group_size)
+        if annotations:
+            ann.update(annotations)
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -150,10 +153,13 @@ class SimCluster:
         }
 
     def submit(self, name: str, profile: str, namespace: str = "default",
-               group: str = "", group_size: int = 0) -> dict:
+               group: str = "", group_size: int = 0,
+               annotations: Optional[dict] = None) -> dict:
         return self.kube.create(
             "Pod",
-            self.pod_manifest(name, profile, namespace, group, group_size),
+            self.pod_manifest(
+                name, profile, namespace, group, group_size, annotations
+            ),
         )
 
     def delete_pod(self, name: str, namespace: str = "default") -> None:

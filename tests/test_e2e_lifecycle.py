@@ -226,6 +226,29 @@ class TestMultiHost:
         n1 = cluster2.pod("w-1")["spec"]["nodeName"]
         assert {n0, n1} == {"node-0", "node-1"}
 
+    def test_shared_handoff_name_in_group_is_rejected(self, cluster2):
+        """A template-stamped identical handoff-name across a group would
+        make agents overwrite each other's worker env; the controller must
+        refuse the allocation and surface the error on the pod."""
+        from instaslice_tpu.controller.gates import HANDOFF_ANNOTATION
+
+        shared = {HANDOFF_ANNOTATION: "shared-name"}
+        cluster2.submit("g-0", "v5e-4x4", group="job-x", group_size=2,
+                        annotations=shared)
+        cluster2.submit("g-1", "v5e-4x4", group="job-x", group_size=2,
+                        annotations=shared)
+        deadline = time.monotonic() + 10
+        err = None
+        while time.monotonic() < deadline and not err:
+            for name in ("g-0", "g-1"):
+                ann = cluster2.pod(name)["metadata"].get("annotations", {})
+                err = err or ann.get("tpu.instaslice.dev/error")
+            time.sleep(0.1)
+        assert err and "handoff-name" in err, err
+        assert cluster2.pod_phase("g-0") == "Pending"
+        assert cluster2.pod_phase("g-1") == "Pending"
+        assert not cluster2.allocations()
+
     def test_group_teardown_releases_both_hosts(self, cluster2):
         cluster2.submit("w-0", "v5e-4x4", group="job-a", group_size=2)
         cluster2.submit("w-1", "v5e-4x4", group="job-a", group_size=2)

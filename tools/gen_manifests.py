@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Regenerate config/crd/bases from the in-code schema.
+
+The reference generates its CRD with controller-gen from Go struct markers
+(``api/v1alpha1/instaslice_types.go`` → ``config/crd/bases/
+inference.codeflare.dev_instaslices.yaml``); here the single source of
+truth is :func:`instaslice_tpu.api.crd.crd_manifest` and this script is
+the ``make manifests`` analog. ``tests/test_manifests.py`` fails if the
+checked-in YAML drifts from the code.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def crd_path() -> str:
+    from instaslice_tpu import GROUP, PLURAL
+
+    return os.path.join(
+        REPO, "config", "crd", "bases", f"{PLURAL}.{GROUP}.yaml"
+    )
+
+
+def render_crd() -> str:
+    from instaslice_tpu.api.crd import crd_manifest
+
+    return yaml.safe_dump(crd_manifest(), sort_keys=False)
+
+
+def main() -> int:
+    path = crd_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    content = render_crd()
+    if "--check" in sys.argv:
+        with open(path) as f:
+            if f.read() != content:
+                print(f"{path} is stale; run tools/gen_manifests.py",
+                      file=sys.stderr)
+                return 1
+        return 0
+    with open(path, "w") as f:
+        f.write(content)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
