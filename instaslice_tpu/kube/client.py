@@ -44,7 +44,10 @@ class BadRequest(ApiError):
     code = 400
 
 
-#: A watch event: ("ADDED" | "MODIFIED" | "DELETED", manifest-dict)
+#: A watch event: ("ADDED" | "MODIFIED" | "DELETED", manifest-dict), or
+#: ("BOOKMARK", {"metadata": {"resourceVersion": ...}}) — a metadata-only
+#: resume-point marker emitted at the end of every establishment burst,
+#: never an object event; consumers must skip it when reading object fields
 WatchEvent = Tuple[str, dict]
 
 
@@ -96,9 +99,23 @@ class KubeClient(abc.ABC):
         namespace: Optional[str] = None,
         replay: bool = True,
         timeout: Optional[float] = None,
+        resource_version: Optional[str] = None,
     ) -> Iterator[WatchEvent]:
         """Stream events. ``replay=True`` first yields current objects as
-        synthetic ADDED events (the informer list+watch pattern)."""
+        synthetic ADDED events (the informer list+watch pattern).
+        ``resource_version`` resumes after that version instead: events
+        newer than it are replayed so nothing emitted while the watch was
+        down is lost; an implementation may fall back to a relist (plus
+        whatever log tail it retains — possibly duplicated/reordered, so
+        consumers must be level-triggered) when it can no longer resume
+        exactly (the 410-Gone contract). ``replay=True`` combined with
+        ``resource_version`` does both: relist AND replay events after the
+        version (a resync that cannot lose deletions — a relist alone
+        never shows objects deleted while the watch was down). The
+        establishment burst ends with
+        a ``("BOOKMARK", {"metadata": {"resourceVersion": ...}})`` event
+        carrying only the current head version, for advancing the resume
+        point; it is not an object event."""
 
 
 def update_with_retry(

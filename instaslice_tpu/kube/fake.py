@@ -55,11 +55,17 @@ class _Watcher:
 
 
 class FakeKube(KubeClient):
+    #: events retained for resourceVersion-resumed watches; beyond this a
+    #: resume gets the 410-Gone treatment (full relist) like the real API
+    HISTORY_MAX = 50_000
+
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._objects: Dict[_Key, dict] = {}
         self._rv = 0
         self._watchers: List[_Watcher] = []
+        #: (seq, event, kind, namespace, snapshot) — event log for resume
+        self._history: List[Tuple[int, str, str, str, dict]] = []
         self.request_count = 0  # observability for tests/bench
 
     # ------------------------------------------------------------- helpers
@@ -78,9 +84,27 @@ class FakeKube(KubeClient):
     def _emit(self, event: str, kind: str, obj: dict) -> None:
         ns = obj.get("metadata", {}).get("namespace", "")
         snapshot = copy.deepcopy(obj)
+        if event == "DELETED":
+            # the stored rv is stale at deletion time; stamp the event with
+            # a fresh one so resumed watches order it after the last update
+            # (the real API server does the same)
+            snapshot.setdefault("metadata", {})["resourceVersion"] = (
+                self._next_rv()
+            )
+        try:
+            seq = int(snapshot["metadata"].get("resourceVersion") or self._rv)
+        except (ValueError, KeyError):
+            seq = self._rv
+        # `snapshot` stays private to the log (every delivery below and in
+        # replay hands out its own copy), so no extra copy needed here.
+        # Trim in chunks: a per-write front-del would memmove the whole
+        # list on every emit at steady state.
+        self._history.append((seq, event, kind, ns, snapshot))
+        if len(self._history) > 2 * self.HISTORY_MAX:
+            del self._history[: len(self._history) - self.HISTORY_MAX]
         for w in list(self._watchers):
             if w.matches(kind, ns):
-                w.q.put((event, snapshot))
+                w.q.put((event, copy.deepcopy(snapshot)))
 
     # -------------------------------------------------------------- client
 
@@ -222,13 +246,61 @@ class FakeKube(KubeClient):
         namespace: Optional[str] = None,
         replay: bool = True,
         timeout: Optional[float] = None,
+        resource_version: Optional[str] = None,
     ) -> Iterator[WatchEvent]:
+        """``resource_version`` resumes the stream after that version: every
+        event with a newer version is replayed from the in-memory log before
+        live events, so a re-established watch misses nothing (the informer
+        relist+resume contract). A version older than the retained log gets
+        a relist PLUS the retained log tail — the 410-Gone fallback; tail
+        replay keeps recent DELETED events visible even then, at the cost
+        of possible duplicates/reordering (safe for level-triggered
+        consumers, which re-read state on reconcile anyway). ``replay=True``
+        together with ``resource_version`` relists AND replays — the
+        deletion-safe resync.
+
+        Every establishment burst ends with a ``BOOKMARK`` event carrying
+        only the current head resourceVersion, so consumers can advance
+        their resume point even when no real events match their filter."""
         w = _Watcher(kind, namespace)
+
+        def _relist() -> None:
+            for (k, ns, _), obj in sorted(self._objects.items()):
+                if k == kind and (namespace is None or ns == namespace):
+                    w.q.put(("ADDED", copy.deepcopy(obj)))
+
+        def _replay_log(after: int) -> None:
+            for seq, ev, k, ns, snap in self._history:
+                if (
+                    seq > after
+                    and k == kind
+                    and (namespace is None or ns == namespace)
+                ):
+                    w.q.put((ev, copy.deepcopy(snap)))
+
         with self._lock:
-            if replay:
-                for (k, ns, _), obj in sorted(self._objects.items()):
-                    if k == kind and (namespace is None or ns == namespace):
-                        w.q.put(("ADDED", copy.deepcopy(obj)))
+            rv: Optional[int] = None
+            if resource_version is not None:
+                try:
+                    rv = int(resource_version)
+                except ValueError:
+                    rv = None
+            if rv is not None:
+                resumable = (
+                    not self._history or self._history[0][0] <= rv + 1
+                )
+                # relist when asked (resync) or forced (log truncated past
+                # the resume point); always replay the usable log tail so
+                # DELETED events — invisible to any relist — still arrive
+                if replay or not resumable:
+                    _relist()
+                _replay_log(after=rv)
+            elif replay:
+                _relist()
+            w.q.put(
+                ("BOOKMARK",
+                 {"metadata": {"resourceVersion": str(self._rv)}})
+            )
             self._watchers.append(w)
 
         def _iter() -> Iterator[WatchEvent]:

@@ -126,18 +126,36 @@ class Manager:
     def _watch_loop(self, kind: str, namespace: Optional[str], fn: MapFunc):
         # Replay (list+watch) on the first establishment and then once per
         # resync_period — not on every re-establishment, which would
-        # re-reconcile every object ~4x/sec on a quiet cluster.
-        last_replay = 0.0
+        # re-reconcile every object ~4x/sec on a quiet cluster. Between
+        # replays, re-establish with the last seen resourceVersion so
+        # events emitted while the watch was down are replayed, not lost.
+        last_replay = 0.0  # monotonic is large at boot → first pass replays
+        # "0" = resume from the beginning of the event log, so that even a
+        # watch that has never seen an event (empty store at startup) can't
+        # lose ones emitted while it was re-establishing
+        last_rv = "0"
         while not self._stop.is_set():
             replay = time.monotonic() - last_replay >= self.resync_period
             if replay:
                 last_replay = time.monotonic()
             try:
+                # resource_version is ALWAYS passed: a resync relist alone
+                # cannot show objects deleted while the watch was down, so
+                # the log replay must ride along with it
                 for event, obj in self.client.watch(
-                    kind, namespace=namespace, replay=replay, timeout=0.25
+                    kind,
+                    namespace=namespace,
+                    replay=replay,
+                    timeout=0.25,
+                    resource_version=last_rv,
                 ):
                     if self._stop.is_set():
                         return
+                    rv = obj.get("metadata", {}).get("resourceVersion")
+                    if rv:
+                        last_rv = rv
+                    if event == "BOOKMARK":
+                        continue  # resume-point advance only, no object
                     for key in fn(event, obj):
                         self.queue.add(key)
             except Exception:
