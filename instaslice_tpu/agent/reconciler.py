@@ -53,6 +53,11 @@ log = logging.getLogger("instaslice_tpu.agent")
 # occupancy computation); re-exported via the import above.
 
 
+#: synthetic workqueue key driving the periodic chip-health sweep ("#" can
+#: never collide with a node name)
+HEALTH_KEY = "#health"
+
+
 class NodeAgent:
     def __init__(
         self,
@@ -61,12 +66,14 @@ class NodeAgent:
         node_name: str,
         namespace: str = "instaslice-tpu-system",
         metrics=None,
+        health_interval: float = 10.0,
     ) -> None:
         self.client = client
         self.backend = backend
         self.node_name = node_name
         self.namespace = namespace
         self.metrics = metrics
+        self.health_interval = health_interval
         self.manager = Manager(
             name=f"agent-{node_name}",
             client=client,
@@ -94,6 +101,8 @@ class NodeAgent:
         self.boot()
         self.manager.start()
         self.manager.queue.add(self.node_name)
+        if self.health_interval > 0:
+            self.manager.queue.add(HEALTH_KEY, delay=self.health_interval)
 
     def stop(self) -> None:
         self.manager.stop()
@@ -101,6 +110,8 @@ class NodeAgent:
     # ----------------------------------------------------------- reconcile
 
     def reconcile(self, key: str) -> Optional[float]:
+        if key == HEALTH_KEY:
+            return self._health_sweep()
         if key != self.node_name:
             return None
         try:
@@ -209,11 +220,16 @@ class NodeAgent:
             self.node_name, alloc.alloc_id, alloc.profile, chip_ids,
         )
 
-    def _mark_failed(self, alloc_id: str, message: str) -> None:
+    def _mark_failed(
+        self,
+        alloc_id: str,
+        message: str,
+        from_statuses=(AllocationStatus.CREATING,),
+    ) -> None:
         def mut(obj: dict) -> Optional[dict]:
             cur = TpuSlice.from_manifest(obj)
             a = cur.spec.allocations.get(alloc_id)
-            if a is None or a.status != AllocationStatus.CREATING:
+            if a is None or a.status not in from_statuses:
                 return None
             a.set_status(AllocationStatus.FAILED, message)
             return cur.to_manifest()
@@ -272,6 +288,141 @@ class NodeAgent:
             self.client, "TpuSlice", self.namespace, self.node_name, mut
         )
         log.info("%s: tore down %s", self.node_name, alloc.alloc_id)
+
+    # -------------------------------------------------------------- health
+
+    def _health_sweep(self) -> float:
+        """Periodic per-chip health check (no reference analog: SURVEY.md
+        §5 — "no health monitoring of slices"). Publishes failed chip ids
+        to ``status.unhealthyChips`` (placement avoids them), fails
+        in-flight allocations touching them, and for granted slices either
+        annotates the consumer pods or — when they opt in via
+        ``tpu.instaslice.dev/restart-on-failure`` — deletes them so their
+        managing controller respawns onto healthy chips (elastic
+        recovery)."""
+        try:
+            health = self.backend.chip_health()
+        except DeviceError as e:
+            log.warning("%s: chip health probe failed: %s",
+                        self.node_name, e)
+            if self.metrics:
+                self.metrics.device_errors.inc()
+            return self.health_interval
+        failed = sorted(i for i, ok in health.items() if not ok)
+        if self.metrics:
+            self.metrics.unhealthy_chips.labels(
+                node=self.node_name
+            ).set(len(failed))
+
+        def mut(obj: dict) -> Optional[dict]:
+            cur = TpuSlice.from_manifest(obj)
+            if sorted(cur.status.unhealthy_chips) == failed:
+                return None
+            cur.status.unhealthy_chips = failed
+            return cur.to_manifest()
+
+        try:
+            stored = update_with_retry(
+                self.client, "TpuSlice", self.namespace, self.node_name, mut
+            )
+            if stored is None:  # no-op write: status already current
+                stored = self.client.get(
+                    "TpuSlice", self.namespace, self.node_name
+                )
+        except NotFound:
+            return self.health_interval
+        ts = TpuSlice.from_manifest(stored)
+        failed_set = set(failed)
+        for alloc_id in sorted(ts.spec.allocations):
+            alloc = ts.spec.allocations[alloc_id]
+            if self.node_name not in alloc.parts:
+                continue
+            dead = failed_set.intersection(self._chip_ids_for(ts, alloc))
+            if not dead:
+                # chips healthy (again): clear any stale degraded marker
+                if alloc.status == AllocationStatus.UNGATED:
+                    self._set_unhealthy_annotation(alloc, None)
+                continue
+            msg = f"{self.node_name}: chips {sorted(dead)} unhealthy"
+            if alloc.status in (
+                AllocationStatus.CREATING,
+                AllocationStatus.CREATED,
+            ):
+                log.warning("failing in-flight allocation %s: %s",
+                            alloc_id, msg)
+                self._mark_failed(
+                    alloc_id, msg,
+                    from_statuses=(
+                        AllocationStatus.CREATING,
+                        AllocationStatus.CREATED,
+                    ),
+                )
+            elif alloc.status == AllocationStatus.UNGATED:
+                self._handle_unhealthy_granted(alloc, msg)
+        return self.health_interval
+
+    def _handle_unhealthy_granted(
+        self, alloc: AllocationDetails, message: str
+    ) -> None:
+        from instaslice_tpu.controller.gates import (
+            RESTART_ON_FAILURE_ANNOTATION,
+        )
+
+        for pod in alloc.pods_on_node(self.node_name):
+            try:
+                obj = self.client.get("Pod", pod.namespace, pod.pod_name)
+            except NotFound:
+                continue
+            md = obj.get("metadata", {})
+            if md.get("deletionTimestamp"):
+                continue
+            ann = md.get("annotations") or {}
+            if ann.get(RESTART_ON_FAILURE_ANNOTATION) == "true":
+                log.warning(
+                    "evicting pod %s/%s: %s (restart-on-failure)",
+                    pod.namespace, pod.pod_name, message,
+                )
+                try:
+                    self.client.delete("Pod", pod.namespace, pod.pod_name)
+                except NotFound:
+                    continue
+                if self.metrics:
+                    self.metrics.health_evictions.inc()
+            else:
+                self._set_unhealthy_annotation(alloc, message, only=pod)
+
+    def _set_unhealthy_annotation(
+        self, alloc: AllocationDetails, message: Optional[str], only=None
+    ) -> None:
+        """Set (or clear, message=None) the per-pod degraded marker. A
+        healed chip must also heal the annotation — a stale failure signal
+        on a healthy pod misleads anything keying off it."""
+        from instaslice_tpu.controller.gates import UNHEALTHY_ANNOTATION
+
+        pods = [only] if only is not None else alloc.pods_on_node(
+            self.node_name
+        )
+        for pod in pods:
+            try:
+                obj = self.client.get("Pod", pod.namespace, pod.pod_name)
+            except NotFound:
+                continue
+            ann = obj.get("metadata", {}).get("annotations") or {}
+            if ann.get(UNHEALTHY_ANNOTATION) == message or (
+                message is None and UNHEALTHY_ANNOTATION not in ann
+            ):
+                continue
+            try:
+                self.client.patch(
+                    "Pod", pod.namespace, pod.pod_name,
+                    {
+                        "metadata": {
+                            "annotations": {UNHEALTHY_ANNOTATION: message}
+                        }
+                    },
+                )
+            except NotFound:
+                pass
 
     # ---------------------------------------------------------------- node
 

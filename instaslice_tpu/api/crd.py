@@ -126,6 +126,7 @@ _STATUS_SCHEMA = {
             "type": "array",
             "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
         },
+        "unhealthyChips": {"type": "array", "items": {"type": "integer"}},
     },
 }
 

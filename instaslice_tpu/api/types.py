@@ -372,19 +372,30 @@ class TpuSliceSpec:
 @dataclasses.dataclass
 class TpuSliceStatus:
     """Reference: ``InstasliceStatus.Processed`` (instaslice_types.go:97)
-    — a string "true"; here a bool plus an observability surface."""
+    — a string "true"; here a bool plus an observability surface.
+
+    ``unhealthy_chips`` is the node agent's published per-chip health
+    (local chip ids currently failed); the controller's placement engine
+    treats them as occupied. No reference analog — SURVEY.md §5 flags "no
+    health monitoring of slices" as a gap to close."""
 
     processed: bool = False
     conditions: List[dict] = dataclasses.field(default_factory=list)
+    unhealthy_chips: List[int] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return {"processed": self.processed, "conditions": list(self.conditions)}
+        return {
+            "processed": self.processed,
+            "conditions": list(self.conditions),
+            "unhealthyChips": sorted(self.unhealthy_chips),
+        }
 
     @staticmethod
     def from_dict(d: dict) -> "TpuSliceStatus":
         return TpuSliceStatus(
             processed=bool(d.get("processed", False)),
             conditions=list(d.get("conditions", [])),
+            unhealthy_chips=[int(c) for c in d.get("unhealthyChips", [])],
         )
 
 

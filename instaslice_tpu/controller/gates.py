@@ -25,6 +25,14 @@ GROUP_SIZE_ANNOTATION = f"{GROUP}/group-size"
 # generated names; their template's envFrom + per-pod resource limit need
 # a fixed name to reference — see samples/vllm-tpu.yaml).
 HANDOFF_ANNOTATION = f"{GROUP}/handoff-name"
+# Slice health (no reference analog — SURVEY.md §5 gap). The agent stamps
+# UNHEALTHY_ANNOTATION on a running pod whose granted chips fail; pods
+# opting in with RESTART_ON_FAILURE_ANNOTATION="true" are deleted instead
+# so their managing controller (Deployment/Job) respawns them onto a fresh
+# slice carved from healthy chips.
+UNHEALTHY_ANNOTATION = f"{GROUP}/slice-unhealthy"
+RESTART_ON_FAILURE_ANNOTATION = f"{GROUP}/restart-on-failure"
+ERROR_ANNOTATION = f"{GROUP}/error"
 
 _RESOURCE_RE = re.compile(r"tpu-(v\d+[a-z]*-\d+x\d+(?:x\d+)?)$")
 

@@ -30,6 +30,7 @@ from instaslice_tpu.api import (
     slice_uuid_for,
 )
 from instaslice_tpu.controller.gates import (
+    ERROR_ANNOTATION,
     GROUP_SIZE_ANNOTATION,
     HANDOFF_ANNOTATION,
     extract_profile,
@@ -41,7 +42,14 @@ from instaslice_tpu.kube.client import (
     NotFound,
     update_with_retry,
 )
-from instaslice_tpu.topology.grid import NodeGrid, Shape, TorusGroup, get_generation
+from instaslice_tpu.topology.grid import (
+    NodeGrid,
+    Shape,
+    TorusGroup,
+    get_generation,
+    id_to_coord,
+    volume,
+)
 from instaslice_tpu.topology.placement import Box, Occupancy, Placement
 from instaslice_tpu.topology.policy import AllocationPolicy, get_policy
 from instaslice_tpu.topology.profiles import TopologyProfile
@@ -180,7 +188,10 @@ class Controller:
     def _occupancy(group: TorusGroup, members: List[TpuSlice]) -> Occupancy:
         """Union of desired (allocations) and realized (prepared) boxes,
         deduped across the member CRs an allocation is fanned out to
-        (reference scans both sources too: instaslice_controller.go:306-329)."""
+        (reference scans both sources too: instaslice_controller.go:306-329).
+        Chips the agents report unhealthy are blocked last — they may sit
+        inside live boxes (that grant's fate is the health monitor's call)
+        but must never enter a new placement."""
         occ = Occupancy(group)
         seen: Dict[str, str] = {}
         for ts in members:
@@ -198,6 +209,18 @@ class Controller:
                     continue
                 seen[f"p-{suid}"] = prep.box
                 occ.occupy(Box.from_key(prep.box), owner=f"p-{suid}")
+        hb = group.generation.host_bounds
+        for ts in members:
+            if not ts.status.unhealthy_chips:
+                continue
+            grid = group.hosts.get(ts.name)
+            if grid is None:
+                continue
+            occ.block([
+                grid.global_coord(id_to_coord(cid, hb))
+                for cid in ts.status.unhealthy_chips
+                if 0 <= cid < volume(hb)
+            ])
         return occ
 
     # Status precedence when merging per-CR copies of one allocation: a
@@ -657,9 +680,7 @@ class Controller:
 
     def _annotate_error(self, pod: dict, message: str) -> None:
         md = pod["metadata"]
-        current = (md.get("annotations") or {}).get(
-            "tpu.instaslice.dev/error"
-        )
+        current = (md.get("annotations") or {}).get(ERROR_ANNOTATION)
         if current == message[:512]:
             return
         try:
@@ -667,9 +688,7 @@ class Controller:
                 "Pod", md.get("namespace", ""), md["name"],
                 {
                     "metadata": {
-                        "annotations": {
-                            "tpu.instaslice.dev/error": message[:512]
-                        }
+                        "annotations": {ERROR_ANNOTATION: message[:512]}
                     }
                 },
             )

@@ -89,6 +89,16 @@ class DeviceBackend(abc.ABC):
         except DeviceError:
             return False
 
+    def chip_health(self) -> Dict[int, bool]:
+        """Per-chip health: local chip id → healthy. Must cover the union
+        of present chips and chips in live reservations — a reserved chip
+        whose device node vanished (driver unbound a failed chip) is
+        reported ``False``, not omitted. Empty dict = backend has no
+        per-chip health signal (treated as all-healthy). The reference has
+        no analog: SURVEY.md §5 flags "no health monitoring of slices" as
+        a gap this rebuild must close."""
+        return {}
+
 
 def env_overrides() -> dict:
     """Topology hints the platform provides via env (GKE TPU node pools

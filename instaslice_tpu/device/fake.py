@@ -45,8 +45,10 @@ class FakeTpuBackend(DeviceBackend):
         self._reservations: Dict[str, Tuple[int, ...]] = {}
         # failure injection: op name → remaining failures to inject
         self._fail: Dict[str, int] = {}
+        self._failed_chips: set = set()
         self.calls: Dict[str, int] = {
             "discover": 0, "reserve": 0, "release": 0, "list": 0,
+            "health": 0,
         }
 
     # ------------------------------------------------------------ test API
@@ -55,6 +57,16 @@ class FakeTpuBackend(DeviceBackend):
         """Make the next ``count`` calls of ``op`` raise DeviceError
         (op in discover|reserve|release|list)."""
         self._fail[op] = self._fail.get(op, 0) + count
+
+    def fail_chip(self, chip_id: int) -> None:
+        """Mark a chip unhealthy (ICI link down / driver unbind analog).
+        Live reservations keep holding it; new reserves touching it fail."""
+        with self._lock:
+            self._failed_chips.add(chip_id)
+
+    def heal_chip(self, chip_id: int) -> None:
+        with self._lock:
+            self._failed_chips.discard(chip_id)
 
     def seed_dangling(self, slice_uuid: str, chip_ids: List[int]) -> None:
         """Pre-existing slice for adoption tests (reference:
@@ -102,6 +114,9 @@ class FakeTpuBackend(DeviceBackend):
             clash = [c for c in ids if c in taken]
             if clash:
                 raise ChipsBusy(f"chips {clash} already reserved")
+            dead = [c for c in ids if c in self._failed_chips]
+            if dead:
+                raise DeviceError(f"chips {dead} unhealthy")
             self._reservations[slice_uuid] = ids
             return Reservation(slice_uuid=slice_uuid, chip_ids=ids)
 
@@ -121,3 +136,12 @@ class FakeTpuBackend(DeviceBackend):
                 Reservation(slice_uuid=u, chip_ids=c)
                 for u, c in sorted(self._reservations.items())
             ]
+
+    def chip_health(self) -> Dict[int, bool]:
+        with self._lock:
+            self.calls["health"] += 1
+            self._maybe_fail("health")
+            ids = set(self._inventory.chip_paths)
+            for r in self._reservations.values():
+                ids.update(r)
+            return {i: i not in self._failed_chips for i in sorted(ids)}

@@ -81,6 +81,9 @@ class NativeBackend(DeviceBackend):
         ]
         self._lib.tpuslice_release.argtypes = [ctypes.c_char_p]
         self._lib.tpuslice_list.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        self._lib.tpuslice_health.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
         self._lib.tpuslice_strerror.argtypes = [ctypes.c_int]
         self._lib.tpuslice_strerror.restype = ctypes.c_char_p
         self._lib.tpuslice_version.restype = ctypes.c_char_p
@@ -148,3 +151,7 @@ class NativeBackend(DeviceBackend):
             Reservation(slice_uuid=r["uuid"], chip_ids=tuple(r["chips"]))
             for r in d["reservations"]
         ]
+
+    def chip_health(self) -> "dict[int, bool]":
+        d = self._call_json(self._lib.tpuslice_health, "health")
+        return {int(c["id"]): bool(c["healthy"]) for c in d["chips"]}

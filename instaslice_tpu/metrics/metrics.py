@@ -53,6 +53,8 @@ class OperatorMetrics:
             self.allocations = _NoopMetric()
             self.pending_pods = _NoopMetric()
             self.reconciles = _NoopMetric()
+            self.unhealthy_chips = _NoopMetric()
+            self.health_evictions = _NoopMetric()
             self.registry = None
             return
         self.registry = registry or CollectorRegistry()
@@ -89,6 +91,17 @@ class OperatorMetrics:
             "tpuslice_reconciles_total",
             "Reconcile invocations",
             ["component"],
+            registry=self.registry,
+        )
+        self.unhealthy_chips = Gauge(
+            "tpuslice_unhealthy_chips",
+            "Chips the health sweep currently reports failed",
+            ["node"],
+            registry=self.registry,
+        )
+        self.health_evictions = Counter(
+            "tpuslice_health_evictions_total",
+            "Pods evicted because their granted chips went unhealthy",
             registry=self.registry,
         )
 

@@ -42,6 +42,7 @@ class SimCluster:
         namespace: str = "instaslice-tpu-system",
         policy: str = "best-fit",
         deletion_grace_seconds: float = 0.3,
+        health_interval: float = 0.15,
         metrics=None,
     ) -> None:
         self.kube = FakeKube()
@@ -70,7 +71,8 @@ class SimCluster:
             )
             self.backends[node] = backend
             self.agents[node] = NodeAgent(
-                self.kube, backend, node, namespace, metrics=metrics
+                self.kube, backend, node, namespace, metrics=metrics,
+                health_interval=health_interval,
             )
         self.controller = Controller(
             self.kube,
@@ -208,6 +210,10 @@ class SimCluster:
             return self.kube.get("ConfigMap", namespace, name)
         except NotFound:
             return None
+
+    def unhealthy_chips(self, node: str) -> List[int]:
+        ts = self.kube.get("TpuSlice", self.namespace, node)
+        return list(ts.get("status", {}).get("unhealthyChips", []))
 
     # ----------------------------------------------- kube-scheduler emulator
 

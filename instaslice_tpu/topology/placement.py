@@ -158,6 +158,16 @@ class Occupancy:
         if owner:
             self._boxes[owner] = box
 
+    def block(self, coords: List[Coord]) -> None:
+        """Mark chips unusable (unhealthy hardware) without overlap
+        accounting: blocking a chip already inside a granted box is legal —
+        the grant stands (its teardown is the health monitor's business),
+        but no NEW placement may use the chip. Out-of-bounds coords are
+        ignored (stale health data for a chip this group no longer maps)."""
+        for c in coords:
+            if all(0 <= c[i] < self.group.bounds[i] for i in range(3)):
+                self._taken.add(c)
+
     def release(self, box: Box, owner: str = "") -> None:
         if owner:
             held = self._boxes.get(owner)

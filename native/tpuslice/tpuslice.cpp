@@ -181,6 +181,31 @@ int load_reservations(std::vector<Reservation>* out) {
   return TPUSLICE_OK;
 }
 
+/* Last-seen chip inventory, persisted at discover time. Health checks
+ * union it in so a chip whose device node vanished while UNRESERVED is
+ * still reported (unhealthy) instead of silently dropping out of the
+ * report — without a baseline, placement would retry the phantom chip
+ * forever. */
+std::string inventory_path() { return path_join(g_registry, ".inventory"); }
+
+void save_inventory(const std::vector<Chip>& chips) {
+  std::string tmp = inventory_path() + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) return;
+  for (const auto& c : chips) fprintf(f, "%d\n", c.id);
+  fclose(f);
+  if (rename(tmp.c_str(), inventory_path().c_str()) != 0)
+    unlink(tmp.c_str());
+}
+
+void load_inventory(std::set<int>* ids) {
+  FILE* f = fopen(inventory_path().c_str(), "r");
+  if (!f) return;
+  int id;
+  while (fscanf(f, "%d", &id) == 1) ids->insert(id);
+  fclose(f);
+}
+
 int write_json(char* buf, size_t buflen, const std::string& s) {
   if (!buf) return TPUSLICE_EINVAL;
   if (s.size() + 1 > buflen) return TPUSLICE_ERANGE;
@@ -209,6 +234,10 @@ int tpuslice_discover(char* buf, size_t buflen) {
   if (!g_inited) return TPUSLICE_EINVAL;
   std::vector<Chip> chips;
   std::string source = scan_chips(&chips);
+  {
+    RegistryLock lock(g_registry);
+    if (lock.ok()) save_inventory(chips);
+  }
   std::string j = "{\"chip_count\":" + std::to_string(chips.size()) +
                   ",\"source\":\"" + source + "\",\"chips\":[";
   for (size_t i = 0; i < chips.size(); ++i) {
@@ -302,6 +331,41 @@ int tpuslice_list(char* buf, size_t buflen) {
       j += std::to_string(live[i].chips[k]);
     }
     j += "]}";
+  }
+  j += "]}";
+  return write_json(buf, buflen, j);
+}
+
+int tpuslice_health(char* buf, size_t buflen) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_inited) return TPUSLICE_EINVAL;
+  RegistryLock lock(g_registry);
+  if (!lock.ok()) return TPUSLICE_EIO;
+  std::vector<Chip> present;
+  scan_chips(&present);
+  std::vector<Reservation> live;
+  int rc = load_reservations(&live);
+  if (rc != TPUSLICE_OK) return rc;
+  // Report over the union of: present chips, reserved chips, and the
+  // last-discovered inventory — a chip that vanished while unreserved
+  // must show up unhealthy, not disappear from the report.
+  std::set<int> all_ids;
+  std::set<int> healthy;
+  for (const auto& c : present) {
+    all_ids.insert(c.id);
+    std::string p = path_join(g_root, c.path);
+    if (access(p.c_str(), R_OK | W_OK) == 0) healthy.insert(c.id);
+  }
+  for (const auto& r : live)
+    for (int c : r.chips) all_ids.insert(c);
+  load_inventory(&all_ids);
+  std::string j = "{\"chips\":[";
+  bool first = true;
+  for (int id : all_ids) {
+    if (!first) j += ",";
+    first = false;
+    j += "{\"id\":" + std::to_string(id) + ",\"healthy\":" +
+         (healthy.count(id) ? "true" : "false") + "}";
   }
   j += "]}";
   return write_json(buf, buflen, j);

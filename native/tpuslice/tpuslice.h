@@ -63,6 +63,17 @@ int tpuslice_release(const char* slice_uuid);
  * {"reservations":[{"uuid":"...","chips":[0,1]},...]} */
 int tpuslice_list(char* buf, size_t buflen);
 
+/* JSON health report over the union of currently-present chips, chips
+ * referenced by live reservations, and the last inventory persisted by
+ * tpuslice_discover:
+ * {"chips":[{"id":0,"healthy":true},...]}
+ * A chip is unhealthy when its device node is missing (driver unbound the
+ * failed chip) or not read/write accessible. A chip that no longer
+ * appears in the /dev scan — reserved or not — is reported unhealthy
+ * rather than omitted; silently dropping it would let the placement
+ * engine retry the phantom chip forever. */
+int tpuslice_health(char* buf, size_t buflen);
+
 /* Human-readable error string for a TPUSLICE_E* code. */
 const char* tpuslice_strerror(int code);
 

@@ -10,6 +10,7 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/stat.h>
+#include <unistd.h>
 
 static void make_fake_dev(const char* root, int nchips) {
   char p[512];
@@ -54,6 +55,19 @@ int main(void) {
   assert(tpuslice_init(root, NULL) == TPUSLICE_OK);
   assert(tpuslice_list(buf, sizeof buf) == TPUSLICE_OK);
   assert(strstr(buf, "slice-b") != NULL && strstr(buf, "slice-c") != NULL);
+
+  /* health: all present chips healthy; removing a reserved chip's device
+   * node must surface it as unhealthy, not drop it from the report */
+  assert(tpuslice_health(buf, sizeof buf) == TPUSLICE_OK);
+  assert(strstr(buf, "\"id\":0,\"healthy\":true") != NULL);
+  {
+    char p[512];
+    snprintf(p, sizeof p, "%s/dev/accel0", root);
+    assert(unlink(p) == 0); /* chip 0 dies (reserved by slice-c) */
+  }
+  assert(tpuslice_health(buf, sizeof buf) == TPUSLICE_OK);
+  assert(strstr(buf, "\"id\":0,\"healthy\":false") != NULL);
+  assert(strstr(buf, "\"id\":1,\"healthy\":true") != NULL);
 
   /* tiny buffer → ERANGE, not overflow */
   char tiny[4];

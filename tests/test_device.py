@@ -113,6 +113,47 @@ class TestBackendContract:
         assert sorted(flat) == list(range(8))
 
 
+class TestChipHealth:
+    def test_all_healthy_by_default(self, backend):
+        h = backend.chip_health()
+        assert len(h) == 8 and all(h.values())
+
+    def test_fake_fail_and_heal(self):
+        b = FakeTpuBackend(generation="v5e")
+        b.fail_chip(3)
+        h = b.chip_health()
+        assert h[3] is False and h[0] is True
+        with pytest.raises(DeviceError, match="unhealthy"):
+            b.reserve("s", [2, 3])
+        b.heal_chip(3)
+        assert b.chip_health()[3] is True
+        b.reserve("s", [2, 3])
+
+    def test_native_missing_device_node(self, native_lib, sim_root):
+        """A reserved chip whose /dev node vanishes (driver unbound the
+        failed chip) must be reported unhealthy, not dropped."""
+        b = NativeBackend(library_path=native_lib, root=sim_root,
+                          generation="v5e")
+        b.reserve("s", [0, 1])
+        os.unlink(os.path.join(sim_root, "dev", "accel0"))
+        h = b.chip_health()
+        assert h[0] is False
+        assert h[1] is True and len(h) == 8
+
+    def test_native_unreserved_vanished_chip_still_reported(
+        self, native_lib, sim_root
+    ):
+        """An UNRESERVED chip whose device node vanishes must also appear
+        unhealthy (via the inventory persisted at discover) — otherwise
+        placement retries the phantom chip forever."""
+        b = NativeBackend(library_path=native_lib, root=sim_root,
+                          generation="v5e")
+        b.discover()  # persists the 8-chip inventory baseline
+        os.unlink(os.path.join(sim_root, "dev", "accel7"))
+        h = b.chip_health()
+        assert h[7] is False and len(h) == 8
+
+
 class TestNativeSpecifics:
     def test_registry_survives_restart(self, native_lib, sim_root):
         b1 = NativeBackend(library_path=native_lib, root=sim_root,

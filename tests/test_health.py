@@ -1,0 +1,124 @@
+"""Slice health monitoring e2e on the simulated cluster.
+
+No reference analog: SURVEY.md §5 flags "no health monitoring of slices"
+as a gap this framework closes. The agent's periodic sweep publishes
+failed chips to the CR status, the controller's placement avoids them,
+in-flight allocations touching them are failed-and-retried, and granted
+pods are annotated or (opt-in) evicted for elastic recovery.
+"""
+
+import time
+
+import pytest
+
+from instaslice_tpu.controller.gates import (
+    RESTART_ON_FAILURE_ANNOTATION,
+    UNHEALTHY_ANNOTATION,
+)
+from instaslice_tpu.sim import SimCluster
+
+
+@pytest.fixture
+def cluster():
+    c = SimCluster(n_nodes=1, generation="v5e",
+                   deletion_grace_seconds=0.2,
+                   health_interval=0.1).start()
+    yield c
+    c.stop()
+
+
+def wait_for(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.03)
+    return False
+
+
+class TestHealthPublication:
+    def test_failed_chip_published_and_healed(self, cluster):
+        cluster.backends["node-0"].fail_chip(5)
+        assert wait_for(lambda: cluster.unhealthy_chips("node-0") == [5])
+        cluster.backends["node-0"].heal_chip(5)
+        assert wait_for(lambda: cluster.unhealthy_chips("node-0") == [])
+
+
+class TestPlacementAvoidance:
+    def test_new_grants_avoid_failed_chip(self, cluster):
+        cluster.backends["node-0"].fail_chip(0)
+        assert wait_for(lambda: cluster.unhealthy_chips("node-0") == [0])
+        cluster.submit("p", "v5e-1x1")
+        assert cluster.wait_phase("p", "Running", timeout=10)
+        res = cluster.backends["node-0"].list_reservations()
+        assert len(res) == 1 and 0 not in res[0].chip_ids
+
+    def test_full_host_profile_unplaceable_with_dead_chip(self, cluster):
+        cluster.backends["node-0"].fail_chip(3)
+        assert wait_for(lambda: cluster.unhealthy_chips("node-0") == [3])
+        cluster.submit("big", "v5e-4x2")  # needs all 8 chips
+        time.sleep(0.5)
+        assert cluster.pod_phase("big") == "Pending"
+        # healing the chip lets the pending pod through
+        cluster.backends["node-0"].heal_chip(3)
+        assert cluster.wait_phase("big", "Running", timeout=10)
+
+
+class TestGrantedSliceFailure:
+    def test_pod_annotated_by_default(self, cluster):
+        cluster.submit("victim", "v5e-2x2")
+        assert cluster.wait_phase("victim", "Running", timeout=10)
+        chips = cluster.backends["node-0"].list_reservations()[0].chip_ids
+        cluster.backends["node-0"].fail_chip(chips[0])
+
+        def annotated():
+            ann = cluster.pod("victim")["metadata"].get("annotations", {})
+            return "unhealthy" in ann.get(UNHEALTHY_ANNOTATION, "")
+
+        assert wait_for(annotated)
+        # no opt-in → not evicted
+        assert cluster.pod_phase("victim") == "Running"
+        # healing the chip must clear the stale degraded marker
+        cluster.backends["node-0"].heal_chip(chips[0])
+        assert wait_for(
+            lambda: UNHEALTHY_ANNOTATION
+            not in cluster.pod("victim")["metadata"].get("annotations", {})
+        )
+
+    def test_opt_in_eviction_and_regrant_on_healthy_chips(self, cluster):
+        """Elastic recovery: the evicted pod's replacement (Deployment
+        respawn analog) lands on healthy chips only."""
+        cluster.submit(
+            "victim", "v5e-2x2",
+            annotations={RESTART_ON_FAILURE_ANNOTATION: "true"},
+        )
+        assert cluster.wait_phase("victim", "Running", timeout=10)
+        dead = cluster.backends["node-0"].list_reservations()[0].chip_ids[0]
+        cluster.backends["node-0"].fail_chip(dead)
+        assert cluster.wait_gone("victim", timeout=10)
+        # old reservation fully released
+        assert wait_for(
+            lambda: cluster.backends["node-0"].list_reservations() == []
+        )
+        # respawn: same workload, fresh pod
+        cluster.submit(
+            "victim", "v5e-2x2",
+            annotations={RESTART_ON_FAILURE_ANNOTATION: "true"},
+        )
+        assert cluster.wait_phase("victim", "Running", timeout=10)
+        res = cluster.backends["node-0"].list_reservations()
+        assert len(res) == 1 and dead not in res[0].chip_ids
+
+
+class TestInFlightFailure:
+    def test_creating_allocation_failed_and_retried(self, cluster):
+        """A chip dying between placement and realization fails the
+        allocation; the controller tears it down and retries on healthy
+        chips (the reference logged device errors and carried on —
+        instaslice_daemonset.go:172-189)."""
+        # Make the first reserve fail as if the chip died mid-flight; the
+        # retry must succeed and avoid nothing (chip healed by then).
+        cluster.backends["node-0"].inject_failures("reserve", 1)
+        cluster.submit("p", "v5e-1x1")
+        assert cluster.wait_phase("p", "Running", timeout=15)
+        assert len(cluster.backends["node-0"].list_reservations()) == 1
