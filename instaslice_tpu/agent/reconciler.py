@@ -136,11 +136,7 @@ class NodeAgent:
 
     def _chip_ids_for(self, ts: TpuSlice, alloc: AllocationDetails) -> List[int]:
         gen = get_generation(ts.spec.generation)
-        _, local_key = alloc.parts[self.node_name]
-        return sorted(
-            coord_to_id(c, gen.host_bounds)
-            for c in Box.from_key(local_key).coords()
-        )
+        return alloc.local_chip_ids(self.node_name, gen.host_bounds)
 
     def _realize(self, ts: TpuSlice, alloc: AllocationDetails) -> None:
         suid = slice_uuid_for(alloc.alloc_id)
@@ -294,12 +290,13 @@ class NodeAgent:
     def _health_sweep(self) -> float:
         """Periodic per-chip health check (no reference analog: SURVEY.md
         §5 — "no health monitoring of slices"). Publishes failed chip ids
-        to ``status.unhealthyChips`` (placement avoids them), fails
-        in-flight allocations touching them, and for granted slices either
-        annotates the consumer pods or — when they opt in via
-        ``tpu.instaslice.dev/restart-on-failure`` — deletes them so their
-        managing controller respawns onto healthy chips (elastic
-        recovery)."""
+        to ``status.unhealthyChips`` via the status subresource (a plain
+        update would be silently dropped by a real apiserver once the CRD
+        declares ``subresources.status``) and fails in-flight allocations
+        touching dead chips. Degraded GRANTED slices are the controller's
+        business: it has the cross-node view a multi-host slice needs
+        (``controller/reconciler.py: _reconcile_slice_health``), and the
+        status write below is exactly what wakes it up."""
         try:
             health = self.backend.chip_health()
         except DeviceError as e:
@@ -314,40 +311,37 @@ class NodeAgent:
                 node=self.node_name
             ).set(len(failed))
 
-        def mut(obj: dict) -> Optional[dict]:
-            cur = TpuSlice.from_manifest(obj)
-            if sorted(cur.status.unhealthy_chips) == failed:
-                return None
-            cur.status.unhealthy_chips = failed
-            return cur.to_manifest()
-
         try:
-            stored = update_with_retry(
-                self.client, "TpuSlice", self.namespace, self.node_name, mut
+            ts = TpuSlice.from_manifest(
+                self.client.get("TpuSlice", self.namespace, self.node_name)
             )
-            if stored is None:  # no-op write: status already current
-                stored = self.client.get(
-                    "TpuSlice", self.namespace, self.node_name
-                )
         except NotFound:
             return self.health_interval
-        ts = TpuSlice.from_manifest(stored)
+        if sorted(ts.status.unhealthy_chips) != failed:
+            try:
+                self.client.patch_status(
+                    "TpuSlice", self.namespace, self.node_name,
+                    {"unhealthyChips": failed},
+                )
+            except NotFound:
+                return self.health_interval
+        if not failed:
+            return self.health_interval
+
+        gen = get_generation(ts.spec.generation)
         failed_set = set(failed)
         for alloc_id in sorted(ts.spec.allocations):
             alloc = ts.spec.allocations[alloc_id]
-            if self.node_name not in alloc.parts:
-                continue
-            dead = failed_set.intersection(self._chip_ids_for(ts, alloc))
+            dead = failed_set.intersection(
+                alloc.local_chip_ids(self.node_name, gen.host_bounds)
+            )
             if not dead:
-                # chips healthy (again): clear any stale degraded marker
-                if alloc.status == AllocationStatus.UNGATED:
-                    self._set_unhealthy_annotation(alloc, None)
                 continue
-            msg = f"{self.node_name}: chips {sorted(dead)} unhealthy"
             if alloc.status in (
                 AllocationStatus.CREATING,
                 AllocationStatus.CREATED,
             ):
+                msg = f"{self.node_name}: chips {sorted(dead)} unhealthy"
                 log.warning("failing in-flight allocation %s: %s",
                             alloc_id, msg)
                 self._mark_failed(
@@ -357,72 +351,7 @@ class NodeAgent:
                         AllocationStatus.CREATED,
                     ),
                 )
-            elif alloc.status == AllocationStatus.UNGATED:
-                self._handle_unhealthy_granted(alloc, msg)
         return self.health_interval
-
-    def _handle_unhealthy_granted(
-        self, alloc: AllocationDetails, message: str
-    ) -> None:
-        from instaslice_tpu.controller.gates import (
-            RESTART_ON_FAILURE_ANNOTATION,
-        )
-
-        for pod in alloc.pods_on_node(self.node_name):
-            try:
-                obj = self.client.get("Pod", pod.namespace, pod.pod_name)
-            except NotFound:
-                continue
-            md = obj.get("metadata", {})
-            if md.get("deletionTimestamp"):
-                continue
-            ann = md.get("annotations") or {}
-            if ann.get(RESTART_ON_FAILURE_ANNOTATION) == "true":
-                log.warning(
-                    "evicting pod %s/%s: %s (restart-on-failure)",
-                    pod.namespace, pod.pod_name, message,
-                )
-                try:
-                    self.client.delete("Pod", pod.namespace, pod.pod_name)
-                except NotFound:
-                    continue
-                if self.metrics:
-                    self.metrics.health_evictions.inc()
-            else:
-                self._set_unhealthy_annotation(alloc, message, only=pod)
-
-    def _set_unhealthy_annotation(
-        self, alloc: AllocationDetails, message: Optional[str], only=None
-    ) -> None:
-        """Set (or clear, message=None) the per-pod degraded marker. A
-        healed chip must also heal the annotation — a stale failure signal
-        on a healthy pod misleads anything keying off it."""
-        from instaslice_tpu.controller.gates import UNHEALTHY_ANNOTATION
-
-        pods = [only] if only is not None else alloc.pods_on_node(
-            self.node_name
-        )
-        for pod in pods:
-            try:
-                obj = self.client.get("Pod", pod.namespace, pod.pod_name)
-            except NotFound:
-                continue
-            ann = obj.get("metadata", {}).get("annotations") or {}
-            if ann.get(UNHEALTHY_ANNOTATION) == message or (
-                message is None and UNHEALTHY_ANNOTATION not in ann
-            ):
-                continue
-            try:
-                self.client.patch(
-                    "Pod", pod.namespace, pod.pod_name,
-                    {
-                        "metadata": {
-                            "annotations": {UNHEALTHY_ANNOTATION: message}
-                        }
-                    },
-                )
-            except NotFound:
-                pass
 
     # ---------------------------------------------------------------- node
 

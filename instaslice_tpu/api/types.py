@@ -202,6 +202,20 @@ class AllocationDetails:
         wid = part[0]
         return [p for p in self.pods if p.worker_id == wid]
 
+    def local_chip_ids(self, node_name: str, host_bounds: Shape) -> List[int]:
+        """Local chip ids this allocation occupies on ``node_name`` (empty
+        when the node serves no part). Shared by the agent (reservation,
+        health intersection) and the controller (degraded-slice detection)."""
+        part = self.parts.get(node_name)
+        if part is None:
+            return []
+        from instaslice_tpu.topology.grid import coord_to_id
+
+        return sorted(
+            coord_to_id(c, host_bounds)
+            for c in Box.from_key(part[1]).coords()
+        )
+
     def fully_realized(self) -> bool:
         return set(self.realized_on) >= set(self.parts)
 

@@ -581,7 +581,8 @@ class Controller:
 
     def _maybe_finish_ungate(self, pod: dict) -> Optional[float]:
         """Pod already ungated/running: make sure the allocation status
-        caught up (covers a crash between pod update and CR write)."""
+        caught up (covers a crash between pod update and CR write), then
+        reconcile slice health for the granted allocation."""
         md = pod["metadata"]
         slices = self._load_slices()
         found = self._find_allocation(slices, pod_uid=md.get("uid", ""))
@@ -590,7 +591,88 @@ class Controller:
         alloc, _ = found
         if alloc.status == AllocationStatus.CREATED:
             self._ungate_all(alloc)
+        if alloc.status in (
+            AllocationStatus.CREATED, AllocationStatus.UNGATED
+        ):
+            self._reconcile_slice_health(alloc, slices)
         return None
+
+    def _reconcile_slice_health(
+        self, alloc: AllocationDetails, slices: List[TpuSlice]
+    ) -> None:
+        """Degraded-slice handling for GRANTED allocations, driven by the
+        per-node ``status.unhealthyChips`` the agents publish (their write
+        wakes this reconciler via the CR watch). The controller owns this
+        — not the agents — because a multi-host slice is only healthy as a
+        whole: a chip death on one host degrades every worker pod of the
+        group, including those on healthy hosts, and the signal must reach
+        (or evict) all of them coherently. No reference analog (SURVEY.md
+        §5: "no health monitoring of slices")."""
+        from instaslice_tpu.controller.gates import (
+            RESTART_ON_FAILURE_ANNOTATION,
+            UNHEALTHY_ANNOTATION,
+        )
+
+        by_name = {ts.name: ts for ts in slices}
+        dead: Dict[str, List[int]] = {}
+        for node in alloc.parts:
+            ts = by_name.get(node)
+            if ts is None or not ts.status.unhealthy_chips:
+                continue
+            try:
+                hb = get_generation(ts.spec.generation).host_bounds
+            except KeyError:
+                continue
+            hit = sorted(
+                set(ts.status.unhealthy_chips)
+                & set(alloc.local_chip_ids(node, hb))
+            )
+            if hit:
+                dead[node] = hit
+        message = (
+            "; ".join(
+                f"{n}: chips {c} unhealthy" for n, c in sorted(dead.items())
+            )
+            if dead
+            else None
+        )
+        for p in alloc.pods:
+            try:
+                obj = self.client.get("Pod", p.namespace, p.pod_name)
+            except NotFound:
+                continue
+            md = obj.get("metadata", {})
+            if md.get("deletionTimestamp"):
+                continue
+            ann = md.get("annotations") or {}
+            if message is None:
+                # healed: clear the stale degraded marker
+                if UNHEALTHY_ANNOTATION in ann:
+                    self.client.patch(
+                        "Pod", p.namespace, p.pod_name,
+                        {"metadata": {
+                            "annotations": {UNHEALTHY_ANNOTATION: None}
+                        }},
+                    )
+                continue
+            if ann.get(RESTART_ON_FAILURE_ANNOTATION) == "true":
+                log.warning(
+                    "evicting pod %s/%s: %s (restart-on-failure)",
+                    p.namespace, p.pod_name, message,
+                )
+                try:
+                    self.client.delete("Pod", p.namespace, p.pod_name)
+                except NotFound:
+                    continue
+                if self.metrics:
+                    self.metrics.health_evictions.inc()
+            elif ann.get(UNHEALTHY_ANNOTATION) != message:
+                self.client.patch(
+                    "Pod", p.namespace, p.pod_name,
+                    {"metadata": {
+                        "annotations": {UNHEALTHY_ANNOTATION: message}
+                    }},
+                )
 
     # ------------------------------------------------------------ deletion
 

@@ -1,0 +1,12 @@
+"""Model family: the flagship sharded transformer LM (dense + MoE, plain /
+ring / pallas-flash attention, KV-cache decode) and its training step.
+
+The reference ships workloads only as sample YAML (SURVEY.md §1); here the
+flagship is a tested library because TPU workloads must actively cooperate
+with the granted slice's mesh.
+"""
+
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.models.train import TrainState, make_train_step
+
+__all__ = ["ModelConfig", "TpuLM", "TrainState", "make_train_step"]

@@ -14,7 +14,7 @@ TPU-first choices, per the design brief:
 - **ICI**: parameters/activations carry ``PartitionSpec`` s over the
   ``("data", "seq", "model")`` mesh from :mod:`meshenv`; XLA inserts the
   all-reduces/all-gathers. Sequence parallelism uses ring attention
-  (:mod:`instaslice_tpu.workload.ring`) — neighbor ``ppermute`` s over ICI.
+  (:mod:`instaslice_tpu.parallel.ring`) — neighbor ``ppermute`` s over ICI.
 - **XLA semantics**: the layer stack is a ``lax.scan`` over stacked
   params — one trace, one compiled block body, no Python-loop unrolling.
 """
@@ -49,6 +49,11 @@ class ModelConfig:
     # over the "model" axis (expert parallelism).
     n_experts: int = 0
     remat: bool = True
+    # attention backend: "auto" (pallas flash kernel on TPU, XLA
+    # elsewhere), "flash" (force the kernel; interpreted off-TPU), or
+    # "xla" (plain formulation). Ring attention ignores this — it has its
+    # own flash-style inner loop over ICI ring steps.
+    attention_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -156,19 +161,36 @@ def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
 
 
 def _rope(x: jax.Array, positions: jax.Array) -> jax.Array:
-    """Rotary embeddings; x: (B, S, H, hd), positions: (S,)."""
+    """Rotary embeddings; x: (B, S, H, hd), positions: (S,) shared across
+    the batch or (B, S) per-row (the KV-cache decode path, where each
+    batch slot sits at its own sequence offset)."""
     hd = x.shape[-1]
     freqs = 10000.0 ** (-jnp.arange(0, hd, 2, dtype=jnp.float32) / hd)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B,S,hd/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1)
     return out.astype(x.dtype)
 
 
-def _attention(q, k, v, causal: bool = True) -> jax.Array:
-    """Plain softmax attention; q/k/v: (B, S, H, hd), fp32 logits."""
+def _attention(q, k, v, causal: bool = True, impl: str = "xla") -> jax.Array:
+    """Softmax attention; q/k/v: (B, S, H, hd), fp32 logits.
+
+    ``impl`` selects the backend (see :class:`ModelConfig.attention_impl`);
+    the pallas flash kernel keeps the (S, S) logits out of HBM.
+    """
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "flash":
+        from instaslice_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal,
+            interpret=jax.default_backend() != "tpu",
+        )
     hd = q.shape[-1]
     logits = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -248,7 +270,7 @@ class TpuLM:
             q = _rope(q, positions)
             k = _rope(k, positions)
             if ring:
-                from instaslice_tpu.workload.ring import ring_attention
+                from instaslice_tpu.parallel.ring import ring_attention
 
                 attn = jax.shard_map(
                     functools.partial(ring_attention, axis_name="seq"),
@@ -258,7 +280,7 @@ class TpuLM:
                     axis_names={"seq"},
                 )(q, k, v)
             else:
-                attn = _attention(q, k, v)
+                attn = _attention(q, k, v, impl=cfg.attention_impl)
             attn = attn.reshape(B, S, cfg.n_heads * cfg.head_dim)
             x = x + jnp.einsum(
                 "bsk,kd->bsd", attn, layer["wo"],
@@ -287,3 +309,101 @@ class TpuLM:
             preferred_element_type=jnp.float32,
         )
         return logits
+
+    # ------------------------------------------------------------ KV cache
+
+    def init_cache(self, batch: int, max_len: int) -> Params:
+        """Zeroed KV cache for incremental decoding: per-layer stacked
+        (L, B, max_len, H, hd) key/value tensors (the serving engine's
+        slot-batched layout)."""
+        cfg = self.cfg
+        shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+        return {
+            "k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+        }
+
+    def apply_with_cache(
+        self,
+        params: Params,
+        tokens: jax.Array,
+        cache: Params,
+        lengths: jax.Array,
+    ) -> Tuple[jax.Array, Params]:
+        """Incremental forward: run ``tokens`` (B, T) through the model
+        with each row appended at its own cache offset ``lengths`` (B,).
+
+        Covers both prefill (T = padded prompt length, lengths = 0) and
+        decode (T = 1). Returns (logits (B, T, vocab), updated cache).
+        Rows may sit at different offsets — the mask admits cache position
+        ``s`` for query ``t`` iff ``s <= lengths[b] + t``, so padded
+        prefill garbage beyond a row's true length is never attended (it
+        is progressively overwritten by later decode steps).
+        """
+        cfg = self.cfg
+        B, T = tokens.shape
+        S_max = cache["k"].shape[2]
+        x = params["embed"][tokens]                       # (B, T, D)
+        positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)
+
+        s_idx = jnp.arange(S_max, dtype=jnp.int32)
+        # (B, T, S_max): query t sees cache slot s iff s <= lengths+t
+        mask = s_idx[None, None, :] <= positions[:, :, None]
+
+        def write(cache_l, new, lens):
+            """Append (B, T, H, hd) at per-row offsets into (B, S, H, hd)."""
+            return jax.vmap(
+                lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0, 0))
+            )(cache_l, new, lens)
+
+        def block(x, xs):
+            layer, kc, vc = xs                            # kc: (B,S,H,hd)
+            h = _rmsnorm(x, layer["ln1"]["scale"])
+            q = jnp.einsum("bsd,dk->bsk", h, layer["wq"],
+                           preferred_element_type=jnp.float32)
+            k = jnp.einsum("bsd,dk->bsk", h, layer["wk"],
+                           preferred_element_type=jnp.float32)
+            v = jnp.einsum("bsd,dk->bsk", h, layer["wv"],
+                           preferred_element_type=jnp.float32)
+            q, k, v = (
+                t.astype(cfg.dtype).reshape(B, T, cfg.n_heads, cfg.head_dim)
+                for t in (q, k, v)
+            )
+            q = _rope(q, positions)
+            k = _rope(k, positions)
+            kc = write(kc, k, lengths)
+            vc = write(vc, v, lengths)
+            logits = jnp.einsum(
+                "bthd,bshd->bhts", q, kc,
+                preferred_element_type=jnp.float32,
+            ) * (cfg.head_dim ** -0.5)
+            logits = jnp.where(mask[:, None], logits, -1e9)
+            probs = jax.nn.softmax(logits, axis=-1).astype(vc.dtype)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, vc)
+            attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
+            x = x + jnp.einsum(
+                "bsk,kd->bsd", attn, layer["wo"],
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
+            h = _rmsnorm(x, layer["ln2"]["scale"])
+            if cfg.n_experts:
+                y = _moe_mlp(h, layer["router"], layer["w_in"],
+                             layer["w_out"])
+            else:
+                y = jnp.einsum("bsd,df->bsf", h, layer["w_in"],
+                               preferred_element_type=jnp.float32)
+                y = jax.nn.gelu(y).astype(cfg.dtype)
+                y = jnp.einsum("bsf,fd->bsd", y, layer["w_out"],
+                               preferred_element_type=jnp.float32
+                               ).astype(cfg.dtype)
+            return x + y, (kc, vc)
+
+        x, (new_k, new_v) = lax.scan(
+            block, x, (params["blocks"], cache["k"], cache["v"])
+        )
+        x = _rmsnorm(x, params["ln_f"]["scale"])
+        logits = jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+        return logits, {"k": new_k, "v": new_v}

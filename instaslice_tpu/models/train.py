@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from instaslice_tpu.workload.model import (
+from instaslice_tpu.models.lm import (
     ModelConfig,
     TpuLM,
     batch_spec,
@@ -114,6 +114,15 @@ def make_train_step(
     ``step_fn(state, tokens) -> (state, loss)``.
     """
     cfg = model.cfg
+    if cfg.attention_impl == "auto":
+        # Training resolves "auto" to the XLA formulation: the flash
+        # kernel's backward currently differentiates the XLA reference
+        # (ops/flash_attention.py: _flash_bwd), so under grad it would
+        # cost an extra forward AND still materialize the (S, S) logits —
+        # strictly worse than plain XLA. Inference keeps the kernel.
+        # Explicit attention_impl="flash" is honored as written.
+        cfg = dataclasses.replace(cfg, attention_impl="xla")
+        model = TpuLM(cfg)
     tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.01)
 
     def init(rng):

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference has no kernel layer (it is a slicing operator, not a compute
+framework); this package exists because the flagship workload's
+performance ceiling on TPU is set by how well the hot ops map to the
+MXU/VMEM hierarchy. XLA fuses most of the model already; the kernels here
+cover what it does not schedule optimally — flash attention's online
+softmax keeps the (S, S) logits matrix out of HBM entirely.
+"""
+
+from instaslice_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

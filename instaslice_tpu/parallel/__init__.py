@@ -1,0 +1,25 @@
+"""Parallelism layer: mesh construction from granted-slice env + sequence
+parallelism (ring attention) over the slice's ICI.
+
+The reference has no parallelism layer at all (SURVEY.md §2b: no
+DP/TP/PP/SP and no communication backend — the MIG slice itself is the
+isolation envelope). On TPU a slice is *defined* by its ICI mesh, so the
+consumer side needs first-class support: :mod:`meshenv` rebuilds the
+``jax.sharding.Mesh`` from the node agent's handoff env, and :mod:`ring`
+provides context parallelism whose neighbor ``ppermute`` hops ride the
+contiguous-rectangle ICI guarantee the placement engine provides.
+"""
+
+from instaslice_tpu.parallel.meshenv import (
+    SliceTopology,
+    initialize_distributed,
+    slice_mesh,
+)
+from instaslice_tpu.parallel.ring import ring_attention
+
+__all__ = [
+    "SliceTopology",
+    "initialize_distributed",
+    "slice_mesh",
+    "ring_attention",
+]

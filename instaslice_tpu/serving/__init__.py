@@ -1,0 +1,12 @@
+"""Serving engine: continuous-batched autoregressive decoding on a slice.
+
+The reference's serving story is a sample YAML that points vLLM at the
+granted MIG slice (``/root/reference/samples/vllm_dep.yaml``, SURVEY.md
+§1); the TPU build ships a real engine because the BASELINE secondary
+metric (tokens/sec/chip) needs a measurable decode path on the granted
+mesh.
+"""
+
+from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+
+__all__ = ["ServingEngine", "GenerationResult"]

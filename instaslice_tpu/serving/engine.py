@@ -1,0 +1,251 @@
+"""Slot-based continuous-batching serving engine.
+
+The vLLM-analog for this framework (the reference only ships
+``samples/vllm_dep.yaml`` pointing vLLM at its MIG slice — SURVEY.md §1),
+built TPU-first instead of translated:
+
+- **Static shapes everywhere**: the decode step is one jitted call over a
+  fixed (max_batch, 1) token tensor and a fixed-size KV cache — requests
+  come and go by occupying/freeing *slots*, never by changing shapes, so
+  XLA compiles exactly two programs (prefill, decode) regardless of
+  traffic. This is the TPU translation of continuous batching: vLLM grows
+  and shrinks a ragged batch; a TPU engine keeps the batch rectangular
+  and masks.
+- **Prefill/decode split**: prompts are prefilled at a fixed padded length
+  (one compile) into the slot's cache stripe; decoding advances all live
+  slots together, one token per step per slot.
+- **Per-slot offsets**: the model's cache mask admits position ``s`` for
+  slot ``b`` iff ``s <= lengths[b] + t``, so slots at different depths
+  coexist in one rectangular batch (``models/lm.py: apply_with_cache``).
+- Sampling is greedy or temperature softmax via ``jax.random`` — on-device,
+  no host round-trip per token beyond the sampled ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from instaslice_tpu.models.lm import Params, TpuLM
+
+
+@dataclasses.dataclass
+class GenerationResult:
+    request_id: int
+    prompt: List[int]
+    tokens: List[int]                 # generated ids (no prompt)
+    finished_reason: str = ""         # "eos" | "max_len" | ""
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    prompt: List[int]
+    generated: List[int]
+
+
+class ServingEngine:
+    def __init__(
+        self,
+        model: TpuLM,
+        params: Optional[Params] = None,
+        *,
+        max_batch: int = 8,
+        max_len: int = 512,
+        prefill_len: int = 64,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> None:
+        if prefill_len > max_len:
+            raise ValueError("prefill_len must be <= max_len")
+        self.model = model
+        self.params = (
+            params if params is not None else model.init(jax.random.key(0))
+        )
+        self.max_batch = max_batch
+        self.max_len = max_len
+        self.prefill_len = prefill_len
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self._rng = jax.random.key(seed)
+        self._next_id = 0
+        self.cache = model.init_cache(max_batch, max_len)
+        self.lengths = jnp.zeros(max_batch, jnp.int32)
+        self.last_token = jnp.zeros(max_batch, jnp.int32)
+        self.slots: Dict[int, _Slot] = {}          # slot index → request
+        self.finished: List[GenerationResult] = []
+        self.tokens_generated = 0
+
+        self._prefill = jax.jit(self._prefill_impl)
+        self._decode = jax.jit(self._decode_impl)
+
+    # ------------------------------------------------------------- jitted
+
+    def _prefill_impl(self, params, cache, tokens, slot, true_len):
+        """Prefill one slot: run the (1, prefill_len) padded prompt with a
+        zeroed cache stripe, write the stripe back at ``slot``, and return
+        the first sampled-from logits row."""
+        stripe = jax.tree.map(
+            lambda c: jnp.zeros_like(
+                jax.lax.dynamic_slice_in_dim(c, 0, 1, axis=1)
+            ),
+            cache,
+        )
+        logits, stripe = self.model.apply_with_cache(
+            params, tokens, stripe, jnp.zeros(1, jnp.int32)
+        )
+        cache = jax.tree.map(
+            lambda c, s: jax.lax.dynamic_update_slice_in_dim(
+                c, s, slot, axis=1
+            ),
+            cache, stripe,
+        )
+        last = jax.lax.dynamic_slice_in_dim(
+            logits[0], true_len - 1, 1, axis=0
+        )[0]                                        # (vocab,)
+        return cache, last
+
+    def _decode_impl(self, params, cache, last_token, lengths):
+        logits, cache = self.model.apply_with_cache(
+            params, last_token[:, None], cache, lengths
+        )
+        return cache, logits[:, 0]                  # (B, vocab)
+
+    def _sample(self, logits: jax.Array) -> jax.Array:
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        self._rng, sub = jax.random.split(self._rng)
+        return jax.random.categorical(
+            sub, logits / self.temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # -------------------------------------------------------------- public
+
+    def free_slots(self) -> int:
+        return self.max_batch - len(self.slots)
+
+    def add_request(self, prompt: List[int]) -> int:
+        """Admit a prompt; returns the request id. Raises when the batch
+        is full (callers queue) or the prompt exceeds prefill_len."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} > prefill_len "
+                f"{self.prefill_len}"
+            )
+        free = [i for i in range(self.max_batch) if i not in self.slots]
+        if not free:
+            raise RuntimeError("no free slots")
+        slot = free[0]
+        rid = self._next_id
+        self._next_id += 1
+        padded = jnp.asarray(
+            prompt + [0] * (self.prefill_len - len(prompt)), jnp.int32
+        )[None]
+        self.cache, last_logits = self._prefill(
+            self.params, self.cache, padded, slot, len(prompt)
+        )
+        tok = self._sample(last_logits[None])[0]
+        self.last_token = self.last_token.at[slot].set(tok)
+        self.lengths = self.lengths.at[slot].set(len(prompt))
+        self.slots[slot] = _Slot(rid, list(prompt), [int(tok)])
+        self.tokens_generated += 1
+        self._maybe_finish(slot)
+        return rid
+
+    def step(self) -> Dict[int, int]:
+        """One decode step for every live slot; returns request id → new
+        token. Slots hitting eos/max_len move to ``finished``."""
+        if not self.slots:
+            return {}
+        # the sampled token for step t is appended at position lengths+1
+        # (the prompt's last token sits at lengths-1; sampled continuation
+        # enters the cache when it is fed back as input here)
+        self.cache, logits = self._decode(
+            self.params, self.cache, self.last_token, self.lengths
+        )
+        toks = self._sample(logits)
+        out: Dict[int, int] = {}
+        for slot, req in list(self.slots.items()):
+            t = int(toks[slot])
+            out[req.request_id] = t
+            req.generated.append(t)
+            self.tokens_generated += 1
+        self.last_token = toks
+        live = jnp.zeros(self.max_batch, jnp.bool_)
+        for slot in self.slots:
+            live = live.at[slot].set(True)
+        self.lengths = jnp.where(live, self.lengths + 1, self.lengths)
+        for slot in list(self.slots):
+            self._maybe_finish(slot)
+        return out
+
+    def _maybe_finish(self, slot: int) -> None:
+        req = self.slots[slot]
+        total = len(req.prompt) + len(req.generated)
+        reason = ""
+        if self.eos_id is not None and req.generated[-1] == self.eos_id:
+            reason = "eos"
+        elif total >= self.max_len - 1:
+            reason = "max_len"
+        if reason:
+            self.finished.append(
+                GenerationResult(
+                    req.request_id, req.prompt, req.generated, reason
+                )
+            )
+            del self.slots[slot]
+
+    def generate(
+        self, prompts: List[List[int]], max_new_tokens: int
+    ) -> List[GenerationResult]:
+        """Batch convenience: run all prompts to completion (continuous
+        batching: new prompts are admitted as slots free up)."""
+        pending = list(enumerate(prompts))
+        want: Dict[int, int] = {}
+        results: Dict[int, GenerationResult] = {}
+        budget: Dict[int, int] = {}
+        while pending or self.slots:
+            while pending and self.free_slots():
+                idx, p = pending.pop(0)
+                rid = self.add_request(p)
+                want[rid] = idx
+                budget[rid] = max_new_tokens
+            self.step()
+            # enforce the per-request budget
+            for slot, req in list(self.slots.items()):
+                if len(req.generated) >= budget[req.request_id]:
+                    self.finished.append(
+                        GenerationResult(
+                            req.request_id, req.prompt, req.generated,
+                            "max_new_tokens",
+                        )
+                    )
+                    del self.slots[slot]
+            for r in self.finished:
+                if r.request_id in want:
+                    results[want.pop(r.request_id)] = r
+            self.finished.clear()
+        return [results[i] for i in sorted(results)]
+
+    def throughput(
+        self, n_steps: int = 50, batch: Optional[int] = None
+    ) -> float:
+        """Decode tokens/sec at the given concurrency (BASELINE secondary
+        metric: tokens/sec/chip — divide by the slice's chip count)."""
+        batch = batch or self.max_batch
+        for _ in range(min(batch, self.free_slots())):
+            self.add_request([1, 2, 3])
+        self.step()                                   # compile
+        t0 = time.perf_counter()
+        done = 0
+        for _ in range(n_steps):
+            done += len(self.step())
+        dt = time.perf_counter() - t0
+        return done / dt if dt > 0 else 0.0

@@ -1,23 +1,27 @@
-"""Slice-consumer SDK: what runs *inside* a granted pod.
-
-The reference ships workloads only as sample YAML (cuda vectoradd, TF
-notebook, vLLM — ``/root/reference/samples/``, SURVEY.md §1 "Workloads ...
-are *consumers* ... not part of the framework"). For a TPU slice that is
-not enough: a slice is defined by its ICI mesh, so the consumer needs real
-library support to (a) reconstruct the mesh from the handoff env the node
-agent publishes (``agent/handoff.py``) and (b) shard its computation over
-it with jax/pjit. This package provides both, plus a flagship sharded
-transformer LM used by the samples, the benchmarks, and
-``__graft_entry__.py``.
+"""Deprecated alias package — the slice-consumer SDK moved to
+:mod:`instaslice_tpu.parallel` (meshenv, ring), :mod:`instaslice_tpu.models`
+(lm, train), :mod:`instaslice_tpu.ops` (pallas kernels) and
+:mod:`instaslice_tpu.serving` (engine). Old import paths keep working via
+the module aliases below.
 """
 
-from instaslice_tpu.workload.meshenv import (
+import sys
+
+from instaslice_tpu.models import lm as model
+from instaslice_tpu.models import train
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.models.train import TrainState, make_train_step
+from instaslice_tpu.parallel import meshenv, ring
+from instaslice_tpu.parallel.meshenv import (
     SliceTopology,
     initialize_distributed,
     slice_mesh,
 )
-from instaslice_tpu.workload.model import ModelConfig, TpuLM
-from instaslice_tpu.workload.train import TrainState, make_train_step
+
+sys.modules[__name__ + ".model"] = model
+sys.modules[__name__ + ".train"] = train
+sys.modules[__name__ + ".meshenv"] = meshenv
+sys.modules[__name__ + ".ring"] = ring
 
 __all__ = [
     "SliceTopology",

@@ -110,6 +110,57 @@ class TestGrantedSliceFailure:
         assert len(res) == 1 and dead not in res[0].chip_ids
 
 
+class TestMultiHostSliceHealth:
+    """A multi-host slice is only healthy as a whole: chip death on ONE
+    host must signal (or evict) the worker pods on EVERY host."""
+
+    @pytest.fixture
+    def cluster2(self):
+        c = SimCluster(n_nodes=2, generation="v5e", shared_torus=True,
+                       deletion_grace_seconds=0.2,
+                       health_interval=0.1).start()
+        yield c
+        c.stop()
+
+    def test_all_group_pods_annotated(self, cluster2):
+        cluster2.submit("w-0", "v5e-4x4", group="j", group_size=2)
+        cluster2.submit("w-1", "v5e-4x4", group="j", group_size=2)
+        assert cluster2.wait_phase("w-0", "Running", timeout=20)
+        assert cluster2.wait_phase("w-1", "Running", timeout=20)
+        cluster2.backends["node-0"].fail_chip(2)
+
+        def both_annotated():
+            return all(
+                "node-0" in (
+                    cluster2.pod(n)["metadata"].get("annotations", {})
+                    .get(UNHEALTHY_ANNOTATION, "")
+                )
+                for n in ("w-0", "w-1")
+            )
+
+        assert wait_for(both_annotated)
+        # both keep running (no opt-in), including the healthy-host pod
+        assert cluster2.pod_phase("w-0") == "Running"
+        assert cluster2.pod_phase("w-1") == "Running"
+
+    def test_opt_in_evicts_whole_group(self, cluster2):
+        ann = {RESTART_ON_FAILURE_ANNOTATION: "true"}
+        cluster2.submit("w-0", "v5e-4x4", group="j", group_size=2,
+                        annotations=ann)
+        cluster2.submit("w-1", "v5e-4x4", group="j", group_size=2,
+                        annotations=ann)
+        assert cluster2.wait_phase("w-0", "Running", timeout=20)
+        assert cluster2.wait_phase("w-1", "Running", timeout=20)
+        cluster2.backends["node-1"].fail_chip(0)
+        # BOTH workers evicted — including the one on the healthy host
+        assert cluster2.wait_gone("w-0", timeout=15)
+        assert cluster2.wait_gone("w-1", timeout=15)
+        assert wait_for(lambda: all(
+            not b.list_reservations()
+            for b in cluster2.backends.values()
+        ))
+
+
 class TestInFlightFailure:
     def test_creating_allocation_failed_and_retried(self, cluster):
         """A chip dying between placement and realization fails the

@@ -12,18 +12,18 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from instaslice_tpu.workload.meshenv import (
+from instaslice_tpu.parallel.meshenv import (
     SliceTopology,
     slice_mesh,
 )
-from instaslice_tpu.workload.model import (
+from instaslice_tpu.models.lm import (
     ModelConfig,
     TpuLM,
     _attention,
     param_specs,
 )
-from instaslice_tpu.workload.ring import ring_attention
-from instaslice_tpu.workload.train import make_train_step
+from instaslice_tpu.parallel.ring import ring_attention
+from instaslice_tpu.models.train import make_train_step
 
 
 def tiny(ring=False, experts=0):
@@ -191,3 +191,15 @@ class TestGraftEntry:
         import __graft_entry__ as ge
 
         ge.dryrun_multichip(8)
+
+
+class TestWorkloadCompatShim:
+    def test_old_import_paths_still_work(self):
+        from instaslice_tpu.workload import ModelConfig as MC1
+        from instaslice_tpu.workload.model import ModelConfig as MC2
+        from instaslice_tpu.workload.meshenv import slice_mesh as sm
+        from instaslice_tpu.workload.ring import ring_attention as ra
+        from instaslice_tpu.models.lm import ModelConfig as MC3
+
+        assert MC1 is MC2 is MC3
+        assert callable(sm) and callable(ra)
