@@ -45,6 +45,7 @@ from instaslice_tpu.kube.client import (
 from instaslice_tpu.topology.grid import coord_to_id, get_generation
 from instaslice_tpu.topology.placement import Box
 from instaslice_tpu.utils.reconcile import Manager
+from instaslice_tpu.utils.trace import get_tracer
 
 log = logging.getLogger("instaslice_tpu.agent")
 
@@ -74,6 +75,7 @@ class NodeAgent:
         self.namespace = namespace
         self.metrics = metrics
         self.health_interval = health_interval
+        self.tracer = get_tracer()
         self.manager = Manager(
             name=f"agent-{node_name}",
             client=client,
@@ -143,7 +145,11 @@ class NodeAgent:
         chip_ids = self._chip_ids_for(ts, alloc)
         t0 = time.monotonic()
         try:
-            self.backend.reserve(suid, chip_ids)
+            with self.tracer.span(
+                "device.reserve", node=self.node_name, slice=suid,
+                chips=len(chip_ids),
+            ):
+                self.backend.reserve(suid, chip_ids)
         except SliceExists:
             log.info("%s: reservation %s already live (idempotent)",
                      self.node_name, suid)
@@ -243,7 +249,10 @@ class NodeAgent:
         # was deleted (raced mut returning None) would otherwise leak the
         # device reservation forever.
         try:
-            self.backend.release(suid)
+            with self.tracer.span(
+                "device.release", node=self.node_name, slice=suid
+            ):
+                self.backend.release(suid)
         except SliceNotFound:
             pass
         except DeviceError as e:

@@ -23,7 +23,28 @@ def main(argv=None) -> int:
     place.add_argument("--hosts", type=int, default=1)
     place.add_argument("--policy", default="best-fit")
 
+    tr = sub.add_parser(
+        "trace-summary",
+        help="summarize a TPUSLICE_TRACE_FILE JSONL (per-span p50/max)",
+    )
+    tr.add_argument("file", help="trace JSONL path")
+
     args = p.parse_args(argv)
+
+    if args.cmd == "trace-summary":
+        from instaslice_tpu.utils.trace import summarize_durations
+
+        by = {}
+        with open(args.file) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                by.setdefault(rec["name"], []).append(rec["durationMs"])
+        for name, row in summarize_durations(by).items():
+            print(json.dumps({"name": name, **row}))
+        return 0
 
     if args.cmd == "catalog":
         from instaslice_tpu.topology import profile_catalog

@@ -9,4 +9,21 @@ with the granted slice's mesh.
 from instaslice_tpu.models.lm import ModelConfig, TpuLM
 from instaslice_tpu.models.train import TrainState, make_train_step
 
-__all__ = ["ModelConfig", "TpuLM", "TrainState", "make_train_step"]
+__all__ = [
+    "ModelConfig",
+    "TpuLM",
+    "TrainState",
+    "make_train_step",
+    "TrainCheckpointer",
+    "abstract_train_state",
+]
+
+
+def __getattr__(name):
+    # Lazy: checkpoint.py needs orbax, which a lean workload container may
+    # not ship; importing the models package must not require it.
+    if name in ("TrainCheckpointer", "abstract_train_state"):
+        from instaslice_tpu.models import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(name)

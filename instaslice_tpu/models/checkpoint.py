@@ -1,0 +1,102 @@
+"""Checkpoint / resume for the training loop (orbax-backed).
+
+SURVEY.md §5 "Checkpoint / resume": the reference's durable state is the
+``Instaslice`` CR in etcd — covered here by the operator's CRs. The
+*workload* side (which the reference doesn't have at all) needs real
+checkpointing: sharded `TrainState` save/restore that works on a multi-host
+slice, where every worker participates in a distributed orbax save and
+arrays are restored **directly into their shardings** (no host-side full
+copy — a 7B state would not fit one host).
+
+Resume-safety contract: saves are atomic (orbax commit protocol), the
+manager keeps the newest ``max_to_keep`` steps, and restoring onto a fresh
+process reproduces bit-identical training continuation (verified in
+``tests/test_checkpoint.py`` against an uninterrupted run).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+from instaslice_tpu.models.train import TrainState
+
+
+def _ocp():
+    """Import orbax lazily: the workload SDK must stay importable in a
+    container that ships jax+optax but not orbax (nothing else in the
+    package needs it)."""
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+class TrainCheckpointer:
+    """Thin, opinionated wrapper over ``ocp.CheckpointManager``."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ) -> None:
+        ocp = _ocp()
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+                enable_async_checkpointing=False,
+            ),
+        )
+
+    # ------------------------------------------------------------------
+
+    def save(self, state: TrainState, step: Optional[int] = None) -> bool:
+        """Persist ``state``; returns False when skipped by the save
+        interval. ``step`` defaults to the state's own step counter."""
+        if step is None:
+            step = int(state.step)
+        saved = self._mgr.save(
+            step, args=_ocp().args.StandardSave(state)
+        )
+        self._mgr.wait_until_finished()
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self, abstract_state: Any, step: Optional[int] = None
+    ) -> Optional[TrainState]:
+        """Restore into the shardings carried by ``abstract_state`` (a
+        pytree of ``jax.ShapeDtypeStruct`` with ``.sharding`` set — build
+        it with :func:`abstract_train_state`). Returns None when the
+        directory holds no checkpoint (fresh start)."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        return self._mgr.restore(
+            step, args=_ocp().args.StandardRestore(abstract_state)
+        )
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def abstract_train_state(init_fn, rng=None) -> Any:
+    """Abstract (shape+dtype+sharding) TrainState for sharded restore,
+    derived from a jitted ``init_fn`` WITHOUT materializing the params:
+    ``jax.eval_shape`` over the jit carries the ``out_shardings``."""
+    rng = rng if rng is not None else jax.random.key(0)
+    return jax.eval_shape(init_fn, rng)

@@ -108,13 +108,17 @@ class Manager:
         watches: List[Tuple[str, Optional[str], MapFunc]],
         resync_period: float = 30.0,
         error_backoff: float = 0.5,
+        tracer=None,
     ) -> None:
+        from instaslice_tpu.utils.trace import get_tracer
+
         self.name = name
         self.client = client
         self.reconcile = reconcile
         self.watches = watches
         self.resync_period = resync_period
         self.error_backoff = error_backoff
+        self.tracer = tracer if tracer is not None else get_tracer()
         self.queue = WorkQueue()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -177,7 +181,10 @@ class Manager:
                 continue
             self.reconcile_count += 1
             try:
-                requeue = self.reconcile(key)
+                with self.tracer.span(
+                    f"{self.name}.reconcile", key=key
+                ):
+                    requeue = self.reconcile(key)
             except Exception:
                 self.error_count += 1
                 log.warning(

@@ -1,0 +1,151 @@
+"""Lightweight structured tracing for the operator.
+
+SURVEY.md §5: the reference has **no** tracing/profiling at all (no
+OpenTelemetry/pprof anywhere in its go.mod). This module closes that gap
+without external deps: every reconcile and device-layer operation becomes
+a span in a thread-safe in-memory ring (inspectable in tests and from the
+CLI), optionally streamed as JSON lines to ``TPUSLICE_TRACE_FILE`` for
+offline analysis. Spans are cheap enough to leave on in production —
+a monotonic clock read and a deque append per span.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str                      # e.g. "controller.reconcile"
+    start: float                   # unix seconds
+    duration_ms: float
+    attrs: Dict[str, str]
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "start": round(self.start, 6),
+            "durationMs": round(self.duration_ms, 3),
+            **({"error": self.error} if self.error else {}),
+        }
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+class Tracer:
+    """Per-process tracer: bounded ring of finished spans + counters."""
+
+    def __init__(self, capacity: int = 4096,
+                 trace_file: Optional[str] = None) -> None:
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)
+        self._counts: Dict[str, int] = {}
+        self._file = None
+        # file writes get their own lock so a slow disk can't serialize
+        # every reconcile thread behind the hot span-record lock
+        self._file_lock = threading.Lock()
+        path = trace_file or os.environ.get("TPUSLICE_TRACE_FILE")
+        if path:
+            self._file = open(path, "a", buffering=1)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs: str) -> Iterator[Span]:
+        rec = Span(
+            name=name,
+            start=time.time(),
+            duration_ms=0.0,
+            attrs={k: str(v) for k, v in attrs.items()},
+        )
+        t0 = time.monotonic()
+        try:
+            yield rec
+        except BaseException as e:
+            rec.error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            rec.duration_ms = (time.monotonic() - t0) * 1e3
+            with self._lock:
+                self._spans.append(rec)
+                self._counts[name] = self._counts.get(name, 0) + 1
+                sink = self._file
+            if sink is not None:
+                line = json.dumps(rec.to_dict()) + "\n"
+                with self._file_lock:
+                    if self._file is not None:
+                        self._file.write(line)
+
+    # ------------------------------------------------------------ querying
+
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def summary(self) -> Dict[str, dict]:
+        """Per-span-name count / p50 / max stats (for the CLI)."""
+        by: Dict[str, List[float]] = {}
+        for s in self.spans():
+            by.setdefault(s.name, []).append(s.duration_ms)
+        counts = self.counts()
+        return summarize_durations(
+            by, counts={n: counts.get(n) for n in by}
+        )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._counts.clear()
+
+    def close(self) -> None:
+        with self._file_lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def summarize_durations(
+    by_name: Dict[str, List[float]],
+    counts: Optional[Dict[str, Optional[int]]] = None,
+) -> Dict[str, dict]:
+    """Aggregate {span name → [durations ms]} into count/p50Ms/maxMs rows
+    (shared by :meth:`Tracer.summary` and the CLI's ``trace-summary``)."""
+    out: Dict[str, dict] = {}
+    for name in sorted(by_name):
+        ds = sorted(by_name[name])
+        count = None
+        if counts is not None:
+            count = counts.get(name)
+        out[name] = {
+            "count": count if count is not None else len(ds),
+            "p50Ms": round(ds[len(ds) // 2], 3),
+            "maxMs": round(ds[-1], 3),
+        }
+    return out
+
+
+_default: Optional[Tracer] = None
+_default_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    """Process-wide default tracer (created lazily)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Tracer()
+        return _default

@@ -1,0 +1,85 @@
+"""Tracing subsystem tests: span recording, error capture, file output,
+and end-to-end spans from a simulated cluster run."""
+
+import json
+import threading
+
+import pytest
+
+from instaslice_tpu.sim import SimCluster
+from instaslice_tpu.utils.trace import Tracer, get_tracer
+
+
+class TestTracer:
+    def test_span_records_duration_and_attrs(self):
+        t = Tracer()
+        with t.span("op", key="a"):
+            pass
+        [s] = t.spans()
+        assert s.name == "op" and s.attrs == {"key": "a"}
+        assert s.duration_ms >= 0
+        assert t.counts() == {"op": 1}
+
+    def test_error_captured_and_reraised(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            with t.span("bad"):
+                raise ValueError("boom")
+        [s] = t.spans()
+        assert "ValueError: boom" in s.error
+
+    def test_ring_bounded(self):
+        t = Tracer(capacity=10)
+        for i in range(25):
+            with t.span("op", i=i):
+                pass
+        assert len(t.spans()) == 10
+        assert t.counts()["op"] == 25  # counters survive eviction
+
+    def test_file_output(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        t = Tracer(trace_file=path)
+        with t.span("op", key="x"):
+            pass
+        t.close()
+        [rec] = [json.loads(line) for line in open(path)]
+        assert rec["name"] == "op" and rec["attrs"] == {"key": "x"}
+
+    def test_thread_safety(self):
+        t = Tracer(capacity=100)
+
+        def worker():
+            for _ in range(200):
+                with t.span("op"):
+                    pass
+
+        ths = [threading.Thread(target=worker) for _ in range(8)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        assert t.counts()["op"] == 1600
+
+    def test_summary(self):
+        t = Tracer()
+        for _ in range(3):
+            with t.span("a"):
+                pass
+        s = t.summary()
+        assert s["a"]["count"] == 3 and s["a"]["maxMs"] >= s["a"]["p50Ms"]
+
+
+class TestEndToEndSpans:
+    def test_sim_run_produces_reconcile_and_device_spans(self):
+        tracer = get_tracer()
+        tracer.clear()
+        with SimCluster(n_nodes=1, deletion_grace_seconds=0.2) as c:
+            c.submit("demo", "v5e-1x1")
+            assert c.wait_phase("demo", "Running", timeout=10)
+            c.delete_pod("demo")
+            assert c.wait_gone("demo", timeout=10)
+        counts = tracer.counts()
+        assert counts.get("controller.reconcile", 0) > 0
+        assert counts.get("agent-node-0.reconcile", 0) > 0
+        assert counts.get("device.reserve", 0) == 1
+        assert counts.get("device.release", 0) >= 1
