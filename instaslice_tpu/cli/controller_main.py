@@ -12,10 +12,12 @@ def build_parser() -> argparse.ArgumentParser:
         description="instaslice_tpu cluster controller: watches gated pods, "
         "allocates TPU sub-slices, ungates.",
     )
+    from instaslice_tpu.topology.policy import policy_names
+
     p.add_argument("--namespace", default="instaslice-tpu-system",
                    help="namespace for operator-owned objects")
-    p.add_argument("--policy", default="first-fit",
-                   help="allocation policy (first-fit|best-fit|packed-fit)")
+    p.add_argument("--policy", default="first-fit", choices=policy_names(),
+                   help="allocation policy")
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
     p.add_argument("--leader-elect", action="store_true")

@@ -29,7 +29,63 @@ def main(argv=None) -> int:
     )
     tr.add_argument("file", help="trace JSONL path")
 
+    sb = sub.add_parser(
+        "serve-bench",
+        help="decode tokens/sec of the serving engine on this host's "
+        "accelerator (BASELINE secondary metric: divide by chip count)",
+    )
+    sb.add_argument("--d-model", type=int, default=512)
+    sb.add_argument("--n-layers", type=int, default=4)
+    sb.add_argument("--n-heads", type=int, default=8)
+    sb.add_argument("--d-ff", type=int, default=2048)
+    sb.add_argument("--vocab", type=int, default=32000)
+    sb.add_argument("--batch", type=int, default=8)
+    sb.add_argument("--max-len", type=int, default=256)
+    sb.add_argument("--prefill-len", type=int, default=16)
+    sb.add_argument("--steps", type=int, default=30)
+
     args = p.parse_args(argv)
+
+    if args.cmd == "serve-bench" and args.prefill_len > args.max_len:
+        p.error(
+            f"--prefill-len {args.prefill_len} must be <= --max-len "
+            f"{args.max_len}"
+        )
+
+    if args.cmd == "serve-bench":
+        import jax
+        import jax.numpy as jnp
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine
+
+        on_tpu = jax.default_backend() == "tpu"
+        cfg = ModelConfig(
+            vocab_size=args.vocab,
+            d_model=args.d_model,
+            n_heads=args.n_heads,
+            n_layers=args.n_layers,
+            d_ff=args.d_ff,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            remat=False,
+        )
+        eng = ServingEngine(
+            TpuLM(cfg), max_batch=args.batch, max_len=args.max_len,
+            prefill_len=args.prefill_len,
+        )
+        tps = eng.throughput(n_steps=args.steps)
+        print(json.dumps({
+            "metric": "serve_decode_tokens_per_sec",
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+            "backend": jax.default_backend(),
+            "batch": args.batch,
+            "model": {
+                "dModel": args.d_model, "nLayers": args.n_layers,
+                "nHeads": args.n_heads, "dFF": args.d_ff,
+            },
+        }))
+        return 0
 
     if args.cmd == "trace-summary":
         from instaslice_tpu.utils.trace import summarize_durations

@@ -103,8 +103,51 @@ class PackedFitPolicy(AllocationPolicy):
         )
 
 
+class LeftToRightPolicy(AllocationPolicy):
+    """Lowest anchor along the x axis (ties: y, then z) — the policy the
+    reference declares but leaves as an empty stub
+    (``LeftToRightPolicy.SetAllocationDetails``,
+    instaslice_controller.go:455-461), implemented for real. Pairs with
+    :class:`RightToLeftPolicy` to segregate long-lived and short-lived
+    workloads at opposite ends of the torus."""
+
+    name = "left-to-right"
+
+    def choose(self, group, profile, occupancy):
+        cands = find_placements(group, profile, occupancy)
+        if not cands:
+            return None
+        return min(cands, key=lambda c: c.box.anchor)
+
+
+class RightToLeftPolicy(AllocationPolicy):
+    """Highest far-corner along the x axis (ties: y, then z) — the
+    reference's other empty stub (instaslice_controller.go:463-469),
+    implemented for real."""
+
+    name = "right-to-left"
+
+    def choose(self, group, profile, occupancy):
+        cands = find_placements(group, profile, occupancy)
+        if not cands:
+            return None
+        return max(
+            cands,
+            key=lambda c: tuple(
+                c.box.anchor[i] + c.box.shape[i] for i in range(3)
+            ),
+        )
+
+
 _REGISTRY: Dict[str, Type[AllocationPolicy]] = {
-    p.name: p for p in (FirstFitPolicy, BestFitPolicy, PackedFitPolicy)
+    p.name: p
+    for p in (
+        FirstFitPolicy,
+        BestFitPolicy,
+        PackedFitPolicy,
+        LeftToRightPolicy,
+        RightToLeftPolicy,
+    )
 }
 
 

@@ -234,6 +234,36 @@ class TestPolicies:
         with pytest.raises(KeyError):
             get_policy("nope")
 
+    def test_left_to_right_and_right_to_left_opposite_ends(self):
+        """The reference's two stub policies, real here: consecutive 1x1
+        grants grow from opposite ends of the same mesh."""
+        g = v5e_16()
+        occ = Occupancy(g)
+        ltr = get_policy("left-to-right")
+        rtl = get_policy("right-to-left")
+        prof = parse_profile_name("v5e-1x1")
+        a = ltr.choose(g, prof, occ)
+        occ.occupy(a.box)
+        b = rtl.choose(g, prof, occ)
+        occ.occupy(b.box)
+        assert a.box.anchor == (0, 0, 0)
+        assert b.box.anchor[0] + b.box.shape[0] == g.bounds[0]
+        # churn to full: ltr grants stay in the low-x half, rtl grants in
+        # the high-x half, converging on the middle (occupancy already
+        # forbids overlap; the POLICY property is the directionality)
+        mid = g.bounds[0] // 2
+        for _ in range(7):
+            pa = ltr.choose(g, prof, occ)
+            occ.occupy(pa.box)
+            pb = rtl.choose(g, prof, occ)
+            occ.occupy(pb.box)
+            assert pa.box.anchor[0] < mid or occ.free_chips() < 2
+            assert (
+                pb.box.anchor[0] + pb.box.shape[0] > mid
+                or occ.free_chips() < 2
+            )
+        assert occ.free_chips() == 0
+
     def test_stress_mix_8_pods_v5e16(self):
         """BASELINE bin-packing stress: 8 concurrent pods, mixed profiles
         on one v5e-16 mesh (16 chips): 1x 2x2 + 3x 2x1 + 4x 1x1 = 14 chips
