@@ -1,0 +1,96 @@
+"""Node-agent process runner — the ``cmd/daemonset/main.go`` analog:
+client resolution, device-backend selection, metrics server, health
+probes, signal handling around the
+:class:`~instaslice_tpu.agent.reconciler.NodeAgent` (reference wiring:
+``cmd/daemonset/main.go:55-168``). No leader election: exactly one agent
+runs per node (DaemonSet), each keyed to its own CR."""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+from instaslice_tpu.agent.reconciler import NodeAgent
+from instaslice_tpu.device.backend import DeviceBackend
+from instaslice_tpu.kube.client import KubeClient
+from instaslice_tpu.metrics.metrics import (
+    OperatorMetrics,
+    start_metrics_server,
+)
+from instaslice_tpu.utils.probes import ProbeServer
+
+log = logging.getLogger("instaslice_tpu.agent.runner")
+
+
+def _port_of(bind_address: str) -> int:
+    try:
+        return int(bind_address.rpartition(":")[2])
+    except ValueError:
+        return 0
+
+
+class AgentRunner:
+    def __init__(
+        self,
+        client: KubeClient,
+        backend: DeviceBackend,
+        node_name: str,
+        namespace: str = "instaslice-tpu-system",
+        metrics_bind_address: str = ":8084",
+        health_probe_bind_address: str = ":8085",
+    ) -> None:
+        self.metrics = OperatorMetrics()
+        self.metrics_port = _port_of(metrics_bind_address)
+        self.probe_address = health_probe_bind_address
+        self.agent = NodeAgent(
+            client, backend, node_name, namespace, metrics=self.metrics
+        )
+        self._stop = threading.Event()
+        self._ready = False
+        self.probes: Optional[ProbeServer] = None
+
+    @classmethod
+    def from_args(cls, args) -> "AgentRunner":
+        from instaslice_tpu.device.select import select_backend
+        from instaslice_tpu.kube.real import build_client
+
+        return cls(
+            build_client(getattr(args, "kubeconfig", "")),
+            select_backend(args.backend),
+            node_name=args.node_name,
+            namespace=args.namespace,
+            metrics_bind_address=args.metrics_bind_address,
+            health_probe_bind_address=args.health_probe_bind_address,
+        )
+
+    def stop(self, *_sig) -> None:
+        self._stop.set()
+
+    def run(self) -> int:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        )
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, self.stop)
+            except ValueError:  # not the main thread (tests)
+                pass
+        self.probes = ProbeServer(
+            self.probe_address, ready_check=lambda: self._ready
+        ).start()
+        start_metrics_server(self.metrics, self.metrics_port)
+        self.agent.start()
+        self._ready = True
+        log.info("agent running (node=%s, backend=%s)",
+                 self.agent.node_name, self.agent.backend.name)
+        try:
+            self._stop.wait()
+        finally:
+            self._ready = False
+            self.agent.stop()
+            if self.probes:
+                self.probes.stop()
+        return 0

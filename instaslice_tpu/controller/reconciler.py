@@ -58,27 +58,7 @@ from instaslice_tpu.utils.reconcile import Manager
 log = logging.getLogger("instaslice_tpu.controller")
 
 
-def _parse_timestamp(val) -> float:
-    """Epoch seconds from either a numeric value (FakeKube) or a real API
-    server's RFC3339 string ('2026-07-29T08:00:00Z')."""
-    if val is None:
-        return 0.0
-    try:
-        return float(val)
-    except (TypeError, ValueError):
-        pass
-    import datetime
-
-    try:
-        # 'Z' suffix only parses from 3.11; normalize for 3.10
-        return datetime.datetime.fromisoformat(
-            str(val).replace("Z", "+00:00")
-        ).timestamp()
-    except ValueError:
-        # epoch 0 = "grace long expired": proceed with teardown rather
-        # than restarting the grace window on every reconcile
-        log.warning("unparseable timestamp %r; treating as epoch", val)
-        return 0.0
+from instaslice_tpu.utils.timeutil import parse_timestamp as _parse_timestamp
 
 
 class Controller:

@@ -1,0 +1,122 @@
+"""Controller process runner — the ``cmd/controller/main.go`` analog:
+client resolution, metrics server, health probes, leader election, signal
+handling around the :class:`~instaslice_tpu.controller.reconciler.Controller`
+reconcile loops (reference wiring: ``cmd/controller/main.go:55-168``,
+leader-election id ``7cbd68d5.codeflare.dev``)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import threading
+from typing import Optional
+
+from instaslice_tpu.controller.reconciler import Controller
+from instaslice_tpu.kube.client import KubeClient
+from instaslice_tpu.metrics.metrics import (
+    OperatorMetrics,
+    start_metrics_server,
+)
+from instaslice_tpu.utils.election import LeaderElector
+from instaslice_tpu.utils.probes import ProbeServer
+
+log = logging.getLogger("instaslice_tpu.controller.runner")
+
+LEASE_NAME = "tpuslice-controller-leader"
+
+
+def _port_of(bind_address: str) -> int:
+    try:
+        return int(bind_address.rpartition(":")[2])
+    except ValueError:
+        return 0
+
+
+class ControllerRunner:
+    def __init__(
+        self,
+        client: KubeClient,
+        namespace: str = "instaslice-tpu-system",
+        policy: str = "first-fit",
+        deletion_grace_seconds: float = 30.0,
+        metrics_bind_address: str = ":8080",
+        health_probe_bind_address: str = ":8081",
+        leader_elect: bool = False,
+        identity: str = "",
+    ) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.leader_elect = leader_elect
+        self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
+        self.metrics = OperatorMetrics()
+        self.metrics_port = _port_of(metrics_bind_address)
+        self.probe_address = health_probe_bind_address
+        self.controller = Controller(
+            client,
+            namespace=namespace,
+            policy=policy,
+            deletion_grace_seconds=deletion_grace_seconds,
+            metrics=self.metrics,
+        )
+        self._stop = threading.Event()
+        self._ready = False
+        self.probes: Optional[ProbeServer] = None
+        self.elector: Optional[LeaderElector] = None
+
+    @classmethod
+    def from_args(cls, args) -> "ControllerRunner":
+        from instaslice_tpu.kube.real import build_client
+
+        return cls(
+            build_client(getattr(args, "kubeconfig", "")),
+            namespace=args.namespace,
+            policy=args.policy,
+            deletion_grace_seconds=args.deletion_grace_seconds,
+            metrics_bind_address=args.metrics_bind_address,
+            health_probe_bind_address=args.health_probe_bind_address,
+            leader_elect=args.leader_elect,
+        )
+
+    # ------------------------------------------------------------------
+
+    def stop(self, *_sig) -> None:
+        self._stop.set()
+
+    def run(self) -> int:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(levelname)s %(name)s %(message)s",
+        )
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, self.stop)
+            except ValueError:  # not the main thread (tests)
+                pass
+        self.probes = ProbeServer(
+            self.probe_address, ready_check=lambda: self._ready
+        ).start()
+        start_metrics_server(self.metrics, self.metrics_port)
+        if self.leader_elect:
+            self.elector = LeaderElector(
+                self.client, self.namespace, LEASE_NAME, self.identity
+            )
+            log.info("waiting for leader lease %s/%s",
+                     self.namespace, LEASE_NAME)
+            if not self.elector.acquire(self._stop):
+                return 0  # stopped while waiting
+            self.elector.start_renewing(on_lost=self.stop)
+        self.controller.start()
+        self._ready = True
+        log.info("controller running (namespace=%s)", self.namespace)
+        try:
+            self._stop.wait()
+        finally:
+            self._ready = False
+            self.controller.stop()
+            if self.elector:
+                self.elector.release()
+            if self.probes:
+                self.probes.stop()
+        return 0

@@ -1,0 +1,360 @@
+"""Real Kubernetes API client over the stdlib (no external deps).
+
+The reference gets its API access from controller-runtime/client-go; this
+environment ships no ``kubernetes`` package, so the client is implemented
+directly against the REST API: bearer-token / client-cert auth, in-cluster
+service-account config, kubeconfig parsing, JSON verbs with the error
+mapping the reconcilers rely on (404 → NotFound, 409 reason AlreadyExists
+vs Conflict), merge-patch, the status subresource, and **streaming watches
+with resourceVersion resume + bookmarks** — the exact contract
+:class:`instaslice_tpu.kube.client.KubeClient` documents and the fake
+implements, so every reconciler runs unchanged against a live cluster.
+
+Tested against a real HTTP server in ``tests/test_realclient.py`` (the
+fake API served over HTTP — the envtest analog: same wire format, no
+cluster needed).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import socket
+import ssl
+import tempfile
+import urllib.parse
+import urllib.request
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from instaslice_tpu import GROUP, KIND, PLURAL, VERSION
+from instaslice_tpu.kube.client import (
+    AlreadyExists,
+    ApiError,
+    BadRequest,
+    Conflict,
+    KubeClient,
+    NotFound,
+    WatchEvent,
+)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def build_client(kubeconfig: str = "") -> "RealKubeClient":
+    """Standard client resolution: explicit kubeconfig → in-cluster
+    service account → default kubeconfig path."""
+    if kubeconfig:
+        return RealKubeClient.from_kubeconfig(kubeconfig)
+    if os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return RealKubeClient.in_cluster()
+    return RealKubeClient.from_kubeconfig()
+
+#: kind → (api prefix, plural, namespaced)
+_KIND_INFO: Dict[str, Tuple[str, str, bool]] = {
+    "Pod": ("api/v1", "pods", True),
+    "Node": ("api/v1", "nodes", False),
+    "ConfigMap": ("api/v1", "configmaps", True),
+    "Namespace": ("api/v1", "namespaces", False),
+    "Lease": ("apis/coordination.k8s.io/v1", "leases", True),
+    KIND: (f"apis/{GROUP}/{VERSION}", PLURAL, True),
+}
+
+
+def _raise_for(status: int, body: bytes) -> None:
+    try:
+        payload = json.loads(body.decode() or "{}")
+    except ValueError:
+        payload = {}
+    message = payload.get("message", body.decode(errors="replace")[:300])
+    reason = payload.get("reason", "")
+    if status == 404:
+        raise NotFound(message)
+    if status == 409:
+        if reason == "AlreadyExists":
+            raise AlreadyExists(message)
+        raise Conflict(message)
+    if status == 400 or status == 422:
+        raise BadRequest(message)
+    err = ApiError(f"HTTP {status}: {message}")
+    err.code = status
+    raise err
+
+
+class RealKubeClient(KubeClient):
+    """Talks to a live API server. Construct via :meth:`in_cluster`,
+    :meth:`from_kubeconfig`, or directly with a base URL (tests)."""
+
+    #: real watches are cheap to hold open; the reconcile Manager reads
+    #: this to avoid 4-reconnects-per-second against a live API server
+    preferred_watch_timeout = 15.0
+
+    def __init__(
+        self,
+        base_url: str,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        client_cert: Optional[Tuple[str, str]] = None,
+        insecure_skip_verify: bool = False,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self._token = token
+        if self.base_url.startswith("https"):
+            ctx = ssl.create_default_context(cafile=ca_file)
+            if insecure_skip_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if client_cert:
+                ctx.load_cert_chain(*client_cert)
+            self._ctx: Optional[ssl.SSLContext] = ctx
+        else:
+            self._ctx = None
+
+    # ------------------------------------------------------------- config
+
+    @classmethod
+    def in_cluster(cls) -> "RealKubeClient":
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if ":" in host and not host.startswith("["):
+            host = f"[{host}]"
+        with open(os.path.join(SA_DIR, "token")) as f:
+            token = f.read().strip()
+        return cls(
+            f"https://{host}:{port}",
+            token=token,
+            ca_file=os.path.join(SA_DIR, "ca.crt"),
+        )
+
+    @classmethod
+    def from_kubeconfig(
+        cls, path: str = "", context: str = ""
+    ) -> "RealKubeClient":
+        import yaml
+
+        path = path or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config")
+        )
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context", "")
+        ctx = next(
+            c["context"] for c in cfg["contexts"] if c["name"] == ctx_name
+        )
+        cluster = next(
+            c["cluster"] for c in cfg["clusters"]
+            if c["name"] == ctx["cluster"]
+        )
+        user = next(
+            u["user"] for u in cfg["users"] if u["name"] == ctx["user"]
+        )
+
+        def materialize(data_key: str, file_key: str, blob: dict):
+            if file_key in blob:
+                return blob[file_key]
+            if data_key in blob:
+                f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+                f.write(base64.b64decode(blob[data_key]))
+                f.close()
+                return f.name
+            return None
+
+        ca = materialize(
+            "certificate-authority-data", "certificate-authority", cluster
+        )
+        cert = materialize(
+            "client-certificate-data", "client-certificate", user
+        )
+        key = materialize("client-key-data", "client-key", user)
+        return cls(
+            cluster["server"],
+            token=user.get("token"),
+            ca_file=ca,
+            client_cert=(cert, key) if cert and key else None,
+            insecure_skip_verify=bool(
+                cluster.get("insecure-skip-tls-verify")
+            ),
+        )
+
+    # -------------------------------------------------------------- http
+
+    def _path(self, kind: str, namespace: Optional[str], name: str = "",
+              subresource: str = "") -> str:
+        try:
+            prefix, plural, namespaced = _KIND_INFO[kind]
+        except KeyError:
+            raise BadRequest(f"unmapped kind {kind!r}") from None
+        parts = [self.base_url, prefix]
+        if namespaced and namespace:
+            parts += ["namespaces", urllib.parse.quote(namespace)]
+        parts.append(plural)
+        if name:
+            parts.append(urllib.parse.quote(name))
+        if subresource:
+            parts.append(subresource)
+        return "/".join(parts)
+
+    def _request(
+        self,
+        method: str,
+        url: str,
+        body: Optional[dict] = None,
+        content_type: str = "application/json",
+        timeout: float = 30.0,
+    ) -> dict:
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(
+                req, context=self._ctx, timeout=timeout
+            ) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            _raise_for(e.code, e.read())
+            raise  # unreachable; _raise_for always raises
+
+    # ------------------------------------------------------------- verbs
+
+    def create(self, kind: str, obj: dict) -> dict:
+        ns = obj.get("metadata", {}).get("namespace", "")
+        return self._request("POST", self._path(kind, ns), obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        return self._request(
+            "GET", self._path(kind, namespace, name)
+        )
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[dict]:
+        url = self._path(kind, namespace)
+        if label_selector:
+            sel = ",".join(f"{k}={v}" for k, v in label_selector.items())
+            url += "?" + urllib.parse.urlencode({"labelSelector": sel})
+        out = self._request("GET", url)
+        items = out.get("items", [])
+        # list items omit apiVersion/kind; restore for manifest roundtrips
+        for it in items:
+            it.setdefault("kind", kind)
+        return items
+
+    def update(self, kind: str, obj: dict) -> dict:
+        md = obj.get("metadata", {})
+        return self._request(
+            "PUT",
+            self._path(kind, md.get("namespace", ""), md.get("name", "")),
+            obj,
+        )
+
+    def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
+        return self._request(
+            "PATCH",
+            self._path(kind, namespace, name),
+            patch,
+            content_type="application/merge-patch+json",
+        )
+
+    def patch_status(
+        self, kind: str, namespace: str, name: str, patch: dict
+    ) -> dict:
+        return self._request(
+            "PATCH",
+            self._path(kind, namespace, name, subresource="status"),
+            {"status": patch},
+            content_type="application/merge-patch+json",
+        )
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._request("DELETE", self._path(kind, namespace, name))
+
+    # ------------------------------------------------------------- watch
+
+    def watch(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        replay: bool = True,
+        timeout: Optional[float] = None,
+        resource_version: Optional[str] = None,
+    ) -> Iterator[WatchEvent]:
+        """List+watch with rv resume, per the KubeClient contract. A 410
+        Gone on the resumed watch falls back to a relist. The stream ends
+        after ``timeout`` seconds of quiet (socket read timeout) — the
+        Manager re-establishes with the bookmark it last saw."""
+        timeout = timeout if timeout is not None else 30.0
+
+        def _stream() -> Iterator[WatchEvent]:
+            rv = resource_version
+            replay_events: List[WatchEvent] = []
+            if replay or rv is None:
+                url = self._path(kind, namespace)
+                out = self._request("GET", url)
+                rv = out.get("metadata", {}).get("resourceVersion", "") or rv
+                for it in out.get("items", []):
+                    it.setdefault("kind", kind)
+                    replay_events.append(("ADDED", it))
+            for ev in replay_events:
+                yield ev
+            # synthetic bookmark after the list burst so the consumer's
+            # resume point advances even on a quiet cluster
+            yield (
+                "BOOKMARK",
+                {"metadata": {"resourceVersion": rv or "0"}},
+            )
+            params = {
+                "watch": "1",
+                "allowWatchBookmarks": "true",
+                "timeoutSeconds": str(max(1, int(timeout * 4))),
+            }
+            if rv:
+                params["resourceVersion"] = rv
+            url = self._path(kind, namespace) + "?" + urllib.parse.urlencode(
+                params
+            )
+            req = urllib.request.Request(url, method="GET")
+            req.add_header("Accept", "application/json")
+            if self._token:
+                req.add_header("Authorization", f"Bearer {self._token}")
+            try:
+                resp = urllib.request.urlopen(
+                    req, context=self._ctx, timeout=timeout
+                )
+            except urllib.error.HTTPError as e:
+                if e.code == 410:  # expired rv → caller relists next round
+                    return
+                _raise_for(e.code, e.read())
+                return
+            try:
+                buf = b""
+                while True:
+                    try:
+                        chunk = resp.read1(65536)
+                    except (socket.timeout, TimeoutError):
+                        return  # quiet period over; caller resumes by rv
+                    if not chunk:
+                        return
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        rec = json.loads(line)
+                        etype = rec.get("type", "")
+                        obj = rec.get("object", {})
+                        if etype == "ERROR":
+                            if obj.get("code") == 410:
+                                return
+                            continue
+                        yield (etype, obj)
+            finally:
+                resp.close()
+
+        return _stream()

@@ -1,0 +1,158 @@
+"""Lease-based leader election.
+
+The reference leans on controller-runtime's election (ids
+``7cbd68d5.codeflare.dev`` / ``7cbd68d6.codeflare.dev`` —
+``cmd/*/main.go:98-120``); this is the same coordination.k8s.io/v1 Lease
+protocol implemented directly: acquire when the lease is free or expired,
+renew at a third of the lease duration, step down (callback) when a renew
+cannot be pushed before expiry. Runs against both the fake kube and a real
+API server (timestamps parse both ways)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from instaslice_tpu.kube.client import (
+    AlreadyExists,
+    ApiError,
+    Conflict,
+    KubeClient,
+    NotFound,
+)
+from instaslice_tpu.utils.timeutil import parse_timestamp, rfc3339_now
+
+log = logging.getLogger("instaslice_tpu.election")
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        client: KubeClient,
+        namespace: str,
+        name: str,
+        identity: str,
+        lease_seconds: float = 15.0,
+        retry_seconds: float = 2.0,
+    ) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        self.identity = identity
+        self.lease_seconds = lease_seconds
+        self.retry_seconds = retry_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.is_leader = threading.Event()
+
+    # ----------------------------------------------------------- protocol
+
+    def _manifest(self, transitions: int) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": self.name, "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(self.lease_seconds),
+                "renewTime": rfc3339_now(),
+                "leaseTransitions": transitions,
+            },
+        }
+
+    def _try_acquire_or_renew(self) -> bool:
+        try:
+            lease = self.client.get("Lease", self.namespace, self.name)
+        except NotFound:
+            try:
+                self.client.create(
+                    "Lease", self._manifest(transitions=0)
+                )
+                return True
+            except (AlreadyExists, Conflict):
+                return False
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "")
+        renew = parse_timestamp(spec.get("renewTime"))
+        duration = float(
+            spec.get("leaseDurationSeconds", self.lease_seconds)
+        )
+        expired = time.time() - renew > duration
+        if holder != self.identity and not expired:
+            return False
+        transitions = int(spec.get("leaseTransitions", 0))
+        if holder != self.identity:
+            transitions += 1
+        new = self._manifest(transitions)
+        new["metadata"]["resourceVersion"] = lease.get("metadata", {}).get(
+            "resourceVersion", ""
+        )
+        try:
+            self.client.update("Lease", new)
+            return True
+        except (Conflict, NotFound):
+            return False
+
+    # ------------------------------------------------------------- public
+
+    def acquire(self, stop: Optional[threading.Event] = None) -> bool:
+        """Block until leadership is held (True) or ``stop`` fires
+        (False)."""
+        while not (stop and stop.is_set()) and not self._stop.is_set():
+            try:
+                if self._try_acquire_or_renew():
+                    self.is_leader.set()
+                    log.info("%s: acquired lease %s/%s", self.identity,
+                             self.namespace, self.name)
+                    return True
+            except ApiError as e:
+                log.warning("lease acquire error: %s", e)
+            waiter = stop or self._stop
+            if waiter.wait(self.retry_seconds):
+                break
+        return False
+
+    def start_renewing(self, on_lost: Callable[[], None]) -> None:
+        """Background renewal at lease/3; calls ``on_lost`` (once) if the
+        lease cannot be renewed before expiry."""
+
+        def loop():
+            interval = max(0.05, self.lease_seconds / 3.0)
+            deadline = time.time() + self.lease_seconds
+            while not self._stop.wait(interval):
+                try:
+                    ok = self._try_acquire_or_renew()
+                except ApiError:
+                    ok = False
+                if ok:
+                    deadline = time.time() + self.lease_seconds
+                elif time.time() > deadline:
+                    log.error("%s: lost lease %s/%s", self.identity,
+                              self.namespace, self.name)
+                    self.is_leader.clear()
+                    on_lost()
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="leader-renew", daemon=True
+        )
+        self._thread.start()
+
+    def release(self) -> None:
+        """Stop renewing and give the lease up if we still hold it."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if not self.is_leader.is_set():
+            return
+        try:
+            lease = self.client.get("Lease", self.namespace, self.name)
+            if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                lease["spec"]["holderIdentity"] = ""
+                lease["spec"]["renewTime"] = None
+                self.client.update("Lease", lease)
+        except ApiError:
+            pass
+        self.is_leader.clear()

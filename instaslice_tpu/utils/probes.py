@@ -1,0 +1,59 @@
+"""healthz/readyz probe endpoints (reference:
+``AddHealthzCheck``/``AddReadyzCheck``, ``cmd/*/main.go:143-150``)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class ProbeServer:
+    """Serves ``/healthz`` (process alive) and ``/readyz`` (callback)."""
+
+    def __init__(
+        self,
+        bind_address: str,
+        ready_check: Optional[Callable[[], bool]] = None,
+    ) -> None:
+        host, _, port = bind_address.rpartition(":")
+        self._ready = ready_check or (lambda: True)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/healthz"):
+                    ok = True
+                elif self.path.startswith("/readyz"):
+                    ok = outer._ready()
+                else:
+                    self.send_error(404)
+                    return
+                body = b"ok" if ok else b"not ready"
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(
+            (host or "0.0.0.0", int(port)), Handler
+        )
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="probes", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._srv.server_address[1]
+
+    def start(self) -> "ProbeServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5)

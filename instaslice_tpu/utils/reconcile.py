@@ -138,6 +138,9 @@ class Manager:
         # watch that has never seen an event (empty store at startup) can't
         # lose ones emitted while it was re-establishing
         last_rv = "0"
+        # real API servers hold watches open cheaply (the client advertises
+        # a long preferred timeout); the in-process fake polls fast
+        watch_timeout = getattr(self.client, "preferred_watch_timeout", 0.25)
         while not self._stop.is_set():
             replay = time.monotonic() - last_replay >= self.resync_period
             if replay:
@@ -150,7 +153,7 @@ class Manager:
                     kind,
                     namespace=namespace,
                     replay=replay,
-                    timeout=0.25,
+                    timeout=watch_timeout,
                     resource_version=last_rv,
                 ):
                     if self._stop.is_set():
