@@ -21,6 +21,7 @@ from instaslice_tpu.kube.client import (
     Conflict,
     AlreadyExists,
     NotFound,
+    ResourceVersionExpired,
     KubeClient,
     update_with_retry,
 )

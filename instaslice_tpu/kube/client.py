@@ -44,6 +44,16 @@ class BadRequest(ApiError):
     code = 400
 
 
+class ResourceVersionExpired(ApiError):
+    """410 Gone on a watch: the resume resourceVersion fell out of the API
+    server's event window. The watcher must relist (replay=True, no
+    resourceVersion) — resuming with the stale version would hot-loop.
+    Raised by the real client; the fake's retained-log tail replay makes
+    it unnecessary there."""
+
+    code = 410
+
+
 #: A watch event: ("ADDED" | "MODIFIED" | "DELETED", manifest-dict), or
 #: ("BOOKMARK", {"metadata": {"resourceVersion": ...}}) — a metadata-only
 #: resume-point marker emitted at the end of every establishment burst,

@@ -65,9 +65,30 @@ def _parse(path: str) -> Tuple[str, Optional[str], str, str]:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.0"  # close-delimited: simplest for streams
     kube: KubeClient = None  # type: ignore[assignment]
+    #: when set, every request's Bearer token must satisfy it or 401 —
+    #: lets tests exercise the client's token-refresh / exec-plugin path
+    token_validator = None  # Optional[Callable[[Optional[str]], bool]]
+    #: when set, watch resumes with resourceVersion < this respond 410 —
+    #: models the real API server's bounded event window
+    min_watch_rv: Optional[int] = None
 
     def log_message(self, *a):  # quiet
         pass
+
+    def _authorized(self) -> bool:
+        if type(self).token_validator is None:
+            return True
+        auth = self.headers.get("Authorization", "")
+        tok = auth[len("Bearer "):] if auth.startswith("Bearer ") else None
+        return bool(type(self).token_validator(tok))
+
+    def _send_401(self) -> None:
+        self._send_json(
+            401,
+            {"kind": "Status", "status": "Failure",
+             "message": "Unauthorized", "reason": "Unauthorized",
+             "code": 401},
+        )
 
     # ------------------------------------------------------------ helpers
 
@@ -119,6 +140,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -------------------------------------------------------------- verbs
 
     def do_GET(self):
+        if not self._authorized():
+            self._send_401()
+            return
         try:
             kind, ns, name, _ = _parse(self._clean_path)
             q = self._query()
@@ -147,6 +171,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_obj(e)
 
     def _do_watch(self, kind, ns, q):
+        floor = type(self).min_watch_rv
+        rv_q = q.get("resourceVersion")
+        if floor is not None and rv_q is not None:
+            try:
+                if int(rv_q) < floor:
+                    self._send_json(
+                        410,
+                        {"kind": "Status", "status": "Failure",
+                         "message": "too old resource version",
+                         "reason": "Expired", "code": 410},
+                    )
+                    return
+            except ValueError:
+                pass
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.end_headers()
@@ -170,6 +208,9 @@ class _Handler(BaseHTTPRequestHandler):
             return
 
     def do_POST(self):
+        if not self._authorized():
+            self._send_401()
+            return
         try:
             kind, _, _, _ = _parse(self._clean_path)
             self._send_json(201, self.kube.create(kind, self._body()))
@@ -177,6 +218,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_obj(e)
 
     def do_PUT(self):
+        if not self._authorized():
+            self._send_401()
+            return
         try:
             kind, _, _, _ = _parse(self._clean_path)
             self._send_json(200, self.kube.update(kind, self._body()))
@@ -184,6 +228,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_obj(e)
 
     def do_PATCH(self):
+        if not self._authorized():
+            self._send_401()
+            return
         try:
             kind, ns, name, sub = _parse(self._clean_path)
             patch = self._body()
@@ -198,6 +245,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_obj(e)
 
     def do_DELETE(self):
+        if not self._authorized():
+            self._send_401()
+            return
         try:
             kind, ns, name, _ = _parse(self._clean_path)
             self.kube.delete(kind, ns or "", name)
@@ -212,6 +262,7 @@ class FakeApiServer:
     def __init__(self, kube: KubeClient, host: str = "127.0.0.1",
                  port: int = 0) -> None:
         handler = type("BoundHandler", (_Handler,), {"kube": kube})
+        self.handler = handler
         self._srv = ThreadingHTTPServer((host, port), handler)
         self._thread = threading.Thread(
             target=self._srv.serve_forever, name="fake-apiserver",

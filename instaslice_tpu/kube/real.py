@@ -17,12 +17,15 @@ cluster needed).
 
 from __future__ import annotations
 
+import atexit
 import base64
 import json
 import os
 import socket
 import ssl
+import subprocess
 import tempfile
+import time
 import urllib.parse
 import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -35,6 +38,7 @@ from instaslice_tpu.kube.client import (
     Conflict,
     KubeClient,
     NotFound,
+    ResourceVersionExpired,
     WatchEvent,
 )
 
@@ -76,6 +80,8 @@ def _raise_for(status: int, body: bytes) -> None:
         raise Conflict(message)
     if status == 400 or status == 422:
         raise BadRequest(message)
+    if status == 410:
+        raise ResourceVersionExpired(message)
     err = ApiError(f"HTTP {status}: {message}")
     err.code = status
     raise err
@@ -96,9 +102,28 @@ class RealKubeClient(KubeClient):
         ca_file: Optional[str] = None,
         client_cert: Optional[Tuple[str, str]] = None,
         insecure_skip_verify: bool = False,
+        token_file: Optional[str] = None,
+        exec_config: Optional[dict] = None,
     ) -> None:
+        """``token`` is a static bearer token. ``token_file`` points at a
+        rotating credential (projected SA tokens rotate hourly on GKE) and
+        is re-read when stale or on 401. ``exec_config`` is a kubeconfig
+        ``user.exec`` stanza (client.authentication.k8s.io ExecCredential
+        — how GKE kubeconfigs authenticate via ``gke-gcloud-auth-plugin``);
+        the plugin's token is cached until its ``expirationTimestamp``.
+        Resolution order per request: exec plugin → token file → static
+        token. The reference inherits all of this from client-go
+        (/root/reference/go.mod:60)."""
         self.base_url = base_url.rstrip("/")
         self._token = token
+        self._token_file = token_file
+        self._exec_config = exec_config
+        self._cached_token: Optional[str] = None
+        self._cached_token_expiry = 0.0   # monotonic deadline
+        #: temp files holding materialized kubeconfig cert/key data —
+        #: private-key material; deleted on close() (atexit-registered by
+        #: from_kubeconfig)
+        self._temp_files: List[str] = []
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
             if insecure_skip_verify:
@@ -118,11 +143,12 @@ class RealKubeClient(KubeClient):
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         if ":" in host and not host.startswith("["):
             host = f"[{host}]"
-        with open(os.path.join(SA_DIR, "token")) as f:
-            token = f.read().strip()
+        # token_file, not a one-shot read: projected SA tokens rotate
+        # (kubelet refreshes the file); a process outliving the rotation
+        # with a startup-read token gets 401s exactly when it matters
         return cls(
             f"https://{host}:{port}",
-            token=token,
+            token_file=os.path.join(SA_DIR, "token"),
             ca_file=os.path.join(SA_DIR, "ca.crt"),
         )
 
@@ -149,6 +175,8 @@ class RealKubeClient(KubeClient):
             u["user"] for u in cfg["users"] if u["name"] == ctx["user"]
         )
 
+        temp_files: List[str] = []
+
         def materialize(data_key: str, file_key: str, blob: dict):
             if file_key in blob:
                 return blob[file_key]
@@ -156,6 +184,7 @@ class RealKubeClient(KubeClient):
                 f = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
                 f.write(base64.b64decode(blob[data_key]))
                 f.close()
+                temp_files.append(f.name)
                 return f.name
             return None
 
@@ -166,7 +195,7 @@ class RealKubeClient(KubeClient):
             "client-certificate-data", "client-certificate", user
         )
         key = materialize("client-key-data", "client-key", user)
-        return cls(
+        client = cls(
             cluster["server"],
             token=user.get("token"),
             ca_file=ca,
@@ -174,7 +203,106 @@ class RealKubeClient(KubeClient):
             insecure_skip_verify=bool(
                 cluster.get("insecure-skip-tls-verify")
             ),
+            exec_config=user.get("exec"),
         )
+        # the cert chain is loaded into the ssl context at construction;
+        # the key material need not persist on disk past process exit
+        client._temp_files = temp_files
+        atexit.register(client.close)
+        return client
+
+    def close(self) -> None:
+        """Delete materialized cert/key temp files (idempotent)."""
+        while self._temp_files:
+            path = self._temp_files.pop()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    # -------------------------------------------------------------- auth
+
+    #: projected SA tokens rotate on the order of an hour; re-reading the
+    #: file once a minute is free and never serves a token more than 60 s
+    #: stale
+    _TOKEN_FILE_TTL = 60.0
+
+    def _run_exec_plugin(self) -> Tuple[str, float]:
+        """Run the kubeconfig exec credential plugin; returns (token,
+        seconds-until-refresh). client-go's exec transport analog."""
+        spec = self._exec_config or {}
+        cmd = [spec["command"]] + list(spec.get("args") or [])
+        env = dict(os.environ)
+        for kv in spec.get("env") or []:
+            env[str(kv.get("name"))] = str(kv.get("value", ""))
+        env["KUBERNETES_EXEC_INFO"] = json.dumps({
+            "apiVersion": spec.get(
+                "apiVersion", "client.authentication.k8s.io/v1"
+            ),
+            "kind": "ExecCredential",
+            "spec": {"interactive": False},
+        })
+        try:
+            out = subprocess.run(
+                cmd, env=env, capture_output=True, timeout=60
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise ApiError(f"exec credential plugin: {e}") from None
+        if out.returncode != 0:
+            raise ApiError(
+                "exec credential plugin failed: "
+                + out.stderr.decode(errors="replace")[:300]
+            )
+        try:
+            status = json.loads(out.stdout.decode()).get("status") or {}
+        except ValueError:
+            raise ApiError(
+                "exec credential plugin emitted invalid JSON"
+            ) from None
+        token = status.get("token")
+        if not token:
+            raise ApiError("exec credential plugin returned no token")
+        ttl = 300.0  # no expiry advertised → re-run every 5 min
+        exp = status.get("expirationTimestamp")
+        if exp:
+            from datetime import datetime, timezone
+
+            try:
+                ts = datetime.fromisoformat(exp.replace("Z", "+00:00"))
+                # refresh 60 s before expiry; floor at 10 s so the last
+                # minute of a token's life doesn't spawn the plugin
+                # subprocess on every single request
+                ttl = max(
+                    10.0,
+                    (ts - datetime.now(timezone.utc)).total_seconds() - 60.0,
+                )
+            except ValueError:
+                pass
+        return token, ttl
+
+    def _bearer_token(self) -> Optional[str]:
+        """Current bearer token: exec plugin → token file → static."""
+        now = time.monotonic()
+        if self._cached_token is not None and now < self._cached_token_expiry:
+            return self._cached_token
+        if self._exec_config:
+            token, ttl = self._run_exec_plugin()
+            self._cached_token = token
+            self._cached_token_expiry = now + ttl
+            return token
+        if self._token_file:
+            with open(self._token_file) as f:
+                self._cached_token = f.read().strip()
+            self._cached_token_expiry = now + self._TOKEN_FILE_TTL
+            return self._cached_token
+        return self._token
+
+    def _refreshable(self) -> bool:
+        return bool(self._exec_config or self._token_file)
+
+    def _invalidate_token(self) -> None:
+        self._cached_token = None
+        self._cached_token_expiry = 0.0
 
     # -------------------------------------------------------------- http
 
@@ -203,20 +331,27 @@ class RealKubeClient(KubeClient):
         timeout: float = 30.0,
     ) -> dict:
         data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        if self._token:
-            req.add_header("Authorization", f"Bearer {self._token}")
-        try:
-            with urllib.request.urlopen(
-                req, context=self._ctx, timeout=timeout
-            ) as resp:
-                return json.loads(resp.read().decode() or "{}")
-        except urllib.error.HTTPError as e:
-            _raise_for(e.code, e.read())
-            raise  # unreachable; _raise_for always raises
+        for attempt in (0, 1):
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            token = self._bearer_token()
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+            try:
+                with urllib.request.urlopen(
+                    req, context=self._ctx, timeout=timeout
+                ) as resp:
+                    return json.loads(resp.read().decode() or "{}")
+            except urllib.error.HTTPError as e:
+                # rotated-out credential: refresh and retry once
+                if e.code == 401 and attempt == 0 and self._refreshable():
+                    self._invalidate_token()
+                    continue
+                _raise_for(e.code, e.read())
+                raise  # unreachable; _raise_for always raises
+        raise AssertionError("unreachable")
 
     # ------------------------------------------------------------- verbs
 
@@ -286,9 +421,13 @@ class RealKubeClient(KubeClient):
         resource_version: Optional[str] = None,
     ) -> Iterator[WatchEvent]:
         """List+watch with rv resume, per the KubeClient contract. A 410
-        Gone on the resumed watch falls back to a relist. The stream ends
-        after ``timeout`` seconds of quiet (socket read timeout) — the
-        Manager re-establishes with the bookmark it last saw."""
+        Gone on the resumed watch raises :class:`ResourceVersionExpired`
+        so the caller relists with a fresh resourceVersion instead of
+        hot-looping on the stale one (a real API server keeps only a
+        bounded event window; the fake's log-tail replay has no such
+        horizon). The stream ends after ``timeout`` seconds of quiet
+        (socket read timeout) — the Manager re-establishes with the
+        bookmark it last saw."""
         timeout = timeout if timeout is not None else 30.0
 
         def _stream() -> Iterator[WatchEvent]:
@@ -321,16 +460,17 @@ class RealKubeClient(KubeClient):
             )
             req = urllib.request.Request(url, method="GET")
             req.add_header("Accept", "application/json")
-            if self._token:
-                req.add_header("Authorization", f"Bearer {self._token}")
+            tok = self._bearer_token()
+            if tok:
+                req.add_header("Authorization", f"Bearer {tok}")
             try:
                 resp = urllib.request.urlopen(
                     req, context=self._ctx, timeout=timeout
                 )
             except urllib.error.HTTPError as e:
-                if e.code == 410:  # expired rv → caller relists next round
-                    return
-                _raise_for(e.code, e.read())
+                if e.code == 401 and self._refreshable():
+                    self._invalidate_token()  # next establishment refreshes
+                _raise_for(e.code, e.read())  # 410 → ResourceVersionExpired
                 return
             try:
                 buf = b""
@@ -351,7 +491,9 @@ class RealKubeClient(KubeClient):
                         obj = rec.get("object", {})
                         if etype == "ERROR":
                             if obj.get("code") == 410:
-                                return
+                                raise ResourceVersionExpired(
+                                    f"watch {kind} rv={rv} expired mid-stream"
+                                )
                             continue
                         yield (etype, obj)
             finally:

@@ -40,8 +40,11 @@ def _xla_attention(q, k, v, causal: bool) -> jax.Array:
     ) * (hd ** -0.5)
     if causal:
         S, K = q.shape[1], k.shape[1]
+        # queries are the LAST S positions of the kv sequence (decode-style
+        # cropped-query attention): query row i sits at absolute position
+        # i + K - S, so key j is visible iff j <= i + K - S
         mask = (
-            jax.lax.broadcasted_iota(jnp.int32, (S, K), 0)
+            jax.lax.broadcasted_iota(jnp.int32, (S, K), 0) + (K - S)
             >= jax.lax.broadcasted_iota(jnp.int32, (S, K), 1)
         )
         logits = jnp.where(mask[None, None], logits, _NEG)
@@ -112,8 +115,9 @@ def _xla_attention_3d(q, k, v, causal: bool) -> jax.Array:
     ) * (hd ** -0.5)
     if causal:
         S, K = q.shape[1], k.shape[1]
+        # same cropped-query offset as _xla_attention
         mask = (
-            jax.lax.broadcasted_iota(jnp.int32, (S, K), 0)
+            jax.lax.broadcasted_iota(jnp.int32, (S, K), 0) + (K - S)
             >= jax.lax.broadcasted_iota(jnp.int32, (S, K), 1)
         )
         logits = jnp.where(mask[None], logits, _NEG)

@@ -11,9 +11,11 @@ built TPU-first instead of translated:
   traffic. This is the TPU translation of continuous batching: vLLM grows
   and shrinks a ragged batch; a TPU engine keeps the batch rectangular
   and masks.
-- **Prefill/decode split**: prompts are prefilled at a fixed padded length
-  (one compile) into the slot's cache stripe; decoding advances all live
-  slots together, one token per step per slot.
+- **Prefill/decode split**: prompts are prefilled in fixed-size chunks of
+  ``prefill_len`` tokens (one compile — every chunk is the same padded
+  shape) into the slot's cache stripe, so prompts up to the cache length
+  are admitted without a third program; decoding advances all live slots
+  together, one token per step per slot.
 - **Per-slot offsets**: the model's cache mask admits position ``s`` for
   slot ``b`` iff ``s <= lengths[b] + t``, so slots at different depths
   coexist in one rectangular batch (``models/lm.py: apply_with_cache``).
@@ -86,18 +88,22 @@ class ServingEngine:
 
     # ------------------------------------------------------------- jitted
 
-    def _prefill_impl(self, params, cache, tokens, slot, true_len):
-        """Prefill one slot: run the (1, prefill_len) padded prompt with a
-        zeroed cache stripe, write the stripe back at ``slot``, and return
-        the first sampled-from logits row."""
+    def _prefill_impl(self, params, cache, tokens, slot, offset):
+        """Prefill one (1, prefill_len) chunk into a slot's cache stripe
+        at ``offset`` and return the chunk's logits (prefill_len, vocab).
+
+        The stripe is read back (not zeroed): chunks after the first must
+        attend to the KV the earlier chunks wrote. Stale data from a prior
+        occupant of the slot is harmless — positions [offset, offset+T)
+        are overwritten before attention and the cache mask admits nothing
+        beyond ``offset + t``."""
         stripe = jax.tree.map(
-            lambda c: jnp.zeros_like(
-                jax.lax.dynamic_slice_in_dim(c, 0, 1, axis=1)
-            ),
+            lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
         )
         logits, stripe = self.model.apply_with_cache(
-            params, tokens, stripe, jnp.zeros(1, jnp.int32)
+            params, tokens, stripe,
+            jnp.full((1,), offset, jnp.int32),
         )
         cache = jax.tree.map(
             lambda c, s: jax.lax.dynamic_update_slice_in_dim(
@@ -105,10 +111,7 @@ class ServingEngine:
             ),
             cache, stripe,
         )
-        last = jax.lax.dynamic_slice_in_dim(
-            logits[0], true_len - 1, 1, axis=0
-        )[0]                                        # (vocab,)
-        return cache, last
+        return cache, logits[0]                     # (prefill_len, vocab)
 
     def _decode_impl(self, params, cache, last_token, lengths):
         logits, cache = self.model.apply_with_cache(
@@ -131,13 +134,22 @@ class ServingEngine:
 
     def add_request(self, prompt: List[int]) -> int:
         """Admit a prompt; returns the request id. Raises when the batch
-        is full (callers queue) or the prompt exceeds prefill_len."""
+        is full (callers queue) or the prompt cannot fit the cache.
+
+        Prompts longer than ``prefill_len`` are prefilled in
+        ``prefill_len``-sized chunks — every chunk reuses the same
+        compiled program, so long prompts cost chunk-count invocations,
+        never a recompile."""
         if not prompt:
             raise ValueError("empty prompt")
-        if len(prompt) > self.prefill_len:
+        P = self.prefill_len
+        n_chunks = -(-len(prompt) // P)
+        # every chunk write must land fully inside the stripe: a clamped
+        # dynamic_update_slice would silently shift into earlier positions
+        if n_chunks * P > self.max_len or len(prompt) > self.max_len - 1:
             raise ValueError(
-                f"prompt length {len(prompt)} > prefill_len "
-                f"{self.prefill_len}"
+                f"prompt length {len(prompt)} cannot fit max_len "
+                f"{self.max_len} (chunked at {P})"
             )
         free = [i for i in range(self.max_batch) if i not in self.slots]
         if not free:
@@ -145,12 +157,15 @@ class ServingEngine:
         slot = free[0]
         rid = self._next_id
         self._next_id += 1
-        padded = jnp.asarray(
-            prompt + [0] * (self.prefill_len - len(prompt)), jnp.int32
-        )[None]
-        self.cache, last_logits = self._prefill(
-            self.params, self.cache, padded, slot, len(prompt)
-        )
+        for i in range(n_chunks):
+            chunk = prompt[i * P:(i + 1) * P]
+            padded = jnp.asarray(
+                chunk + [0] * (P - len(chunk)), jnp.int32
+            )[None]
+            self.cache, chunk_logits = self._prefill(
+                self.params, self.cache, padded, slot, i * P
+            )
+        last_logits = chunk_logits[(len(prompt) - 1) % P]
         tok = self._sample(last_logits[None])[0]
         self.last_token = self.last_token.at[slot].set(tok)
         self.lengths = self.lengths.at[slot].set(len(prompt))
@@ -218,9 +233,14 @@ class ServingEngine:
                 want[rid] = idx
                 budget[rid] = max_new_tokens
             self.step()
-            # enforce the per-request budget
+            # enforce the per-request budget — only for requests admitted
+            # by THIS call; slots created via add_request()/throughput()
+            # before generate() keep running under their own rules
             for slot, req in list(self.slots.items()):
-                if len(req.generated) >= budget[req.request_id]:
+                if (
+                    req.request_id in budget
+                    and len(req.generated) >= budget[req.request_id]
+                ):
                     self.finished.append(
                         GenerationResult(
                             req.request_id, req.prompt, req.generated,
@@ -228,10 +248,19 @@ class ServingEngine:
                         )
                     )
                     del self.slots[slot]
+            # harvest only our own finished entries; leave results that
+            # belong to requests outside this call for their owners
+            remaining: List[GenerationResult] = []
             for r in self.finished:
                 if r.request_id in want:
                     results[want.pop(r.request_id)] = r
-            self.finished.clear()
+                else:
+                    remaining.append(r)
+            self.finished = remaining
+            if not pending and not any(
+                req.request_id in budget for req in self.slots.values()
+            ):
+                break  # foreign slots still live; ours are all done
         return [results[i] for i in sorted(results)]
 
     def throughput(

@@ -128,23 +128,41 @@ class Manager:
     # ------------------------------------------------------------------
 
     def _watch_loop(self, kind: str, namespace: Optional[str], fn: MapFunc):
+        from instaslice_tpu.kube.client import ResourceVersionExpired
+
         # Replay (list+watch) on the first establishment and then once per
         # resync_period — not on every re-establishment, which would
         # re-reconcile every object ~4x/sec on a quiet cluster. Between
         # replays, re-establish with the last seen resourceVersion so
         # events emitted while the watch was down are replayed, not lost.
-        last_replay = 0.0  # monotonic is large at boot → first pass replays
+        # -inf, not 0.0: monotonic() is small right after host boot, and
+        # the first pass (and any forced relist) must replay regardless
+        last_replay = float("-inf")
+        force_replay = True
         # "0" = resume from the beginning of the event log, so that even a
         # watch that has never seen an event (empty store at startup) can't
         # lose ones emitted while it was re-establishing
-        last_rv = "0"
+        last_rv: Optional[str] = "0"
         # real API servers hold watches open cheaply (the client advertises
         # a long preferred timeout); the in-process fake polls fast
         watch_timeout = getattr(self.client, "preferred_watch_timeout", 0.25)
+        # informer-style store: last-seen object per (namespace, name).
+        # A replay relist is diffed against it so objects deleted while
+        # the watch was down — invisible to any relist — still fire their
+        # DELETED map-func (a real API server has no log-tail replay).
+        store: Dict[Tuple[str, str], dict] = {}
         while not self._stop.is_set():
-            replay = time.monotonic() - last_replay >= self.resync_period
+            replay = (
+                force_replay
+                or time.monotonic() - last_replay >= self.resync_period
+            )
             if replay:
+                force_replay = False
                 last_replay = time.monotonic()
+            listed: set = set()
+            in_burst = replay  # relist burst runs until the first BOOKMARK
+            started = time.monotonic()
+            events = 0
             try:
                 # resource_version is ALWAYS passed: a resync relist alone
                 # cannot show objects deleted while the watch was down, so
@@ -158,19 +176,52 @@ class Manager:
                 ):
                     if self._stop.is_set():
                         return
-                    rv = obj.get("metadata", {}).get("resourceVersion")
+                    md = obj.get("metadata", {})
+                    rv = md.get("resourceVersion")
                     if rv:
                         last_rv = rv
                     if event == "BOOKMARK":
+                        if in_burst:
+                            # end of the relist burst: anything we knew
+                            # that the relist did not show is gone
+                            in_burst = False
+                            for skey in set(store) - listed:
+                                gone = store.pop(skey)
+                                for key in fn("DELETED", gone):
+                                    self.queue.add(key)
                         continue  # resume-point advance only, no object
+                    events += 1  # real (non-BOOKMARK) events only
+                    okey = (md.get("namespace", ""), md.get("name", ""))
+                    if event == "DELETED":
+                        store.pop(okey, None)
+                    else:
+                        store[okey] = obj
+                        if in_burst:
+                            listed.add(okey)
                     for key in fn(event, obj):
                         self.queue.add(key)
+            except ResourceVersionExpired:
+                # stale resume point: resuming with it would hot-loop 410s
+                # — drop it and force a relist on the next establishment
+                log.info(
+                    "%s: watch %s resourceVersion expired; relisting",
+                    self.name, kind,
+                )
+                last_rv = None
+                force_replay = True
+                time.sleep(self.error_backoff)
             except Exception:
                 log.warning(
                     "%s: watch %s failed:\n%s",
                     self.name, kind, traceback.format_exc(),
                 )
                 time.sleep(self.error_backoff)
+            else:
+                # a healthy stream lives for ~watch_timeout; one that dies
+                # instantly with nothing to say is a broken server or a
+                # stale-rv loop — pace it like an error, don't hammer
+                if events == 0 and time.monotonic() - started < 0.05:
+                    time.sleep(self.error_backoff)
             # watch ended (timeout/quiet) → re-establish; brief pause keeps
             # the fake-kube polling cheap
             self._stop.wait(0.02)

@@ -60,6 +60,16 @@ class TestFlashAttention:
         for a, b in zip(g_flash, g_ref):
             assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
+    def test_causal_cropped_query_offset(self):
+        # decode-style cross attention: q is the LAST S positions of a
+        # kv_len sequence. The mask must be offset by kv_len - S — queries
+        # aligned to the start would wrongly hide most keys.
+        B, K, H, hd, S = 2, 64, 2, 16, 8
+        qf, k, v = _qkv(B, K, H, hd, key=5)
+        full = _xla_attention(qf, k, v, True)        # S == kv_len oracle
+        out = flash_attention(qf[:, -S:], k, v, causal=True)
+        assert float(jnp.max(jnp.abs(out - full[:, -S:]))) < 2e-5
+
     def test_model_flash_impl_matches_xla_impl(self):
         from instaslice_tpu.models.lm import ModelConfig, TpuLM
 
