@@ -141,7 +141,7 @@ class NodeAgent:
         return alloc.local_chip_ids(self.node_name, gen.host_bounds)
 
     def _realize(self, ts: TpuSlice, alloc: AllocationDetails) -> None:
-        suid = slice_uuid_for(alloc.alloc_id)
+        suid = slice_uuid_for(alloc.alloc_id, multihost=len(alloc.parts) > 1)
         chip_ids = self._chip_ids_for(ts, alloc)
         t0 = time.monotonic()
         try:
@@ -243,7 +243,7 @@ class NodeAgent:
     # ------------------------------------------------------------ teardown
 
     def _teardown(self, ts: TpuSlice, alloc: AllocationDetails) -> None:
-        suid = slice_uuid_for(alloc.alloc_id)
+        suid = slice_uuid_for(alloc.alloc_id, multihost=len(alloc.parts) > 1)
         # Always attempt release, even when this node never made it into
         # realized_on: a reserve that succeeded right as the allocation
         # was deleted (raced mut returning None) would otherwise leak the

@@ -276,11 +276,21 @@ class PreparedPart:
         )
 
 
-def slice_uuid_for(alloc_id: str) -> str:
+def slice_uuid_for(alloc_id: str, multihost: bool = False) -> str:
     """Deterministic per-allocation slice uuid — every agent serving a
     multi-host allocation derives the same id with no rendezvous, and the
-    controller uses it to match ``prepared`` entries to allocations."""
-    return f"sl-{alloc_id}"
+    controller uses it to match ``prepared`` entries to allocations.
+
+    Multi-host allocations get a distinguishable prefix: a node-local part
+    of a multi-host slice is a full-host tile, which would otherwise be
+    indistinguishable from a standalone whole-host reservation — and the
+    device plugin must never advertise another job's part as an
+    allocatable slice device."""
+    return f"sl-mh-{alloc_id}" if multihost else f"sl-{alloc_id}"
+
+
+def is_multihost_slice_uuid(suid: str) -> bool:
+    return suid.startswith("sl-mh-")
 
 
 @dataclasses.dataclass

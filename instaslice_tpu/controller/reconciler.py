@@ -182,7 +182,10 @@ class Controller:
                 occ.occupy(Box.from_key(alloc.box), owner=f"a-{alloc.alloc_id}")
             for suid, prep in ts.spec.prepared.items():
                 covered = any(
-                    suid == slice_uuid_for(aid)
+                    suid in (
+                        slice_uuid_for(aid),
+                        slice_uuid_for(aid, multihost=True),
+                    )
                     for aid in ts.spec.allocations
                 )
                 if covered or seen.get(f"p-{suid}"):

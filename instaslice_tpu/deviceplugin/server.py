@@ -54,7 +54,9 @@ DEFAULT_RESOURCE = "google.com/tpu"
 DEFAULT_PLUGIN_DIR = "/var/lib/kubelet/device-plugins"
 SOCKET_NAME = "tpuslice.sock"
 DEVICE_ID_PREFIX = "tpu-"
+SLICE_ID_PREFIX = "slice-"
 CHIPS_ANNOTATION = f"{GROUP}/chips"
+SLICE_DEVICE_ANNOTATION = f"{GROUP}/slice-device"
 
 
 def device_id(chip_id: int) -> str:
@@ -65,6 +67,43 @@ def chip_of(dev_id: str) -> int:
     if not dev_id.startswith(DEVICE_ID_PREFIX):
         raise ValueError(f"not a tpu device id: {dev_id!r}")
     return int(dev_id[len(DEVICE_ID_PREFIX):])
+
+
+def slice_device_id(slice_uuid: str) -> str:
+    return f"{SLICE_ID_PREFIX}{slice_uuid}"
+
+
+def slice_of(dev_id: str) -> str:
+    if not dev_id.startswith(SLICE_ID_PREFIX):
+        raise ValueError(f"not a slice device id: {dev_id!r}")
+    return dev_id[len(SLICE_ID_PREFIX):]
+
+
+def reservation_profile(
+    chip_ids: Sequence[int], host_bounds: Shape, generation: str
+) -> str:
+    """Canonical profile name (``v5e-2x2``) for a reservation's chip set,
+    derived from its bounding box on the host grid. Returns "" when the
+    chips do not form a full axis-aligned box (never true for reservations
+    made by the placement engine, which only grants aligned boxes)."""
+    from instaslice_tpu.topology.profiles import parse_shape
+
+    if not chip_ids:
+        return ""
+    coords = [id_to_coord(c, host_bounds) for c in chip_ids]
+    lo = tuple(min(c[i] for c in coords) for i in range(3))
+    hi = tuple(max(c[i] for c in coords) for i in range(3))
+    ext = tuple(hi[i] - lo[i] + 1 for i in range(3))
+    if ext[0] * ext[1] * ext[2] != len(set(chip_ids)):
+        return ""
+    shape_str = (
+        f"{ext[0]}x{ext[1]}" if ext[2] == 1
+        else f"{ext[0]}x{ext[1]}x{ext[2]}"
+    )
+    try:
+        return parse_shape(generation, shape_str).name
+    except (ValueError, KeyError):
+        return ""
 
 
 def preferred_rectangle(
@@ -137,6 +176,21 @@ class TpuDevicePluginServicer:
     def GetPreferredAllocation(self, request, context):
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
+            if self._p.mode == "slices":
+                # slice devices are already carved boxes: any available
+                # one is maximally compact; must_include first (kubelet
+                # contract), then deterministic lowest-id fill
+                must_ids = sorted(creq.must_include_deviceIDs)
+                rest = sorted(
+                    set(creq.available_deviceIDs) - set(must_ids)
+                )
+                chosen_ids = (must_ids + rest)[: creq.allocation_size]
+                resp.container_responses.append(
+                    pb.ContainerPreferredAllocationResponse(
+                        deviceIDs=chosen_ids
+                    )
+                )
+                continue
             try:
                 avail = [chip_of(d) for d in creq.available_deviceIDs]
                 must = [chip_of(d) for d in creq.must_include_deviceIDs]
@@ -153,6 +207,8 @@ class TpuDevicePluginServicer:
         return resp
 
     def Allocate(self, request, context):
+        if self._p.mode == "slices":
+            return self._allocate_slices(request, context)
         resp = pb.AllocateResponse()
         for creq in request.container_requests:
             try:
@@ -186,6 +242,64 @@ class TpuDevicePluginServicer:
             self._p.metrics_allocations += 1
         return resp
 
+    def _allocate_slices(self, request, context):
+        """Slice-mode Allocate: each device ID is a realized reservation;
+        inject exactly that reservation's chip device nodes — the fence
+        kubelet applies is the same carve the controller placed, by
+        construction (the MIG-device-plugin strategy, which the reference
+        outsources to the GPU operator)."""
+        resp = pb.AllocateResponse()
+        reservations = {
+            r.slice_uuid: r for r in self._p.backend.list_reservations()
+        }
+        for creq in request.container_requests:
+            cresp = pb.ContainerAllocateResponse()
+            all_chips: List[int] = []
+            suids: List[str] = []
+            for dev in creq.devicesIDs:
+                try:
+                    suid = slice_of(dev)
+                except ValueError as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                res = reservations.get(suid)
+                if res is None:
+                    context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        f"no live reservation {suid!r} "
+                        f"(have {sorted(reservations)})",
+                    )
+                for c in res.chip_ids:
+                    path = self._p.chip_paths.get(c)
+                    if path is None:
+                        context.abort(
+                            grpc.StatusCode.NOT_FOUND,
+                            f"reservation {suid} chip {c} not on this host",
+                        )
+                    cresp.devices.append(
+                        pb.DeviceSpec(
+                            container_path=path, host_path=path,
+                            permissions="rw",
+                        )
+                    )
+                    all_chips.append(c)
+                suids.append(suid)
+            cresp.annotations[SLICE_DEVICE_ANNOTATION] = ",".join(suids)
+            chips_csv = ",".join(str(c) for c in sorted(all_chips))
+            cresp.envs["TPU_KUBELET_ASSIGNED_CHIPS"] = chips_csv
+            # ALSO the libtpu fence: device-plugin env overrides envFrom,
+            # so kubelet's pick is authoritative. Same-profile slices on
+            # one host are interchangeable aligned boxes (identical
+            # bounds/worker topology), so honoring kubelet's choice over
+            # the ConfigMap's is always safe — and it closes the
+            # fungibility race where kubelet hands pod A the device carved
+            # under pod B's same-profile allocation.
+            cresp.envs["TPU_VISIBLE_CHIPS"] = chips_csv
+            cresp.envs["TPU_PLATFORM"] = self._p.generation
+            cresp.annotations[CHIPS_ANNOTATION] = chips_csv
+            resp.container_responses.append(cresp)
+            self._p.metrics_allocations += 1
+        return resp
+
     def PreStartContainer(self, request, context):
         return pb.PreStartContainerResponse()
 
@@ -201,8 +315,21 @@ class TpuDevicePlugin:
         socket_name: str = SOCKET_NAME,
         health_poll_seconds: float = 5.0,
         register_with_kubelet: bool = True,
+        mode: str = "chips",
+        profile: str = "",
     ) -> None:
+        """``mode="chips"`` advertises raw chips (whole-host workloads);
+        ``mode="slices"`` advertises realized reservations matching
+        ``profile`` as devices under a per-profile resource — the MIG
+        device-plugin strategy, so kubelet's device fence IS the
+        controller's carve (SURVEY.md §2a row 3)."""
+        if mode not in ("chips", "slices"):
+            raise ValueError(f"unknown plugin mode {mode!r}")
+        if mode == "slices" and not profile:
+            raise ValueError("slice mode requires a profile")
         inv = backend.discover()
+        self.mode = mode
+        self.profile = profile
         self.backend = backend
         self.generation = inv.generation
         self.host_bounds: Shape = get_generation(inv.generation).host_bounds
@@ -224,6 +351,31 @@ class TpuDevicePlugin:
 
     def device_list(self) -> List["pb.Device"]:
         unhealthy = self.unhealthy_chips()
+        if self.mode == "slices":
+            from instaslice_tpu.api.types import is_multihost_slice_uuid
+
+            try:
+                reservations = self.backend.list_reservations()
+            except DeviceError:
+                return []
+            return [
+                pb.Device(
+                    ID=slice_device_id(r.slice_uuid),
+                    health=(
+                        UNHEALTHY
+                        if any(c in unhealthy for c in r.chip_ids)
+                        else HEALTHY
+                    ),
+                )
+                for r in sorted(reservations, key=lambda r: r.slice_uuid)
+                # a node-local part of a multi-host slice is a full-host
+                # tile that would pass the profile check — but it belongs
+                # to another job; never advertise it as allocatable
+                if not is_multihost_slice_uuid(r.slice_uuid)
+                and reservation_profile(
+                    r.chip_ids, self.host_bounds, self.generation
+                ) == self.profile
+            ]
         return [
             pb.Device(
                 ID=device_id(c),
@@ -355,6 +507,110 @@ class TpuDevicePlugin:
                 os.unlink(self.socket_path)
             except OSError:
                 pass
+
+
+class SlicePluginManager:
+    """One slice-mode plugin per profile present on the node.
+
+    Kubelet's registration model is one resource name per plugin endpoint,
+    so per-profile resources (``google.com/tpu-v5e-2x2``) need one plugin
+    each. The manager polls the backend's reservations and brings up a
+    plugin for every profile it sees; plugins for vanished profiles stay
+    registered with an empty inventory (capacity 0) — kubelet handles
+    that gracefully, and the next same-profile slice reuses the endpoint.
+
+    Reference analog: the NVIDIA device plugin's per-MIG-profile resources
+    (``nvidia.com/mig-1g.5gb``), which the reference kicks via a node
+    label (``instaslice_daemonset.go:474-497``) instead of owning.
+    """
+
+    def __init__(
+        self,
+        backend: DeviceBackend,
+        plugin_dir: str = DEFAULT_PLUGIN_DIR,
+        resource_prefix: str = "google.com/tpu-",
+        poll_seconds: float = 0.5,
+        register_with_kubelet: bool = True,
+    ) -> None:
+        inv = backend.discover()
+        self.backend = backend
+        self.plugin_dir = plugin_dir
+        self.resource_prefix = resource_prefix
+        self.poll_seconds = poll_seconds
+        self.register_with_kubelet = register_with_kubelet
+        self.generation = inv.generation
+        self.host_bounds: Shape = get_generation(inv.generation).host_bounds
+        self.plugins: Dict[str, TpuDevicePlugin] = {}   # profile → plugin
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def profiles_present(self) -> Set[str]:
+        try:
+            reservations = self.backend.list_reservations()
+        except DeviceError:
+            return set()
+        out: Set[str] = set()
+        for r in reservations:
+            p = reservation_profile(
+                r.chip_ids, self.host_bounds, self.generation
+            )
+            if p:
+                out.add(p)
+        return out
+
+    def ensure_profile(self, profile: str) -> TpuDevicePlugin:
+        from instaslice_tpu.topology.profiles import parse_profile_name
+
+        # canonicalize (v5e-2x4 → v5e-4x2) so any legal spelling of the
+        # resource matches the canonical reservation-derived profile
+        profile = parse_profile_name(profile).name
+        with self._lock:
+            plugin = self.plugins.get(profile)
+            if plugin is None:
+                plugin = TpuDevicePlugin(
+                    self.backend,
+                    plugin_dir=self.plugin_dir,
+                    resource_name=f"{self.resource_prefix}{profile}",
+                    socket_name=f"tpuslice-{profile}.sock",
+                    health_poll_seconds=self.poll_seconds,
+                    register_with_kubelet=self.register_with_kubelet,
+                    mode="slices",
+                    profile=profile,
+                )
+                plugin.start()
+                self.plugins[profile] = plugin
+            return plugin
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                for profile in self.profiles_present():
+                    self.ensure_profile(profile)
+                # wake existing plugins so ListAndWatch streams re-derive
+                # their inventory from the current reservations
+                with self._lock:
+                    for p in self.plugins.values():
+                        p.notify_health()
+            except Exception:           # pragma: no cover - defensive
+                log.exception("slice plugin manager sweep failed")
+            self._stop.wait(self.poll_seconds)
+
+    def start(self) -> "SlicePluginManager":
+        self._thread = threading.Thread(
+            target=self._loop, name="tpuslice-plugin-mgr", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        with self._lock:
+            for p in self.plugins.values():
+                p.stop()
+            self.plugins.clear()
 
 
 def serve(args) -> int:

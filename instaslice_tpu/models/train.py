@@ -114,15 +114,9 @@ def make_train_step(
     ``step_fn(state, tokens) -> (state, loss)``.
     """
     cfg = model.cfg
-    if cfg.attention_impl == "auto":
-        # Training resolves "auto" to the XLA formulation: the flash
-        # kernel's backward currently differentiates the XLA reference
-        # (ops/flash_attention.py: _flash_bwd), so under grad it would
-        # cost an extra forward AND still materialize the (S, S) logits —
-        # strictly worse than plain XLA. Inference keeps the kernel.
-        # Explicit attention_impl="flash" is honored as written.
-        cfg = dataclasses.replace(cfg, attention_impl="xla")
-        model = TpuLM(cfg)
+    # "auto" resolves inside _attention: the pallas flash kernel on TPU
+    # (forward AND backward are blockwise — ops/flash_attention.py), the
+    # XLA formulation elsewhere. No training-time downgrade needed.
     tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.01)
 
     def init(rng):

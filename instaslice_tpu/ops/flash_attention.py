@@ -14,6 +14,11 @@ Kernel shape choices, per the pallas guide:
   S ≤ 4k, hd ≤ 256 that is ≤ 2 MB each, inside the ~16 MB VMEM budget;
   the causal mask is built with ``broadcasted_iota`` (2-D, TPU rule).
 - fp32 accumulators; output cast back to the input dtype.
+- backward is blockwise too: the forward saves only (o, lse); two kernels
+  recompute softmax probabilities per block from (q, k, lse) and
+  accumulate dq (one query block vs streamed K/V) and dk/dv (one K/V
+  block vs streamed queries, starting at the causal diagonal) — training
+  never materializes the (S, S) logits either.
 
 Off-TPU the same kernel runs in interpreter mode so tests exercise the
 real kernel logic on CPU; ``flash_attention`` also falls back to the XLA
@@ -53,9 +58,12 @@ def _xla_attention(q, k, v, causal: bool) -> jax.Array:
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+    block_k: int, causal: bool, sm_scale: float,
 ):
-    """One query block vs all K/V blocks with online softmax."""
+    """One query block vs all K/V blocks with online softmax. Also emits
+    the per-row logsumexp (lse) so the backward kernels can recompute
+    softmax probabilities blockwise instead of saving them."""
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (BQ, hd)
     block_q, hd = q.shape
     kv_len = k_ref.shape[1]
@@ -101,48 +109,194 @@ def _flash_kernel(
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, hd), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_live, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
-def _xla_attention_3d(q, k, v, causal: bool) -> jax.Array:
-    """(BH, S, hd) flavor of the reference formulation — used as the
-    numerically-equivalent function to differentiate in the backward pass
-    (a dedicated flash backward kernel is a future optimization; the
-    forward's HBM savings are where the inference win is)."""
-    hd = q.shape[-1]
-    logits = jnp.einsum(
-        "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
-    ) * (hd ** -0.5)
-    if causal:
-        S, K = q.shape[1], k.shape[1]
-        # same cropped-query offset as _xla_attention
-        mask = (
-            jax.lax.broadcasted_iota(jnp.int32, (S, K), 0) + (K - S)
-            >= jax.lax.broadcasted_iota(jnp.int32, (S, K), 1)
+def _flash_bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+    block_k: int, causal: bool, sm_scale: float,
+):
+    """dq for one query block: recompute p blockwise from (q, k, lse),
+    ds = p * (dp - delta), dq += ds @ k — never an (S, S) tensor."""
+    q = q_ref[0].astype(jnp.float32)                      # (BQ, hd)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                             # (BQ, 1)
+    delta = delta_ref[0][:, None]
+    block_q, hd = q.shape
+    kv_len = k_ref.shape[1]
+    n_blocks = kv_len // block_k
+    qi = pl.program_id(1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(j, acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
-        logits = jnp.where(mask[None], logits, _NEG)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bqk,bkd->bqd", probs, v)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse)                              # (BQ, BK)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        n_live = jnp.minimum(
+            n_blocks, ((qi + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        n_live = n_blocks
+    acc = jax.lax.fori_loop(
+        0, n_live, body, jnp.zeros((block_q, hd), jnp.float32)
+    )
+    dq_ref[0] = (sm_scale * acc).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+    block_q: int, causal: bool, sm_scale: float,
+):
+    """dk/dv for one K/V block: stream query blocks (from the diagonal
+    when causal), recomputing p from (q, k, lse) per block."""
+    k = k_ref[0].astype(jnp.float32)                      # (BK, hd)
+    v = v_ref[0].astype(jnp.float32)
+    block_k, hd = k.shape
+    S = q_ref.shape[1]
+    n_q_blocks = S // block_q
+    kj = pl.program_id(1)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = sm_scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                 # (BQ, BK)
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                 # (BK, hd)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    # causal: query blocks strictly before this K/V block's diagonal see
+    # none of it; start the stream at the diagonal (program_id-derived —
+    # static-shape friendly)
+    start = (kj * block_k) // block_q if causal else 0
+    zeros = jnp.zeros((block_k, hd), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q_blocks, body, (zeros, zeros))
+    dk_ref[0] = (sm_scale * dk).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_call(q, k, v, causal, block_q, block_k, interpret)
+    return _flash_call(q, k, v, causal, block_q, block_k, interpret)[0]
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    o, lse = _flash_call(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _xla_attention_3d(q, k, v, causal), q, k, v
+    q, k, v, o, lse = res
+    return _flash_bwd_call(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
     )
-    return vjp(g)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _flash_bwd_call(q, k, v, o, lse, g, causal, block_q, block_k, interpret):
+    BH, S, hd = q.shape
+    kv_len = k.shape[1]
+    # delta[b, i] = rowsum(do * o) — O(S·hd), fine in plain XLA
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )                                                     # (BH, S)
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel,
+            block_k=block_k, causal=causal, sm_scale=hd ** -0.5,
+        ),
+        out_shape=jax.ShapeDtypeStruct((BH, S, hd), q.dtype),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, kv_len, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, kv_len, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel,
+            block_q=block_q, causal=causal, sm_scale=hd ** -0.5,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, kv_len, hd), k.dtype),
+            jax.ShapeDtypeStruct((BH, kv_len, hd), v.dtype),
+        ),
+        grid=(BH, kv_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, S, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, S, hd), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda b, j: (b, j, 0)),
+        ),
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
 
 
 @functools.partial(
@@ -159,14 +313,20 @@ def _flash_call(q, k, v, causal, block_q, block_k, interpret):
     )
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((BH, S, hd), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, S, hd), q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),   # logsumexp
+        ),
         grid=(BH, S // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, kv_len, hd), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, kv_len, hd), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ),
         interpret=interpret,
     )(q, k, v)
 

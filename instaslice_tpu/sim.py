@@ -15,6 +15,7 @@ machine — the thing the reference never tests"), and the engine behind
 
 from __future__ import annotations
 
+import tempfile
 import threading
 import time
 import uuid as uuidlib
@@ -44,6 +45,7 @@ class SimCluster:
         deletion_grace_seconds: float = 0.3,
         health_interval: float = 0.15,
         metrics=None,
+        device_plugins: bool = False,
     ) -> None:
         self.kube = FakeKube()
         self.namespace = namespace
@@ -81,6 +83,23 @@ class SimCluster:
             deletion_grace_seconds=deletion_grace_seconds,
             metrics=metrics,
         )
+        # Optional fake-kubelet tier: a per-node SlicePluginManager serving
+        # real gRPC device plugins over unix sockets; the sim scheduler
+        # plays kubelet (GetPreferredAllocation → Allocate) when binding
+        # pods that request a ``google.com/tpu-<profile>`` device resource.
+        self.plugin_managers: Dict[str, "object"] = {}
+        self._dp_allocated: Dict[str, set] = {}
+        if device_plugins:
+            from instaslice_tpu.deviceplugin.server import SlicePluginManager
+
+            for node, backend in self.backends.items():
+                self.plugin_managers[node] = SlicePluginManager(
+                    backend,
+                    plugin_dir=tempfile.mkdtemp(prefix=f"dp-{node}-"),
+                    poll_seconds=0.05,
+                    register_with_kubelet=False,
+                )
+                self._dp_allocated[node] = set()
         self._sched_stop = threading.Event()
         self._sched = threading.Thread(
             target=self._scheduler_loop, name="sim-scheduler", daemon=True
@@ -91,6 +110,8 @@ class SimCluster:
     def start(self) -> "SimCluster":
         for agent in self.agents.values():
             agent.start()
+        for mgr in self.plugin_managers.values():
+            mgr.start()
         self.controller.start()
         self._sched.start()
         return self
@@ -98,6 +119,8 @@ class SimCluster:
     def stop(self) -> None:
         self._sched_stop.set()
         self.controller.stop()
+        for mgr in self.plugin_managers.values():
+            mgr.stop()
         for agent in self.agents.values():
             agent.stop()
         self.kube.stop_watches()
@@ -119,16 +142,23 @@ class SimCluster:
         group: str = "",
         group_size: int = 0,
         annotations: Optional[dict] = None,
+        device_resource: bool = False,
     ) -> dict:
         """The samples/test-pod.yaml analog: scheduling-gated, finalized,
         profile annotation + per-pod extended resource request + envFrom
-        the ConfigMap named after the pod."""
+        the ConfigMap named after the pod. With ``device_resource`` the
+        pod also requests ``google.com/tpu-<profile>: 1`` — the per-profile
+        device-plugin resource (the reference's ``nvidia.com/mig-*``
+        analog), served by the slice plugins when ``device_plugins=True``."""
         ann = {PROFILE_ANNOTATION: profile}
         if group:
             ann[GROUP_ANNOTATION] = group
             ann[GROUP_SIZE_ANNOTATION] = str(group_size)
         if annotations:
             ann.update(annotations)
+        limits = {f"{POD_RESOURCE_PREFIX}{name}": "1"}
+        if device_resource:
+            limits[f"google.com/tpu-{profile}"] = "1"
         return {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -144,9 +174,7 @@ class SimCluster:
                     {
                         "name": "main",
                         "image": "jax-smoke",
-                        "resources": {
-                            "limits": {f"{POD_RESOURCE_PREFIX}{name}": "1"}
-                        },
+                        "resources": {"limits": limits},
                         "envFrom": [{"configMapRef": {"name": name}}],
                     }
                 ],
@@ -156,11 +184,13 @@ class SimCluster:
 
     def submit(self, name: str, profile: str, namespace: str = "default",
                group: str = "", group_size: int = 0,
-               annotations: Optional[dict] = None) -> dict:
+               annotations: Optional[dict] = None,
+               device_resource: bool = False) -> dict:
         return self.kube.create(
             "Pod",
             self.pod_manifest(
-                name, profile, namespace, group, group_size, annotations
+                name, profile, namespace, group, group_size, annotations,
+                device_resource,
             ),
         )
 
@@ -236,16 +266,74 @@ class SimCluster:
                     node = self._node_for(pod)
                     if node is None:
                         continue
+                    patch = {
+                        "spec": {"nodeName": node},
+                        "status": {"phase": "Running"},
+                    }
+                    dp_profile = self._device_resource_profile(pod)
+                    if self.plugin_managers and dp_profile:
+                        granted = self._kubelet_allocate(node, dp_profile)
+                        if granted is None:
+                            continue  # no device yet: stays Pending
+                        patch["metadata"] = {"annotations": granted}
                     self.kube.patch(
-                        "Pod", md.get("namespace", ""), md["name"],
-                        {
-                            "spec": {"nodeName": node},
-                            "status": {"phase": "Running"},
-                        },
+                        "Pod", md.get("namespace", ""), md["name"], patch,
                     )
             except Exception:
                 pass
             self._sched_stop.wait(0.02)
+
+    @staticmethod
+    def _device_resource_profile(pod: dict) -> str:
+        """Profile from a ``google.com/tpu-<profile>: 1`` limit ("" when
+        the pod uses only the annotation path — no device resource)."""
+        for ctr in pod.get("spec", {}).get("containers", []) or []:
+            limits = (ctr.get("resources") or {}).get("limits") or {}
+            for key in limits:
+                if key.startswith("google.com/tpu-"):
+                    return key[len("google.com/tpu-"):]
+        return ""
+
+    def _kubelet_allocate(self, node: str, profile: str) -> Optional[dict]:
+        """Play kubelet against the node's slice device plugin over its
+        real gRPC socket: list devices, GetPreferredAllocation over the
+        unallocated ones, Allocate the pick. Returns the annotations the
+        injected response carries (device paths + chips), or None when no
+        device of the profile is available yet (pod stays Pending — the
+        kubelet behavior for exhausted extended resources)."""
+        import grpc
+
+        from instaslice_tpu.deviceplugin.wire import DevicePluginClient
+
+        mgr = self.plugin_managers[node]
+        plugin = mgr.ensure_profile(profile)
+        taken = self._dp_allocated[node]
+        devices = plugin.device_list()          # one snapshot for both
+        # devices whose reservation vanished (teardown) free their slot
+        taken &= {d.ID for d in devices}
+        with grpc.insecure_channel(f"unix://{plugin.socket_path}") as ch:
+            client = DevicePluginClient(ch)
+            avail = [
+                d.ID for d in devices
+                if d.health == "Healthy" and d.ID not in taken
+            ]
+            if not avail:
+                return None
+            pref = client.preferred(avail, 1)
+            chosen = list(
+                pref.container_responses[0].deviceIDs
+            ) or avail[:1]
+            resp = client.allocate(chosen)
+        cresp = resp.container_responses[0]
+        taken.update(chosen)
+        ann = dict(cresp.annotations)
+        ann["tpu.instaslice.dev/device-paths"] = ",".join(
+            d.host_path for d in cresp.devices
+        )
+        ann["tpu.instaslice.dev/kubelet-env-chips"] = cresp.envs.get(
+            "TPU_KUBELET_ASSIGNED_CHIPS", ""
+        )
+        return ann
 
     def _node_for(self, pod: dict) -> Optional[str]:
         wanted = None

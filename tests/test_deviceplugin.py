@@ -224,3 +224,71 @@ class TestPreferredRectangleUnit:
     def test_must_include_not_available_ignored_gracefully(self):
         got = preferred_rectangle([0, 1, 2], 2, self.HB, must_include=[7])
         assert got == [0, 1]
+
+
+class TestSliceMode:
+    """Slice-mode plugin: realized reservations as per-profile devices."""
+
+    def _plugin(self, backend, plugin_dir, profile):
+        p = TpuDevicePlugin(
+            backend, plugin_dir=plugin_dir,
+            resource_name=f"google.com/tpu-{profile}",
+            socket_name=f"tpuslice-{profile}.sock",
+            register_with_kubelet=False,
+            mode="slices", profile=profile,
+        )
+        p.start()
+        return p
+
+    def test_advertises_only_matching_profile(self, plugin_dir):
+        backend = FakeTpuBackend(generation="v5e")
+        backend.reserve("sl-a", [0, 1, 2, 3])        # 2x2 box
+        backend.reserve("sl-b", [4])                 # 1x1
+        p = self._plugin(backend, plugin_dir, "v5e-2x2")
+        try:
+            devs = p.device_list()
+            assert [d.ID for d in devs] == ["slice-sl-a"]
+        finally:
+            p.stop()
+
+    def test_multihost_parts_never_advertised(self, plugin_dir):
+        """A node-local part of a multi-host allocation is a full-host
+        tile; advertising it would let kubelet grant another job's chips."""
+        backend = FakeTpuBackend(generation="v5e")
+        backend.reserve("sl-mh-group1", list(range(8)))  # full 2x4 host
+        p = self._plugin(backend, plugin_dir, "v5e-4x2")
+        try:
+            assert p.device_list() == []
+            # a standalone whole-host reservation IS advertised
+            backend.release("sl-mh-group1")
+            backend.reserve("sl-solo", list(range(8)))
+            assert [d.ID for d in p.device_list()] == ["slice-sl-solo"]
+        finally:
+            p.stop()
+
+    def test_allocate_injects_reservation_chips(self, plugin_dir):
+        backend = FakeTpuBackend(generation="v5e")
+        backend.reserve("sl-x", [0, 1, 2, 3])
+        p = self._plugin(backend, plugin_dir, "v5e-2x2")
+        try:
+            with grpc.insecure_channel(f"unix://{p.socket_path}") as ch:
+                resp = DevicePluginClient(ch).allocate(["slice-sl-x"])
+            cresp = resp.container_responses[0]
+            inv = backend.discover()
+            assert sorted(d.host_path for d in cresp.devices) == sorted(
+                inv.chip_paths[c] for c in (0, 1, 2, 3)
+            )
+            assert cresp.envs["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+            assert cresp.envs["TPU_KUBELET_ASSIGNED_CHIPS"] == "0,1,2,3"
+        finally:
+            p.stop()
+
+    def test_allocate_unknown_reservation_rejected(self, plugin_dir):
+        backend = FakeTpuBackend(generation="v5e")
+        p = self._plugin(backend, plugin_dir, "v5e-2x2")
+        try:
+            with grpc.insecure_channel(f"unix://{p.socket_path}") as ch:
+                with pytest.raises(grpc.RpcError):
+                    DevicePluginClient(ch).allocate(["slice-nope"])
+        finally:
+            p.stop()
