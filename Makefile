@@ -76,3 +76,8 @@ deploy: install  ## CRD + RBAC + controller/agent/device-plugin workloads
 .PHONY: undeploy
 undeploy:
 	$(KUBECTL) delete -k config/default --ignore-not-found
+
+.PHONY: test-deploy
+test-deploy:  ## Deploy-plane validation without a cluster: render config/default, apply over HTTP to the fake apiserver, cross-check selectors/SAs/ports, lint Dockerfiles against pyproject scripts
+	$(PY) tools/test_deploy.py > deploy/test-deploy.log 2>&1; \
+	  st=$$?; cat deploy/test-deploy.log; exit $$st

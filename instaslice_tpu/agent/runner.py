@@ -24,11 +24,15 @@ from instaslice_tpu.utils.probes import ProbeServer
 log = logging.getLogger("instaslice_tpu.agent.runner")
 
 
-def _port_of(bind_address: str) -> int:
+def _split_bind(bind_address: str) -> tuple:
+    """(host, port) from ':8080' / '127.0.0.1:8080'. The host part is
+    honored by the metrics server — the kube-rbac-proxy patch relies on a
+    real 127.0.0.1 bind, not a cosmetic one."""
+    host, _, port_s = bind_address.rpartition(":")
     try:
-        return int(bind_address.rpartition(":")[2])
+        return host, int(port_s)
     except ValueError:
-        return 0
+        return host, 0
 
 
 class AgentRunner:
@@ -42,7 +46,9 @@ class AgentRunner:
         health_probe_bind_address: str = ":8085",
     ) -> None:
         self.metrics = OperatorMetrics()
-        self.metrics_port = _port_of(metrics_bind_address)
+        self.metrics_host, self.metrics_port = _split_bind(
+            metrics_bind_address
+        )
         self.probe_address = health_probe_bind_address
         self.agent = NodeAgent(
             client, backend, node_name, namespace, metrics=self.metrics
@@ -81,7 +87,9 @@ class AgentRunner:
         self.probes = ProbeServer(
             self.probe_address, ready_check=lambda: self._ready
         ).start()
-        start_metrics_server(self.metrics, self.metrics_port)
+        start_metrics_server(
+            self.metrics, self.metrics_port, host=self.metrics_host
+        )
         self.agent.start()
         self._ready = True
         log.info("agent running (node=%s, backend=%s)",

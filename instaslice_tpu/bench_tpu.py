@@ -1,0 +1,211 @@
+"""On-chip benchmarks: serving decode throughput, train-step MFU, and the
+pallas flash-attention kernel (compiled, ``interpret=False``) vs the XLA
+formulation — the BASELINE.md secondary metrics ("vLLM tokens/sec/chip —
+measure & report"; the reference publishes no numbers at all).
+
+Run as ``python -m instaslice_tpu.bench_tpu``: prints one JSON object.
+``bench.py`` invokes it as a subprocess with a timeout so a hung TPU
+tunnel surfaces as a reported error instead of wedging the whole bench
+(the control-plane metric never needs a chip).
+
+Requires a real TPU backend: refuses to silently bench the CPU emulator
+(exit code 2 + {"error": ...}).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+#: peak dense bf16 TFLOP/s per chip, from public Cloud TPU specs
+PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
+
+def _timeit(fn, *args, warmup: int = 2, iters: int = 10) -> float:
+    """Median wall seconds per call, after warmup, blocking on results."""
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def bench_flash_kernel(out: dict) -> None:
+    """Compiled pallas kernel vs XLA attention: numerics + TFLOP/s."""
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.ops.flash_attention import (
+        _xla_attention,
+        flash_attention,
+    )
+
+    B, S, H, hd = 4, 2048, 8, 128
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (B, S, H, hd), jnp.bfloat16) for kk in ks
+    )
+
+    flash = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                        interpret=False)
+    )
+    xla = jax.jit(lambda q, k, v: _xla_attention(q, k, v, True))
+
+    # numerics: the kernel must match XLA at bf16 tolerance
+    diff = float(
+        jnp.max(jnp.abs(
+            flash(q, k, v).astype(jnp.float32)
+            - xla(q, k, v).astype(jnp.float32)
+        ))
+    )
+    out["flash_vs_xla_max_abs_diff"] = round(diff, 4)
+    if diff > 0.1:
+        raise AssertionError(
+            f"pallas kernel numerics off vs XLA: max|Δ|={diff}"
+        )
+
+    # causal attention FLOPs ≈ 2 matmuls * 2*B*H*S²*hd * 1/2 (masked half)
+    flops = 2 * 2 * B * H * S * S * hd * 0.5
+    t_flash = _timeit(flash, q, k, v)
+    t_xla = _timeit(xla, q, k, v)
+    out["flash_fwd_tflops"] = round(flops / t_flash / 1e12, 2)
+    out["xla_fwd_tflops"] = round(flops / t_xla / 1e12, 2)
+    out["flash_fwd_speedup_vs_xla"] = round(t_xla / t_flash, 3)
+
+    # backward: the blockwise kernels vs XLA's autodiff
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32))
+
+    g_flash = jax.jit(jax.grad(loss(
+        lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                        interpret=False)
+    ), argnums=(0, 1, 2)))
+    g_xla = jax.jit(jax.grad(loss(
+        lambda q, k, v: _xla_attention(q, k, v, True)
+    ), argnums=(0, 1, 2)))
+    t_gf = _timeit(g_flash, q, k, v, iters=5)
+    t_gx = _timeit(g_xla, q, k, v, iters=5)
+    bwd_flops = flops * 2.5  # fwd recompute + dq + dk/dv
+    out["flash_bwd_tflops"] = round(bwd_flops / t_gf / 1e12, 2)
+    out["xla_bwd_tflops"] = round(bwd_flops / t_gx / 1e12, 2)
+    out["flash_bwd_speedup_vs_xla"] = round(t_gx / t_gf, 3)
+
+
+def bench_serving(out: dict) -> None:
+    """Continuous-batching decode tokens/sec on one chip — the
+    tokens/sec/chip secondary metric (single-chip slice ⇒ per-chip)."""
+    import jax.numpy as jnp
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.serving import ServingEngine
+
+    # ~1.3B-param decoder (fits one v5e chip's 16 GiB with cache); the
+    # vLLM-sample scale class without the 7B fit gymnastics
+    cfg = ModelConfig(
+        vocab_size=32000, d_model=2048, n_heads=16, n_layers=16,
+        d_ff=8192, max_seq_len=2048, dtype=jnp.bfloat16, remat=False,
+    )
+    model = TpuLM(cfg)
+    eng = ServingEngine(
+        model, max_batch=8, max_len=1024, prefill_len=128,
+    )
+    t0 = time.perf_counter()
+    tput = eng.throughput(n_steps=64)
+    out["decode_tokens_per_sec_per_chip"] = round(tput, 1)
+    out["serving_bench_seconds"] = round(time.perf_counter() - t0, 1)
+    out["serving_model_params_m"] = round(
+        (cfg.vocab_size * cfg.d_model
+         + cfg.n_layers * (4 * cfg.d_model ** 2
+                           + 2 * cfg.d_model * cfg.d_ff)) / 1e6
+    )
+
+
+def bench_train_mfu(out: dict, generation: str) -> None:
+    """One-chip train-step MFU on the same model class."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.models.train import make_train_step
+
+    cfg = ModelConfig(
+        vocab_size=32000, d_model=2048, n_heads=16, n_layers=16,
+        d_ff=8192, max_seq_len=2048, dtype=jnp.bfloat16, remat=True,
+    )
+    model = TpuLM(cfg)
+    mesh = Mesh(
+        np.array(jax.devices()[:1]).reshape(1, 1, 1),
+        ("data", "seq", "model"),
+    )
+    init_fn, step_fn = make_train_step(model, mesh)
+    state = init_fn(jax.random.key(0))
+    B, S = 4, 1024
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 0, 32000)
+
+    def step(state, tokens):
+        return step_fn(state, tokens)
+
+    # warmup/compile
+    state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, tokens)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    params = (
+        cfg.vocab_size * cfg.d_model
+        + cfg.n_layers * (4 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff)
+    )
+    # 6ND for fwd+bwd, +33% for remat's recompute-forward
+    step_flops = 6 * params * B * S * (1 + 1 / 3)
+    peak = PEAK_TFLOPS.get(generation, 197.0) * 1e12
+    out["train_step_seconds"] = round(dt, 4)
+    out["train_mfu"] = round(step_flops / dt / peak, 4)
+    out["train_loss_finite"] = bool(jnp.isfinite(loss))
+
+
+def main() -> int:
+    import os
+
+    out: dict = {}
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        out["jax_backend"] = backend
+        out["device_count"] = jax.device_count()
+        if backend == "cpu":
+            out["error"] = (
+                "no TPU backend (default_backend=cpu) — refusing to bench "
+                "the CPU emulator as if it were a chip"
+            )
+            print(json.dumps(out))
+            return 2
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        out["tpu_generation"] = gen
+        bench_flash_kernel(out)
+        bench_serving(out)
+        bench_train_mfu(out, gen)
+    except Exception as e:  # noqa: BLE001 - report, don't crash silently
+        out["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(out))
+        return 2
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -337,6 +337,23 @@ class Controller:
         if gid:
             peers = self._group_peers(md.get("namespace", ""), gid)
             if len(peers) < size:
+                # Not enough GATED peers — but the group may already be
+                # fully granted (its members ungated, so invisible to
+                # _group_peers). Then this pod is surplus and must be
+                # told so; silently requeueing would livelock forever.
+                aid = self._group_alloc_id(md.get("namespace", ""), gid)
+                for ts in slices:
+                    a = ts.spec.allocations.get(aid)
+                    if a is not None and not any(
+                        p.pod_uuid == md.get("uid") for p in a.pods
+                    ):
+                        self._annotate_error(
+                            pod,
+                            f"pod group {gid!r} already has {size} "
+                            "members; this pod is surplus (raise "
+                            f"{GROUP_SIZE_ANNOTATION}?)",
+                        )
+                        return None
                 return 1.0  # wait for the rest of the group
             pods = peers[:size]
             # A stable handoff name is per-POD state (ConfigMap + node
@@ -398,15 +415,8 @@ class Controller:
                 sorted(pods, key=lambda p: p["metadata"]["name"])
             )
         ]
-        # group ids are only unique per namespace; qualify them so two
-        # namespaces using the same group name can't collide on alloc_id
-        # (and thus on the derived slice uuid at the device layer). A
-        # separator alone is ambiguous ('team--a'+'x' vs 'team'+'a--x'),
-        # so disambiguate with a short digest of the exact (ns, gid) pair.
         if gid:
-            ns = pod_refs[0].namespace
-            h = hashlib.sha1(f"{ns}\x00{gid}".encode()).hexdigest()[:10]
-            aid = f"{gid}-{h}"
+            aid = self._group_alloc_id(pod_refs[0].namespace, gid)
         else:
             aid = pod_refs[0].pod_uuid
         alloc = AllocationDetails.from_placement(
@@ -422,6 +432,17 @@ class Controller:
             alloc.alloc_id, alloc.profile, alloc.box, list(alloc.parts),
         )
         return self.no_capacity_requeue  # check progress even if events drop
+
+    @staticmethod
+    def _group_alloc_id(namespace: str, gid: str) -> str:
+        """Deterministic allocation id for a pod group. Group ids are only
+        unique per namespace; qualify them so two namespaces using the
+        same group name can't collide on alloc_id (and thus on the
+        derived slice uuid at the device layer). A separator alone is
+        ambiguous ('team--a'+'x' vs 'team'+'a--x'), so disambiguate with
+        a short digest of the exact (ns, gid) pair."""
+        h = hashlib.sha1(f"{namespace}\x00{gid}".encode()).hexdigest()[:10]
+        return f"{gid}-{h}"
 
     def _group_peers(self, namespace: str, gid: str) -> List[dict]:
         from instaslice_tpu.controller.gates import GROUP_ANNOTATION
@@ -480,24 +501,35 @@ class Controller:
         if missing:
             self._write_allocation(alloc)
 
-    def _for_each_holder(self, alloc: AllocationDetails, mutate) -> None:
+    def _for_each_holder(self, alloc: AllocationDetails, mutate) -> bool:
+        """Apply ``mutate`` to the allocation in every holder CR. Returns
+        True when at least one CR actually transitioned — the signal
+        metrics must key on, or a crash-recovery re-run that loses the
+        CR race observes the same event twice."""
+        transitioned = False
         for node in alloc.parts:
+            applied = [False]
+
             def mut(obj: dict) -> Optional[dict]:
                 ts = TpuSlice.from_manifest(obj)
                 a = ts.spec.allocations.get(alloc.alloc_id)
+                applied[0] = False  # conflict retry re-reads fresh state
                 if a is None:
                     return None
                 if not mutate(a):
                     return None
+                applied[0] = True
                 return ts.to_manifest()
 
             try:
                 update_with_retry(
                     self.client, KIND, self.namespace, node, mut
                 )
+                transitioned = transitioned or applied[0]
             except NotFound:
                 log.warning("CR %s gone while updating %s", node,
                             alloc.alloc_id)
+        return transitioned
 
     def _promote_created(self, alloc: AllocationDetails) -> None:
         def mutate(a: AllocationDetails) -> bool:
@@ -552,10 +584,14 @@ class Controller:
             a.set_status(AllocationStatus.UNGATED)
             return True
 
-        self._for_each_holder(alloc, mutate)
+        transitioned = self._for_each_holder(alloc, mutate)
         for p in alloc.pods:
             self._set_pending(f"{p.namespace}/{p.pod_name}", False)
-        if self.metrics and alloc.status == AllocationStatus.CREATED:
+        # observe only when the CREATED→UNGATED transition actually landed
+        # in a CR: the crash-recovery path (_maybe_finish_ungate) re-runs
+        # _ungate_all, and keying on the stale in-memory status would
+        # double-count the north-star grant-latency metric
+        if self.metrics and transitioned:
             if alloc.created_at:
                 self.metrics.slice_grant_seconds.observe(
                     granted_at - alloc.created_at

@@ -27,11 +27,15 @@ log = logging.getLogger("instaslice_tpu.controller.runner")
 LEASE_NAME = "tpuslice-controller-leader"
 
 
-def _port_of(bind_address: str) -> int:
+def _split_bind(bind_address: str) -> tuple:
+    """(host, port) from ':8080' / '127.0.0.1:8080'. The host part is
+    honored by the metrics server — the kube-rbac-proxy patch relies on a
+    real 127.0.0.1 bind, not a cosmetic one."""
+    host, _, port_s = bind_address.rpartition(":")
     try:
-        return int(bind_address.rpartition(":")[2])
+        return host, int(port_s)
     except ValueError:
-        return 0
+        return host, 0
 
 
 class ControllerRunner:
@@ -51,7 +55,9 @@ class ControllerRunner:
         self.leader_elect = leader_elect
         self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
         self.metrics = OperatorMetrics()
-        self.metrics_port = _port_of(metrics_bind_address)
+        self.metrics_host, self.metrics_port = _split_bind(
+            metrics_bind_address
+        )
         self.probe_address = health_probe_bind_address
         self.controller = Controller(
             client,
@@ -97,7 +103,9 @@ class ControllerRunner:
         self.probes = ProbeServer(
             self.probe_address, ready_check=lambda: self._ready
         ).start()
-        start_metrics_server(self.metrics, self.metrics_port)
+        start_metrics_server(
+            self.metrics, self.metrics_port, host=self.metrics_host
+        )
         if self.leader_elect:
             self.elector = LeaderElector(
                 self.client, self.namespace, LEASE_NAME, self.identity

@@ -62,6 +62,24 @@ _KIND_INFO: Dict[str, Tuple[str, str, bool]] = {
     "Namespace": ("api/v1", "namespaces", False),
     "Lease": ("apis/coordination.k8s.io/v1", "leases", True),
     KIND: (f"apis/{GROUP}/{VERSION}", PLURAL, True),
+    # deploy-plane kinds: the operator never touches these at runtime,
+    # but `make test-deploy` applies the rendered kustomize tree through
+    # this client against the fake API server (wire-level apply check)
+    "Deployment": ("apis/apps/v1", "deployments", True),
+    "DaemonSet": ("apis/apps/v1", "daemonsets", True),
+    "Service": ("api/v1", "services", True),
+    "ServiceAccount": ("api/v1", "serviceaccounts", True),
+    "ClusterRole": (
+        "apis/rbac.authorization.k8s.io/v1", "clusterroles", False),
+    "ClusterRoleBinding": (
+        "apis/rbac.authorization.k8s.io/v1", "clusterrolebindings", False),
+    "Role": ("apis/rbac.authorization.k8s.io/v1", "roles", True),
+    "RoleBinding": (
+        "apis/rbac.authorization.k8s.io/v1", "rolebindings", True),
+    "CustomResourceDefinition": (
+        "apis/apiextensions.k8s.io/v1", "customresourcedefinitions", False),
+    "ServiceMonitor": (
+        "apis/monitoring.coreos.com/v1", "servicemonitors", True),
 }
 
 

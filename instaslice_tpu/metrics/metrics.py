@@ -109,10 +109,19 @@ class OperatorMetrics:
 _server_started = threading.Lock()
 
 
-def start_metrics_server(metrics: OperatorMetrics, port: int) -> bool:
-    """Serve ``metrics.registry`` on ``port``; False if unavailable."""
+def start_metrics_server(
+    metrics: OperatorMetrics, port: int, host: str = ""
+) -> bool:
+    """Serve ``metrics.registry`` on ``host:port``; False if unavailable.
+
+    ``host`` matters: the kube-rbac-proxy deployment binds the manager to
+    127.0.0.1 so the sidecar is the only path to /metrics
+    (config/default/manager_auth_proxy_patch.yaml) — ignoring the host
+    and listening on 0.0.0.0 would silently bypass the auth proxy."""
     if not _PROM or metrics.registry is None or port <= 0:
         return False
     with _server_started:
-        start_http_server(port, registry=metrics.registry)
+        start_http_server(
+            port, addr=host or "0.0.0.0", registry=metrics.registry
+        )
     return True

@@ -1,0 +1,22 @@
+"""Deploy-plane validation as a test: the `make test-deploy` logic
+(render config/default, apply the rendered tree over HTTP to the fake
+API server, cross-check references, lint the build plane) must stay
+green. Reference anchor: /root/reference/test/e2e/e2e_test.go:84-118 —
+the half of its e2e that needs no cluster."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_deploy_plane_validates():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "test_deploy.py")],
+        capture_output=True, timeout=120,
+    )
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, out[-2000:]
+    assert "FAIL" not in out, out[-2000:]
+    assert "OK: 0 failures" in out

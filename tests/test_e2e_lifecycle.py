@@ -289,6 +289,24 @@ class TestReviewRegressions:
             time.sleep(0.05)
         assert "surplus" in ann.get("tpu.instaslice.dev/error", "")
 
+    def test_late_surplus_pod_annotated(self, cluster2):
+        """Surplus detection must also work when the extra pod reconciles
+        AFTER its peers were granted and ungated — gated-peer counting
+        alone would requeue it forever (the silent livelock)."""
+        cluster2.submit("w-0", "v5e-4x4", group="job-b", group_size=2)
+        cluster2.submit("w-1", "v5e-4x4", group="job-b", group_size=2)
+        assert cluster2.wait_phase("w-0", "Running", timeout=20)
+        assert cluster2.wait_phase("w-1", "Running", timeout=20)
+        cluster2.submit("w-2", "v5e-4x4", group="job-b", group_size=2)
+        deadline = time.monotonic() + 20
+        ann = {}
+        while time.monotonic() < deadline:
+            ann = cluster2.pod("w-2")["metadata"].get("annotations", {})
+            if "tpu.instaslice.dev/error" in ann:
+                break
+            time.sleep(0.05)
+        assert "surplus" in ann.get("tpu.instaslice.dev/error", "")
+
     def test_raced_reserve_released_on_teardown(self, cluster2):
         """Reserve succeeds on node B while node A's failure marks the
         allocation FAILED->DELETED: B's reservation must not leak."""

@@ -173,3 +173,37 @@ class TestInFlightFailure:
         cluster.submit("p", "v5e-1x1")
         assert cluster.wait_phase("p", "Running", timeout=15)
         assert len(cluster.backends["node-0"].list_reservations()) == 1
+
+
+class TestGrantMetricOnce:
+    def test_grant_latency_observed_once_despite_recovery_rerun(self):
+        """The crash-recovery path re-runs _ungate_all with a stale
+        in-memory CREATED status; the grant histogram must key on the CR
+        transition actually landing, not the stale copy."""
+        import copy
+
+        from instaslice_tpu.api.types import AllocationStatus
+        from instaslice_tpu.metrics.metrics import OperatorMetrics
+        from instaslice_tpu.sim import SimCluster
+
+        m = OperatorMetrics()
+        if m.registry is None:
+            pytest.skip("prometheus_client unavailable")
+        with SimCluster(n_nodes=1, metrics=m) as sim:
+            sim.submit("demo", "v5e-1x1")
+            assert sim.wait_phase("demo", "Running", timeout=10)
+            alloc = None
+            for ts in sim.kube.list("TpuSlice", namespace=sim.namespace):
+                from instaslice_tpu.api.types import TpuSlice
+
+                for a in TpuSlice.from_manifest(ts).spec.allocations.values():
+                    alloc = a
+            assert alloc is not None
+            assert alloc.status == AllocationStatus.UNGATED
+            # replay the recovery path's stale view: in-memory CREATED,
+            # CR already UNGATED → mutate is a no-op → no second observe
+            stale = copy.deepcopy(alloc)
+            stale.status = AllocationStatus.CREATED  # bypass legality: simulating staleness
+            sim.controller._ungate_all(stale)
+        count = m.registry.get_sample_value("tpuslice_grant_seconds_count")
+        assert count == 1.0, count

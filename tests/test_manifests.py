@@ -228,3 +228,57 @@ class TestManagerManifests:
             for res in k.get("resources", []):
                 target = os.path.normpath(os.path.join(base, res))
                 assert os.path.exists(target), (kfile, res)
+            for patch in k.get("patches", []):
+                if "path" in patch:
+                    target = os.path.normpath(
+                        os.path.join(base, patch["path"])
+                    )
+                    assert os.path.exists(target), (kfile, patch)
+
+
+class TestMetricsAuthn:
+    """Reference parity: /metrics must sit behind kube-rbac-proxy
+    (/root/reference/config/default/manager_auth_proxy_patch.yaml:12-33)."""
+
+    def test_auth_proxy_patch_wires_sidecar_and_localhost_bind(self):
+        docs = load_all(os.path.join(
+            REPO, "config", "default", "manager_auth_proxy_patch.yaml"
+        ))
+        (patch,) = docs
+        ctrs = {
+            c["name"]: c
+            for c in patch["spec"]["template"]["spec"]["containers"]
+        }
+        proxy = ctrs["kube-rbac-proxy"]
+        assert any("--upstream=http://127.0.0.1:8080/" in a
+                   for a in proxy["args"])
+        assert any(p.get("name") == "https" for p in proxy["ports"])
+        # the manager must retreat to localhost so the sidecar is the only
+        # path to /metrics
+        manager = ctrs["manager"]
+        assert any("--metrics-bind-address=127.0.0.1:8080" in a
+                   for a in manager["args"])
+
+    def test_default_kustomization_applies_the_patch(self):
+        (k,) = load_all(os.path.join(
+            REPO, "config", "default", "kustomization.yaml"
+        ))
+        paths = [p.get("path", "") for p in k.get("patches", [])]
+        assert "manager_auth_proxy_patch.yaml" in paths
+
+    def test_rbac_grants_token_and_access_review(self):
+        docs = load_all(os.path.join(
+            REPO, "config", "rbac", "auth_proxy_role.yaml"
+        ))
+        (role,) = docs
+        resources = {r for rule in role["rules"]
+                     for r in rule.get("resources", [])}
+        assert {"tokenreviews", "subjectaccessreviews"} <= resources
+
+    def test_service_monitor_scrapes_https_with_token(self):
+        (mon,) = load_all(os.path.join(
+            REPO, "config", "prometheus", "monitor.yaml"
+        ))
+        (ep,) = mon["spec"]["endpoints"]
+        assert ep["scheme"] == "https"
+        assert "serviceaccount/token" in ep["bearerTokenFile"]

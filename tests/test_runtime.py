@@ -186,3 +186,36 @@ class TestFullStackOverHttp:
                 break
             time.sleep(0.1)
         assert allocs == {}
+
+
+class TestMetricsBind:
+    def test_split_bind_parses_host(self):
+        from instaslice_tpu.controller.runner import _split_bind
+
+        assert _split_bind(":8080") == ("", 8080)
+        assert _split_bind("127.0.0.1:9090") == ("127.0.0.1", 9090)
+        assert _split_bind("bogus") == ("", 0)
+
+    def test_metrics_server_honors_localhost_bind(self):
+        """The kube-rbac-proxy patch depends on a REAL 127.0.0.1 bind —
+        an 0.0.0.0 listener would bypass the auth proxy entirely."""
+        import socket
+        import urllib.request
+
+        from instaslice_tpu.metrics.metrics import (
+            OperatorMetrics,
+            start_metrics_server,
+        )
+
+        m = OperatorMetrics()
+        if m.registry is None:
+            pytest.skip("prometheus_client unavailable")
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        assert start_metrics_server(m, port, host="127.0.0.1")
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read()
+        assert b"tpuslice" in body

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Deploy-plane validation without a cluster or a container runtime.
+
+The reference's e2e builds images, loads them into KinD, and `make
+deploy`s the kustomize tree (/root/reference/test/e2e/e2e_test.go:84-118).
+Neither docker nor kind exists in this environment, so this is the
+dry-run equivalent, split into the same two halves:
+
+1. **Manifest apply** — render `config/default` (a small kustomize
+   emulator: resource recursion + strategic-merge patches keyed by
+   containers[].name) and APPLY every document through RealKubeClient →
+   FakeApiServer over real HTTP: URL building, JSON bodies, create
+   semantics. Then cross-checks `kubectl` would do server-side:
+   selector↔template labels, serviceAccount references, Service
+   targetPort names, namespace consistency.
+2. **Image build plan** — every Dockerfile COPY source exists, every
+   ENTRYPOINT binary is a console script declared in pyproject.toml,
+   every image referenced by a workload is produced by `make
+   docker-build`.
+
+Run via `make test-deploy`; exits non-zero on the first failure class.
+"""
+
+from __future__ import annotations
+
+import copy
+import glob
+import os
+import re
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FAILURES: list = []
+
+
+def check(ok: bool, what: str) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        FAILURES.append(what)
+
+
+# --------------------------------------------------------------- kustomize
+
+def render(dir_path: str) -> list:
+    """Emulate `kustomize build`: recurse resources, apply patches."""
+    kfile = os.path.join(dir_path, "kustomization.yaml")
+    with open(kfile) as f:
+        k = yaml.safe_load(f)
+    docs: list = []
+    for res in k.get("resources", []):
+        target = os.path.normpath(os.path.join(dir_path, res))
+        if os.path.isdir(target):
+            docs.extend(render(target))
+        else:
+            with open(target) as f:
+                docs.extend(d for d in yaml.safe_load_all(f) if d)
+    for patch in k.get("patches", []):
+        ppath = os.path.normpath(os.path.join(dir_path, patch["path"]))
+        with open(ppath) as f:
+            for pdoc in yaml.safe_load_all(f):
+                if pdoc:
+                    docs = [_apply_patch(d, pdoc) for d in docs]
+    return docs
+
+
+def _apply_patch(doc: dict, patch: dict) -> dict:
+    if (
+        doc.get("kind") != patch.get("kind")
+        or doc.get("metadata", {}).get("name")
+        != patch.get("metadata", {}).get("name")
+    ):
+        return doc
+    return _strategic_merge(copy.deepcopy(doc), patch)
+
+
+def _strategic_merge(base, patch):
+    """Enough of strategic-merge for this tree: dicts merge recursively;
+    `containers` lists merge by item name; other lists replace."""
+    if isinstance(base, dict) and isinstance(patch, dict):
+        out = dict(base)
+        for key, pval in patch.items():
+            if key in ("apiVersion", "kind"):
+                continue
+            if key == "containers" and isinstance(pval, list):
+                merged = {c.get("name"): c for c in base.get(key, [])}
+                for pc in pval:
+                    name = pc.get("name")
+                    merged[name] = _strategic_merge(
+                        merged.get(name, {}), pc
+                    )
+                out[key] = list(merged.values())
+            elif key in base:
+                out[key] = _strategic_merge(base[key], pval)
+            else:
+                out[key] = pval
+        return out
+    return copy.deepcopy(patch)
+
+
+# ----------------------------------------------------------------- checks
+
+def iter_pod_specs(doc):
+    kind = doc.get("kind")
+    if kind in ("Deployment", "DaemonSet", "StatefulSet", "Job"):
+        yield doc["spec"]["template"]
+    elif kind == "Pod":
+        yield doc
+
+
+def console_scripts() -> set:
+    with open(os.path.join(REPO, "pyproject.toml")) as f:
+        text = f.read()
+    m = re.search(r"\[project\.scripts\](.*?)(\n\[|\Z)", text, re.S)
+    return {
+        line.split("=")[0].strip()
+        for line in (m.group(1) if m else "").splitlines()
+        if "=" in line
+    }
+
+
+def check_apply(docs: list) -> None:
+    """Apply every rendered doc over real HTTP against the fake server."""
+    from instaslice_tpu.kube import FakeKube
+    from instaslice_tpu.kube.httptest import FakeApiServer
+    from instaslice_tpu.kube.real import RealKubeClient
+
+    store = FakeKube()
+    with FakeApiServer(store) as srv:
+        client = RealKubeClient(srv.url)
+        for doc in docs:
+            kind = doc.get("kind", "?")
+            name = doc.get("metadata", {}).get("name", "?")
+            try:
+                client.create(kind, doc)
+                check(True, f"apply {kind}/{name}")
+            except Exception as e:  # noqa: BLE001
+                check(False, f"apply {kind}/{name}: {e}")
+
+
+def check_cross_references(docs: list) -> None:
+    by_kind: dict = {}
+    for d in docs:
+        by_kind.setdefault(d.get("kind"), []).append(d)
+
+    for doc in docs:
+        kind = doc.get("kind")
+        name = doc.get("metadata", {}).get("name")
+        if kind in ("Deployment", "DaemonSet"):
+            sel = doc["spec"]["selector"]["matchLabels"]
+            labels = doc["spec"]["template"]["metadata"]["labels"]
+            check(
+                all(labels.get(k) == v for k, v in sel.items()),
+                f"{kind}/{name}: selector matches template labels",
+            )
+            sa = doc["spec"]["template"]["spec"].get("serviceAccountName")
+            if sa:
+                sas = {s["metadata"]["name"]
+                       for s in by_kind.get("ServiceAccount", [])}
+                check(sa in sas, f"{kind}/{name}: serviceAccount {sa} exists")
+        if kind == "Service":
+            # every named targetPort must exist on a selected workload
+            sel = doc["spec"].get("selector", {})
+            port_names = set()
+            for d in docs:
+                for tpl in iter_pod_specs(d):
+                    tlabels = tpl.get("metadata", {}).get("labels", {})
+                    if sel and all(tlabels.get(k) == v
+                                   for k, v in sel.items()):
+                        for c in tpl["spec"].get("containers", []):
+                            for p in c.get("ports", []) or []:
+                                if p.get("name"):
+                                    port_names.add(p["name"])
+            for p in doc["spec"].get("ports", []):
+                tp = p.get("targetPort")
+                if isinstance(tp, str):
+                    check(
+                        tp in port_names,
+                        f"Service/{name}: targetPort {tp!r} resolves "
+                        f"(have {sorted(port_names)})",
+                    )
+        if kind in ("ClusterRoleBinding", "RoleBinding"):
+            ref = doc["roleRef"]["name"]
+            role_kind = doc["roleRef"]["kind"]
+            names = {r["metadata"]["name"]
+                     for r in by_kind.get(role_kind, [])}
+            check(ref in names, f"{kind}/{name}: roleRef {ref} exists")
+
+    # the auth-proxy patch must have landed: no workload may expose the
+    # plain metrics bind on all interfaces
+    for doc in by_kind.get("Deployment", []):
+        for tpl in iter_pod_specs(doc):
+            for c in tpl["spec"].get("containers", []):
+                if c.get("name") == "manager":
+                    check(
+                        any("--metrics-bind-address=127.0.0.1" in a
+                            for a in c.get("args", [])),
+                        "manager metrics bound to localhost "
+                        "(kube-rbac-proxy fronting)",
+                    )
+
+
+def check_build_plane(docs: list) -> None:
+    scripts = console_scripts()
+    check(bool(scripts), f"console scripts declared: {sorted(scripts)}")
+
+    with open(os.path.join(REPO, "Makefile")) as f:
+        mk = f.read()
+    # expand `VAR ?= default` style Makefile vars used in image tags
+    mkvars = dict(re.findall(r"^(\w+)\s*\?=\s*(\S+)", mk, re.M))
+    images_built = set()
+    for m in re.finditer(r"-t\s+(\S+)\s", mk):
+        img = re.sub(
+            r"\$\((\w+)\)", lambda v: mkvars.get(v.group(1), ""),
+            m.group(1),
+        )
+        images_built.add(img.split(":")[0])
+
+    for df in sorted(glob.glob(os.path.join(REPO, "Dockerfile.*"))):
+        base = os.path.basename(df)
+        with open(df) as f:
+            lines = f.read().splitlines()
+        for line in lines:
+            m = re.match(r"^\s*COPY\s+(?!--from)(\S+)\s+\S+", line)
+            if m:
+                src = m.group(1)
+                check(
+                    os.path.exists(os.path.join(REPO, src)),
+                    f"{base}: COPY source {src} exists",
+                )
+            m = re.match(r'^\s*ENTRYPOINT\s+\["([^"]+)"', line)
+            if m:
+                check(
+                    m.group(1) in scripts,
+                    f"{base}: entrypoint {m.group(1)} is a console script",
+                )
+
+    for doc in docs:
+        for tpl in iter_pod_specs(doc):
+            for c in tpl["spec"].get("containers", []):
+                img = c.get("image", "").split(":")[0]
+                if img.startswith("instaslice-tpu"):
+                    df = f"Dockerfile.{img.split('-')[-1]}"
+                    check(
+                        os.path.exists(os.path.join(REPO, df)),
+                        f"image {img} has {df}",
+                    )
+                    check(
+                        img in images_built,
+                        f"image {img} is built by `make docker-build` "
+                        f"(builds {sorted(images_built)})",
+                    )
+                cmd = (c.get("command") or [None])[0]
+                if cmd and cmd.startswith("tpuslice"):
+                    check(
+                        cmd in scripts,
+                        f"{doc['metadata']['name']}: command {cmd} "
+                        "is a console script",
+                    )
+
+
+def main() -> int:
+    docs = render(os.path.join(REPO, "config", "default"))
+    check(len(docs) >= 10, f"rendered {len(docs)} manifests")
+    check_apply(docs)
+    check_cross_references(docs)
+    check_build_plane(docs)
+    # samples must also apply (they're what users kubectl apply first)
+    sample_docs = []
+    for path in sorted(glob.glob(os.path.join(REPO, "samples", "*.yaml"))):
+        with open(path) as f:
+            sample_docs.extend(d for d in yaml.safe_load_all(f) if d)
+    check_apply([d for d in sample_docs
+                 if d.get("kind") in ("Pod", "ConfigMap", "Service")])
+    print(
+        f"\n{'FAILED' if FAILURES else 'OK'}: "
+        f"{len(FAILURES)} failures"
+    )
+    return 1 if FAILURES else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
