@@ -70,8 +70,14 @@ class Controller:
         deletion_grace_seconds: float = 30.0,
         no_capacity_requeue: float = 2.0,
         metrics=None,
+        fence=None,
     ) -> None:
+        """``fence``: optional ``() -> bool`` leadership check; when it
+        turns False every subsequent CR/pod write raises ``Fenced`` so a
+        deposed leader cannot race its successor (update_with_retry
+        re-checks it on every conflict retry)."""
         self.client = client
+        self.fence = fence
         self.namespace = namespace
         self.policy = (
             policy if isinstance(policy, AllocationPolicy) else get_policy(policy)
@@ -484,7 +490,8 @@ class Controller:
                 return ts.to_manifest()
 
             update_with_retry(
-                self.client, KIND, self.namespace, node, mut
+                self.client, KIND, self.namespace, node, mut,
+                fence=self.fence,
             )
 
     def _repair_fanout(
@@ -523,7 +530,8 @@ class Controller:
 
             try:
                 update_with_retry(
-                    self.client, KIND, self.namespace, node, mut
+                    self.client, KIND, self.namespace, node, mut,
+                    fence=self.fence,
                 )
                 transitioned = transitioned or applied[0]
             except NotFound:
@@ -571,7 +579,8 @@ class Controller:
 
             try:
                 update_with_retry(
-                    self.client, "Pod", p.namespace, p.pod_name, mut
+                    self.client, "Pod", p.namespace, p.pod_name, mut,
+                    fence=self.fence,
                 )
             except NotFound:
                 continue
@@ -727,7 +736,8 @@ class Controller:
 
         try:
             update_with_retry(
-                self.client, "Pod", md.get("namespace", ""), md["name"], mut
+                self.client, "Pod", md.get("namespace", ""), md["name"],
+                mut, fence=self.fence,
             )
         except NotFound:
             pass
@@ -776,7 +786,8 @@ class Controller:
             return p
 
         update_with_retry(
-            self.client, "Pod", md.get("namespace", ""), md["name"], mut
+            self.client, "Pod", md.get("namespace", ""), md["name"],
+            mut, fence=self.fence,
         )
 
     def _annotate_error(self, pod: dict, message: str) -> None:

@@ -65,11 +65,22 @@ class ControllerRunner:
             policy=policy,
             deletion_grace_seconds=deletion_grace_seconds,
             metrics=self.metrics,
+            # with election on, every controller write is fenced on the
+            # lease: a deposed leader raises Fenced instead of racing its
+            # successor's writes (tested in tests/test_runtime.py)
+            fence=self._fence,
         )
         self._stop = threading.Event()
         self._ready = False
         self.probes: Optional[ProbeServer] = None
         self.elector: Optional[LeaderElector] = None
+
+    def _fence(self) -> bool:
+        """Leadership fence for controller writes; always open when
+        election is off (single-replica / tests)."""
+        if not self.leader_elect or self.elector is None:
+            return True
+        return self.elector.is_leader.is_set()
 
     @classmethod
     def from_args(cls, args) -> "ControllerRunner":

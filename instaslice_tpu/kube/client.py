@@ -44,6 +44,18 @@ class BadRequest(ApiError):
     code = 400
 
 
+class Fenced(ApiError):
+    """A write was refused because the caller's leadership fence reports
+    it deposed. Raised by :func:`update_with_retry` when a ``fence``
+    callable returns False — the deposed leader must not race the new
+    leader's writes. (The residual window — an attempt already past the
+    fence check when deposition lands — is closed by resourceVersion
+    conflicts: a write based on a pre-deposition read conflicts if the
+    new leader wrote first.)"""
+
+    code = 409
+
+
 class ResourceVersionExpired(ApiError):
     """410 Gone on a watch: the resume resourceVersion fell out of the API
     server's event window. The watcher must relist (replay=True, no
@@ -135,6 +147,7 @@ def update_with_retry(
     name: str,
     mutate: Callable[[dict], Optional[dict]],
     attempts: int = 8,
+    fence: Optional[Callable[[], bool]] = None,
 ) -> Optional[dict]:
     """Get-mutate-update with conflict retry.
 
@@ -142,9 +155,17 @@ def update_with_retry(
     manifest (may be the same object) or ``None`` to abort (e.g. the state
     it wanted to change is already gone — makes reconcilers idempotent).
     Returns the stored result, or ``None`` if aborted.
+
+    ``fence`` (optional) is re-checked before EVERY attempt, including
+    conflict retries: a leader deposed mid-retry-loop raises
+    :class:`Fenced` instead of landing a write after the new leader has
+    acted (the election-handover race the reference inherits unguarded
+    from controller-runtime's default non-fenced client).
     """
     last: Optional[ApiError] = None
     for attempt in range(attempts):
+        if fence is not None and not fence():
+            raise Fenced(f"deposed: refusing {kind} {namespace}/{name}")
         obj = client.get(kind, namespace, name)
         mutated = mutate(obj)
         if mutated is None:
