@@ -59,6 +59,10 @@ docker-build:  ## Controller, agent, and device-plugin images
 	docker build -f Dockerfile.agent -t $(IMG_PREFIX)-agent:$(TAG) .
 	docker build -f Dockerfile.deviceplugin -t $(IMG_PREFIX)-deviceplugin:$(TAG) .
 
+.PHONY: build-images
+build-images:  ## Build the images with whatever builder exists; without one, execute the Dockerfiles' build steps on the host and log the proof (deploy/docker-build.log)
+	$(PY) tools/build_images.py
+
 # ----------------------------------------------------------------- deploy
 
 .PHONY: install

@@ -11,9 +11,13 @@ mixed-profile load, and reports the p50 over all grants.
 
 Secondary (BASELINE.md "measure & report"): decode tokens/sec/chip, train
 MFU, and the compiled pallas flash kernel vs XLA — measured on the real
-chip by ``instaslice_tpu/bench_tpu.py`` in a subprocess with a hard
-timeout. A missing or hung TPU is a REPORTED error in the output
-(``tpu_error``), never a silent CPU fallback.
+chip by ``instaslice_tpu/bench_tpu.py``. Each phase runs in ITS OWN
+subprocess with its own timeout, cheapest first, and its JSON fragment is
+merged (and echoed to stderr) the moment it lands — a hang in one phase
+costs only that phase's numbers. A persistent XLA compilation cache is
+shared across the phase subprocesses so re-runs skip the 20-40 s first
+compiles. A missing or hung TPU is a REPORTED per-phase error in the
+output (``tpu_<phase>_error``), never a silent CPU fallback.
 
 Prints ONE JSON line. The required keys ({"metric", "value", "unit",
 "vs_baseline"}) carry the headline; the TPU numbers ride alongside.
@@ -36,18 +40,32 @@ WAVE = ["v5e-2x2", "v5e-2x1", "v5e-2x1", "v5e-2x1",
         "v5e-1x1", "v5e-1x1", "v5e-1x1", "v5e-1x1"]
 WAVES = 3
 
-#: wall budget for the on-chip half; first compiles are ~20-40 s each.
-TPU_BENCH_TIMEOUT = float(os.environ.get("TPUSLICE_TPU_BENCH_TIMEOUT", "900"))
+#: total wall budget for the on-chip half; first compiles are ~20-40 s.
+TPU_BENCH_TIMEOUT = float(os.environ.get("TPUSLICE_TPU_BENCH_TIMEOUT", "870"))
+
+#: (phase, per-phase cap seconds), cheapest first — probe is a tiny
+#: compile that proves the chip answers before anything expensive runs.
+TPU_PHASES = [
+    ("probe", 120.0),
+    ("flash_fwd", 180.0),
+    ("flash_bwd", 180.0),
+    ("serving", 300.0),
+    ("mfu", 300.0),
+    ("serving_tp", 300.0),
+]
 
 
-def bench_control_plane() -> float:
+def bench_control_plane(transport: str = "inproc") -> float:
     """Slice-grant p50 over 3 mixed waves on the 2-node sim. Pure control
-    plane — no jax, no chip."""
+    plane — no jax, no chip. ``transport="http"`` runs the same waves
+    with the controller, both agents, and the submitter each on their own
+    real-HTTP connection to the served fake API (URL building, JSON
+    verbs, streaming watches — everything but a real etcd/scheduler)."""
     from instaslice_tpu.sim import SimCluster
 
     grants = []
     with SimCluster(n_nodes=2, generation="v5e",
-                    deletion_grace_seconds=0.2) as c:
+                    deletion_grace_seconds=0.2, transport=transport) as c:
         for wave in range(WAVES):
             names = []
             t0 = {}
@@ -70,40 +88,89 @@ def bench_control_plane() -> float:
     return statistics.median(grants)
 
 
-def bench_tpu() -> dict:
-    """Run the on-chip bench in a subprocess so a hung TPU tunnel (or a
-    missing chip) becomes a reported error, not a wedged bench."""
+def _run_tpu_phase(phase: str, timeout: float, env: dict) -> dict:
+    """One phase in its own subprocess; returns its JSON fragment or a
+    ``{"error": ...}`` fragment for timeouts / crashes / no-JSON."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "instaslice_tpu.bench_tpu"],
+            [sys.executable, "-m", "instaslice_tpu.bench_tpu",
+             "--phase", phase],
             capture_output=True,
-            timeout=TPU_BENCH_TIMEOUT,
+            timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env,
         )
     except subprocess.TimeoutExpired:
-        return {"tpu_error": (
-            f"TPU bench exceeded {TPU_BENCH_TIMEOUT:.0f}s "
-            "(chip unreachable or tunnel hung)"
+        return {"error": (
+            f"phase exceeded its {timeout:.0f}s cap "
+            "(chip unreachable, tunnel hung, or compile too slow)"
         )}
-    lines = (proc.stdout or b"").decode().strip().splitlines()
     out: dict = {}
     parsed = False
+    lines = (proc.stdout or b"").decode().strip().splitlines()
     for line in reversed(lines):  # last JSON line wins; skip stray prints
         try:
-            out = json.loads(line)
-            parsed = True
-            break
+            cand = json.loads(line)
         except ValueError:
             continue
+        if isinstance(cand, dict):  # bare scalars ('0', 'null') also parse
+            out = cand
+            parsed = True
+            break
     if not parsed:
         out["error"] = (
-            f"TPU bench emitted no JSON (rc={proc.returncode}): "
+            f"phase emitted no JSON (rc={proc.returncode}): "
             + (proc.stderr or proc.stdout or b"").decode()[-300:]
         )
     elif proc.returncode != 0 and "error" not in out:
-        out["error"] = (proc.stderr or b"").decode()[-300:]
-    if "error" in out:
-        return {"tpu_error": out.pop("error"), **out}
+        out["error"] = (
+            (proc.stderr or b"").decode()[-300:].strip()
+            or f"phase exited rc={proc.returncode} with no stderr"
+        )
+    return out
+
+
+def bench_tpu() -> dict:
+    """Run each on-chip phase in its own subprocess under its own cap and
+    a shared total budget. Fragments merge incrementally; per-phase
+    failures land as ``tpu_<phase>_error`` keys so one hung phase cannot
+    forfeit the others' numbers (the round-2 failure mode)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache")
+    )
+    deadline = time.monotonic() + TPU_BENCH_TIMEOUT
+    out: dict = {}
+    for phase, cap in TPU_PHASES:
+        remaining = deadline - time.monotonic()
+        if remaining < 15:
+            out[f"tpu_{phase}_error"] = (
+                f"skipped: total bench budget ({TPU_BENCH_TIMEOUT:.0f}s) "
+                "exhausted by earlier phases"
+            )
+            continue
+        frag = _run_tpu_phase(phase, min(cap, remaining), env)
+        err = frag.pop("error", None)
+        out.update(frag)
+        if err is not None:
+            err = err or "phase failed with empty error message"
+            out[f"tpu_{phase}_error"] = err
+            print(f"[bench] {phase}: ERROR {err}", file=sys.stderr)
+            if phase == "probe":
+                # the probe exists so a dead/missing chip fails CHEAPLY;
+                # grinding the expensive phases against it would just
+                # drain the budget into guaranteed timeouts
+                out["tpu_error"] = err
+                for rest, _ in TPU_PHASES:
+                    if rest != "probe" and f"tpu_{rest}_error" not in out:
+                        out[f"tpu_{rest}_error"] = (
+                            "skipped: probe failed (chip dead or "
+                            "unreachable)"
+                        )
+                break
+        else:
+            print(f"[bench] {phase}: {json.dumps(frag)}", file=sys.stderr)
     return out
 
 
@@ -120,6 +187,11 @@ def main() -> int:
         "unit": "seconds",
         "vs_baseline": round(BASELINE_S / p50, 1) if p50 > 0 else 0,
     }
+    try:
+        http_p50 = bench_control_plane(transport="http")
+        result["slice_grant_p50_latency_http"] = round(http_p50, 4)
+    except Exception as e:  # noqa: BLE001 - report alongside, don't kill
+        result["slice_grant_http_error"] = f"{type(e).__name__}: {e}"
     result.update(bench_tpu())
     print(json.dumps(result))
     return 0

@@ -3,41 +3,136 @@ pallas flash-attention kernel (compiled, ``interpret=False``) vs the XLA
 formulation — the BASELINE.md secondary metrics ("vLLM tokens/sec/chip —
 measure & report"; the reference publishes no numbers at all).
 
-Run as ``python -m instaslice_tpu.bench_tpu``: prints one JSON object.
-``bench.py`` invokes it as a subprocess with a timeout so a hung TPU
-tunnel surfaces as a reported error instead of wedging the whole bench
-(the control-plane metric never needs a chip).
+Run as ``python -m instaslice_tpu.bench_tpu --phase <name>``: prints one
+JSON object for that phase. Phases are independent so the driver
+(``bench.py``) can give each its own subprocess and timeout — a hang in
+one phase (e.g. a slow first compile over a flaky TPU tunnel) costs only
+that phase's numbers, never the whole bench. ``--phase all`` preserves
+the old single-process behavior.
 
-Requires a real TPU backend: refuses to silently bench the CPU emulator
-(exit code 2 + {"error": ...}).
+Phases, cheapest first:
+
+- ``probe``    — backend check + a tiny jitted matmul proving the chip
+                 answers; refuses the CPU emulator (exit 2).
+- ``flash_fwd`` — pallas flash kernel forward vs XLA: numerics + TFLOP/s.
+- ``flash_bwd`` — blockwise backward kernels vs XLA autodiff.
+- ``serving``  — continuous-batching decode tokens/sec, one chip.
+- ``mfu``      — one-chip train-step MFU.
+- ``serving_tp`` — tensor-parallel serving decode over every local chip
+                 (the multi-chip grant path; skipped as reported when
+                 only one chip is visible).
+
+A persistent XLA compilation cache (``JAX_COMPILATION_CACHE_DIR``) is
+enabled when the env var is set, so retries and phase subprocesses reuse
+each other's compiles.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import math
+import os
 import sys
 import time
 
 #: peak dense bf16 TFLOP/s per chip, from public Cloud TPU specs
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
+PHASES = ("probe", "flash_fwd", "flash_bwd", "serving", "mfu", "serving_tp")
 
-def _timeit(fn, *args, warmup: int = 2, iters: int = 10) -> float:
-    """Median wall seconds per call, after warmup, blocking on results."""
+
+def _readback_rtt(reps: int = 7) -> float:
+    """Median seconds for a tiny dispatch + scalar readback.
+
+    Over the axon tunnel ``jax.block_until_ready`` returns before the
+    computation finishes (launch-ack, not completion), so every timing
+    here forces a device→host readback — whose round-trip (~tens of ms
+    through the tunnel) must be measured and subtracted."""
     import jax
+    import jax.numpy as jnp
 
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    times = []
-    for _ in range(iters):
+    f = jax.jit(lambda x: x.sum())
+    x = jnp.zeros((8, 128), jnp.float32)
+    float(f(x))                                       # compile
+    ts = []
+    for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2]
+        float(f(x))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
 
 
-def bench_flash_kernel(out: dict) -> None:
+def _chained_per_call(step_fn, x0, n: int, rtt: float,
+                      reps: int = 5) -> float:
+    """Seconds per ``step_fn`` call, measured as one compiled
+    ``fori_loop`` of n chained calls ending in a scalar readback (real
+    sync), minus the measured readback round-trip. ``step_fn`` must map
+    x → x (same shape/dtype) so the chain has a true data dependence —
+    XLA cannot elide or reorder any iteration."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(x):
+        out = jax.lax.fori_loop(0, n, lambda i, v: step_fn(v), x)
+        return out.astype(jnp.float32).sum()
+
+    float(run(x0))                                    # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(run(x0))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return max(ts[len(ts) // 2] - rtt, 1e-9) / n
+
+
+def _flash_inputs():
+    import jax
+    import jax.numpy as jnp
+
+    B, S, H, hd = 4, 2048, 8, 128
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (
+        jax.random.normal(kk, (B, S, H, hd), jnp.bfloat16) for kk in ks
+    )
+    # causal attention FLOPs ≈ 2 matmuls * 2*B*H*S²*hd * 1/2 (masked half)
+    flops = 2 * 2 * B * H * S * S * hd * 0.5
+    return q, k, v, flops
+
+
+def bench_probe(out: dict) -> None:
+    """Prove the chip is reachable and responsive: one tiny compile +
+    execute with a forced readback, so a wedged tunnel dies here
+    (cheaply) instead of inside a 1.3B-model compile. Also reports the
+    tunnel's readback round-trip and the chip's achievable matmul
+    TFLOP/s (amortized over a chained loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.perf_counter()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    float(jax.jit(lambda a: (a @ a).astype(jnp.float32).sum())(x))
+    out["probe_matmul_seconds"] = round(time.perf_counter() - t0, 2)
+    rtt = _readback_rtt()
+    out["readback_rtt_ms"] = round(rtt * 1000, 1)
+
+    # achievable dense bf16 TFLOP/s: chained 4096³ matmuls (normalized
+    # each step so values stay finite over the chain)
+    n = 4096
+    a = jax.random.normal(jax.random.key(0), (n, n), jnp.bfloat16)
+
+    def step(x):
+        y = x @ a
+        return (y / (1.0 + jnp.abs(y).max())).astype(x.dtype)
+
+    t = _chained_per_call(step, a, n=64, rtt=rtt)
+    out["peak_matmul_tflops"] = round(2 * n ** 3 / t / 1e12, 1)
+
+
+def bench_flash_fwd(out: dict) -> None:
     """Compiled pallas kernel vs XLA attention: numerics + TFLOP/s."""
     import jax
     import jax.numpy as jnp
@@ -47,12 +142,7 @@ def bench_flash_kernel(out: dict) -> None:
         flash_attention,
     )
 
-    B, S, H, hd = 4, 2048, 8, 128
-    ks = jax.random.split(jax.random.key(0), 3)
-    q, k, v = (
-        jax.random.normal(kk, (B, S, H, hd), jnp.bfloat16) for kk in ks
-    )
-
+    q, k, v, flops = _flash_inputs()
     flash = jax.jit(
         lambda q, k, v: flash_attention(q, k, v, causal=True,
                                         interpret=False)
@@ -72,15 +162,29 @@ def bench_flash_kernel(out: dict) -> None:
             f"pallas kernel numerics off vs XLA: max|Δ|={diff}"
         )
 
-    # causal attention FLOPs ≈ 2 matmuls * 2*B*H*S²*hd * 1/2 (masked half)
-    flops = 2 * 2 * B * H * S * S * hd * 0.5
-    t_flash = _timeit(flash, q, k, v)
-    t_xla = _timeit(xla, q, k, v)
+    # chained timing: o is q-shaped (and bounded — a convex combination
+    # of v rows per head dim), so o feeds the next call's q
+    rtt = _readback_rtt()
+    t_flash = _chained_per_call(lambda x: flash(x, k, v), q, n=128,
+                                rtt=rtt)
+    t_xla = _chained_per_call(lambda x: xla(x, k, v), q, n=128, rtt=rtt)
     out["flash_fwd_tflops"] = round(flops / t_flash / 1e12, 2)
     out["xla_fwd_tflops"] = round(flops / t_xla / 1e12, 2)
     out["flash_fwd_speedup_vs_xla"] = round(t_xla / t_flash, 3)
 
-    # backward: the blockwise kernels vs XLA's autodiff
+
+def bench_flash_bwd(out: dict) -> None:
+    """Blockwise backward kernels vs XLA's autodiff."""
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.ops.flash_attention import (
+        _xla_attention,
+        flash_attention,
+    )
+
+    q, k, v, flops = _flash_inputs()
+
     def loss(fn):
         return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32))
 
@@ -91,41 +195,92 @@ def bench_flash_kernel(out: dict) -> None:
     g_xla = jax.jit(jax.grad(loss(
         lambda q, k, v: _xla_attention(q, k, v, True)
     ), argnums=(0, 1, 2)))
-    t_gf = _timeit(g_flash, q, k, v, iters=5)
-    t_gx = _timeit(g_xla, q, k, v, iters=5)
+
+    # chain dq (q-shaped) back into q, tanh-bounded so 32 chained
+    # gradient calls cannot overflow bf16; the elementwise tanh is noise
+    # next to the blockwise kernels and identical for both variants
+    def chain(g):
+        def step(x):
+            dq, _, _ = g(x, k, v)
+            return jnp.tanh(dq.astype(jnp.float32)).astype(x.dtype)
+        return step
+
+    rtt = _readback_rtt()
+    t_gf = _chained_per_call(chain(g_flash), q, n=32, rtt=rtt)
+    t_gx = _chained_per_call(chain(g_xla), q, n=32, rtt=rtt)
     bwd_flops = flops * 2.5  # fwd recompute + dq + dk/dv
     out["flash_bwd_tflops"] = round(bwd_flops / t_gf / 1e12, 2)
     out["xla_bwd_tflops"] = round(bwd_flops / t_gx / 1e12, 2)
     out["flash_bwd_speedup_vs_xla"] = round(t_gx / t_gf, 3)
 
 
-def bench_serving(out: dict) -> None:
-    """Continuous-batching decode tokens/sec on one chip — the
-    tokens/sec/chip secondary metric (single-chip slice ⇒ per-chip)."""
+def _serving_model():
+    """~1.3B-param decoder (fits one v5e chip's 16 GiB with cache); the
+    vLLM-sample scale class without the 7B fit gymnastics."""
     import jax.numpy as jnp
 
     from instaslice_tpu.models.lm import ModelConfig, TpuLM
-    from instaslice_tpu.serving import ServingEngine
 
-    # ~1.3B-param decoder (fits one v5e chip's 16 GiB with cache); the
-    # vLLM-sample scale class without the 7B fit gymnastics
     cfg = ModelConfig(
         vocab_size=32000, d_model=2048, n_heads=16, n_layers=16,
         d_ff=8192, max_seq_len=2048, dtype=jnp.bfloat16, remat=False,
     )
-    model = TpuLM(cfg)
+    return cfg, TpuLM(cfg)
+
+
+def _param_count(cfg) -> int:
+    return (
+        cfg.vocab_size * cfg.d_model
+        + cfg.n_layers * (4 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff)
+    )
+
+
+def bench_serving(out: dict) -> None:
+    """Continuous-batching decode tokens/sec on one chip — the
+    tokens/sec/chip secondary metric (single-chip slice ⇒ per-chip).
+    Uses the engine's on-device block-decode scan, so one dispatch +
+    one readback covers 256 steps; the tunnel round-trip is measured
+    and subtracted."""
+    from instaslice_tpu.serving import ServingEngine
+
+    cfg, model = _serving_model()
     eng = ServingEngine(
         model, max_batch=8, max_len=1024, prefill_len=128,
     )
+    rtt = _readback_rtt()
     t0 = time.perf_counter()
-    tput = eng.throughput(n_steps=64)
+    tput = eng.throughput(n_steps=256, overhead_seconds=rtt)
     out["decode_tokens_per_sec_per_chip"] = round(tput, 1)
     out["serving_bench_seconds"] = round(time.perf_counter() - t0, 1)
-    out["serving_model_params_m"] = round(
-        (cfg.vocab_size * cfg.d_model
-         + cfg.n_layers * (4 * cfg.d_model ** 2
-                           + 2 * cfg.d_model * cfg.d_ff)) / 1e6
+    out["serving_model_params_m"] = round(_param_count(cfg) / 1e6)
+
+
+def bench_serving_tp(out: dict) -> None:
+    """Tensor-parallel decode over every locally visible chip — the
+    multi-chip-grant serving path (BASELINE headline: 7B-class on a 2x2
+    slice needs the model sharded over the slice's mesh)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from instaslice_tpu.serving import ServingEngine
+
+    n = jax.local_device_count()
+    if n < 2:
+        out["serving_tp_skipped"] = (
+            f"only {n} chip visible — tensor-parallel serving needs a "
+            "multi-chip slice (path is covered by the CPU-mesh tests)"
+        )
+        return
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("model",))
+    cfg, model = _serving_model()
+    eng = ServingEngine(
+        model, max_batch=8, max_len=1024, prefill_len=128, mesh=mesh,
     )
+    tput = eng.throughput(n_steps=256, overhead_seconds=_readback_rtt())
+    out["decode_tokens_per_sec_tp"] = round(tput, 1)
+    out["decode_tokens_per_sec_per_chip_tp"] = round(tput / n, 1)
+    out["serving_tp_chips"] = n
 
 
 def bench_train_mfu(out: dict, generation: str) -> None:
@@ -155,31 +310,75 @@ def bench_train_mfu(out: dict, generation: str) -> None:
     def step(state, tokens):
         return step_fn(state, tokens)
 
-    # warmup/compile
+    # warmup/compile; float() forces a real sync (block_until_ready is a
+    # launch-ack over the tunnel, not completion)
     state, loss = step(state, tokens)
-    jax.block_until_ready(loss)
-    iters = 5
+    loss0 = float(loss)
+    rtt = _readback_rtt()
+    iters = 8
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss = step(state, tokens)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / iters
+    # the final loss depends on every chained state update, so one
+    # readback syncs the whole loop
+    loss_f = float(loss)
+    dt = (time.perf_counter() - t0 - rtt) / iters
 
-    params = (
-        cfg.vocab_size * cfg.d_model
-        + cfg.n_layers * (4 * cfg.d_model ** 2 + 2 * cfg.d_model * cfg.d_ff)
-    )
-    # 6ND for fwd+bwd, +33% for remat's recompute-forward
-    step_flops = 6 * params * B * S * (1 + 1 / 3)
+    params = _param_count(cfg)
+    # MFU counts only the model's 6ND fwd+bwd FLOPs; HFU adds remat's
+    # recompute-forward (+1/3) actually executed by the hardware
+    model_flops = 6 * params * B * S
     peak = PEAK_TFLOPS.get(generation, 197.0) * 1e12
     out["train_step_seconds"] = round(dt, 4)
-    out["train_mfu"] = round(step_flops / dt / peak, 4)
-    out["train_loss_finite"] = bool(jnp.isfinite(loss))
+    out["train_mfu"] = round(model_flops / dt / peak, 4)
+    out["train_hfu"] = round(model_flops * (1 + 1 / 3) / dt / peak, 4)
+    out["train_loss_finite"] = bool(
+        math.isfinite(loss_f) and math.isfinite(loss0)
+    )
 
 
-def main() -> int:
-    import os
+def _enable_compile_cache() -> None:
+    """Persistent compile cache shared across phase subprocesses (and
+    bench re-runs): first compiles are 20-40 s each, cached reloads are
+    sub-second, so a phase that retries doesn't pay twice."""
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache_dir:
+        return
+    try:
+        import jax
 
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # pragma: no cover - older jax: env var still works
+        pass
+
+
+def run_phase(phase: str, out: dict) -> None:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    if phase == "probe":
+        bench_probe(out)
+    elif phase == "flash_fwd":
+        bench_flash_fwd(out)
+    elif phase == "flash_bwd":
+        bench_flash_bwd(out)
+    elif phase == "serving":
+        bench_serving(out)
+    elif phase == "mfu":
+        bench_train_mfu(out, gen)
+    elif phase == "serving_tp":
+        bench_serving_tp(out)
+    else:
+        raise ValueError(f"unknown phase {phase!r} (want one of {PHASES})")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="instaslice_tpu.bench_tpu")
+    ap.add_argument("--phase", default="all",
+                    choices=("all",) + PHASES)
+    args = ap.parse_args(argv)
+
+    _enable_compile_cache()
     out: dict = {}
     try:
         import jax
@@ -194,11 +393,10 @@ def main() -> int:
             )
             print(json.dumps(out))
             return 2
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-        out["tpu_generation"] = gen
-        bench_flash_kernel(out)
-        bench_serving(out)
-        bench_train_mfu(out, gen)
+        out["tpu_generation"] = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        phases = PHASES if args.phase == "all" else (args.phase,)
+        for phase in phases:
+            run_phase(phase, out)
     except Exception as e:  # noqa: BLE001 - report, don't crash silently
         out["error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(out))

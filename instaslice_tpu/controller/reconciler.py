@@ -15,6 +15,7 @@ Reference analog: ``InstasliceReconciler.Reconcile``
 
 from __future__ import annotations
 
+import dataclasses
 import hashlib
 import logging
 import threading
@@ -244,7 +245,6 @@ class Controller:
                         break
         if not copies:
             return None
-        merged = copies[0]
         realized = set()
         messages = []
         status = AllocationStatus.CREATING
@@ -256,9 +256,15 @@ class Controller:
                 c.status
             ) < self._STATUS_PRECEDENCE.index(status):
                 status = c.status
-        merged.realized_on = sorted(realized)
-        merged.status = status
-        merged.message = "; ".join(messages)
+        # Fresh object: copies[0] is the live parsed spec inside
+        # holders[0]; writing the synthetic merged view onto it would
+        # persist it if a holder were ever serialized after the merge.
+        merged = dataclasses.replace(
+            copies[0],
+            realized_on=sorted(realized),
+            status=status,
+            message="; ".join(messages),
+        )
         return merged, holders
 
     # ------------------------------------------------------------ reconcile

@@ -17,9 +17,13 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-_NEG = jnp.float32(-1e9)
+# numpy, not jnp: a module-level jnp scalar would initialize the jax
+# backend at import time, locking the platform before consumers (e.g.
+# multi-process CPU workers) can configure it
+_NEG = np.float32(-1e9)
 
 
 def ring_attention(

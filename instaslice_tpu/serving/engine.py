@@ -21,6 +21,18 @@ built TPU-first instead of translated:
   coexist in one rectangular batch (``models/lm.py: apply_with_cache``).
 - Sampling is greedy or temperature softmax via ``jax.random`` — on-device,
   no host round-trip per token beyond the sampled ids.
+- **Block decode**: :meth:`decode_block` runs N decode steps as ONE
+  compiled ``lax.scan`` — the sampled token feeds straight back into the
+  next step on-device, and the host sees one (N, B) token block per
+  dispatch instead of one round-trip per token. Off a tunnel this hides
+  dispatch latency; on any topology it keeps the decode loop out of
+  Python.
+- **Tensor parallelism**: pass ``mesh=`` (any mesh with a ``"model"``
+  axis) and the weights + KV cache shard over it — heads/ff-hidden/vocab
+  split across the granted slice's chips, XLA inserting the ICI
+  collectives. Prefill and decode stay the same two compiled programs.
+  This is how a multi-chip grant (e.g. the BASELINE 2x2 v5e slice for a
+  7B-class model that cannot fit one chip) is consumed.
 """
 
 from __future__ import annotations
@@ -31,8 +43,9 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from instaslice_tpu.models.lm import Params, TpuLM
+from instaslice_tpu.models.lm import Params, TpuLM, param_specs
 
 
 @dataclasses.dataclass
@@ -62,6 +75,7 @@ class ServingEngine:
         temperature: float = 0.0,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        mesh: Optional[Mesh] = None,
     ) -> None:
         if prefill_len > max_len:
             raise ValueError("prefill_len must be <= max_len")
@@ -74,17 +88,55 @@ class ServingEngine:
         self.prefill_len = prefill_len
         self.temperature = temperature
         self.eos_id = eos_id
+        self.mesh = mesh
         self._rng = jax.random.key(seed)
         self._next_id = 0
         self.cache = model.init_cache(max_batch, max_len)
         self.lengths = jnp.zeros(max_batch, jnp.int32)
         self.last_token = jnp.zeros(max_batch, jnp.int32)
+        if mesh is not None:
+            self._shard_over(mesh)
         self.slots: Dict[int, _Slot] = {}          # slot index → request
         self.finished: List[GenerationResult] = []
         self.tokens_generated = 0
 
         self._prefill = jax.jit(self._prefill_impl)
         self._decode = jax.jit(self._decode_impl)
+        self._decode_block = jax.jit(
+            self._decode_block_impl, static_argnames=("n_steps", "greedy")
+        )
+
+    def _shard_over(self, mesh: Mesh) -> None:
+        """Tensor-parallel layout over the mesh's ``"model"`` axis: weights
+        per :func:`param_specs` (heads / ff-hidden / vocab split), KV cache
+        over the heads axis of its (L, B, S, H, hd) tensors, decode state
+        replicated. XLA's sharding propagation inserts the collectives —
+        the same two compiled programs serve any slice size."""
+        if "model" not in mesh.axis_names:
+            raise ValueError(
+                f"serving mesh needs a 'model' axis, got {mesh.axis_names}"
+            )
+        tp = mesh.shape["model"]
+        if self.model.cfg.n_heads % tp:
+            raise ValueError(
+                f"n_heads={self.model.cfg.n_heads} not divisible by the "
+                f"mesh's model axis ({tp} devices)"
+            )
+        specs = param_specs(self.model.cfg)
+        self.params = jax.device_put(
+            self.params,
+            jax.tree.map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P),
+            ),
+        )
+        cache_sharding = NamedSharding(mesh, P(None, None, None, "model"))
+        self.cache = jax.tree.map(
+            lambda c: jax.device_put(c, cache_sharding), self.cache
+        )
+        replicated = NamedSharding(mesh, P())
+        self.lengths = jax.device_put(self.lengths, replicated)
+        self.last_token = jax.device_put(self.last_token, replicated)
 
     # ------------------------------------------------------------- jitted
 
@@ -118,6 +170,39 @@ class ServingEngine:
             params, last_token[:, None], cache, lengths
         )
         return cache, logits[:, 0]                  # (B, vocab)
+
+    def _decode_block_impl(self, params, cache, last_token, lengths, rng,
+                           temperature, *, n_steps: int, greedy: bool):
+        """``n_steps`` decode steps as one ``lax.scan``: each sampled
+        token feeds the next step on-device — no host round-trip inside
+        the block. Returns the advanced state plus the (n_steps, B) token
+        block.
+
+        ``greedy`` is a static (compile-keyed) switch while
+        ``temperature`` stays a traced value, so mutating
+        ``self.temperature`` between calls behaves like :meth:`step`
+        instead of silently replaying the first trace."""
+
+        def step(carry, i):
+            cache, last, lens = carry
+            logits, cache = self.model.apply_with_cache(
+                params, last[:, None], cache, lens
+            )
+            logits = logits[:, 0]
+            if greedy:
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                toks = jax.random.categorical(
+                    jax.random.fold_in(rng, i),
+                    logits / temperature, axis=-1,
+                ).astype(jnp.int32)
+            return (cache, toks, lens + 1), toks
+
+        (cache, last, lengths), toks = jax.lax.scan(
+            step, (cache, last_token, lengths),
+            jnp.arange(n_steps, dtype=jnp.int32),
+        )
+        return cache, last, lengths, toks
 
     def _sample(self, logits: jax.Array) -> jax.Array:
         if self.temperature <= 0.0:
@@ -201,6 +286,46 @@ class ServingEngine:
             self._maybe_finish(slot)
         return out
 
+    def decode_block(self, n_steps: int) -> Dict[int, List[int]]:
+        """Run ``n_steps`` decode steps fully on-device (one dispatch, one
+        (n_steps, B) readback) and return request id → new tokens.
+
+        EOS inside the block still finishes the slot — tokens past the
+        EOS are discarded host-side (the cache positions they occupied are
+        never attended by a later occupant: prefill resets the slot's
+        length and the cache mask hides everything beyond it). Raises if
+        any live slot would run past the cache, so block misuse is loud
+        instead of silently clamping writes."""
+        if not self.slots:
+            return {}
+        worst = max(
+            len(r.prompt) + len(r.generated) for r in self.slots.values()
+        )
+        if worst + n_steps > self.max_len - 1:
+            raise ValueError(
+                f"decode_block({n_steps}) would overrun max_len "
+                f"{self.max_len} (deepest live slot at {worst})"
+            )
+        self._rng, sub = jax.random.split(self._rng)
+        self.cache, self.last_token, self.lengths, toks = (
+            self._decode_block(
+                self.params, self.cache, self.last_token, self.lengths,
+                sub, jnp.float32(max(self.temperature, 1e-6)),
+                n_steps=n_steps, greedy=self.temperature <= 0.0,
+            )
+        )
+        block = jax.device_get(toks)               # single host round-trip
+        out: Dict[int, List[int]] = {}
+        for slot, req in list(self.slots.items()):
+            seq = [int(t) for t in block[:, slot]]
+            if self.eos_id is not None and self.eos_id in seq:
+                seq = seq[: seq.index(self.eos_id) + 1]
+            req.generated.extend(seq)
+            self.tokens_generated += len(seq)
+            out[req.request_id] = seq
+            self._maybe_finish(slot)
+        return out
+
     def _maybe_finish(self, slot: int) -> None:
         req = self.slots[slot]
         total = len(req.prompt) + len(req.generated)
@@ -264,17 +389,34 @@ class ServingEngine:
         return [results[i] for i in sorted(results)]
 
     def throughput(
-        self, n_steps: int = 50, batch: Optional[int] = None
+        self, n_steps: int = 50, batch: Optional[int] = None,
+        overhead_seconds: float = 0.0,
     ) -> float:
         """Decode tokens/sec at the given concurrency (BASELINE secondary
-        metric: tokens/sec/chip — divide by the slice's chip count)."""
+        metric: tokens/sec/chip — divide by the slice's chip count).
+
+        Measures the on-device block-decode path: one compiled scan of
+        ``n_steps`` steps, one readback. ``overhead_seconds`` (e.g. a
+        measured host↔device round-trip, significant over a tunnel) is
+        subtracted from the wall time."""
         batch = batch or self.max_batch
         for _ in range(min(batch, self.free_slots())):
             self.add_request([1, 2, 3])
-        self.step()                                   # compile
+        # two blocks (warm + timed) must both fit the cache: clamp the
+        # block size to half the headroom of the deepest slot
+        worst = max(
+            (len(r.prompt) + len(r.generated)
+             for r in self.slots.values()),
+            default=0,
+        )
+        n = min(n_steps, max(1, (self.max_len - 2 - worst) // 2))
+        self.decode_block(n)                          # compile + warm
+        # refill slots the warm-up finished (eos / max_len) so the timed
+        # block never measures an empty batch
+        for _ in range(min(batch, self.free_slots())):
+            self.add_request([1, 2, 3])
         t0 = time.perf_counter()
-        done = 0
-        for _ in range(n_steps):
-            done += len(self.step())
-        dt = time.perf_counter() - t0
+        out = self.decode_block(n)
+        dt = time.perf_counter() - t0 - overhead_seconds
+        done = sum(len(seq) for seq in out.values())
         return done / dt if dt > 0 else 0.0

@@ -46,8 +46,31 @@ class SimCluster:
         health_interval: float = 0.15,
         metrics=None,
         device_plugins: bool = False,
+        transport: str = "inproc",
     ) -> None:
-        self.kube = FakeKube()
+        """``transport="inproc"`` wires every component straight to the
+        in-process FakeKube. ``transport="http"`` puts the store behind
+        :class:`FakeApiServer` and gives the controller, every agent, and
+        the submit/observe side each their OWN :class:`RealKubeClient`
+        connection — the full wire path (URL building, JSON verbs,
+        streaming watch parsing, timestamp round-tripping) between every
+        component, the way separate processes would talk to a real API
+        server."""
+        self.backing = FakeKube()
+        self.server = None
+        if transport == "http":
+            from instaslice_tpu.kube.httptest import FakeApiServer
+            from instaslice_tpu.kube.real import RealKubeClient
+
+            self.server = FakeApiServer(self.backing).start()
+            url = self.server.url
+            self._client_for = lambda: RealKubeClient(url)
+            self.kube: "FakeKube" = self._client_for()  # type: ignore
+        elif transport == "inproc":
+            self._client_for = lambda: self.backing
+            self.kube = self.backing
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
         self.namespace = namespace
         self.generation = generation
         gen = get_generation(generation)
@@ -73,11 +96,11 @@ class SimCluster:
             )
             self.backends[node] = backend
             self.agents[node] = NodeAgent(
-                self.kube, backend, node, namespace, metrics=metrics,
-                health_interval=health_interval,
+                self._client_for(), backend, node, namespace,
+                metrics=metrics, health_interval=health_interval,
             )
         self.controller = Controller(
-            self.kube,
+            self._client_for(),
             namespace=namespace,
             policy=policy,
             deletion_grace_seconds=deletion_grace_seconds,
@@ -123,7 +146,9 @@ class SimCluster:
             mgr.stop()
         for agent in self.agents.values():
             agent.stop()
-        self.kube.stop_watches()
+        self.backing.stop_watches()
+        if self.server is not None:
+            self.server.stop()
         self._sched.join(timeout=2)
 
     def __enter__(self) -> "SimCluster":

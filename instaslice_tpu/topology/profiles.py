@@ -241,4 +241,11 @@ def orientations(gen: Generation, shape: Shape) -> List[Shape]:
             continue
         if all(cand[i] <= hb[i] for i in range(3)):
             out.append(cand)
-    return out or [shape]
+    if out:
+        return out
+    # Multi-host shapes are orientation-fixed — but only a shape that is
+    # itself legal may pass through. Echoing back an invalid shape would
+    # re-admit it to the placement scan (caught only by downstream bounds
+    # checks).
+    _validate_shape(gen, shape)
+    return [shape]

@@ -11,6 +11,7 @@ API server (timestamps parse both ways)."""
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 from typing import Callable, Optional
@@ -49,18 +50,53 @@ class LeaderElector:
 
     # ----------------------------------------------------------- protocol
 
+    # Annotation carrying the precise (possibly sub-second) duration:
+    # ``spec.leaseDurationSeconds`` is an integer in the coordination.k8s.io
+    # schema, so a 0.3 s lease would truncate to 0 and read back as
+    # instantly-expired to every elector (ownership ping-pong). The integer
+    # field stays schema-valid (>= 1) for real API servers; electors prefer
+    # the annotation when present.
+    DURATION_MS_ANNOTATION = "tpu.instaslice.dev/lease-duration-ms"
+
     def _manifest(self, transitions: int) -> dict:
         return {
             "apiVersion": "coordination.k8s.io/v1",
             "kind": "Lease",
-            "metadata": {"name": self.name, "namespace": self.namespace},
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "annotations": {
+                    self.DURATION_MS_ANNOTATION: str(
+                        int(self.lease_seconds * 1000)
+                    ),
+                },
+            },
             "spec": {
                 "holderIdentity": self.identity,
-                "leaseDurationSeconds": int(self.lease_seconds),
+                "leaseDurationSeconds": max(
+                    1, int(math.ceil(self.lease_seconds))
+                ),
                 "renewTime": rfc3339_now(),
                 "leaseTransitions": transitions,
             },
         }
+
+    def _remote_duration(self, lease: dict) -> float:
+        """The holder's advertised lease duration, preferring the precise
+        millisecond annotation over the integer spec field."""
+        ann = (
+            lease.get("metadata", {}).get("annotations") or {}
+        ).get(self.DURATION_MS_ANNOTATION)
+        if ann is not None:
+            try:
+                return float(ann) / 1000.0
+            except (TypeError, ValueError):
+                pass
+        return float(
+            lease.get("spec", {}).get(
+                "leaseDurationSeconds", self.lease_seconds
+            )
+        )
 
     def _try_acquire_or_renew(self) -> bool:
         try:
@@ -76,9 +112,7 @@ class LeaderElector:
         spec = lease.get("spec", {})
         holder = spec.get("holderIdentity", "")
         renew = parse_timestamp(spec.get("renewTime"))
-        duration = float(
-            spec.get("leaseDurationSeconds", self.lease_seconds)
-        )
+        duration = self._remote_duration(lease)
         expired = time.time() - renew > duration
         if holder != self.identity and not expired:
             return False

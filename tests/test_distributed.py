@@ -1,0 +1,117 @@
+"""Two-process DCN rendezvous smoke (SURVEY.md §7 risk #2).
+
+Spawns two REAL OS processes, each with the handoff env the node agent
+would publish for its worker of a two-host v5e-16 (4x4) placement, and
+has them rendezvous through ``initialize_distributed`` →
+``jax.distributed`` → one global psum. This covers the seam the
+single-process dryrun cannot: cross-process coordinator bootstrap,
+process_id assignment from ``TPU_WORKER_ID``, and a collective that
+only sums correctly when BOTH processes' devices joined the mesh.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+from instaslice_tpu.agent.handoff import slice_env
+from instaslice_tpu.api.types import AllocationDetails, PodRef
+from instaslice_tpu.topology.grid import (
+    NodeGrid,
+    TorusGroup,
+    get_generation,
+)
+from instaslice_tpu.topology.placement import legal_placements
+from instaslice_tpu.topology.profiles import parse_profile_name
+
+LOCAL_DEVICES = 4  # virtual CPU devices per process ("chips" per host)
+
+
+def _worker_envs():
+    """Handoff env for BOTH workers of a real two-host 4x4 grant, via the
+    real pipeline: placement engine → AllocationDetails → slice_env."""
+    gen = get_generation("v5e")
+    hosts = {
+        "node-0": NodeGrid(gen, host_offset=(0, 0, 0), torus_group="g"),
+        "node-1": NodeGrid(gen, host_offset=(2, 0, 0), torus_group="g"),
+    }
+    group = TorusGroup("g", gen, (4, 4, 1), hosts)
+    placement = legal_placements(group, parse_profile_name("v5e-4x4"))[0]
+    pods = [
+        PodRef(
+            pod_uuid=f"uid-{p.worker_id}",
+            pod_name=f"worker-{p.worker_id}",
+            namespace="default",
+            worker_id=p.worker_id,
+        )
+        for p in placement.parts
+    ]
+    alloc = AllocationDetails.from_placement(placement, pods)
+    return [
+        slice_env(alloc, pod, placement.parts[i].node_name, "v5e")
+        for i, pod in enumerate(pods)
+    ]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestDcnRendezvous:
+    def test_two_process_psum(self):
+        envs = _worker_envs()
+        assert len(envs) == 2
+        port = _free_port()
+        procs = []
+        for env in envs:
+            child = dict(os.environ)
+            child.update(env)
+            # pod names resolve over the cluster's headless Service; in
+            # this two-process test both workers are this host
+            child["TPU_WORKER_HOSTNAMES"] = "127.0.0.1,127.0.0.1"
+            child["TPUSLICE_SMOKE_PORT"] = str(port)
+            child["TPUSLICE_SMOKE_FORCE_CPU"] = "1"
+            child["TPUSLICE_SMOKE_CPU_DEVICES"] = str(LOCAL_DEVICES)
+            child.pop("XLA_FLAGS", None)  # no forced 8-dev override
+            # a single-chip TPU tunnel (if the session has one) cannot be
+            # claimed by two processes at once — its interpreter hook
+            # registers at startup and the second claim blocks forever;
+            # these workers are CPU-only by design, so drop the trigger
+            child.pop("PALLAS_AXON_POOL_IPS", None)
+            child["JAX_PLATFORMS"] = "cpu"
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, "-m",
+                     "instaslice_tpu.parallel.dcn_smoke"],
+                    env=child,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                )
+            )
+        outs = []
+        for p in procs:
+            try:
+                stdout, stderr = p.communicate(timeout=180)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise AssertionError(
+                    "rendezvous hung: worker never completed"
+                )
+            assert p.returncode == 0, stderr.decode()[-800:]
+            outs.append(json.loads(stdout.decode().strip().splitlines()[-1]))
+
+        # every worker saw both processes and all devices
+        expected_total = sum(
+            (w + 1) * LOCAL_DEVICES for w in range(2)
+        )  # 1*4 + 2*4 = 12
+        for out in outs:
+            assert out["num_workers"] == 2
+            assert out["processes_seen"] == 2
+            assert out["global_devices"] == 2 * LOCAL_DEVICES
+            assert out["local_devices"] == LOCAL_DEVICES
+            assert out["psum_total"] == expected_total
+        assert sorted(o["worker_id"] for o in outs) == [0, 1]

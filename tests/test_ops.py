@@ -124,3 +124,24 @@ class TestFlashAttention:
         a = m_xla.apply(params, toks)
         b = m_flash.apply(params, toks)
         assert float(jnp.max(jnp.abs(a - b))) < 1e-3
+
+
+class TestBlockFitting:
+    """_fit_block: degrade block size instead of abandoning the kernel
+    (review finding: (256,512) defaults silently dropped S=384-style
+    shapes to the O(S²)-HBM XLA path)."""
+
+    def test_fit_block_halves_to_divisor(self):
+        from instaslice_tpu.ops.flash_attention import _fit_block
+
+        assert _fit_block(256, 384) == 128   # 384 = 3·128
+        assert _fit_block(512, 384) == 384   # whole axis in one block
+        assert _fit_block(256, 2048) == 256  # defaults untouched
+        assert _fit_block(256, 100) == 100   # single whole-axis block
+        assert _fit_block(256, 7) == 0       # nothing tiles → XLA
+
+    def test_s384_stays_on_kernel_and_matches(self):
+        q, k, v = _qkv(2, 384, 2, 32, key=3)
+        out = flash_attention(q, k, v, causal=True)  # (256,512) prefs
+        ref = _xla_attention(q, k, v, True)
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5

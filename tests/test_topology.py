@@ -118,6 +118,23 @@ class TestProfiles:
         a = parse_profile_name("v5e-2x2").attributes()
         assert a["chips"] == 4 and a["hosts"] == 1 and a["hbmGiB"] == 64
 
+    def test_orientations_raises_on_fully_invalid_shape(self):
+        # No permutation of (3,1,1) is a power-of-two shape; the scan must
+        # refuse rather than echo the invalid shape back into placement.
+        from instaslice_tpu.topology.profiles import orientations
+
+        gen = get_generation("v5e")
+        with pytest.raises(ValueError):
+            orientations(gen, (3, 1, 1))
+
+    def test_orientations_multi_host_fixed(self):
+        from instaslice_tpu.topology.profiles import orientations
+
+        gen = get_generation("v5e")
+        # 4x4 exceeds the 2x4 host bounds in every permutation but is a
+        # legal multi-host shape: orientation-fixed single result.
+        assert orientations(gen, (4, 4, 1)) == [(4, 4, 1)]
+
     def test_parse_shape(self):
         assert parse_shape("v5e", "2x2").name == "v5e-2x2"
 
