@@ -240,17 +240,25 @@ def bench_serving(out: dict) -> None:
     tokens/sec/chip secondary metric (single-chip slice ⇒ per-chip).
     Uses the engine's on-device block-decode scan, so one dispatch +
     one readback covers 256 steps; the tunnel round-trip is measured
-    and subtracted."""
+    and subtracted.
+
+    Decode at this scale is HBM-bound (weights + cache re-read every
+    step), so throughput scales with concurrency until the MXU wakes
+    up: measured at vLLM-style batch 32 (headline) and batch 8."""
     from instaslice_tpu.serving import ServingEngine
 
     cfg, model = _serving_model()
-    eng = ServingEngine(
-        model, max_batch=8, max_len=1024, prefill_len=128,
-    )
     rtt = _readback_rtt()
     t0 = time.perf_counter()
-    tput = eng.throughput(n_steps=256, overhead_seconds=rtt)
-    out["decode_tokens_per_sec_per_chip"] = round(tput, 1)
+    for batch, key in ((32, "decode_tokens_per_sec_per_chip"),
+                       (8, "decode_tokens_per_sec_per_chip_b8")):
+        eng = ServingEngine(
+            model, max_batch=batch, max_len=1024, prefill_len=128,
+        )
+        tput = eng.throughput(n_steps=256, overhead_seconds=rtt)
+        out[key] = round(tput, 1)
+        del eng  # free the 2·(L,B,S,H,hd) cache before the next size
+    out["serving_batch"] = 32
     out["serving_bench_seconds"] = round(time.perf_counter() - t0, 1)
     out["serving_model_params_m"] = round(_param_count(cfg) / 1e6)
 

@@ -66,8 +66,12 @@ class ModelConfig:
 # data = batch, seq = sequence, model = heads / ff hidden / experts.
 # ---------------------------------------------------------------------------
 
-def param_specs(cfg: ModelConfig) -> Params:
-    """PartitionSpecs mirroring :func:`init_params`' tree structure."""
+def param_specs(cfg: ModelConfig, pipe_axis: str = "") -> Params:
+    """PartitionSpecs mirroring :func:`init_params`' tree structure.
+
+    With ``pipe_axis``, the scan-stacked layer axis is sharded over that
+    mesh axis — each pipeline stage holds only its own layers' weights
+    (pipeline parallelism's memory win)."""
     # specs below describe one layer's (unstacked) param shapes
     block = {
         "ln1": {"scale": P(None)},
@@ -95,9 +99,10 @@ def param_specs(cfg: ModelConfig) -> Params:
                 "w_out": P("model", None),
             }
         )
-    # scan-stacked: leading layer axis is unsharded
+    # scan-stacked: leading layer axis — unsharded, or one stage of
+    # layers per device along the pipe axis
     stacked = jax.tree.map(
-        lambda spec: P(None, *spec), block,
+        lambda spec: P(pipe_axis or None, *spec), block,
         is_leaf=lambda x: isinstance(x, P),
     )
     return {
@@ -203,6 +208,45 @@ def _attention(q, k, v, causal: bool = True, impl: str = "xla") -> jax.Array:
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
+                       positions: jax.Array, attn_fn) -> jax.Array:
+    """One pre-norm block: attention (via ``attn_fn(q, k, v)``) +
+    MLP/MoE, shared by the scan stack in :meth:`TpuLM.apply` and the
+    pipeline-parallel stage body (:mod:`instaslice_tpu.parallel.pipeline`).
+    x: (B, S, D)."""
+    B, S = x.shape[:2]
+    h = _rmsnorm(x, layer["ln1"]["scale"])
+    q = jnp.einsum("bsd,dk->bsk", h, layer["wq"],
+                   preferred_element_type=jnp.float32)
+    k = jnp.einsum("bsd,dk->bsk", h, layer["wk"],
+                   preferred_element_type=jnp.float32)
+    v = jnp.einsum("bsd,dk->bsk", h, layer["wv"],
+                   preferred_element_type=jnp.float32)
+    q, k, v = (
+        t.astype(cfg.dtype).reshape(B, S, cfg.n_heads, cfg.head_dim)
+        for t in (q, k, v)
+    )
+    q = _rope(q, positions)
+    k = _rope(k, positions)
+    attn = attn_fn(q, k, v)
+    attn = attn.reshape(B, S, cfg.n_heads * cfg.head_dim)
+    x = x + jnp.einsum(
+        "bsk,kd->bsd", attn, layer["wo"],
+        preferred_element_type=jnp.float32,
+    ).astype(cfg.dtype)
+    h = _rmsnorm(x, layer["ln2"]["scale"])
+    if cfg.n_experts:
+        y = _moe_mlp(h, layer["router"], layer["w_in"], layer["w_out"])
+    else:
+        y = jnp.einsum("bsd,df->bsf", h, layer["w_in"],
+                       preferred_element_type=jnp.float32)
+        y = jax.nn.gelu(y).astype(cfg.dtype)
+        y = jnp.einsum("bsf,fd->bsd", y, layer["w_out"],
+                       preferred_element_type=jnp.float32
+                       ).astype(cfg.dtype)
+    return x + y
+
+
 def _moe_mlp(x, router_w, w_in, w_out):
     """Soft-routed MoE (top-1 via straight-through softmax weighting kept
     dense — compiler-friendly: no gather/scatter, no dynamic shapes).
@@ -255,49 +299,24 @@ class TpuLM:
             )
         positions = jnp.arange(S, dtype=jnp.int32)
 
-        def block(x, layer):
-            h = _rmsnorm(x, layer["ln1"]["scale"])
-            q = jnp.einsum("bsd,dk->bsk", h, layer["wq"],
-                           preferred_element_type=jnp.float32)
-            k = jnp.einsum("bsd,dk->bsk", h, layer["wk"],
-                           preferred_element_type=jnp.float32)
-            v = jnp.einsum("bsd,dk->bsk", h, layer["wv"],
-                           preferred_element_type=jnp.float32)
-            q, k, v = (
-                t.astype(cfg.dtype).reshape(B, S, cfg.n_heads, cfg.head_dim)
-                for t in (q, k, v)
-            )
-            q = _rope(q, positions)
-            k = _rope(k, positions)
-            if ring:
-                from instaslice_tpu.parallel.ring import ring_attention
+        if ring:
+            from instaslice_tpu.parallel.ring import ring_attention
 
-                attn = jax.shard_map(
+            def attn_fn(q, k, v):
+                return jax.shard_map(
                     functools.partial(ring_attention, axis_name="seq"),
                     mesh=mesh,
                     in_specs=(P(None, "seq", None, None),) * 3,
                     out_specs=P(None, "seq", None, None),
                     axis_names={"seq"},
                 )(q, k, v)
-            else:
-                attn = _attention(q, k, v, impl=cfg.attention_impl)
-            attn = attn.reshape(B, S, cfg.n_heads * cfg.head_dim)
-            x = x + jnp.einsum(
-                "bsk,kd->bsd", attn, layer["wo"],
-                preferred_element_type=jnp.float32,
-            ).astype(cfg.dtype)
-            h = _rmsnorm(x, layer["ln2"]["scale"])
-            if cfg.n_experts:
-                y = _moe_mlp(h, layer["router"], layer["w_in"],
-                             layer["w_out"])
-            else:
-                y = jnp.einsum("bsd,df->bsf", h, layer["w_in"],
-                               preferred_element_type=jnp.float32)
-                y = jax.nn.gelu(y).astype(cfg.dtype)
-                y = jnp.einsum("bsf,fd->bsd", y, layer["w_out"],
-                               preferred_element_type=jnp.float32
-                               ).astype(cfg.dtype)
-            return x + y, None
+        else:
+            def attn_fn(q, k, v):
+                return _attention(q, k, v, impl=cfg.attention_impl)
+
+        def block(x, layer):
+            return _transformer_block(cfg, layer, x, positions,
+                                      attn_fn), None
 
         body = block
         if cfg.remat:
@@ -309,6 +328,53 @@ class TpuLM:
             preferred_element_type=jnp.float32,
         )
         return logits
+
+    def apply_pipelined(
+        self,
+        params: Params,
+        tokens: jax.Array,
+        *,
+        mesh: Mesh,
+        n_micro: int,
+        axis_name: str = "pipe",
+    ) -> jax.Array:
+        """Pipeline-parallel forward: the layer stack runs as GPipe
+        stages over the mesh's ``axis_name`` axis, microbatching the
+        batch dim (:func:`instaslice_tpu.parallel.pipeline.pipeline_blocks`).
+        Embedding/unembedding stay outside the pipeline (replicated).
+        Composes with tensor parallelism — the stage body's einsums keep
+        their ``model``-axis sharding; ring attention (a second manual
+        axis) is not supported inside a pipeline stage."""
+        from instaslice_tpu.parallel.pipeline import pipeline_blocks
+
+        cfg = self.cfg
+        if cfg.ring_attention:
+            raise ValueError(
+                "ring_attention cannot run inside a pipeline stage "
+                "(nested manual mesh axes); use sequence parallelism OR "
+                "pipeline parallelism for this model, not both"
+            )
+        B, S = tokens.shape
+        x = params["embed"][tokens]
+        positions = jnp.arange(S, dtype=jnp.int32)
+
+        def block_fn(layer, xb):
+            return _transformer_block(
+                cfg, layer, xb, positions,
+                lambda q, k, v: _attention(q, k, v,
+                                           impl=cfg.attention_impl),
+            )
+
+        x = pipeline_blocks(
+            block_fn, params["blocks"], x,
+            mesh=mesh, axis_name=axis_name, n_micro=n_micro,
+            remat=cfg.remat,
+        )
+        x = _rmsnorm(x, params["ln_f"]["scale"])
+        return jnp.einsum(
+            "bsd,vd->bsv", x, params["embed"],
+            preferred_element_type=jnp.float32,
+        )
 
     # ------------------------------------------------------------ KV cache
 

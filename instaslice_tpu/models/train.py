@@ -46,9 +46,24 @@ def loss_fn(
     params: Params,
     tokens: jax.Array,
     mesh: Optional[Mesh] = None,
+    n_micro: int = 0,
+    pipe_axis: str = "pipe",
 ) -> jax.Array:
-    """Next-token cross-entropy; tokens (B, S) predict tokens[:, 1:]."""
-    logits = model.apply(params, tokens, mesh=mesh)  # (B, S, V) fp32
+    """Next-token cross-entropy; tokens (B, S) predict tokens[:, 1:].
+    With ``n_micro`` > 0 the forward runs pipeline-parallel over the
+    mesh's ``pipe_axis``."""
+    if n_micro:
+        if mesh is None:
+            raise ValueError(
+                "pipeline-parallel loss (n_micro > 0) needs the mesh "
+                "carrying the pipe axis"
+            )
+        logits = model.apply_pipelined(
+            params, tokens, mesh=mesh, n_micro=n_micro,
+            axis_name=pipe_axis,
+        )
+    else:
+        logits = model.apply(params, tokens, mesh=mesh)  # (B, S, V) fp32
     targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -58,10 +73,11 @@ def loss_fn(
 
 
 def state_shardings(
-    mesh: Mesh, cfg: ModelConfig, opt_state_shape: Any
+    mesh: Mesh, cfg: ModelConfig, opt_state_shape: Any,
+    pipe_axis: str = "",
 ) -> TrainState:
     """NamedShardings for a TrainState (optimizer state follows params)."""
-    pspecs = param_specs(cfg)
+    pspecs = param_specs(cfg, pipe_axis=pipe_axis)
 
     def ns(spec):
         return NamedSharding(mesh, spec)
@@ -106,14 +122,26 @@ def make_train_step(
     model: TpuLM,
     mesh: Mesh,
     learning_rate: float = 3e-4,
+    n_micro: int = 0,
+    pipe_axis: str = "pipe",
 ) -> Tuple[Callable, Callable]:
     """Returns ``(init_fn, step_fn)``, both jitted over ``mesh``.
 
     ``init_fn(rng) -> TrainState`` materializes params *already sharded*
     (out_shardings on the jit — no host-side full copy).
     ``step_fn(state, tokens) -> (state, loss)``.
+
+    ``n_micro`` > 0 turns on pipeline parallelism: the forward/backward
+    run GPipe-style over the mesh's ``pipe_axis`` with that many
+    microbatches, and the stacked layer weights (plus their optimizer
+    moments) shard one stage per device along it.
     """
     cfg = model.cfg
+    if n_micro and pipe_axis not in mesh.axis_names:
+        raise ValueError(
+            f"n_micro={n_micro} but mesh has no {pipe_axis!r} axis "
+            f"(axes: {mesh.axis_names})"
+        )
     # "auto" resolves inside _attention: the pallas flash kernel on TPU
     # (forward AND backward are blockwise — ops/flash_attention.py), the
     # XLA formulation elsewhere. No training-time downgrade needed.
@@ -129,14 +157,20 @@ def make_train_step(
 
     # shape-evaluate to build shardings for outputs
     state_shape = jax.eval_shape(init, jax.random.key(0))
-    sh = state_shardings(mesh, cfg, state_shape.opt_state)
+    sh = state_shardings(
+        mesh, cfg, state_shape.opt_state,
+        pipe_axis=pipe_axis if n_micro else "",
+    )
     tok_sharding = NamedSharding(mesh, batch_spec(cfg))
 
     init_fn = jax.jit(init, out_shardings=sh)
 
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, tokens, mesh)
+            lambda p: loss_fn(
+                model, p, tokens, mesh,
+                n_micro=n_micro, pipe_axis=pipe_axis,
+            )
         )(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

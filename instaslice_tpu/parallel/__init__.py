@@ -15,11 +15,13 @@ from instaslice_tpu.parallel.meshenv import (
     initialize_distributed,
     slice_mesh,
 )
+from instaslice_tpu.parallel.pipeline import pipeline_blocks
 from instaslice_tpu.parallel.ring import ring_attention
 
 __all__ = [
     "SliceTopology",
     "initialize_distributed",
-    "slice_mesh",
+    "pipeline_blocks",
     "ring_attention",
+    "slice_mesh",
 ]

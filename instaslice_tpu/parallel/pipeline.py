@@ -1,0 +1,149 @@
+"""Pipeline parallelism: GPipe-style layer stages over a mesh axis.
+
+Completes the parallelism set (SURVEY.md §2b — the reference has none of
+DP/TP/PP/SP/EP; this SDK already provides DP/TP via ``pjit`` shardings,
+SP via ring attention, EP via the MoE block): the layer stack is split
+into ``P`` contiguous stages, one per device along the ``pipe`` mesh
+axis, and microbatches stream through the stages with activations moving
+stage→stage over ICI ``ppermute`` hops — the TPU-native transport for
+neighbor traffic, riding the contiguous-rectangle guarantee the
+placement engine provides.
+
+TPU-first shape of the schedule:
+
+- The whole pipeline is ONE ``lax.scan`` over ``M + P - 1`` ticks inside
+  ONE ``shard_map`` — no per-tick dispatch, no data-dependent Python.
+  Every stage runs the same compiled tick body; stage identity comes
+  from ``lax.axis_index``, so the program is SPMD like everything else
+  XLA compiles.
+- Bubble fraction is the textbook ``(P-1)/(M+P-1)``: pick
+  ``n_micro >= 4*P`` to keep it under ~20%.
+- ``shard_map`` is *partial-manual* over the pipe axis only: the stage
+  body's einsums keep their GSPMD shardings, so tensor parallelism over
+  a ``model`` axis composes inside each stage.
+- Backward falls out of autodiff: ``ppermute`` transposes to the
+  reverse permutation, giving the standard reverse-schedule activation
+  flow; ``remat=True`` wraps each stage's layer scan in
+  ``jax.checkpoint`` so the M in-flight microbatches don't hold full
+  activations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+def pipeline_blocks(
+    block_fn: Callable[[Params, jax.Array], jax.Array],
+    stacked_params: Params,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_micro: int,
+    axis_name: str = "pipe",
+    remat: bool = True,
+) -> jax.Array:
+    """Apply ``L`` stacked layers to ``x`` (B, S, D), pipelined.
+
+    ``stacked_params`` leaves carry a leading layer axis ``L`` divisible
+    by the pipe-axis size ``P``; stage ``s`` owns layers
+    ``[s·L/P, (s+1)·L/P)``. ``block_fn(layer_params, x) -> x`` is one
+    layer. ``B`` must be divisible by ``n_micro``. Returns the (B, S, D)
+    result identical (up to fp reassociation) to scanning the layers on
+    one device.
+    """
+    n_pipe = mesh.shape[axis_name]
+    leaves = jax.tree.leaves(stacked_params)
+    n_layers = leaves[0].shape[0]
+    if n_layers % n_pipe:
+        raise ValueError(
+            f"{n_layers} layers not divisible by pipe axis size {n_pipe}"
+        )
+    B = x.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by n_micro {n_micro}")
+    M = n_micro
+    # (L, ...) → (P, L/P, ...): leading axis sharded one stage per device
+    staged = jax.tree.map(
+        lambda p: p.reshape((n_pipe, n_layers // n_pipe) + p.shape[1:]),
+        stacked_params,
+    )
+    x_mb = x.reshape((M, B // M) + x.shape[1:])
+
+    layer_body = jax.checkpoint(block_fn) if remat else block_fn
+
+    def stage(params_stage, x_mb):
+        # params_stage leaves: (1, L/P, ...) — this stage's layer block
+        params_local = jax.tree.map(lambda p: p[0], params_stage)
+        s = lax.axis_index(axis_name)
+        perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
+
+        def run_layers(h):
+            return lax.scan(
+                lambda c, p: (layer_body(p, c), None), h, params_local
+            )[0]
+
+        def tick(carry, t):
+            prev, acc = carry
+            # activation from the upstream stage's previous tick; the
+            # wraparound edge (last → 0) carries garbage that the s == 0
+            # select below discards
+            recv = lax.ppermute(prev, axis_name, perm)
+            idx_in = jnp.clip(t, 0, M - 1)
+            first = lax.dynamic_index_in_dim(x_mb, idx_in, 0,
+                                             keepdims=False)
+            inp = jnp.where(s == 0, first, recv)
+            out = run_layers(inp)
+            # stage P-1 finishes microbatch t-(P-1) at tick t
+            idx_out = jnp.clip(t - (n_pipe - 1), 0, M - 1)
+            take = jnp.logical_and(s == n_pipe - 1, t >= n_pipe - 1)
+            cur = lax.dynamic_index_in_dim(acc, idx_out, 0,
+                                           keepdims=False)
+            acc = lax.dynamic_update_index_in_dim(
+                acc, jnp.where(take, out, cur), idx_out, 0
+            )
+            return (out, acc), None
+
+        zero = jnp.zeros_like(x_mb[0])
+        acc0 = jnp.zeros_like(x_mb)
+        # mark carries device-varying over the pipe axis so the scan's
+        # varying-manual-axes annotation is consistent from step 0 (the
+        # tick body makes them varying via axis_index/ppermute)
+        _vary = getattr(lax, "pcast", None)
+        if _vary is not None:
+            zero, acc0 = (
+                _vary(t, (axis_name,), to="varying") for t in (zero, acc0)
+            )
+        else:  # pragma: no cover - older jax
+            zero, acc0 = (lax.pvary(t, (axis_name,)) for t in (zero, acc0))
+        (_, acc), _ = lax.scan(
+            tick,
+            (zero, acc0),
+            jnp.arange(M + n_pipe - 1, dtype=jnp.int32),
+        )
+        # only the last stage's accumulator holds the result; mask +
+        # psum replicates it so the out_spec (replicated over pipe) holds
+        acc = lax.psum(
+            jnp.where(s == n_pipe - 1, acc, jnp.zeros_like(acc)),
+            axis_name,
+        )
+        return acc
+
+    out = jax.shard_map(
+        stage,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(axis_name), staged),
+            P(),
+        ),
+        out_specs=P(),
+        axis_names={axis_name},
+    )(staged, x_mb)
+    return out.reshape(x.shape)

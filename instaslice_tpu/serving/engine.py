@@ -343,24 +343,31 @@ class ServingEngine:
             del self.slots[slot]
 
     def generate(
-        self, prompts: List[List[int]], max_new_tokens: int
+        self, prompts: List[List[int]], max_new_tokens: int,
+        block_size: int = 32,
     ) -> List[GenerationResult]:
         """Batch convenience: run all prompts to completion (continuous
-        batching: new prompts are admitted as slots free up)."""
+        batching: new prompts are admitted as slots free up).
+
+        Decodes in on-device blocks (:meth:`decode_block`) of up to
+        ``block_size`` steps — capped at the smallest remaining budget
+        among this call's requests so no request overshoots
+        ``max_new_tokens``, and at the cache headroom."""
         pending = list(enumerate(prompts))
         want: Dict[int, int] = {}
         results: Dict[int, GenerationResult] = {}
         budget: Dict[int, int] = {}
-        while pending or self.slots:
+        while True:
             while pending and self.free_slots():
                 idx, p = pending.pop(0)
                 rid = self.add_request(p)
                 want[rid] = idx
                 budget[rid] = max_new_tokens
-            self.step()
-            # enforce the per-request budget — only for requests admitted
-            # by THIS call; slots created via add_request()/throughput()
-            # before generate() keep running under their own rules
+            # enforce the per-request budget BEFORE decoding (add_request
+            # already produced one token, so max_new_tokens=1 requests
+            # are done on admission) — only for requests admitted by THIS
+            # call; slots created via add_request()/throughput() before
+            # generate() keep running under their own rules
             for slot, req in list(self.slots.items()):
                 if (
                     req.request_id in budget
@@ -368,7 +375,8 @@ class ServingEngine:
                 ):
                     self.finished.append(
                         GenerationResult(
-                            req.request_id, req.prompt, req.generated,
+                            req.request_id, req.prompt,
+                            req.generated[: budget[req.request_id]],
                             "max_new_tokens",
                         )
                     )
@@ -386,6 +394,28 @@ class ServingEngine:
                 req.request_id in budget for req in self.slots.values()
             ):
                 break  # foreign slots still live; ours are all done
+            if self.slots:
+                owned = [
+                    r for r in self.slots.values()
+                    if r.request_id in budget
+                ]
+                n = block_size
+                if owned:
+                    # at-budget slots were just removed: remaining >= 1
+                    n = min(n, min(
+                        budget[r.request_id] - len(r.generated)
+                        for r in owned
+                    ))
+                worst = max(
+                    len(r.prompt) + len(r.generated)
+                    for r in self.slots.values()
+                )
+                n = min(n, self.max_len - 2 - worst)
+                if n >= 1:
+                    self.decode_block(n)
+                else:
+                    self.step()  # a slot at capacity: finish it one
+                    #              step at a time (_maybe_finish max_len)
         return [results[i] for i in sorted(results)]
 
     def throughput(

@@ -375,3 +375,13 @@ class TestDevicePluginLifecycle:
                 for r in sim.backends["node-0"].list_reservations()
             }
             assert {chips_a, chips_b} == reserved
+
+
+class TestDemoCli:
+    def test_demo_main_inproc(self, capsys):
+        from instaslice_tpu.cli.demo import main
+
+        assert main(["--profile", "v5e-1x1", "--nodes", "1"]) == 0
+        out = capsys.readouterr().out
+        assert '"demo": "ok"' in out
+        assert "TPU_VISIBLE_CHIPS" in out
