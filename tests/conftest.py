@@ -24,3 +24,31 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+
+# --------------------------------------------------------------- helpers
+# Shared across process-spawning tests (promoted here so fixes reach all
+# copies — review finding r3).
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port on localhost."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_until(pred, timeout: float, what: str, diag=None) -> None:
+    """Poll ``pred`` until true or raise with ``what`` (plus ``diag()``'s
+    output, when given — e.g. subprocess log tails)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    extra = f"\n{diag()}" if diag else ""
+    raise AssertionError(f"timed out waiting for {what}{extra}")

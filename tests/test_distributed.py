@@ -11,9 +11,10 @@ only sums correctly when BOTH processes' devices joined the mesh.
 
 import json
 import os
-import socket
 import subprocess
 import sys
+
+from conftest import free_port
 
 from instaslice_tpu.agent.handoff import slice_env
 from instaslice_tpu.api.types import AllocationDetails, PodRef
@@ -54,17 +55,11 @@ def _worker_envs():
     ]
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 class TestDcnRendezvous:
     def test_two_process_psum(self):
         envs = _worker_envs()
         assert len(envs) == 2
-        port = _free_port()
+        port = free_port()
         procs = []
         for env in envs:
             child = dict(os.environ)
