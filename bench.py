@@ -50,6 +50,7 @@ TPU_PHASES = [
     ("flash_fwd", 180.0),
     ("flash_bwd", 180.0),
     ("serving", 300.0),
+    ("serving_quant", 300.0),
     ("mfu", 300.0),
     ("serving_tp", 300.0),
 ]

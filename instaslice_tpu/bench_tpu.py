@@ -39,7 +39,8 @@ import time
 #: peak dense bf16 TFLOP/s per chip, from public Cloud TPU specs
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
-PHASES = ("probe", "flash_fwd", "flash_bwd", "serving", "mfu", "serving_tp")
+PHASES = ("probe", "flash_fwd", "flash_bwd", "serving", "serving_quant",
+          "mfu", "serving_tp")
 
 
 def _readback_rtt(reps: int = 7) -> float:
@@ -263,6 +264,26 @@ def bench_serving(out: dict) -> None:
     out["serving_model_params_m"] = round(_param_count(cfg) / 1e6)
 
 
+def bench_serving_quant(out: dict) -> None:
+    """Fully quantized decode tokens/sec: int8 weights (per-channel) AND
+    int8 KV cache (per-vector). Decode re-reads all weights and the
+    whole cache every step, so int8 storage halves the HBM bytes on both
+    streams — the throughput lever quantized serving exists for."""
+    import jax
+
+    from instaslice_tpu.models.quant import quantize_params
+    from instaslice_tpu.serving import ServingEngine
+
+    cfg, model = _serving_model()
+    qparams = quantize_params(model.init(jax.random.key(0)))
+    eng = ServingEngine(
+        model, qparams, max_batch=32, max_len=1024, prefill_len=128,
+        kv_quant=True,
+    )
+    tput = eng.throughput(n_steps=256, overhead_seconds=_readback_rtt())
+    out["decode_tokens_per_sec_per_chip_int8"] = round(tput, 1)
+
+
 def bench_serving_tp(out: dict) -> None:
     """Tensor-parallel decode over every locally visible chip — the
     multi-chip-grant serving path (BASELINE headline: 7B-class on a 2x2
@@ -372,6 +393,8 @@ def run_phase(phase: str, out: dict) -> None:
         bench_flash_bwd(out)
     elif phase == "serving":
         bench_serving(out)
+    elif phase == "serving_quant":
+        bench_serving_quant(out)
     elif phase == "mfu":
         bench_train_mfu(out, gen)
     elif phase == "serving_tp":

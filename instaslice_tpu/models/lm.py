@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from instaslice_tpu.models.quant import embed_lookup, weight
+
 Params = Dict[str, Any]
 
 
@@ -181,6 +183,18 @@ def _rope(x: jax.Array, positions: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _kv_quantize(t: jax.Array):
+    """(…, hd) → (int8 values, per-vector fp32 scale): symmetric int8
+    over each position's head vector (the KV-cache storage quant)."""
+    t32 = t.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(t32), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(t32 / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
 def _attention(q, k, v, causal: bool = True, impl: str = "xla") -> jax.Array:
     """Softmax attention; q/k/v: (B, S, H, hd), fp32 logits.
 
@@ -216,11 +230,11 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
     x: (B, S, D)."""
     B, S = x.shape[:2]
     h = _rmsnorm(x, layer["ln1"]["scale"])
-    q = jnp.einsum("bsd,dk->bsk", h, layer["wq"],
+    q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"]),
                    preferred_element_type=jnp.float32)
-    k = jnp.einsum("bsd,dk->bsk", h, layer["wk"],
+    k = jnp.einsum("bsd,dk->bsk", h, weight(layer["wk"]),
                    preferred_element_type=jnp.float32)
-    v = jnp.einsum("bsd,dk->bsk", h, layer["wv"],
+    v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"]),
                    preferred_element_type=jnp.float32)
     q, k, v = (
         t.astype(cfg.dtype).reshape(B, S, cfg.n_heads, cfg.head_dim)
@@ -231,17 +245,18 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
     attn = attn_fn(q, k, v)
     attn = attn.reshape(B, S, cfg.n_heads * cfg.head_dim)
     x = x + jnp.einsum(
-        "bsk,kd->bsd", attn, layer["wo"],
+        "bsk,kd->bsd", attn, weight(layer["wo"]),
         preferred_element_type=jnp.float32,
     ).astype(cfg.dtype)
     h = _rmsnorm(x, layer["ln2"]["scale"])
     if cfg.n_experts:
-        y = _moe_mlp(h, layer["router"], layer["w_in"], layer["w_out"])
+        y = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
+                     weight(layer["w_out"]))
     else:
-        y = jnp.einsum("bsd,df->bsf", h, layer["w_in"],
+        y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
                        preferred_element_type=jnp.float32)
         y = jax.nn.gelu(y).astype(cfg.dtype)
-        y = jnp.einsum("bsf,fd->bsd", y, layer["w_out"],
+        y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"]),
                        preferred_element_type=jnp.float32
                        ).astype(cfg.dtype)
     return x + y
@@ -290,7 +305,7 @@ class TpuLM:
         cfg = self.cfg
         ring = cfg.ring_attention and mesh is not None
         B, S = tokens.shape
-        x = params["embed"][tokens]  # (B, S, D) bf16
+        x = embed_lookup(params["embed"], tokens)  # (B, S, D) bf16
         if ring:
             from jax.sharding import NamedSharding
 
@@ -324,7 +339,7 @@ class TpuLM:
         x, _ = lax.scan(body, x, params["blocks"])
         x = _rmsnorm(x, params["ln_f"]["scale"])
         logits = jnp.einsum(
-            "bsd,vd->bsv", x, params["embed"],
+            "bsd,vd->bsv", x, weight(params["embed"]),
             preferred_element_type=jnp.float32,
         )
         return logits
@@ -355,7 +370,7 @@ class TpuLM:
                 "pipeline parallelism for this model, not both"
             )
         B, S = tokens.shape
-        x = params["embed"][tokens]
+        x = embed_lookup(params["embed"], tokens)
         positions = jnp.arange(S, dtype=jnp.int32)
 
         def block_fn(layer, xb):
@@ -372,18 +387,31 @@ class TpuLM:
         )
         x = _rmsnorm(x, params["ln_f"]["scale"])
         return jnp.einsum(
-            "bsd,vd->bsv", x, params["embed"],
+            "bsd,vd->bsv", x, weight(params["embed"]),
             preferred_element_type=jnp.float32,
         )
 
     # ------------------------------------------------------------ KV cache
 
-    def init_cache(self, batch: int, max_len: int) -> Params:
+    def init_cache(self, batch: int, max_len: int,
+                   quant: bool = False) -> Params:
         """Zeroed KV cache for incremental decoding: per-layer stacked
         (L, B, max_len, H, hd) key/value tensors (the serving engine's
-        slot-batched layout)."""
+        slot-batched layout).
+
+        ``quant=True`` stores K/V as int8 with one fp32 scale per
+        (layer, slot, position, head) — decode streams the whole cache
+        every step, so int8 halves its HBM traffic and doubles how many
+        tokens fit; the per-vector scale keeps the error sub-percent."""
         cfg = self.cfg
         shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+        if quant:
+            return {
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_s": jnp.zeros(shape[:-1], jnp.float32),
+                "v_s": jnp.zeros(shape[:-1], jnp.float32),
+            }
         return {
             "k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
@@ -407,9 +435,10 @@ class TpuLM:
         is progressively overwritten by later decode steps).
         """
         cfg = self.cfg
+        quant = "k_s" in cache                        # int8 KV storage
         B, T = tokens.shape
         S_max = cache["k"].shape[2]
-        x = params["embed"][tokens]                       # (B, T, D)
+        x = embed_lookup(params["embed"], tokens)         # (B, T, D)
         positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)
 
         s_idx = jnp.arange(S_max, dtype=jnp.int32)
@@ -422,14 +451,23 @@ class TpuLM:
                 lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0, 0))
             )(cache_l, new, lens)
 
+        def write_s(scale_l, new, lens):
+            """Append (B, T, H) scales at per-row offsets into (B, S, H)."""
+            return jax.vmap(
+                lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0))
+            )(scale_l, new, lens)
+
         def block(x, xs):
-            layer, kc, vc = xs                            # kc: (B,S,H,hd)
+            if quant:
+                layer, kc, vc, ks, vs = xs            # kc int8, ks f32
+            else:
+                layer, kc, vc = xs                    # kc: (B,S,H,hd)
             h = _rmsnorm(x, layer["ln1"]["scale"])
-            q = jnp.einsum("bsd,dk->bsk", h, layer["wq"],
+            q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"]),
                            preferred_element_type=jnp.float32)
-            k = jnp.einsum("bsd,dk->bsk", h, layer["wk"],
+            k = jnp.einsum("bsd,dk->bsk", h, weight(layer["wk"]),
                            preferred_element_type=jnp.float32)
-            v = jnp.einsum("bsd,dk->bsk", h, layer["wv"],
+            v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"]),
                            preferred_element_type=jnp.float32)
             q, k, v = (
                 t.astype(cfg.dtype).reshape(B, T, cfg.n_heads, cfg.head_dim)
@@ -437,39 +475,58 @@ class TpuLM:
             )
             q = _rope(q, positions)
             k = _rope(k, positions)
-            kc = write(kc, k, lengths)
-            vc = write(vc, v, lengths)
+            if quant:
+                k8, k_sc = _kv_quantize(k)
+                v8, v_sc = _kv_quantize(v)
+                kc = write(kc, k8, lengths)
+                vc = write(vc, v8, lengths)
+                ks = write_s(ks, k_sc, lengths)
+                vs = write_s(vs, v_sc, lengths)
+                # dequant is an elementwise producer XLA fuses into the
+                # dots: the int8 bytes are what cross HBM
+                k_read = (kc.astype(jnp.float32)
+                          * ks[..., None]).astype(cfg.dtype)
+                v_read = (vc.astype(jnp.float32)
+                          * vs[..., None]).astype(cfg.dtype)
+            else:
+                kc = write(kc, k, lengths)
+                vc = write(vc, v, lengths)
+                k_read, v_read = kc, vc
             logits = jnp.einsum(
-                "bthd,bshd->bhts", q, kc,
+                "bthd,bshd->bhts", q, k_read,
                 preferred_element_type=jnp.float32,
             ) * (cfg.head_dim ** -0.5)
             logits = jnp.where(mask[:, None], logits, -1e9)
-            probs = jax.nn.softmax(logits, axis=-1).astype(vc.dtype)
-            attn = jnp.einsum("bhts,bshd->bthd", probs, vc)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, v_read)
             attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
             x = x + jnp.einsum(
-                "bsk,kd->bsd", attn, layer["wo"],
+                "bsk,kd->bsd", attn, weight(layer["wo"]),
                 preferred_element_type=jnp.float32,
             ).astype(cfg.dtype)
             h = _rmsnorm(x, layer["ln2"]["scale"])
             if cfg.n_experts:
-                y = _moe_mlp(h, layer["router"], layer["w_in"],
-                             layer["w_out"])
+                y = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
+                             weight(layer["w_out"]))
             else:
-                y = jnp.einsum("bsd,df->bsf", h, layer["w_in"],
+                y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
                                preferred_element_type=jnp.float32)
                 y = jax.nn.gelu(y).astype(cfg.dtype)
-                y = jnp.einsum("bsf,fd->bsd", y, layer["w_out"],
+                y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"]),
                                preferred_element_type=jnp.float32
                                ).astype(cfg.dtype)
-            return x + y, (kc, vc)
+            return x + y, (kc, vc, ks, vs) if quant else (kc, vc)
 
-        x, (new_k, new_v) = lax.scan(
-            block, x, (params["blocks"], cache["k"], cache["v"])
-        )
+        xs_in = (params["blocks"], cache["k"], cache["v"])
+        if quant:
+            xs_in += (cache["k_s"], cache["v_s"])
+        x, new = lax.scan(block, x, xs_in)
         x = _rmsnorm(x, params["ln_f"]["scale"])
         logits = jnp.einsum(
-            "bsd,vd->bsv", x, params["embed"],
+            "bsd,vd->bsv", x, weight(params["embed"]),
             preferred_element_type=jnp.float32,
         )
-        return logits, {"k": new_k, "v": new_v}
+        out_cache = {"k": new[0], "v": new[1]}
+        if quant:
+            out_cache["k_s"], out_cache["v_s"] = new[2], new[3]
+        return logits, out_cache

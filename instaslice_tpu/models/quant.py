@@ -1,0 +1,161 @@
+"""Weight-only int8 quantization for the LM.
+
+Decode serving at LM scale is HBM-bandwidth-bound: every step re-reads
+all weights, so storing them as int8 (+ a per-output-channel fp32 scale)
+halves the bytes the matmuls stream versus bf16 — the classic
+weight-only-quant serving trade (accuracy cost is small because
+activations stay bf16 and the scale is per-channel symmetric). On TPU
+the dequantize (convert + channel-scale multiply) is an elementwise
+producer that XLA fuses into the dot's operand load, so the int8 bytes
+are what actually cross HBM.
+
+Usage::
+
+    qparams = quantize_params(params)
+    logits = model.apply(qparams, tokens)          # same code path
+    eng = ServingEngine(model, qparams, ...)       # sharding specs apply
+                                                   # as prefix trees
+
+:class:`QuantizedTensor` is a registered pytree node, so optimizer-free
+trees (serving, checkpointing) treat ``(q, s)`` as ordinary leaves, and
+``jax.device_put`` with the existing ``param_specs`` tree shards ``q``
+and ``s`` together via prefix-tree semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+#: params tree keys that stay full precision: norms and the MoE router
+#: are tiny and precision-critical.
+_SKIP_KEYS = frozenset({"ln1", "ln2", "ln_f", "router"})
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """int8 values + per-output-channel scale; dequantizes lazily.
+
+    ``q``: int8, same shape as the original weight. ``s``: fp32 scale
+    broadcastable against ``q`` (kept with the original rank so sharding
+    specs written for the weight apply to both leaves).
+    """
+
+    def __init__(self, q: jax.Array, s: jax.Array):
+        self.q = q
+        self.s = s
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # what dequantization yields
+        return self.s.dtype
+
+    def dequantize(self, dtype=None) -> jax.Array:
+        out = self.q.astype(jnp.float32) * self.s.astype(jnp.float32)
+        return out.astype(dtype or self.s.dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QuantizedTensor(shape={self.q.shape}, s={self.s.shape})"
+
+
+def quantize_tensor(w: jax.Array, reduce_axis: int = -2) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 quantization: the amax reduces
+    over ``reduce_axis`` (the axis the matmul will CONTRACT), leaving one
+    scale per output channel so quantization error does not couple
+    across outputs. Projections are laid out (…, in, out) → reduce -2;
+    the embedding table is (out=vocab, in=d) → reduce -1."""
+    w32 = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axis, keepdims=True)
+    # the scale is STORED in the weight's dtype (so dequantization lands
+    # back in the model's compute dtype); round it to storage precision
+    # BEFORE computing q, or a bf16-rounded scale would mismatch the
+    # fp32 scale q was computed against and add its rounding error to
+    # every dequantized element
+    scale = (
+        (jnp.maximum(amax, 1e-8) / 127.0).astype(w.dtype)
+        .astype(jnp.float32)
+    )
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q, scale.astype(w.dtype))
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize every matmul weight in an :func:`init_params` tree to
+    int8; norms/router stay full precision. Idempotent on already
+    quantized leaves."""
+
+    def walk(tree, key=""):
+        if isinstance(tree, QuantizedTensor):
+            return tree
+        if isinstance(tree, dict):
+            # skipped subtrees (norms, router) pass through wholesale
+            return {
+                k: (tree[k] if k in _SKIP_KEYS else walk(tree[k], k))
+                for k in tree
+            }
+        return quantize_tensor(tree, reduce_axis=-1 if key == "embed"
+                               else -2)
+
+    return walk(params)
+
+
+def shard_params(params: Params, mesh, specs: Params) -> Params:
+    """``jax.device_put`` a (possibly quantized) params tree onto
+    ``mesh`` per the :func:`param_specs`-shaped ``specs`` tree.
+
+    A :class:`QuantizedTensor`'s values take the weight's spec verbatim;
+    its scale takes the same spec with sharded entries masked to None on
+    every size-1 (reduced) axis — a prefix-tree device_put would demand
+    the contracted axis of the scale be divisible by the mesh axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def place(leaf, spec):
+        if isinstance(leaf, QuantizedTensor):
+            q = jax.device_put(leaf.q, NamedSharding(mesh, spec))
+            sspec = P(*(
+                (spec[d] if d < len(spec) else None)
+                if leaf.s.shape[d] != 1 else None
+                for d in range(leaf.s.ndim)
+            ))
+            s = jax.device_put(leaf.s, NamedSharding(mesh, sspec))
+            return QuantizedTensor(q, s)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(
+        place, params, specs,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor),
+    )
+
+
+def weight(leaf, dtype=None) -> jax.Array:
+    """A usable weight from a params leaf: dequantize
+    :class:`QuantizedTensor`, pass arrays through. The model calls this
+    at every weight use so one code path serves both precisions."""
+    if isinstance(leaf, QuantizedTensor):
+        return leaf.dequantize(dtype)
+    return leaf if dtype is None else leaf.astype(dtype)
+
+
+def embed_lookup(leaf, tokens: jax.Array) -> jax.Array:
+    """Embedding-table gather that dequantizes AFTER the gather (a
+    full-table dequantize would materialize the V×D bf16 matrix the
+    quantization exists to avoid)."""
+    if isinstance(leaf, QuantizedTensor):
+        rows = leaf.q[tokens].astype(jnp.float32)
+        scales = leaf.s[tokens].astype(jnp.float32)   # (..., 1) per-row
+        return (rows * scales).astype(leaf.s.dtype)
+    return leaf[tokens]
