@@ -77,11 +77,23 @@ class ServingEngine:
         seed: int = 0,
         mesh: Optional[Mesh] = None,
         kv_quant: bool = False,
+        draft_model: Optional[TpuLM] = None,
+        draft_params: Optional[Params] = None,
+        spec_k: int = 4,
     ) -> None:
         """``kv_quant=True`` stores the KV cache as int8 with per-vector
         scales (``TpuLM.init_cache(quant=True)``): decode streams the
         whole cache every step, so this halves the dominant HBM traffic
-        at high concurrency and doubles cache capacity."""
+        at high concurrency and doubles cache capacity.
+
+        ``draft_model`` (+ ``draft_params``) enables greedy speculative
+        decoding (:meth:`spec_step`): the draft proposes ``spec_k``
+        tokens per round, the target verifies them in ONE forward, and
+        the longest agreeing prefix plus the target's own next token are
+        emitted — ≥1 and up to ``spec_k + 1`` tokens per target pass,
+        token-identical to plain greedy decoding. Rollback is free: the
+        per-slot offset cache never attends past ``lengths``, and a
+        rejected position is exactly the next write position."""
         if prefill_len > max_len:
             raise ValueError("prefill_len must be <= max_len")
         self.model = model
@@ -105,36 +117,71 @@ class ServingEngine:
         self.finished: List[GenerationResult] = []
         self.tokens_generated = 0
 
+        self.draft_model = draft_model
+        self.spec_k = spec_k
+        if draft_model is not None:
+            if temperature > 0.0:
+                raise ValueError(
+                    "speculative decoding is greedy-only (acceptance "
+                    "compares argmax chains); temperature must be 0"
+                )
+            self.draft_params = (
+                draft_params if draft_params is not None
+                else draft_model.init(jax.random.key(1))
+            )
+            self.draft_cache = draft_model.init_cache(max_batch, max_len)
+            if mesh is not None:
+                self.draft_params, self.draft_cache = (
+                    self._shard_model_state(
+                        mesh, draft_model, self.draft_params,
+                        self.draft_cache,
+                    )
+                )
+
         self._prefill = jax.jit(self._prefill_impl)
         self._decode = jax.jit(self._decode_impl)
         self._decode_block = jax.jit(
             self._decode_block_impl, static_argnames=("n_steps", "greedy")
         )
+        if draft_model is not None:
+            self._draft_prefill = jax.jit(self._draft_prefill_impl)
+            self._draft_catchup = jax.jit(self._draft_catchup_impl)
+            self._spec_draft = jax.jit(
+                self._spec_draft_impl, static_argnames=("k",)
+            )
+            self._spec_verify = jax.jit(self._spec_verify_impl)
 
-    def _shard_over(self, mesh: Mesh) -> None:
-        """Tensor-parallel layout over the mesh's ``"model"`` axis: weights
-        per :func:`param_specs` (heads / ff-hidden / vocab split), KV cache
-        over the heads axis of its (L, B, S, H, hd) tensors, decode state
-        replicated. XLA's sharding propagation inserts the collectives —
-        the same two compiled programs serve any slice size."""
+    def _shard_model_state(self, mesh: Mesh, model: TpuLM, params, cache):
+        """One model's tensor-parallel layout over the mesh's ``model``
+        axis: weights per :func:`param_specs` (heads / ff-hidden / vocab
+        split, quant-aware), KV cache over the heads axis. Shared by the
+        target and the speculative draft so the two layouts cannot
+        drift."""
         if "model" not in mesh.axis_names:
             raise ValueError(
                 f"serving mesh needs a 'model' axis, got {mesh.axis_names}"
             )
         tp = mesh.shape["model"]
-        if self.model.cfg.n_heads % tp:
+        if model.cfg.n_heads % tp:
             raise ValueError(
-                f"n_heads={self.model.cfg.n_heads} not divisible by the "
+                f"n_heads={model.cfg.n_heads} not divisible by the "
                 f"mesh's model axis ({tp} devices)"
             )
         from instaslice_tpu.models.quant import shard_params
 
-        self.params = shard_params(
-            self.params, mesh, param_specs(self.model.cfg)
-        )
+        params = shard_params(params, mesh, param_specs(model.cfg))
         cache_sharding = NamedSharding(mesh, P(None, None, None, "model"))
-        self.cache = jax.tree.map(
-            lambda c: jax.device_put(c, cache_sharding), self.cache
+        cache = jax.tree.map(
+            lambda c: jax.device_put(c, cache_sharding), cache
+        )
+        return params, cache
+
+    def _shard_over(self, mesh: Mesh) -> None:
+        """Tensor-parallel layout for the target model + replicated
+        decode state. XLA's sharding propagation inserts the collectives
+        — the same two compiled programs serve any slice size."""
+        self.params, self.cache = self._shard_model_state(
+            mesh, self.model, self.params, self.cache
         )
         replicated = NamedSharding(mesh, P())
         self.lengths = jax.device_put(self.lengths, replicated)
@@ -142,9 +189,10 @@ class ServingEngine:
 
     # ------------------------------------------------------------- jitted
 
-    def _prefill_impl(self, params, cache, tokens, slot, offset):
+    def _prefill_stripe(self, model, params, cache, tokens, slot, offset):
         """Prefill one (1, prefill_len) chunk into a slot's cache stripe
-        at ``offset`` and return the chunk's logits (prefill_len, vocab).
+        at ``offset``; returns (cache, chunk logits (prefill_len, vocab)).
+        Shared by the target and draft prefills.
 
         The stripe is read back (not zeroed): chunks after the first must
         attend to the KV the earlier chunks wrote. Stale data from a prior
@@ -155,7 +203,7 @@ class ServingEngine:
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
         )
-        logits, stripe = self.model.apply_with_cache(
+        logits, stripe = model.apply_with_cache(
             params, tokens, stripe,
             jnp.full((1,), offset, jnp.int32),
         )
@@ -165,7 +213,12 @@ class ServingEngine:
             ),
             cache, stripe,
         )
-        return cache, logits[0]                     # (prefill_len, vocab)
+        return cache, logits[0]
+
+    def _prefill_impl(self, params, cache, tokens, slot, offset):
+        return self._prefill_stripe(
+            self.model, params, cache, tokens, slot, offset
+        )
 
     def _decode_impl(self, params, cache, last_token, lengths):
         logits, cache = self.model.apply_with_cache(
@@ -205,6 +258,49 @@ class ServingEngine:
             jnp.arange(n_steps, dtype=jnp.int32),
         )
         return cache, last, lengths, toks
+
+    def _draft_prefill_impl(self, params, cache, tokens, slot, offset):
+        """The draft cache must hold the prompt too before it can
+        propose (logits discarded — only the target samples)."""
+        cache, _ = self._prefill_stripe(
+            self.draft_model, params, cache, tokens, slot, offset
+        )
+        return cache
+
+    def _draft_catchup_impl(self, params, cache, inputs, lens):
+        """Teacher-force ``inputs`` (B, T) through the draft so its
+        cache tracks tokens produced OUTSIDE spec_step (plain step() /
+        decode_block() on a draft-enabled engine) — otherwise those
+        positions would be zero-holes the draft attends forever."""
+        _, cache = self.draft_model.apply_with_cache(
+            params, inputs, cache, lens
+        )
+        return cache
+
+    def _spec_draft_impl(self, params, cache, last, lens, *, k: int):
+        """k greedy draft steps as one scan → (B, k) proposals."""
+
+        def step(carry, _):
+            cache, last, lens = carry
+            logits, cache = self.draft_model.apply_with_cache(
+                params, last[:, None], cache, lens
+            )
+            toks = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            return (cache, toks, lens + 1), toks
+
+        (cache, _, _), toks = jax.lax.scan(
+            step, (cache, last, lens), None, length=k
+        )
+        return cache, jnp.swapaxes(toks, 0, 1)
+
+    def _spec_verify_impl(self, params, cache, inputs, lens):
+        """One target forward over (B, k+1) inputs → (B, k+1) greedy
+        next-token predictions (position j predicts the token after
+        input j)."""
+        logits, cache = self.model.apply_with_cache(
+            params, inputs, cache, lens
+        )
+        return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     def _sample(self, logits: jax.Array) -> jax.Array:
         if self.temperature <= 0.0:
@@ -252,6 +348,11 @@ class ServingEngine:
             self.cache, chunk_logits = self._prefill(
                 self.params, self.cache, padded, slot, i * P
             )
+            if self.draft_model is not None:
+                self.draft_cache = self._draft_prefill(
+                    self.draft_params, self.draft_cache, padded, slot,
+                    i * P,
+                )
         last_logits = chunk_logits[(len(prompt) - 1) % P]
         tok = self._sample(last_logits[None])[0]
         self.last_token = self.last_token.at[slot].set(tok)
@@ -266,6 +367,14 @@ class ServingEngine:
         token. Slots hitting eos/max_len move to ``finished``."""
         if not self.slots:
             return {}
+        if self.draft_model is not None:
+            # keep the draft cache position-complete: it must consume
+            # every token the target consumes or later spec_steps attend
+            # zero-holes
+            self.draft_cache = self._draft_catchup(
+                self.draft_params, self.draft_cache,
+                self.last_token[:, None], self.lengths,
+            )
         # the sampled token for step t is appended at position lengths+1
         # (the prompt's last token sits at lengths-1; sampled continuation
         # enters the cache when it is fed back as input here)
@@ -309,6 +418,7 @@ class ServingEngine:
                 f"{self.max_len} (deepest live slot at {worst})"
             )
         self._rng, sub = jax.random.split(self._rng)
+        last_before, lengths_before = self.last_token, self.lengths
         self.cache, self.last_token, self.lengths, toks = (
             self._decode_block(
                 self.params, self.cache, self.last_token, self.lengths,
@@ -316,10 +426,80 @@ class ServingEngine:
                 n_steps=n_steps, greedy=self.temperature <= 0.0,
             )
         )
+        if self.draft_model is not None:
+            # teacher-force the block's inputs ([last, toks[:-1]])
+            # through the draft in ONE forward so its cache tracks
+            # positions produced outside spec_step
+            consumed = jnp.concatenate(
+                [last_before[:, None], jnp.swapaxes(toks, 0, 1)[:, :-1]],
+                axis=1,
+            )
+            self.draft_cache = self._draft_catchup(
+                self.draft_params, self.draft_cache, consumed,
+                lengths_before,
+            )
         block = jax.device_get(toks)               # single host round-trip
         out: Dict[int, List[int]] = {}
         for slot, req in list(self.slots.items()):
             seq = [int(t) for t in block[:, slot]]
+            if self.eos_id is not None and self.eos_id in seq:
+                seq = seq[: seq.index(self.eos_id) + 1]
+            req.generated.extend(seq)
+            self.tokens_generated += len(seq)
+            out[req.request_id] = seq
+            self._maybe_finish(slot)
+        return out
+
+    def spec_step(self) -> Dict[int, List[int]]:
+        """One speculative round for every live slot: draft ``spec_k``
+        proposals (one cheap scan), verify with ONE target forward,
+        emit the longest agreeing prefix plus the target's own next
+        token — between 1 and ``spec_k + 1`` tokens per slot per target
+        pass, token-identical to plain greedy decode.
+
+        Rollback costs nothing: rejected positions sit at/beyond each
+        slot's new write offset, so the mask never admits them and the
+        next round overwrites them — in BOTH caches (the draft's wrong
+        entry is exactly its next write position). Near the cache end
+        ``k`` shrinks automatically (down to a plain greedy step at
+        ``k = 0``), so slots drain to ``max_len`` through this path
+        instead of raising."""
+        if self.draft_model is None:
+            raise RuntimeError(
+                "spec_step needs an engine built with draft_model="
+            )
+        if not self.slots:
+            return {}
+        worst = max(
+            len(r.prompt) + len(r.generated) for r in self.slots.values()
+        )
+        # shrink k near the cache end instead of refusing: k=0 degrades
+        # to a plain (draft-cache-maintaining) greedy step, so a slot can
+        # always be drained to max_len through this path
+        k = max(0, min(self.spec_k, self.max_len - 2 - worst))
+        # the draft scans k+1 steps: step j consumes [last, d0..d_{k-1}]
+        # so on FULL acceptance (new write position = lens+k+1) every
+        # admitted draft-cache position is really written — a k-step scan
+        # would leave d_{k-1}'s position as a permanent zero-hole
+        self.draft_cache, d_all = self._spec_draft(
+            self.draft_params, self.draft_cache, self.last_token,
+            self.lengths, k=k + 1,
+        )
+        d = d_all[:, :k]
+        inputs = jnp.concatenate([self.last_token[:, None], d], axis=1)
+        self.cache, t = self._spec_verify(
+            self.params, self.cache, inputs, self.lengths
+        )
+        matches = (d == t[:, :k]).astype(jnp.int32)
+        accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
+        bonus = jnp.take_along_axis(t, accepted[:, None], axis=1)[:, 0]
+        d_h, t_h, a_h = jax.device_get((d, t, accepted))
+        self.last_token = bonus
+        self.lengths = self.lengths + accepted + 1
+        out: Dict[int, List[int]] = {}
+        for slot, req in list(self.slots.items()):
+            n = int(a_h[slot])
+            seq = [int(x) for x in d_h[slot, :n]] + [int(t_h[slot, n])]
             if self.eos_id is not None and self.eos_id in seq:
                 seq = seq[: seq.index(self.eos_id) + 1]
             req.generated.extend(seq)

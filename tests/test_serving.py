@@ -257,3 +257,145 @@ class TestTensorParallelServing:
         mesh = self._mesh(4)
         with pytest.raises(ValueError, match="divisible"):
             ServingEngine(m, params, mesh=mesh)
+
+
+class TestSpeculativeDecoding:
+    """Greedy speculative decoding: draft k, verify in one target pass,
+    emit the agreeing prefix + the target's own token. The hard
+    property: token-IDENTICAL to plain greedy decode for any draft."""
+
+    def _draft(self):
+        cfg = ModelConfig(
+            vocab_size=64, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            dtype=jnp.float32, remat=False,
+        )
+        m = TpuLM(cfg)
+        return m, m.init(jax.random.key(7))
+
+    def test_lossless_vs_plain_greedy(self, model):
+        m, params = model
+        dm, dp = self._draft()
+        plain = ServingEngine(m, params, max_batch=2, max_len=64,
+                              prefill_len=8)
+        rref = plain.add_request([5, 9, 2, 7])
+        ref = plain.decode_block(12)[rref]
+        spec = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=8, draft_model=dm,
+                             draft_params=dp, spec_k=4)
+        rid = spec.add_request([5, 9, 2, 7])
+        got = []
+        while len(got) < 12:
+            got.extend(spec.spec_step()[rid])
+        assert got[:12] == ref
+
+    def test_self_draft_accepts_k_plus_one(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=4)
+        rid = eng.add_request([5, 9, 2, 7])
+        assert len(eng.spec_step()[rid]) == 5   # all k accepted + bonus
+
+    def test_quantized_self_draft_lossless(self, model):
+        """The classic deployment: the draft is the target's own int8
+        quantization — high acceptance, still token-identical output."""
+        from instaslice_tpu.models.quant import quantize_params
+
+        m, params = model
+        plain = ServingEngine(m, params, max_batch=1, max_len=64,
+                              prefill_len=8)
+        rref = plain.add_request([9, 3, 1])
+        ref = plain.decode_block(12)[rref]
+        spec = ServingEngine(m, params, max_batch=1, max_len=64,
+                             prefill_len=8, draft_model=m,
+                             draft_params=quantize_params(params),
+                             spec_k=4)
+        rid = spec.add_request([9, 3, 1])
+        got = []
+        while len(got) < 12:
+            got.extend(spec.spec_step()[rid])
+        assert got[:12] == ref
+
+    def test_multi_slot_ragged_acceptance(self, model):
+        """Slots at different depths with different acceptance counts
+        must each stay on their own greedy chain."""
+        m, params = model
+        dm, dp = self._draft()
+        plain = ServingEngine(m, params, max_batch=2, max_len=64,
+                              prefill_len=8)
+        ra = plain.add_request([5, 9, 2, 7])
+        rb = plain.add_request([11, 4])
+        ref = {r: toks for r, toks in (
+            (ra, []), (rb, []),
+        )}
+        for _ in range(10):
+            for r, t in plain.step().items():
+                ref[r].append(t)
+        spec = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=8, draft_model=dm,
+                             draft_params=dp, spec_k=3)
+        sa = spec.add_request([5, 9, 2, 7])
+        sb = spec.add_request([11, 4])
+        got = {sa: [], sb: []}
+        while len(got[sa]) < 10 or len(got[sb]) < 10:
+            for r, seq in spec.spec_step().items():
+                got[r].extend(seq)
+        assert got[sa][:10] == ref[ra]
+        assert got[sb][:10] == ref[rb]
+
+    def test_requires_draft_and_greedy(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=32,
+                            prefill_len=8)
+        with pytest.raises(RuntimeError, match="draft_model"):
+            eng.spec_step()
+        with pytest.raises(ValueError, match="greedy"):
+            ServingEngine(m, params, temperature=0.7, draft_model=m,
+                          draft_params=params)
+
+    def test_k_shrinks_near_cache_end_and_drains(self, model):
+        """Near max_len, k shrinks (down to a plain greedy step) so the
+        slot drains to its max_len finish through spec_step alone — and
+        the tokens still match the plain engine's chain."""
+        m, params = model
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        plain = ServingEngine(m, params, max_batch=1, max_len=16,
+                              prefill_len=8)
+        rp = plain.add_request(prompt)
+        ref = [plain.slots[0].generated[0]]
+        while plain.slots:
+            ref.extend(plain.step().values())
+        spec = ServingEngine(m, params, max_batch=1, max_len=16,
+                             prefill_len=8, draft_model=m,
+                             draft_params=params, spec_k=8)
+        spec.add_request(prompt)
+        got = [spec.slots[0].generated[0]]
+        for _ in range(32):
+            if not spec.slots:
+                break
+            for seq in spec.spec_step().values():
+                got.extend(seq)
+        assert not spec.slots, "slot never drained to max_len"
+        assert spec.finished[-1].finished_reason == "max_len"
+        assert got == ref
+
+    def test_mixed_step_and_spec_keeps_draft_cache_whole(self, model):
+        """Plain step()/decode_block() on a draft-enabled engine must
+        teacher-force the draft cache, so a later spec_step still
+        proposes from a complete prefix (self-draft: full acceptance
+        proves no holes)."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        rid = eng.add_request([5, 9, 2, 7])
+        eng.step()
+        eng.decode_block(4)
+        out = eng.spec_step()[rid]
+        assert len(out) == 4     # k accepted + bonus: cache had no holes
+        plain = ServingEngine(m, params, max_batch=1, max_len=64,
+                              prefill_len=8)
+        rp = plain.add_request([5, 9, 2, 7])
+        ref = plain.decode_block(10)[rp]
+        assert eng.slots[0].generated[1:] == ref[:len(
+            eng.slots[0].generated) - 1]
