@@ -1,0 +1,379 @@
+"""Minimal OpenAI-style HTTP front-end for :class:`ServingEngine`.
+
+The reference's serving story is "point vLLM at the slice"
+(``/root/reference/samples/vllm_dep.yaml``); this is the TPU-native
+equivalent: a single process that rebuilds the slice mesh from the
+agent's handoff env, shards the model over it, and serves continuous-
+batched completions over HTTP.
+
+- ``POST /v1/completions`` with ``{"prompt": [token ids], "max_tokens":
+  N, "temperature": T}`` → ``{"choices": [{"token_ids": [...],
+  "finish_reason": ...}]}``. Token-id prompts (vLLM supports the same)
+  keep the server tokenizer-free — the tokenizer belongs to the client
+  model stack, not the slice operator.
+- ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters.
+
+One scheduler thread owns the engine (the engine is not thread-safe by
+design — XLA dispatch is serialized anyway): it admits queued requests
+as slots free up, decodes in on-device blocks sized to the smallest
+remaining budget (one dispatch, one readback per block — the tunnel/
+dispatch-latency lesson from the bench), enforces per-request budgets,
+and resolves waiting HTTP threads. Run via ``tpuslice-serve`` or
+``python -m instaslice_tpu.serving.api_server``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+
+log = logging.getLogger("instaslice_tpu.serving.api")
+
+
+class _Pending:
+    def __init__(self, prompt: List[int], max_tokens: int):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        self.done = threading.Event()
+        self.result: Optional[GenerationResult] = None
+        self.error: str = ""
+
+
+class _Scheduler(threading.Thread):
+    """Owns the engine: admission, block decode, budgets, delivery."""
+
+    def __init__(self, engine: ServingEngine, block_size: int = 16):
+        super().__init__(name="serve-scheduler", daemon=True)
+        self.engine = engine
+        self.block_size = block_size
+        self.queue: "queue.Queue[_Pending]" = queue.Queue()
+        self.stop_flag = threading.Event()
+        self._by_rid: Dict[int, _Pending] = {}
+        self._budget: Dict[int, int] = {}
+
+    def submit(self, pending: _Pending) -> None:
+        self.queue.put(pending)
+
+    def run(self) -> None:
+        eng = self.engine
+        while not self.stop_flag.is_set():
+            # admit while there is room
+            while eng.free_slots():
+                try:
+                    p = self.queue.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    rid = eng.add_request(p.prompt)
+                except Exception as e:  # bad prompt (too long, empty…)
+                    p.error = f"{type(e).__name__}: {e}"
+                    p.done.set()
+                    continue
+                self._by_rid[rid] = p
+                self._budget[rid] = p.max_tokens
+            # budget enforcement BEFORE decoding (add_request already
+            # produced one token, so a max_tokens=1 arrival is done on
+            # admission — decoding first would waste a batch-wide step
+            # whose tokens get truncated away; same ordering rationale
+            # as ServingEngine.generate())
+            for slot, req in list(eng.slots.items()):
+                b = self._budget.get(req.request_id)
+                if b is not None and len(req.generated) >= b:
+                    eng.finished.append(GenerationResult(
+                        req.request_id, req.prompt, req.generated[:b],
+                        "max_new_tokens",
+                    ))
+                    del eng.slots[slot]
+            self._deliver()
+            if not eng.slots:
+                self.stop_flag.wait(0.005)
+                continue
+            # block bounded by the smallest remaining budget among OUR
+            # requests and the cache headroom (same shape as generate())
+            owned = [
+                r for r in eng.slots.values()
+                if r.request_id in self._budget
+            ]
+            n = self.block_size
+            if owned:
+                # at-budget slots were just removed: remaining >= 1
+                n = min(n, min(
+                    self._budget[r.request_id] - len(r.generated)
+                    for r in owned
+                ))
+            worst = max(
+                len(r.prompt) + len(r.generated)
+                for r in eng.slots.values()
+            )
+            n = min(n, eng.max_len - 2 - worst)
+            try:
+                if eng.draft_model is not None:
+                    eng.spec_step()
+                elif n >= 1:
+                    eng.decode_block(n)
+                else:
+                    eng.step()
+            except Exception as e:  # pragma: no cover - engine invariant
+                log.exception("decode failed: %s", e)
+            self._deliver()
+
+    def _deliver(self) -> None:
+        eng = self.engine
+        keep: List[GenerationResult] = []
+        for r in eng.finished:
+            p = self._by_rid.pop(r.request_id, None)
+            if p is None:
+                keep.append(r)        # not ours (direct engine use)
+                continue
+            b = self._budget.pop(r.request_id, None)
+            if b is not None and len(r.tokens) > b:
+                r.tokens = r.tokens[:b]
+                # the cut can drop the eos the engine finished on — the
+                # client-visible reason must describe the tokens it got
+                if (r.finished_reason == "eos"
+                        and self.engine.eos_id not in r.tokens):
+                    r.finished_reason = "max_new_tokens"
+            p.result = r
+            p.done.set()
+        eng.finished = keep
+
+    def stats(self) -> dict:
+        eng = self.engine
+        return {
+            "live_slots": len(eng.slots),
+            "free_slots": eng.free_slots(),
+            "queued": self.queue.qsize(),
+            "tokens_generated": eng.tokens_generated,
+            "max_batch": eng.max_batch,
+            "max_len": eng.max_len,
+            "speculative": eng.draft_model is not None,
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    scheduler: _Scheduler = None  # type: ignore[assignment]
+    request_timeout: float = 300.0
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.startswith("/healthz"):
+            self._send(200, {"status": "ok"})
+        elif self.path.startswith("/v1/stats"):
+            self._send(200, type(self).scheduler.stats())
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        if not self.path.startswith("/v1/completions"):
+            self._send(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            req = json.loads(self.rfile.read(n).decode() or "{}")
+            if not isinstance(req, dict):
+                raise ValueError("body must be a JSON object")
+            prompt = req.get("prompt")
+            if (not isinstance(prompt, list)
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise ValueError(
+                    "prompt must be a list of token ids (the server is "
+                    "tokenizer-free; tokenize client-side)"
+                )
+            max_tokens = int(req.get("max_tokens", 16))
+            if max_tokens < 1:
+                raise ValueError("max_tokens must be >= 1")
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        pending = _Pending(prompt, max_tokens)
+        type(self).scheduler.submit(pending)
+        if not pending.done.wait(type(self).request_timeout):
+            self._send(503, {"error": "request timed out in queue"})
+            return
+        if pending.error:
+            self._send(400, {"error": pending.error})
+            return
+        r = pending.result
+        self._send(200, {
+            "object": "text_completion",
+            "choices": [{
+                "index": 0,
+                "token_ids": r.tokens,
+                "finish_reason": r.finished_reason or "stop",
+            }],
+            "usage": {
+                "prompt_tokens": len(r.prompt),
+                "completion_tokens": len(r.tokens),
+            },
+        })
+
+
+class ApiServer:
+    """HTTP server + scheduler around an engine."""
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0, block_size: int = 16):
+        self.scheduler = _Scheduler(engine, block_size=block_size)
+        handler = type("BoundHandler", (_Handler,),
+                       {"scheduler": self.scheduler})
+        self._srv = ThreadingHTTPServer((host, port), handler)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="serve-http", daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._srv.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ApiServer":
+        self.scheduler.start()
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.scheduler.stop_flag.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "ApiServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="tpuslice-serve")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--prefill-len", type=int, default=128)
+    ap.add_argument("--d-model", type=int, default=2048)
+    ap.add_argument("--n-heads", type=int, default=16)
+    ap.add_argument("--n-layers", type=int, default=16)
+    ap.add_argument("--d-ff", type=int, default=8192)
+    ap.add_argument("--vocab-size", type=int, default=32000)
+    ap.add_argument("--checkpoint", default="",
+                    help="orbax checkpoint dir to restore params from")
+    ap.add_argument("--quantize", action="store_true",
+                    help="serve int8 weights + int8 KV cache")
+    ap.add_argument("--from-env", action="store_true",
+                    help="build the TP mesh from the granted slice's "
+                    "handoff env (TPU_* vars) instead of one device")
+    return ap
+
+
+def build_engine(args) -> ServingEngine:
+    """Model + params (optionally checkpoint-restored, optionally
+    quantized) + mesh (optionally from the handoff env) → engine.
+    Split from :func:`main` so tests drive the exact CLI wiring."""
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+
+    mesh = None
+    if args.from_env:
+        from instaslice_tpu.parallel.meshenv import (
+            SliceTopology,
+            initialize_distributed,
+            slice_mesh,
+        )
+
+        # rendezvous FIRST: jax.distributed.initialize must run before
+        # any computation initializes the backend (model init below)
+        topo = SliceTopology.from_env()
+        initialize_distributed(topo)
+        # on hardware the visible devices ARE the granted chips; off
+        # hardware (tests, CPU) cap at the slice's chip count so the
+        # mesh matches the handoff env rather than the host
+        devs = jax.devices()[: topo.num_chips]
+        mesh = slice_mesh(axes=("data", "seq", "model"),
+                          axis_sizes=(1, 1, -1), devices=devs,
+                          topo=topo)
+
+    cfg = ModelConfig(
+        vocab_size=args.vocab_size, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+        max_seq_len=args.max_len, dtype=jnp.bfloat16, remat=False,
+    )
+    model = TpuLM(cfg)
+    if args.checkpoint:
+        from instaslice_tpu.models.checkpoint import TrainCheckpointer
+
+        with TrainCheckpointer(args.checkpoint) as ckpt:
+            # template-free restore: serving only needs the params half
+            # of whatever TrainState the trainer saved
+            restored = ckpt.restore(None)
+            if restored is None:
+                raise SystemExit(
+                    f"no checkpoint found under {args.checkpoint}"
+                )
+            if isinstance(restored, dict) and "params" in restored:
+                params = restored["params"]
+            elif hasattr(restored, "params"):
+                params = restored.params
+            elif isinstance(restored, (list, tuple)) and len(restored) == 3:
+                # a template-free restore flattens TrainState into its
+                # children (step, params, opt_state)
+                params = restored[1]
+            else:
+                raise SystemExit(
+                    f"unrecognized checkpoint layout in {args.checkpoint}"
+                )
+    else:
+        # only init when there is nothing to restore: a 7B-class init
+        # tree alive NEXT TO the restored one would double weight memory
+        # exactly on the chips that can barely fit the model once
+        params = model.init(jax.random.key(0))
+    kv_quant = False
+    if args.quantize:
+        from instaslice_tpu.models.quant import quantize_params
+
+        params = quantize_params(params)
+        kv_quant = True
+    return ServingEngine(
+        model, params, max_batch=args.max_batch, max_len=args.max_len,
+        prefill_len=args.prefill_len, mesh=mesh, kv_quant=kv_quant,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    engine = build_engine(args)
+    mesh, quantized = engine.mesh, args.quantize
+    srv = ApiServer(engine, host=args.host, port=args.port).start()
+    log.info("serving on %s (mesh=%s, quantized=%s)", srv.url,
+             mesh and dict(mesh.shape), quantized)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
