@@ -29,6 +29,17 @@ def main(argv=None) -> int:
     )
     tr.add_argument("file", help="trace JSONL path")
 
+    st = sub.add_parser(
+        "status",
+        help="cluster slice status: per-node chips, health, allocations "
+        "(the `kubectl get` + `nvidia-smi` half of the reference's demo "
+        "transcript, from the CRs)",
+    )
+    st.add_argument("--kubeconfig", default="")
+    st.add_argument("--namespace", default="instaslice-tpu-system")
+    st.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+
     sb = sub.add_parser(
         "serve-bench",
         help="decode tokens/sec of the serving engine on this host's "
@@ -85,6 +96,68 @@ def main(argv=None) -> int:
                 "nHeads": args.n_heads, "dFF": args.d_ff,
             },
         }))
+        return 0
+
+    if args.cmd == "status":
+        from instaslice_tpu import KIND
+        from instaslice_tpu.api.types import TpuSlice
+        from instaslice_tpu.kube.real import build_client
+
+        client = build_client(args.kubeconfig)
+        nodes = []
+        # multi-host allocations are fanned out to every participating
+        # node's CR (controller/reconciler._write_allocation): merge by
+        # allocation id so one slice is reported ONCE, with the union of
+        # realized parts (the controller's own merged-view semantics)
+        slices: dict = {}
+        for m in sorted(
+            client.list(KIND, namespace=args.namespace),
+            key=lambda m: m["metadata"]["name"],
+        ):
+            ts = TpuSlice.from_manifest(m)
+            nodes.append({
+                "node": ts.name,
+                "generation": ts.spec.generation,
+                "chips": len(ts.spec.chips),
+                "unhealthyChips": sorted(ts.status.unhealthy_chips),
+                "prepared": len(ts.spec.prepared),
+            })
+            for aid, a in sorted(ts.spec.allocations.items()):
+                s = slices.setdefault(aid, {
+                    "id": aid,
+                    "profile": a.profile,
+                    "box": a.box,
+                    "status": a.status.value,
+                    "pods": sorted(p.pod_name for p in a.pods),
+                    "nodes": sorted(a.parts),
+                    "parts": len(a.parts),
+                    "realizedOn": set(),
+                })
+                s["realizedOn"].update(a.realized_on)
+        for s in slices.values():
+            s["realizedOn"] = sorted(s["realizedOn"])
+        out = {"nodes": nodes, "slices": sorted(
+            slices.values(), key=lambda s: s["id"]
+        )}
+        if args.as_json:
+            print(json.dumps(out))
+            return 0
+        if not nodes:
+            print(f"no {KIND} objects in namespace {args.namespace}")
+            return 0
+        for n in nodes:
+            bad = (f" unhealthy={n['unhealthyChips']}"
+                   if n["unhealthyChips"] else "")
+            print(f"{n['node']}: {n['generation']} chips={n['chips']}"
+                  f" prepared={n['prepared']}{bad}")
+        if out["slices"]:
+            print("slices:")
+        for s in out["slices"]:
+            print(f"  {s['id'][:20]:<20} {s['profile']:<10} "
+                  f"{s['box']:<14} {s['status']:<9} "
+                  f"pods={','.join(s['pods'])} "
+                  f"nodes={','.join(s['nodes'])} "
+                  f"realized={len(s['realizedOn'])}/{s['parts']}")
         return 0
 
     if args.cmd == "trace-summary":

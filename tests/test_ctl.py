@@ -1,0 +1,119 @@
+"""tpuslicectl operator CLI: catalog / plan / status.
+
+``status`` is the `kubectl get` + `nvidia-smi` half of the reference's
+README demo transcript (`/root/reference/README.md:190-300`), rebuilt
+from the CRs over a real kubeconfig + HTTP.
+"""
+
+import json
+
+import pytest
+
+from instaslice_tpu.cli.tpuslicectl import main
+
+
+class TestCatalogAndPlan:
+    def test_catalog(self, capsys):
+        assert main(["catalog", "v5e"]) == 0
+        out = capsys.readouterr().out
+        assert "v5e-2x2" in out
+
+    def test_plan(self, capsys):
+        assert main(["plan", "v5e", "v5e-2x2", "v5e-1x1"]) == 0
+        out = capsys.readouterr().out
+        assert "v5e-2x2" in out
+
+
+class TestStatus:
+    @pytest.fixture
+    def cluster_kubeconfig(self, tmp_path):
+        """A live SimCluster over HTTP + a kubeconfig pointing at it."""
+        from instaslice_tpu.sim import SimCluster
+
+        cluster = SimCluster(n_nodes=2, generation="v5e",
+                             deletion_grace_seconds=0.2,
+                             transport="http")
+        cluster.start()
+        cfg = {
+            "apiVersion": "v1", "kind": "Config",
+            "current-context": "sim",
+            "contexts": [{"name": "sim",
+                          "context": {"cluster": "sim", "user": "u"}}],
+            "clusters": [{"name": "sim",
+                          "cluster": {"server": cluster.server.url}}],
+            "users": [{"name": "u", "user": {"token": "t"}}],
+        }
+        path = tmp_path / "kubeconfig.yaml"
+        path.write_text(json.dumps(cfg))
+        try:
+            yield cluster, str(path)
+        finally:
+            cluster.stop()
+
+    def test_status_shows_grant(self, cluster_kubeconfig, capsys):
+        cluster, kubeconfig = cluster_kubeconfig
+        cluster.submit("status-pod", profile="v5e-2x2")
+        assert cluster.wait_phase("status-pod", "Running", timeout=30)
+        assert main(["status", "--kubeconfig", kubeconfig]) == 0
+        out = capsys.readouterr().out
+        assert "node-0" in out and "node-1" in out
+        assert "v5e-2x2" in out
+        assert "ungated" in out
+        assert "status-pod" in out
+
+    def test_status_json(self, cluster_kubeconfig, capsys):
+        cluster, kubeconfig = cluster_kubeconfig
+        cluster.submit("j-pod", profile="v5e-1x1")
+        assert cluster.wait_phase("j-pod", "Running", timeout=30)
+        assert main(["status", "--kubeconfig", kubeconfig,
+                     "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert len(data["nodes"]) == 2
+        assert len(data["slices"]) == 1
+        chips = {n["chips"] for n in data["nodes"]}
+        assert chips == {8}          # v5e: 8 chips per host
+
+    def test_status_empty_namespace(self, cluster_kubeconfig, capsys):
+        _, kubeconfig = cluster_kubeconfig
+        assert main(["status", "--kubeconfig", kubeconfig,
+                     "--namespace", "nothing-here"]) == 0
+        assert "no TpuSlice" in capsys.readouterr().out
+
+    def test_status_multihost_slice_reported_once(self, tmp_path, capsys):
+        """A 2-host allocation fans out to both node CRs; status must
+        merge it into ONE slice row with both nodes and the union of
+        realized parts."""
+        from instaslice_tpu.sim import SimCluster
+
+        cluster = SimCluster(n_nodes=2, generation="v5e",
+                             deletion_grace_seconds=0.2,
+                             transport="http")
+        cluster.start()
+        try:
+            cfg = {
+                "apiVersion": "v1", "kind": "Config",
+                "current-context": "sim",
+                "contexts": [{"name": "sim",
+                              "context": {"cluster": "sim", "user": "u"}}],
+                "clusters": [{"name": "sim",
+                              "cluster": {"server": cluster.server.url}}],
+                "users": [{"name": "u", "user": {"token": "t"}}],
+            }
+            path = tmp_path / "kubeconfig.yaml"
+            path.write_text(json.dumps(cfg))
+            for w in (0, 1):
+                cluster.submit(f"mh-w{w}", profile="v5e-4x4",
+                               group="mh", group_size=2)
+            for w in (0, 1):
+                assert cluster.wait_phase(f"mh-w{w}", "Running",
+                                          timeout=30), w
+            assert main(["status", "--kubeconfig", str(path),
+                         "--json"]) == 0
+            data = json.loads(capsys.readouterr().out)
+            assert len(data["slices"]) == 1          # merged, not doubled
+            s = data["slices"][0]
+            assert s["nodes"] == ["node-0", "node-1"]
+            assert s["pods"] == ["mh-w0", "mh-w1"]
+            assert len(s["realizedOn"]) == 2
+        finally:
+            cluster.stop()
