@@ -141,7 +141,8 @@ class ServingEngine:
         self._prefill = jax.jit(self._prefill_impl)
         self._decode = jax.jit(self._decode_impl)
         self._decode_block = jax.jit(
-            self._decode_block_impl, static_argnames=("n_steps", "greedy")
+            self._decode_block_impl,
+            static_argnames=("n_steps", "greedy", "attend_len"),
         )
         if draft_model is not None:
             self._draft_prefill = jax.jit(self._draft_prefill_impl)
@@ -227,7 +228,8 @@ class ServingEngine:
         return cache, logits[:, 0]                  # (B, vocab)
 
     def _decode_block_impl(self, params, cache, last_token, lengths, rng,
-                           temperature, *, n_steps: int, greedy: bool):
+                           temperature, *, n_steps: int, greedy: bool,
+                           attend_len: int = 0):
         """``n_steps`` decode steps as one ``lax.scan``: each sampled
         token feeds the next step on-device — no host round-trip inside
         the block. Returns the advanced state plus the (n_steps, B) token
@@ -241,7 +243,8 @@ class ServingEngine:
         def step(carry, i):
             cache, last, lens = carry
             logits, cache = self.model.apply_with_cache(
-                params, last[:, None], cache, lens
+                params, last[:, None], cache, lens,
+                attend_len=attend_len,
             )
             logits = logits[:, 0]
             if greedy:
@@ -419,11 +422,19 @@ class ServingEngine:
             )
         self._rng, sub = jax.random.split(self._rng)
         last_before, lengths_before = self.last_token, self.lengths
+        # decode is HBM-bound on the cache stream and every slot's depth
+        # is known host-side: attend only the live prefix, bucketed to
+        # 256-position steps (few compiled variants; bit-identical
+        # tokens — attention past a row's length is masked anyway)
+        need = worst + n_steps + 1
+        bucket = min(self.max_len, ((need + 255) // 256) * 256)
+        attend = bucket if bucket < self.max_len else 0
         self.cache, self.last_token, self.lengths, toks = (
             self._decode_block(
                 self.params, self.cache, self.last_token, self.lengths,
                 sub, jnp.float32(max(self.temperature, 1e-6)),
                 n_steps=n_steps, greedy=self.temperature <= 0.0,
+                attend_len=attend,
             )
         )
         if self.draft_model is not None:

@@ -399,3 +399,55 @@ class TestSpeculativeDecoding:
         ref = plain.decode_block(10)[rp]
         assert eng.slots[0].generated[1:] == ref[:len(
             eng.slots[0].generated) - 1]
+
+
+class TestBoundedAttentionWindow:
+    """decode_block buckets the attended cache window to the live
+    prefix (decode HBM traffic is dominated by the cache stream); the
+    tokens must be bit-identical to full-window attention."""
+
+    def test_bucketed_matches_full_window(self, model):
+        m, params = model
+        # max_len 512 with shallow slots → bucket 256 < 512 (the sliced
+        # path); the default test engines (max_len 64) never slice
+        full = ServingEngine(m, params, max_batch=2, max_len=512,
+                             prefill_len=8)
+        sliced = ServingEngine(m, params, max_batch=2, max_len=512,
+                               prefill_len=8)
+        rf = full.add_request([5, 9, 2, 7])
+        rs = sliced.add_request([5, 9, 2, 7])
+        # force the full-window variant by monkey-free means: call the
+        # jitted impl directly with attend_len=0
+        import jax.numpy as jnp
+
+        full.cache, full.last_token, full.lengths, toks = (
+            full._decode_block(
+                full.params, full.cache, full.last_token, full.lengths,
+                jax.random.key(0), jnp.float32(1e-6),
+                n_steps=10, greedy=True, attend_len=0,
+            )
+        )
+        ref = [int(t) for t in jax.device_get(toks)[:, 0]]
+        # spy that the sliced engine REALLY buckets (this exact plumbing
+        # once silently no-opped — the window must not regress to dead
+        # code that trivially equals the full path)
+        seen = {}
+        orig = sliced._decode_block
+
+        def spy(*a, **kw):
+            seen.update(kw)
+            return orig(*a, **kw)
+
+        sliced._decode_block = spy
+        got = sliced.decode_block(10)[rs]        # bucketed internally
+        assert seen.get("attend_len") == 256, seen
+        assert got == ref
+
+    def test_quant_cache_bucketed(self, model):
+        m, params = model
+        a = ServingEngine(m, params, max_batch=1, max_len=512,
+                          prefill_len=8, kv_quant=True)
+        b = ServingEngine(m, params, max_batch=1, max_len=64,
+                          prefill_len=8, kv_quant=True)
+        ra, rb = a.add_request([9, 3, 1]), b.add_request([9, 3, 1])
+        assert a.decode_block(8)[ra] == b.decode_block(8)[rb]
