@@ -106,13 +106,56 @@ class OperatorMetrics:
         )
 
 
+class ServingMetrics:
+    """Metrics for the serving front-end (serving/api_server.py) — the
+    operator-side view of a granted slice doing inference work."""
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None):
+        if not _PROM:
+            self.requests = _NoopMetric()
+            self.tokens = _NoopMetric()
+            self.queue_depth = _NoopMetric()
+            self.live_slots = _NoopMetric()
+            self.request_seconds = _NoopMetric()
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        self.requests = Counter(
+            "tpuslice_serve_requests_total",
+            "Completion requests by outcome",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.tokens = Counter(
+            "tpuslice_serve_tokens_total",
+            "Tokens returned to clients",
+            registry=self.registry,
+        )
+        self.queue_depth = Gauge(
+            "tpuslice_serve_queue_depth",
+            "Requests waiting for a slot",
+            registry=self.registry,
+        )
+        self.live_slots = Gauge(
+            "tpuslice_serve_live_slots",
+            "Slots currently decoding",
+            registry=self.registry,
+        )
+        self.request_seconds = Histogram(
+            "tpuslice_serve_request_seconds",
+            "Wall time from admission-queue entry to completion",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
+            registry=self.registry,
+        )
+
+
 _server_started = threading.Lock()
 
 
-def start_metrics_server(
-    metrics: OperatorMetrics, port: int, host: str = ""
-) -> bool:
+def start_metrics_server(metrics, port: int, host: str = "") -> bool:
     """Serve ``metrics.registry`` on ``host:port``; False if unavailable.
+    ``metrics`` is any holder with a ``registry`` attribute
+    (:class:`OperatorMetrics`, :class:`ServingMetrics`).
 
     ``host`` matters: the kube-rbac-proxy deployment binds the manager to
     127.0.0.1 so the sidecar is the only path to /metrics

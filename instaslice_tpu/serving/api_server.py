@@ -29,6 +29,7 @@ import json
 import logging
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
@@ -44,12 +45,15 @@ class _Pending:
         self.done = threading.Event()
         self.result: Optional[GenerationResult] = None
         self.error: str = ""
+        self.timed_out = False        # set by the HTTP layer on 503
+        self.t0 = time.monotonic()
 
 
 class _Scheduler(threading.Thread):
     """Owns the engine: admission, block decode, budgets, delivery."""
 
-    def __init__(self, engine: ServingEngine, block_size: int = 16):
+    def __init__(self, engine: ServingEngine, block_size: int = 16,
+                 metrics=None):
         super().__init__(name="serve-scheduler", daemon=True)
         self.engine = engine
         self.block_size = block_size
@@ -57,6 +61,11 @@ class _Scheduler(threading.Thread):
         self.stop_flag = threading.Event()
         self._by_rid: Dict[int, _Pending] = {}
         self._budget: Dict[int, int] = {}
+        if metrics is None:
+            from instaslice_tpu.metrics.metrics import ServingMetrics
+
+            metrics = ServingMetrics()
+        self.metrics = metrics
 
     def submit(self, pending: _Pending) -> None:
         self.queue.put(pending)
@@ -74,6 +83,7 @@ class _Scheduler(threading.Thread):
                     rid = eng.add_request(p.prompt)
                 except Exception as e:  # bad prompt (too long, empty…)
                     p.error = f"{type(e).__name__}: {e}"
+                    self.metrics.requests.labels(outcome="rejected").inc()
                     p.done.set()
                     continue
                 self._by_rid[rid] = p
@@ -126,6 +136,8 @@ class _Scheduler(threading.Thread):
 
     def _deliver(self) -> None:
         eng = self.engine
+        self.metrics.queue_depth.set(self.queue.qsize())
+        self.metrics.live_slots.set(len(eng.slots))
         keep: List[GenerationResult] = []
         for r in eng.finished:
             p = self._by_rid.pop(r.request_id, None)
@@ -141,6 +153,15 @@ class _Scheduler(threading.Thread):
                         and self.engine.eos_id not in r.tokens):
                     r.finished_reason = "max_new_tokens"
             p.result = r
+            # a request the HTTP layer already 503'd must not read as a
+            # success on the dashboard — the client never got the tokens
+            outcome = "timeout" if p.timed_out else "ok"
+            self.metrics.requests.labels(outcome=outcome).inc()
+            if not p.timed_out:
+                self.metrics.tokens.inc(len(r.tokens))
+            self.metrics.request_seconds.observe(
+                time.monotonic() - p.t0
+            )
             p.done.set()
         eng.finished = keep
 
@@ -154,6 +175,7 @@ class _Scheduler(threading.Thread):
             "max_batch": eng.max_batch,
             "max_len": eng.max_len,
             "speculative": eng.draft_model is not None,
+            "mesh": dict(eng.mesh.shape) if eng.mesh is not None else None,
         }
 
 
@@ -205,6 +227,7 @@ class _Handler(BaseHTTPRequestHandler):
         pending = _Pending(prompt, max_tokens)
         type(self).scheduler.submit(pending)
         if not pending.done.wait(type(self).request_timeout):
+            pending.timed_out = True
             self._send(503, {"error": "request timed out in queue"})
             return
         if pending.error:
@@ -229,8 +252,9 @@ class ApiServer:
     """HTTP server + scheduler around an engine."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0, block_size: int = 16):
-        self.scheduler = _Scheduler(engine, block_size=block_size)
+                 port: int = 0, block_size: int = 16, metrics=None):
+        self.scheduler = _Scheduler(engine, block_size=block_size,
+                                    metrics=metrics)
         handler = type("BoundHandler", (_Handler,),
                        {"scheduler": self.scheduler})
         self._srv = ThreadingHTTPServer((host, port), handler)
@@ -265,6 +289,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="tpuslice-serve")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="Prometheus /metrics port (0 = off)")
     ap.add_argument("--max-batch", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=1024)
     ap.add_argument("--prefill-len", type=int, default=128)
@@ -364,6 +390,12 @@ def main(argv=None) -> int:
     engine = build_engine(args)
     mesh, quantized = engine.mesh, args.quantize
     srv = ApiServer(engine, host=args.host, port=args.port).start()
+    if args.metrics_port:
+        from instaslice_tpu.metrics.metrics import start_metrics_server
+
+        start_metrics_server(
+            srv.scheduler.metrics, args.metrics_port, host=args.host
+        )
     log.info("serving on %s (mesh=%s, quantized=%s)", srv.url,
              mesh and dict(mesh.shape), quantized)
     try:

@@ -204,3 +204,113 @@ class TestBuildEngineCli:
         assert jnp.allclose(
             got.astype(jnp.float32), want.astype(jnp.float32)
         )
+
+
+class TestGrantToServe:
+    """The whole story in one test: operator grants a slice → agent
+    publishes the handoff env → tpuslice-serve (a REAL subprocess) joins
+    with --from-env, builds the mesh from that env, and serves a
+    completion over HTTP. This is what samples/native-serve.yaml does in
+    a cluster."""
+
+    def test_granted_env_serves_completions(self, tmp_path):
+        import os
+        import subprocess
+        import sys
+        import time
+        from pathlib import Path
+
+        from conftest import free_port, wait_until
+        from instaslice_tpu.sim import SimCluster
+
+        with SimCluster(n_nodes=1, generation="v5e",
+                        deletion_grace_seconds=0.2) as c:
+            c.submit("serve-pod", profile="v5e-2x2")
+            assert c.wait_phase("serve-pod", "Running", timeout=30)
+            cm = c.configmap("serve-pod")
+            handoff = dict(cm["data"])
+
+        port = free_port()
+        env = {
+            "PATH": "/usr/bin:/bin",
+            "PYTHONPATH": str(Path(__file__).resolve().parent.parent),
+            # CPU-only child (and we must not touch a single-claim TPU
+            # tunnel from a second process); 8 virtual devices so the
+            # 4-chip grant's mesh has devices to cap from
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            **handoff,
+        }
+        code = (
+            "import jax;"
+            "jax.config.update('jax_platforms','cpu');"
+            "jax.config.update('jax_num_cpu_devices',8);"
+            "from instaslice_tpu.serving.api_server import main;"
+            f"main(['--host','127.0.0.1','--port','{port}',"
+            "'--d-model','32','--n-heads','4','--n-layers','2',"
+            "'--d-ff','64','--vocab-size','64','--max-len','64',"
+            "'--prefill-len','8','--max-batch','2','--from-env'])"
+        )
+        log = open(tmp_path / "serve.log", "w+")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=log, stderr=subprocess.STDOUT,
+        )
+        try:
+            def ready():
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=1
+                    ) as r:
+                        return r.status == 200
+                except Exception:
+                    return False
+
+            wait_until(
+                ready, 90, "server ready",
+                lambda: Path(log.name).read_text()[-800:],
+            )
+            code_, out = post(f"http://127.0.0.1:{port}",
+                              {"prompt": [5, 9, 2, 7], "max_tokens": 4})
+            assert code_ == 200, out
+            assert len(out["choices"][0]["token_ids"]) == 4
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/stats", timeout=5
+            ) as r:
+                stats = json.loads(r.read().decode())
+            assert stats["tokens_generated"] >= 4
+            # the mesh really came from the 2x2 grant's handoff env:
+            # 4 chips, all on the model axis
+            assert stats["mesh"] == {"data": 1, "seq": 1, "model": 4}
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.close()
+
+
+class TestServingMetrics:
+    def test_counters_track_requests(self, model):
+        from instaslice_tpu.metrics.metrics import ServingMetrics
+
+        m, params = model
+        metrics = ServingMetrics()
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, metrics=metrics) as srv:
+            code, _ = post(srv.url, {"prompt": [5, 9, 2], "max_tokens": 3})
+            assert code == 200
+            code, _ = post(srv.url, {"prompt": [1] * 80, "max_tokens": 2})
+            assert code == 400           # too long → rejected
+        if metrics.registry is None:
+            pytest.skip("prometheus_client unavailable")
+        from prometheus_client import generate_latest
+
+        body = generate_latest(metrics.registry).decode()
+        assert 'tpuslice_serve_requests_total{outcome="ok"} 1.0' in body
+        assert ('tpuslice_serve_requests_total{outcome="rejected"} 1.0'
+                in body)
+        assert "tpuslice_serve_tokens_total 3.0" in body
+        assert "tpuslice_serve_request_seconds_bucket" in body
