@@ -221,6 +221,20 @@ class _Handler(BaseHTTPRequestHandler):
             max_tokens = int(req.get("max_tokens", 16))
             if max_tokens < 1:
                 raise ValueError("max_tokens must be >= 1")
+            # sampling config is engine-level (slots share one compiled
+            # decode program); reject mismatching per-request values
+            # instead of silently ignoring them
+            eng = type(self).scheduler.engine
+            for key, have in (("temperature", eng.temperature),
+                              ("top_k", eng.top_k),
+                              ("top_p", eng.top_p)):
+                want = req.get(key)
+                if want is not None and float(want) != float(have):
+                    raise ValueError(
+                        f"{key} is engine-level on this server "
+                        f"(running with {key}={have}); restart "
+                        f"tpuslice-serve with --{key.replace('_', '-')}"
+                    )
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             self._send(400, {"error": str(e)})
             return
@@ -303,6 +317,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="orbax checkpoint dir to restore params from")
     ap.add_argument("--quantize", action="store_true",
                     help="serve int8 weights + int8 KV cache")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy; sampling config is engine-level "
+                    "(one compiled program per setting)")
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=1.0)
     ap.add_argument("--from-env", action="store_true",
                     help="build the TP mesh from the granted slice's "
                     "handoff env (TPU_* vars) instead of one device")
@@ -381,6 +400,7 @@ def build_engine(args) -> ServingEngine:
     return ServingEngine(
         model, params, max_batch=args.max_batch, max_len=args.max_len,
         prefill_len=args.prefill_len, mesh=mesh, kv_quant=kv_quant,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
     )
 
 

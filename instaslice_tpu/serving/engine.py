@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from instaslice_tpu.models.lm import Params, TpuLM, param_specs
+from instaslice_tpu.serving.sampling import filter_logits
 
 
 @dataclasses.dataclass
@@ -80,6 +81,8 @@ class ServingEngine:
         draft_model: Optional[TpuLM] = None,
         draft_params: Optional[Params] = None,
         spec_k: int = 4,
+        top_k: int = 0,
+        top_p: float = 1.0,
     ) -> None:
         """``kv_quant=True`` stores the KV cache as int8 with per-vector
         scales (``TpuLM.init_cache(quant=True)``): decode streams the
@@ -104,6 +107,17 @@ class ServingEngine:
         self.max_len = max_len
         self.prefill_len = prefill_len
         self.temperature = temperature
+        # sampling filters (applied only when temperature > 0); BOTH are
+        # compile-keyed statics in the block-decode path (top_k changes
+        # traced shapes via lax.top_k; top_p gates a Python-level branch
+        # in filter_logits), so mutating them recompiles instead of
+        # silently replaying the first trace
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        self.top_k = top_k
+        self.top_p = top_p
         self.eos_id = eos_id
         self.mesh = mesh
         self._rng = jax.random.key(seed)
@@ -142,7 +156,8 @@ class ServingEngine:
         self._decode = jax.jit(self._decode_impl)
         self._decode_block = jax.jit(
             self._decode_block_impl,
-            static_argnames=("n_steps", "greedy", "attend_len"),
+            static_argnames=("n_steps", "greedy", "attend_len",
+                             "top_k", "top_p"),
         )
         if draft_model is not None:
             self._draft_prefill = jax.jit(self._draft_prefill_impl)
@@ -228,8 +243,9 @@ class ServingEngine:
         return cache, logits[:, 0]                  # (B, vocab)
 
     def _decode_block_impl(self, params, cache, last_token, lengths, rng,
-                           temperature, *, n_steps: int, greedy: bool,
-                           attend_len: int = 0):
+                           temperature, *, n_steps: int,
+                           greedy: bool, attend_len: int = 0,
+                           top_k: int = 0, top_p: float = 1.0):
         """``n_steps`` decode steps as one ``lax.scan``: each sampled
         token feeds the next step on-device — no host round-trip inside
         the block. Returns the advanced state plus the (n_steps, B) token
@@ -250,9 +266,14 @@ class ServingEngine:
             if greedy:
                 toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
+                # temperature FIRST, then the nucleus: the top_p set is
+                # defined over the tempered distribution (the standard
+                # order OpenAI/HF clients are calibrated against)
+                logits = filter_logits(
+                    logits / temperature, top_k, top_p
+                )
                 toks = jax.random.categorical(
-                    jax.random.fold_in(rng, i),
-                    logits / temperature, axis=-1,
+                    jax.random.fold_in(rng, i), logits, axis=-1,
                 ).astype(jnp.int32)
             return (cache, toks, lens + 1), toks
 
@@ -309,9 +330,13 @@ class ServingEngine:
         if self.temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         self._rng, sub = jax.random.split(self._rng)
-        return jax.random.categorical(
-            sub, logits / self.temperature, axis=-1
-        ).astype(jnp.int32)
+        # temperature first, then the nucleus (see _decode_block_impl)
+        logits = filter_logits(
+            logits / self.temperature, self.top_k, self.top_p
+        )
+        return jax.random.categorical(sub, logits, axis=-1).astype(
+            jnp.int32
+        )
 
     # -------------------------------------------------------------- public
 
@@ -434,7 +459,8 @@ class ServingEngine:
                 self.params, self.cache, self.last_token, self.lengths,
                 sub, jnp.float32(max(self.temperature, 1e-6)),
                 n_steps=n_steps, greedy=self.temperature <= 0.0,
-                attend_len=attend,
+                attend_len=attend, top_k=self.top_k,
+                top_p=float(self.top_p),
             )
         )
         if self.draft_model is not None:

@@ -1,0 +1,56 @@
+"""On-device sampling filters: top-k and nucleus (top-p).
+
+Pure, jit-friendly logit transforms shared by the engine's host-side
+``_sample`` and the on-device block-decode scan — both paths must apply
+the same filters or interactive and block decoding would sample from
+different distributions.
+
+TPU notes: ``top_k`` uses ``lax.top_k`` (no full sort); ``top_p`` sorts
+the vocab once per step — a (B, V) descending sort is a cheap XLA sort
+next to the decode matmuls, and everything stays static-shaped (the
+nucleus boundary is a mask, never a dynamic slice).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# numpy, not jnp: a module-level jnp scalar would initialize the jax
+# backend at import time (see parallel/ring.py)
+_NEG = np.float32(-1e9)
+
+
+def filter_logits(
+    logits: jax.Array, top_k: int = 0, top_p: float = 1.0
+) -> jax.Array:
+    """Mask ``logits`` (…, V) outside the top-k / nucleus to -inf.
+
+    ``top_k <= 0`` and ``top_p >= 1`` are no-ops. ``top_p`` keeps the
+    smallest set of tokens whose probabilities sum to at least ``top_p``
+    (the token crossing the threshold is kept, matching the standard
+    nucleus-sampling definition). Filters compose: top-k first, then
+    nucleus over the survivors.
+    """
+    logits = logits.astype(jnp.float32)
+    if top_k and top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # drop tokens whose cumulative mass BEFORE them already reached
+        # top_p (the crossing token stays); the argmax is NEVER dropped,
+        # so a degenerate top_p <= 0 degrades to greedy rather than to
+        # uniform-over-the-vocab garbage
+        idx = jnp.arange(logits.shape[-1])
+        drop_sorted = ((cum - probs) >= top_p) & (idx > 0)
+        # threshold logit = smallest kept logit; everything below drops
+        threshold = jnp.min(
+            jnp.where(drop_sorted, jnp.inf, sorted_logits),
+            axis=-1, keepdims=True,
+        )
+        logits = jnp.where(logits < threshold, _NEG, logits)
+    return logits

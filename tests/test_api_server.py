@@ -314,3 +314,29 @@ class TestServingMetrics:
                 in body)
         assert "tpuslice_serve_tokens_total 3.0" in body
         assert "tpuslice_serve_request_seconds_bucket" in body
+
+
+class TestSamplingConfig:
+    def test_mismatched_request_sampling_rejected(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=32,
+                            prefill_len=8)
+        with ApiServer(eng) as srv:
+            code, out = post(srv.url, {"prompt": [1, 2],
+                                       "max_tokens": 2,
+                                       "temperature": 0.9})
+            assert code == 400 and "engine-level" in out["error"]
+            # matching values pass through
+            code, out = post(srv.url, {"prompt": [1, 2], "max_tokens": 2,
+                                       "temperature": 0.0, "top_p": 1.0})
+            assert code == 200
+
+    def test_sampled_server(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=32,
+                            prefill_len=8, temperature=0.9, top_k=4)
+        with ApiServer(eng) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9], "max_tokens": 4,
+                                       "temperature": 0.9})
+            assert code == 200
+            assert len(out["choices"][0]["token_ids"]) == 4

@@ -451,3 +451,86 @@ class TestBoundedAttentionWindow:
                           prefill_len=8, kv_quant=True)
         ra, rb = a.add_request([9, 3, 1]), b.add_request([9, 3, 1])
         assert a.decode_block(8)[ra] == b.decode_block(8)[rb]
+
+
+class TestSamplingFilters:
+    """top-k / nucleus sampling: the filter math, and that BOTH sample
+    paths (host _sample and the on-device block scan) apply it."""
+
+    def test_filter_logits_top_k(self):
+        from instaslice_tpu.serving.sampling import filter_logits
+
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+        out = filter_logits(logits, top_k=2)
+        kept = [i for i in range(5) if float(out[0, i]) > -1e8]
+        assert kept == [1, 4]                     # the two largest
+
+    def test_filter_logits_top_p(self):
+        from instaslice_tpu.serving.sampling import filter_logits
+
+        # probs ≈ [0.64, 0.24, 0.09, 0.03]: top_p=0.7 keeps the first
+        # two (0.64 < 0.7, crossing token kept)
+        logits = jnp.log(jnp.asarray([[0.64, 0.24, 0.09, 0.03]]))
+        out = filter_logits(logits, top_p=0.7)
+        kept = [i for i in range(4) if float(out[0, i]) > -1e8]
+        assert kept == [0, 1]
+
+    def test_filter_degenerate_top_p_keeps_argmax(self):
+        from instaslice_tpu.serving.sampling import filter_logits
+
+        logits = jnp.asarray([[1.0, 5.0, 3.0]])
+        out = filter_logits(logits, top_p=1e-9)
+        kept = [i for i in range(3) if float(out[0, i]) > -1e8]
+        assert kept == [1]            # greedy, never uniform garbage
+
+    def test_engine_validates_sampling_ranges(self, model):
+        m, params = model
+        with pytest.raises(ValueError, match="top_p"):
+            ServingEngine(m, params, top_p=0.0)
+        with pytest.raises(ValueError, match="top_k"):
+            ServingEngine(m, params, top_k=-1)
+
+    def test_filter_noop_defaults(self):
+        from instaslice_tpu.serving.sampling import filter_logits
+
+        logits = jax.random.normal(jax.random.key(0), (2, 16))
+        out = filter_logits(logits)
+        assert jnp.allclose(out, logits)
+
+    def test_top_k_one_equals_greedy(self, model):
+        """temperature > 0 with top_k=1 must reproduce the greedy chain
+        on BOTH paths — the filter leaves a single candidate."""
+        m, params = model
+        greedy = ServingEngine(m, params, max_batch=1, max_len=64,
+                               prefill_len=8)
+        rg = greedy.add_request([5, 9, 2, 7])
+        ref = greedy.decode_block(8)[rg]
+        sampled = ServingEngine(m, params, max_batch=1, max_len=64,
+                                prefill_len=8, temperature=0.8, top_k=1)
+        rs = sampled.add_request([5, 9, 2, 7])
+        assert sampled.decode_block(8)[rs] == ref      # block path
+        stepped = ServingEngine(m, params, max_batch=1, max_len=64,
+                                prefill_len=8, temperature=0.8, top_k=1)
+        rt = stepped.add_request([5, 9, 2, 7])
+        got = [stepped.step()[rt] for _ in range(8)]
+        assert got == ref                              # host path
+
+    def test_sampled_tokens_within_top_k(self, model):
+        """With top_k=3, every sampled token must be among the 3 most
+        likely next tokens of the oracle at that position."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, temperature=1.0, top_k=3)
+        prompt = [5, 9, 2, 7]
+        rid = eng.add_request(prompt)
+        chain = [next(iter(eng.slots.values())).generated[0]]
+        chain += eng.decode_block(6)[rid]
+        toks = list(prompt)
+        for t in chain:
+            logits = m.apply(params, jnp.asarray(toks, jnp.int32)[None])
+            top3 = set(
+                int(i) for i in
+                jnp.argsort(logits[0, -1])[::-1][:3]
+            )
+            assert t in top3, (t, top3)
+            toks.append(t)
