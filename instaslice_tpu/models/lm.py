@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from instaslice_tpu.models.quant import embed_lookup, weight
+from instaslice_tpu.parallel.pipeline import REMAT_POLICIES, apply_remat
 
 Params = Dict[str, Any]
 
@@ -51,11 +52,29 @@ class ModelConfig:
     # over the "model" axis (expert parallelism).
     n_experts: int = 0
     remat: bool = True
+    # what the block-level jax.checkpoint may KEEP for the backward:
+    # "full"  — keep only block inputs, recompute the whole block
+    #           (max memory savings; hardware recomputes the forward,
+    #           so HFU ≈ 4/3 × MFU);
+    # "dots"  — keep every matmul output, recompute only the cheap
+    #           elementwise/VPU work (HFU ≈ MFU at a fraction of
+    #           "full"'s recompute; memory between "full" and no remat).
+    # Ignored when ``remat`` is False.
+    remat_policy: str = "full"
     # attention backend: "auto" (pallas flash kernel on TPU, XLA
     # elsewhere), "flash" (force the kernel; interpreted off-TPU), or
     # "xla" (plain formulation). Ring attention ignores this — it has its
     # own flash-style inner loop over ICI ring steps.
     attention_impl: str = "auto"
+
+    def __post_init__(self) -> None:
+        # catch a typo at construction, not deep inside tracing (and even
+        # when remat is off, so flipping it on later cannot surface one)
+        if self.remat_policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} "
+                f"(want one of {REMAT_POLICIES})"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -335,7 +354,7 @@ class TpuLM:
 
         body = block
         if cfg.remat:
-            body = jax.checkpoint(block)
+            body = apply_remat(block, cfg.remat_policy)
         x, _ = lax.scan(body, x, params["blocks"])
         x = _rmsnorm(x, params["ln_f"]["scale"])
         logits = jnp.einsum(
@@ -383,7 +402,7 @@ class TpuLM:
         x = pipeline_blocks(
             block_fn, params["blocks"], x,
             mesh=mesh, axis_name=axis_name, n_micro=n_micro,
-            remat=cfg.remat,
+            remat=cfg.remat, remat_policy=cfg.remat_policy,
         )
         x = _rmsnorm(x, params["ln_f"]["scale"])
         return jnp.einsum(

@@ -39,6 +39,29 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 Params = Dict[str, Any]
 
+#: remat_policy names → what block-level ``jax.checkpoint`` may keep
+#: (see ``ModelConfig.remat_policy``); shared by the scan stack and the
+#: pipeline stage body so the two paths cannot drift.
+REMAT_POLICIES = ("full", "dots")
+
+
+def apply_remat(fn, policy_name: str):
+    """Wrap ``fn`` in block-level rematerialization with a named
+    keep-policy: ``"full"`` keeps only block inputs (max memory savings,
+    forward re-run in the backward), ``"dots"`` keeps matmul outputs and
+    recomputes only elementwise work (HFU ≈ MFU)."""
+    if policy_name == "full":
+        return jax.checkpoint(fn)
+    if policy_name == "dots":
+        return jax.checkpoint(
+            fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    raise ValueError(
+        f"unknown remat policy {policy_name!r} (want one of "
+        f"{REMAT_POLICIES})"
+    )
+
 
 def pipeline_blocks(
     block_fn: Callable[[Params, jax.Array], jax.Array],
@@ -49,6 +72,7 @@ def pipeline_blocks(
     n_micro: int,
     axis_name: str = "pipe",
     remat: bool = True,
+    remat_policy: str = "full",
 ) -> jax.Array:
     """Apply ``L`` stacked layers to ``x`` (B, S, D), pipelined.
 
@@ -77,7 +101,7 @@ def pipeline_blocks(
     )
     x_mb = x.reshape((M, B // M) + x.shape[1:])
 
-    layer_body = jax.checkpoint(block_fn) if remat else block_fn
+    layer_body = apply_remat(block_fn, remat_policy) if remat else block_fn
 
     def stage(params_stage, x_mb):
         # params_stage leaves: (1, L/P, ...) — this stage's layer block

@@ -49,10 +49,11 @@ class TestPipelineForward:
         b = m.apply_pipelined(params, toks, mesh=mesh, n_micro=8)
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
-    def test_remat_stage_matches(self):
+    @pytest.mark.parametrize("policy", ["full", "dots"])
+    def test_remat_stage_matches(self, policy):
         cfg = ModelConfig(
             vocab_size=64, d_model=32, n_heads=2, n_layers=4, d_ff=64,
-            dtype=jnp.float32, remat=True,
+            dtype=jnp.float32, remat=True, remat_policy=policy,
         )
         m = TpuLM(cfg)
         params = m.init(jax.random.key(0))

@@ -163,6 +163,45 @@ class TestTrainStep:
         assert int(state.step) == 2
         assert np.isfinite(float(loss))
 
+    def test_remat_policies_agree(self):
+        """remat none / full / dots are pure memory-vs-FLOPs trades —
+        the loss (and thus gradients up to fp reassociation) must match."""
+        devs = jax.devices()[:2]
+        mesh = Mesh(np.array(devs).reshape(1, 1, 2),
+                    ("data", "seq", "model"))
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 32), 0, 128, jnp.int32
+        )
+        losses = {}
+        for label, remat, policy in (
+            ("none", False, "full"),
+            ("full", True, "full"),
+            ("dots", True, "dots"),
+        ):
+            cfg = ModelConfig(
+                vocab_size=128, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, dtype=jnp.float32, remat=remat,
+                remat_policy=policy,
+            )
+            init_fn, step_fn = make_train_step(TpuLM(cfg), mesh)
+            state = init_fn(jax.random.key(0))
+            state, loss = step_fn(state, tokens)
+            _, loss2 = step_fn(state, tokens)
+            losses[label] = (float(loss), float(loss2))
+        ref = losses["none"]
+        for label, pair in losses.items():
+            assert pair == pytest.approx(ref, rel=1e-5), (label, losses)
+
+    def test_remat_policy_unknown_raises_at_construction(self):
+        # even with remat off: flipping it on later must not be the
+        # first place a typo surfaces
+        with pytest.raises(ValueError, match="remat_policy"):
+            ModelConfig(
+                vocab_size=128, d_model=32, n_heads=4, n_layers=2,
+                d_ff=64, dtype=jnp.float32, remat=False,
+                remat_policy="bogus",
+            )
+
     def test_params_actually_sharded(self):
         devs = jax.devices()[:8]
         mesh = Mesh(np.array(devs).reshape(2, 1, 4),
