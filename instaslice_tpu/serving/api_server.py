@@ -12,6 +12,11 @@ batched completions over HTTP.
   keep the server tokenizer-free — the tokenizer belongs to the client
   model stack, not the slice operator.
 - ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters.
+- ``POST /v1/prefixes`` with ``{"tokens": [token ids]}`` → prefill the
+  shared prefix once; later prompts starting with it skip that prefill
+  (engine prefix cache; length must be a multiple of the prefill chunk;
+  capped at the engine's ``max_prefixes`` — each stripe pins HBM).
+  ``DELETE /v1/prefixes`` with the same body frees the stripe.
 
 One scheduler thread owns the engine (the engine is not thread-safe by
 design — XLA dispatch is serialized anyway): it admits queued requests
@@ -39,9 +44,13 @@ log = logging.getLogger("instaslice_tpu.serving.api")
 
 
 class _Pending:
-    def __init__(self, prompt: List[int], max_tokens: int):
+    def __init__(self, prompt: List[int], max_tokens: int,
+                 prefix_op: str = ""):
         self.prompt = prompt
         self.max_tokens = max_tokens
+        # "register"/"drop" → not a completion: mutate the engine's
+        # prefix cache on the scheduler thread (the engine owner)
+        self.prefix_op = prefix_op
         self.done = threading.Event()
         self.result: Optional[GenerationResult] = None
         self.error: str = ""
@@ -79,6 +88,18 @@ class _Scheduler(threading.Thread):
                     p = self.queue.get_nowait()
                 except queue.Empty:
                     break
+                if p.prefix_op:
+                    # register needs a free slot to prefill through,
+                    # which the admission loop just guaranteed
+                    try:
+                        if p.prefix_op == "register":
+                            eng.register_prefix(p.prompt)
+                        elif not eng.drop_prefix(p.prompt):
+                            p.error = "ValueError: no such prefix"
+                    except Exception as e:
+                        p.error = f"{type(e).__name__}: {e}"
+                    p.done.set()
+                    continue
                 try:
                     rid = eng.add_request(p.prompt)
                 except Exception as e:  # bad prompt (too long, empty…)
@@ -176,6 +197,9 @@ class _Scheduler(threading.Thread):
             "max_len": eng.max_len,
             "speculative": eng.draft_model is not None,
             "mesh": dict(eng.mesh.shape) if eng.mesh is not None else None,
+            "prefixes": len(eng.prefixes),
+            "prefix_hits": eng.prefix_hits,
+            "prefix_tokens_saved": eng.prefix_tokens_saved,
         }
 
 
@@ -203,6 +227,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
+        if self.path.startswith("/v1/prefixes"):
+            self._prefix_request("register")
+            return
         if not self.path.startswith("/v1/completions"):
             self._send(404, {"error": f"no route {self.path}"})
             return
@@ -260,6 +287,41 @@ class _Handler(BaseHTTPRequestHandler):
                 "completion_tokens": len(r.tokens),
             },
         })
+
+
+    def do_DELETE(self):
+        if self.path.startswith("/v1/prefixes"):
+            self._prefix_request("drop")
+        else:
+            self._send(404, {"error": f"no route {self.path}"})
+
+    def _prefix_request(self, op: str) -> None:
+        """POST /v1/prefixes {"tokens": [...]} — prefill once, reuse for
+        every prompt that starts with it; DELETE with the same body
+        frees the stored stripe (``ServingEngine.register_prefix`` /
+        ``drop_prefix``, run on the scheduler thread)."""
+        try:
+            n = int(self.headers.get("Content-Length", "0") or 0)
+            req = json.loads(self.rfile.read(n).decode() or "{}")
+            tokens = req.get("tokens") if isinstance(req, dict) else None
+            if (not isinstance(tokens, list)
+                    or not all(isinstance(t, int) for t in tokens)):
+                raise ValueError("tokens must be a list of token ids")
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        pending = _Pending(tokens, 0, prefix_op=op)
+        type(self).scheduler.submit(pending)
+        if not pending.done.wait(type(self).request_timeout):
+            pending.timed_out = True
+            self._send(503, {"error": "request timed out in queue"})
+            return
+        if pending.error:
+            code = 404 if "no such prefix" in pending.error else 400
+            self._send(code, {"error": pending.error})
+            return
+        key = "registered" if op == "register" else "dropped"
+        self._send(200, {key: len(tokens)})
 
 
 class ApiServer:

@@ -33,6 +33,12 @@ built TPU-first instead of translated:
   collectives. Prefill and decode stay the same two compiled programs.
   This is how a multi-chip grant (e.g. the BASELINE 2x2 v5e slice for a
   7B-class model that cannot fit one chip) is consumed.
+- **Prefix caching**: :meth:`register_prefix` prefills a shared prompt
+  prefix once and stores its KV stripe; any prompt starting with it
+  copies the stripe in (one on-device write) instead of re-running
+  prefill. vLLM's automatic prefix caching made explicit and
+  static-shape: prefixes end on chunk boundaries, so admission reuses
+  the one compiled prefill program for the remainder.
 """
 
 from __future__ import annotations
@@ -64,6 +70,15 @@ class _Slot:
     generated: List[int]
 
 
+@dataclasses.dataclass
+class _Prefix:
+    """A registered shared prompt prefix: its prefilled KV stripe(s),
+    ready to be copied into any slot instead of re-running prefill."""
+    tokens: tuple                      # the prefix token ids
+    stripe: Params                     # cache leaves (L, 1, T, H, …)
+    draft_stripe: Optional[Params]     # ditto for the speculative draft
+
+
 class ServingEngine:
     def __init__(
         self,
@@ -83,6 +98,7 @@ class ServingEngine:
         spec_k: int = 4,
         top_k: int = 0,
         top_p: float = 1.0,
+        max_prefixes: int = 8,
     ) -> None:
         """``kv_quant=True`` stores the KV cache as int8 with per-vector
         scales (``TpuLM.init_cache(quant=True)``): decode streams the
@@ -130,6 +146,15 @@ class ServingEngine:
         self.slots: Dict[int, _Slot] = {}          # slot index → request
         self.finished: List[GenerationResult] = []
         self.tokens_generated = 0
+        # prefix cache: registered prompt prefixes → stored KV stripes
+        # (:meth:`register_prefix`); admission auto-matches the longest.
+        # Each stripe pins HBM for the engine's lifetime, so the count is
+        # capped — registration past the cap raises (drop one first);
+        # explicit beats silent eviction for an operator-driven cache.
+        self.prefixes: Dict[tuple, _Prefix] = {}
+        self.max_prefixes = max_prefixes
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
 
         self.draft_model = draft_model
         self.spec_k = spec_k
@@ -153,6 +178,12 @@ class ServingEngine:
                 )
 
         self._prefill = jax.jit(self._prefill_impl)
+        # stripe length is a static shape: one compile per distinct
+        # registered-prefix length (chunk multiples keep the set small)
+        self._read_stripe = jax.jit(
+            self._read_stripe_impl, static_argnames=("length",)
+        )
+        self._write_stripe = jax.jit(self._write_stripe_impl)
         self._decode = jax.jit(self._decode_impl)
         self._decode_block = jax.jit(
             self._decode_block_impl,
@@ -235,6 +266,26 @@ class ServingEngine:
         return self._prefill_stripe(
             self.model, params, cache, tokens, slot, offset
         )
+
+    def _read_stripe_impl(self, cache, slot, *, length: int):
+        """Copy out one slot's cache positions [0, length) — every leaf
+        is (L, B, S, H, …) with slot on axis 1 and position on axis 2."""
+
+        def rd(c):
+            one = jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
+            return jax.lax.slice_in_dim(one, 0, length, axis=2)
+
+        return jax.tree.map(rd, cache)
+
+    def _write_stripe_impl(self, cache, stripe, slot):
+        """Write a stored stripe into a slot at position 0 (prefixes are
+        absolute-position entities: RoPE bakes positions into K)."""
+
+        def wr(c, s):
+            starts = (jnp.int32(0), slot) + (jnp.int32(0),) * (c.ndim - 2)
+            return jax.lax.dynamic_update_slice(c, s, starts)
+
+        return jax.tree.map(wr, cache, stripe)
 
     def _decode_impl(self, params, cache, last_token, lengths):
         logits, cache = self.model.apply_with_cache(
@@ -343,14 +394,16 @@ class ServingEngine:
     def free_slots(self) -> int:
         return self.max_batch - len(self.slots)
 
-    def add_request(self, prompt: List[int]) -> int:
-        """Admit a prompt; returns the request id. Raises when the batch
-        is full (callers queue) or the prompt cannot fit the cache.
+    def _first_free_slot(self, why: str) -> int:
+        """Slot-allocation policy, shared by admission and prefix
+        registration so the two cannot drift."""
+        for i in range(self.max_batch):
+            if i not in self.slots:
+                return i
+        raise RuntimeError(why)
 
-        Prompts longer than ``prefill_len`` are prefilled in
-        ``prefill_len``-sized chunks — every chunk reuses the same
-        compiled program, so long prompts cost chunk-count invocations,
-        never a recompile."""
+    def _check_prompt_fits(self, prompt: List[int]) -> int:
+        """Validate the prompt against the cache; returns chunk count."""
         if not prompt:
             raise ValueError("empty prompt")
         P = self.prefill_len
@@ -362,13 +415,16 @@ class ServingEngine:
                 f"prompt length {len(prompt)} cannot fit max_len "
                 f"{self.max_len} (chunked at {P})"
             )
-        free = [i for i in range(self.max_batch) if i not in self.slots]
-        if not free:
-            raise RuntimeError("no free slots")
-        slot = free[0]
-        rid = self._next_id
-        self._next_id += 1
-        for i in range(n_chunks):
+        return n_chunks
+
+    def _prefill_chunks(self, slot: int, prompt: List[int],
+                        start_chunk: int = 0):
+        """Run chunks [start_chunk, n) of ``prompt`` into a slot's cache
+        stripe (target + draft); returns the last chunk's logits."""
+        P = self.prefill_len
+        n_chunks = -(-len(prompt) // P)
+        chunk_logits = None
+        for i in range(start_chunk, n_chunks):
             chunk = prompt[i * P:(i + 1) * P]
             padded = jnp.asarray(
                 chunk + [0] * (P - len(chunk)), jnp.int32
@@ -381,7 +437,99 @@ class ServingEngine:
                     self.draft_params, self.draft_cache, padded, slot,
                     i * P,
                 )
-        last_logits = chunk_logits[(len(prompt) - 1) % P]
+        return chunk_logits
+
+    def _match_prefix(self, prompt: List[int]) -> Optional[_Prefix]:
+        """Longest registered prefix that is a strict prefix of
+        ``prompt`` (strict so at least one chunk still runs — its logits
+        seed the first sampled token)."""
+        pt = tuple(prompt)
+        best = None
+        for pref in self.prefixes.values():
+            L = len(pref.tokens)
+            if L < len(prompt) and pt[:L] == pref.tokens and (
+                best is None or L > len(best.tokens)
+            ):
+                best = pref
+        return best
+
+    def register_prefix(self, prefix: List[int]) -> None:
+        """Prefill ``prefix`` once and store its KV stripe; later
+        :meth:`add_request` calls whose prompt starts with it copy the
+        stripe (one on-device write) instead of re-running prefill — the
+        shared-system-prompt optimization (vLLM's automatic prefix
+        caching, made explicit: registration is the natural grant-time
+        hook for a slice serving one application).
+
+        Constraints keeping every shape static: the length must be a
+        multiple of ``prefill_len`` (stripes start at position 0 —
+        RoPE bakes absolute positions into K — and end on a chunk
+        boundary so the remainder prefill reuses the one compiled
+        program) and short enough that a strictly-longer prompt still
+        fits the cache. Needs a free slot to prefill through (freed
+        immediately; the stripe is masked for the next occupant). Not
+        thread-safe against a running scheduler — register via the
+        serving API or before starting it."""
+        key = tuple(prefix)
+        if key in self.prefixes:
+            return
+        P = self.prefill_len
+        if not prefix or len(prefix) % P:
+            raise ValueError(
+                f"prefix length {len(prefix)} must be a non-zero "
+                f"multiple of prefill_len {P}"
+            )
+        if len(prefix) > self.max_len - 2:
+            raise ValueError(
+                f"prefix length {len(prefix)} leaves no room for a "
+                f"longer prompt in max_len {self.max_len}"
+            )
+        if len(self.prefixes) >= self.max_prefixes:
+            raise RuntimeError(
+                f"prefix cache full ({self.max_prefixes}); drop_prefix "
+                "one first (each stored stripe pins HBM)"
+            )
+        slot = self._first_free_slot("no free slots to prefill the prefix")
+        self._prefill_chunks(slot, list(prefix))
+        stripe = self._read_stripe(self.cache, slot, length=len(prefix))
+        draft_stripe = None
+        if self.draft_model is not None:
+            draft_stripe = self._read_stripe(
+                self.draft_cache, slot, length=len(prefix)
+            )
+        self.prefixes[key] = _Prefix(key, stripe, draft_stripe)
+
+    def drop_prefix(self, prefix: List[int]) -> bool:
+        """Free a registered prefix's stored stripe (HBM)."""
+        return self.prefixes.pop(tuple(prefix), None) is not None
+
+    def add_request(self, prompt: List[int]) -> int:
+        """Admit a prompt; returns the request id. Raises when the batch
+        is full (callers queue) or the prompt cannot fit the cache.
+
+        Prompts longer than ``prefill_len`` are prefilled in
+        ``prefill_len``-sized chunks — every chunk reuses the same
+        compiled program, so long prompts cost chunk-count invocations,
+        never a recompile. A prompt starting with a registered prefix
+        (:meth:`register_prefix`) skips that prefix's chunks: the stored
+        stripe is copied in and prefill resumes at the boundary."""
+        self._check_prompt_fits(prompt)
+        slot = self._first_free_slot("no free slots")
+        rid = self._next_id
+        self._next_id += 1
+        start_chunk = 0
+        pref = self._match_prefix(prompt)
+        if pref is not None:
+            self.cache = self._write_stripe(self.cache, pref.stripe, slot)
+            if self.draft_model is not None:
+                self.draft_cache = self._write_stripe(
+                    self.draft_cache, pref.draft_stripe, slot
+                )
+            start_chunk = len(pref.tokens) // self.prefill_len
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += len(pref.tokens)
+        chunk_logits = self._prefill_chunks(slot, prompt, start_chunk)
+        last_logits = chunk_logits[(len(prompt) - 1) % self.prefill_len]
         tok = self._sample(last_logits[None])[0]
         self.last_token = self.last_token.at[slot].set(tok)
         self.lengths = self.lengths.at[slot].set(len(prompt))

@@ -110,6 +110,70 @@ class TestApiServer:
             got = out["choices"][0]["token_ids"]
             assert got == greedy_reference(m, params, [9, 3, 1], 8)
 
+    def test_prefix_registration_route(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        prefix = list(range(1, 9))                    # one chunk
+        prompt = prefix + [40, 41]
+        want = greedy_reference(m, params, prompt, 6)
+        with ApiServer(eng) as srv:
+            req = urllib.request.Request(
+                f"{srv.url}/v1/prefixes",
+                data=json.dumps({"tokens": prefix}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["registered"] == len(prefix)
+            code, out = post(srv.url, {"prompt": prompt, "max_tokens": 6})
+            assert code == 200
+            assert out["choices"][0]["token_ids"] == want
+            with urllib.request.urlopen(
+                f"{srv.url}/v1/stats", timeout=30
+            ) as r:
+                stats = json.loads(r.read())
+            assert stats["prefixes"] == 1
+            assert stats["prefix_hits"] == 1
+            assert stats["prefix_tokens_saved"] == len(prefix)
+            # invalid: not a chunk multiple → 400 with the engine error
+            req = urllib.request.Request(
+                f"{srv.url}/v1/prefixes",
+                data=json.dumps({"tokens": [1, 2, 3]}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=60)
+                assert False, "expected 400"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+                assert "multiple of prefill_len" in (
+                    json.loads(e.read())["error"]
+                )
+            # DELETE frees the stripe; a second DELETE 404s
+            req = urllib.request.Request(
+                f"{srv.url}/v1/prefixes",
+                data=json.dumps({"tokens": prefix}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="DELETE",
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+                assert json.loads(r.read())["dropped"] == len(prefix)
+            req = urllib.request.Request(
+                f"{srv.url}/v1/prefixes",
+                data=json.dumps({"tokens": prefix}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="DELETE",
+            )
+            try:
+                urllib.request.urlopen(req, timeout=60)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
     def test_bad_requests(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=1, max_len=16,

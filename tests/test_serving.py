@@ -453,6 +453,132 @@ class TestBoundedAttentionWindow:
         assert a.decode_block(8)[ra] == b.decode_block(8)[rb]
 
 
+class TestPrefixCaching:
+    PREFIX = list(range(1, 17))            # 16 = one prefill_len chunk
+
+    def test_hit_matches_cold_prefill_exactly(self, model):
+        m, params = model
+        prompt = self.PREFIX + [40, 41, 42]
+        cold = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=16)
+        [want] = cold.generate([prompt], max_new_tokens=8)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        eng.register_prefix(self.PREFIX)
+        [got] = eng.generate([prompt], max_new_tokens=8)
+        assert got.tokens == want.tokens
+        assert eng.prefix_hits == 1
+        assert eng.prefix_tokens_saved == len(self.PREFIX)
+
+    def test_longest_of_multiple_prefixes_wins(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        long = self.PREFIX + list(range(17, 33))       # 32 tokens
+        eng.register_prefix(self.PREFIX)
+        eng.register_prefix(long)
+        eng.add_request(long + [7])
+        assert eng.prefix_tokens_saved == len(long)
+
+    def test_exact_equal_prompt_is_not_a_hit(self, model):
+        # strict-prefix rule: the remainder chunk's logits seed the
+        # first sampled token, so prompt == prefix must prefill
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        eng.register_prefix(self.PREFIX)
+        eng.add_request(list(self.PREFIX))
+        assert eng.prefix_hits == 0
+
+    def test_non_chunk_multiple_rejected(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        with pytest.raises(ValueError, match="multiple of prefill_len"):
+            eng.register_prefix([1, 2, 3])
+
+    def test_register_needs_free_slot_and_leaves_slots_free(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=16)
+        eng.register_prefix(self.PREFIX)
+        assert eng.free_slots() == 1
+        eng.add_request([1, 2])                       # occupies the slot
+        with pytest.raises(RuntimeError, match="free slot"):
+            eng.register_prefix(list(range(17, 33)))
+
+    def test_prefix_cap_enforced(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16, max_prefixes=1)
+        eng.register_prefix(self.PREFIX)
+        with pytest.raises(RuntimeError, match="prefix cache full"):
+            eng.register_prefix(list(range(17, 33)))
+        eng.drop_prefix(self.PREFIX)
+        eng.register_prefix(list(range(17, 33)))      # room again
+
+    def test_drop_prefix_frees_and_misses(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        eng.register_prefix(self.PREFIX)
+        assert eng.drop_prefix(self.PREFIX)
+        assert not eng.drop_prefix(self.PREFIX)
+        eng.add_request(self.PREFIX + [7])
+        assert eng.prefix_hits == 0
+
+    def test_quantized_cache_prefix_hit(self, model):
+        m, params = model
+        prompt = self.PREFIX + [9, 8]
+        cold = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=16, kv_quant=True)
+        [want] = cold.generate([prompt], max_new_tokens=6)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16, kv_quant=True)
+        eng.register_prefix(self.PREFIX)
+        [got] = eng.generate([prompt], max_new_tokens=6)
+        assert got.tokens == want.tokens
+        assert eng.prefix_hits == 1
+
+    def test_tp_mesh_prefix_hit(self, model):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        m, params = model
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("model",))
+        prompt = self.PREFIX + [3, 4, 5]
+        cold = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=16, mesh=mesh)
+        [want] = cold.generate([prompt], max_new_tokens=6)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16, mesh=mesh)
+        eng.register_prefix(self.PREFIX)
+        [got] = eng.generate([prompt], max_new_tokens=6)
+        assert got.tokens == want.tokens
+        assert eng.prefix_hits == 1
+
+    def test_speculative_draft_prefix_hit(self, model):
+        m, params = model
+        prompt = self.PREFIX + [11, 12]
+        cold = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=16, draft_model=m,
+                             draft_params=params, spec_k=3)
+        cold.add_request(prompt)
+        for _ in range(4):
+            cold.spec_step()
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16, draft_model=m,
+                            draft_params=params, spec_k=3)
+        eng.register_prefix(self.PREFIX)
+        eng.add_request(prompt)
+        for _ in range(4):
+            eng.spec_step()
+        want = next(iter(cold.slots.values())).generated
+        got = next(iter(eng.slots.values())).generated
+        assert got == want
+        assert eng.prefix_hits == 1
+
+
 class TestSamplingFilters:
     """top-k / nucleus sampling: the filter math, and that BOTH sample
     paths (host _sample and the on-device block scan) apply it."""
