@@ -48,7 +48,8 @@ TPU_BENCH_TIMEOUT = float(os.environ.get("TPUSLICE_TPU_BENCH_TIMEOUT", "870"))
 TPU_PHASES = [
     ("probe", 120.0),
     ("flash_fwd", 180.0),
-    ("flash_bwd", 180.0),
+    ("flash_bwd", 240.0),
+    ("serving_small", 180.0),
     ("serving", 300.0),
     ("serving_quant", 300.0),
     ("mfu", 300.0),

@@ -23,7 +23,9 @@ design — XLA dispatch is serialized anyway): it admits queued requests
 as slots free up, decodes in on-device blocks sized to the smallest
 remaining budget (one dispatch, one readback per block — the tunnel/
 dispatch-latency lesson from the bench), enforces per-request budgets,
-and resolves waiting HTTP threads. Run via ``tpuslice-serve`` or
+evicts requests whose client already got a 503 (their slots go back to
+the batch instead of decoding tokens nobody reads), and resolves
+waiting HTTP threads. Run via ``tpuslice-serve`` or
 ``python -m instaslice_tpu.serving.api_server``.
 """
 
@@ -88,6 +90,11 @@ class _Scheduler(threading.Thread):
                     p = self.queue.get_nowait()
                 except queue.Empty:
                     break
+                if p.timed_out:
+                    # queued past its HTTP deadline: the client is gone
+                    self.metrics.requests.labels(outcome="timeout").inc()
+                    p.done.set()
+                    continue
                 if p.prefix_op:
                     # register needs a free slot to prefill through,
                     # which the admission loop just guaranteed
@@ -109,6 +116,17 @@ class _Scheduler(threading.Thread):
                     continue
                 self._by_rid[rid] = p
                 self._budget[rid] = p.max_tokens
+            # evict abandoned requests: the HTTP layer already 503'd the
+            # client, so decoding the slot to its budget would burn
+            # batch capacity producing tokens nobody reads
+            for slot, req in list(eng.slots.items()):
+                p = self._by_rid.get(req.request_id)
+                if p is not None and p.timed_out:
+                    del eng.slots[slot]
+                    self._by_rid.pop(req.request_id, None)
+                    self._budget.pop(req.request_id, None)
+                    self.metrics.requests.labels(outcome="timeout").inc()
+                    p.done.set()
             # budget enforcement BEFORE decoding (add_request already
             # produced one token, so a max_tokens=1 arrival is done on
             # admission — decoding first would waste a batch-wide step
@@ -234,17 +252,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {self.path}"})
             return
         try:
-            n = int(self.headers.get("Content-Length", "0") or 0)
-            req = json.loads(self.rfile.read(n).decode() or "{}")
-            if not isinstance(req, dict):
-                raise ValueError("body must be a JSON object")
-            prompt = req.get("prompt")
-            if (not isinstance(prompt, list)
-                    or not all(isinstance(t, int) for t in prompt)):
+            req = self._read_body()
+            try:
+                prompt = self._token_list(req, "prompt")
+            except ValueError:
                 raise ValueError(
                     "prompt must be a list of token ids (the server is "
                     "tokenizer-free; tokenize client-side)"
-                )
+                ) from None
             max_tokens = int(req.get("max_tokens", 16))
             if max_tokens < 1:
                 raise ValueError("max_tokens must be >= 1")
@@ -295,18 +310,30 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
+    def _read_body(self) -> dict:
+        """Parse the request body as a JSON object (raises ValueError)."""
+        n = int(self.headers.get("Content-Length", "0") or 0)
+        req = json.loads(self.rfile.read(n).decode() or "{}")
+        if not isinstance(req, dict):
+            raise ValueError("body must be a JSON object")
+        return req
+
+    @staticmethod
+    def _token_list(req: dict, key: str) -> List[int]:
+        """Extract a list-of-token-ids field (raises ValueError)."""
+        tokens = req.get(key)
+        if (not isinstance(tokens, list)
+                or not all(isinstance(t, int) for t in tokens)):
+            raise ValueError(f"{key} must be a list of token ids")
+        return tokens
+
     def _prefix_request(self, op: str) -> None:
         """POST /v1/prefixes {"tokens": [...]} — prefill once, reuse for
         every prompt that starts with it; DELETE with the same body
         frees the stored stripe (``ServingEngine.register_prefix`` /
         ``drop_prefix``, run on the scheduler thread)."""
         try:
-            n = int(self.headers.get("Content-Length", "0") or 0)
-            req = json.loads(self.rfile.read(n).decode() or "{}")
-            tokens = req.get("tokens") if isinstance(req, dict) else None
-            if (not isinstance(tokens, list)
-                    or not all(isinstance(t, int) for t in tokens)):
-                raise ValueError("tokens must be a list of token ids")
+            tokens = self._token_list(self._read_body(), "tokens")
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             self._send(400, {"error": str(e)})
             return
@@ -328,11 +355,13 @@ class ApiServer:
     """HTTP server + scheduler around an engine."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0, block_size: int = 16, metrics=None):
+                 port: int = 0, block_size: int = 16, metrics=None,
+                 request_timeout: float = 300.0):
         self.scheduler = _Scheduler(engine, block_size=block_size,
                                     metrics=metrics)
         handler = type("BoundHandler", (_Handler,),
-                       {"scheduler": self.scheduler})
+                       {"scheduler": self.scheduler,
+                        "request_timeout": request_timeout})
         self._srv = ThreadingHTTPServer((host, port), handler)
         self._thread = threading.Thread(
             target=self._srv.serve_forever, name="serve-http", daemon=True
@@ -365,6 +394,9 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="tpuslice-serve")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--request-timeout", type=float, default=300.0,
+                    help="seconds before a queued/decoding request 503s "
+                         "and its slot is evicted back to the batch")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="Prometheus /metrics port (0 = off)")
     ap.add_argument("--max-batch", type=int, default=8)
@@ -471,7 +503,8 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     engine = build_engine(args)
     mesh, quantized = engine.mesh, args.quantize
-    srv = ApiServer(engine, host=args.host, port=args.port).start()
+    srv = ApiServer(engine, host=args.host, port=args.port,
+                    request_timeout=args.request_timeout).start()
     if args.metrics_port:
         from instaslice_tpu.metrics.metrics import start_metrics_server
 

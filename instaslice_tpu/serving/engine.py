@@ -479,10 +479,14 @@ class ServingEngine:
                 f"prefix length {len(prefix)} must be a non-zero "
                 f"multiple of prefill_len {P}"
             )
-        if len(prefix) > self.max_len - 2:
+        # a hit needs a strictly-longer prompt, whose remainder chunk
+        # must also fit the cache: len(prefix) + one more chunk <= max_len
+        # (a looser bound would admit stripes no prompt can ever use)
+        if len(prefix) + P > self.max_len:
             raise ValueError(
                 f"prefix length {len(prefix)} leaves no room for a "
-                f"longer prompt in max_len {self.max_len}"
+                f"longer prompt's remainder chunk in max_len "
+                f"{self.max_len} (chunked at {P})"
             )
         if len(self.prefixes) >= self.max_prefixes:
             raise RuntimeError(

@@ -110,6 +110,44 @@ class TestApiServer:
             got = out["choices"][0]["token_ids"]
             assert got == greedy_reference(m, params, [9, 3, 1], 8)
 
+    def test_timed_out_request_evicted_frees_slot(self, model):
+        import time as _time
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8)
+        # 0.15 s HTTP deadline << the time 40 decode tokens take on the
+        # one slot, so the client 503s while the request still decodes
+        with ApiServer(eng, request_timeout=0.15) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 40}, timeout=30)
+            assert code == 503
+            # the scheduler must evict the abandoned slot, not decode it
+            # to its 40-token budget
+            deadline = _time.monotonic() + 10
+            while _time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                    f"{srv.url}/v1/stats", timeout=10
+                ) as r:
+                    if json.loads(r.read())["live_slots"] == 0:
+                        break
+                _time.sleep(0.05)
+            else:
+                assert False, "timed-out request still occupies its slot"
+            # the freed slot serves the next request normally — retried,
+            # because the 0.15 s deadline applies server-wide and a
+            # fresh block size can cost one more compile
+            for _ in range(40):
+                code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                           "max_tokens": 4}, timeout=60)
+                if code == 200:
+                    break
+                _time.sleep(0.25)
+            assert code == 200
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [5, 9, 2, 7], 4
+            )
+
     def test_prefix_registration_route(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=64,

@@ -497,6 +497,17 @@ class TestPrefixCaching:
         with pytest.raises(ValueError, match="multiple of prefill_len"):
             eng.register_prefix([1, 2, 3])
 
+    def test_unusable_prefix_rejected(self, model):
+        # a 64-token prefix in a 64-slot cache can never be hit (the
+        # strictly-longer prompt's remainder chunk cannot fit) — it must
+        # be rejected, not pin an unusable stripe
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        with pytest.raises(ValueError, match="remainder chunk"):
+            eng.register_prefix(list(range(64)))
+        eng.register_prefix(list(range(48)))       # 48 + 16 == 64: fits
+
     def test_register_needs_free_slot_and_leaves_slots_free(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=1, max_len=64,
