@@ -10,7 +10,10 @@ batched completions over HTTP.
   N, "temperature": T}`` → ``{"choices": [{"token_ids": [...],
   "finish_reason": ...}]}``. Token-id prompts (vLLM supports the same)
   keep the server tokenizer-free — the tokenizer belongs to the client
-  model stack, not the slice operator.
+  model stack, not the slice operator. Add ``"stream": true`` for
+  server-sent events: one ``data:`` chunk of fresh token ids per decode
+  block, a final chunk with finish reason + usage, ``data: [DONE]``;
+  a client that disconnects mid-stream has its slot evicted.
 - ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters.
 - ``POST /v1/prefixes`` with ``{"tokens": [token ids]}`` → prefill the
   shared prefix once; later prompts starting with it skip that prefill
@@ -47,7 +50,7 @@ log = logging.getLogger("instaslice_tpu.serving.api")
 
 class _Pending:
     def __init__(self, prompt: List[int], max_tokens: int,
-                 prefix_op: str = ""):
+                 prefix_op: str = "", stream: bool = False):
         self.prompt = prompt
         self.max_tokens = max_tokens
         # "register"/"drop" → not a completion: mutate the engine's
@@ -56,8 +59,16 @@ class _Pending:
         self.done = threading.Event()
         self.result: Optional[GenerationResult] = None
         self.error: str = ""
-        self.timed_out = False        # set by the HTTP layer on 503
+        self.timed_out = False        # set by the HTTP layer on 503,
+        #                               or on a broken streaming socket
         self.t0 = time.monotonic()
+        # streaming: the scheduler pushes token chunks (List[int]) after
+        # every decode block; a GenerationResult ends the stream, a str
+        # is a pre-admission error. ``sent`` tracks the delivered count.
+        self.stream_q: Optional["queue.Queue"] = (
+            queue.Queue() if stream else None
+        )
+        self.sent = 0
 
 
 class _Scheduler(threading.Thread):
@@ -112,6 +123,8 @@ class _Scheduler(threading.Thread):
                 except Exception as e:  # bad prompt (too long, empty…)
                     p.error = f"{type(e).__name__}: {e}"
                     self.metrics.requests.labels(outcome="rejected").inc()
+                    if p.stream_q is not None:
+                        p.stream_q.put(p.error)
                     p.done.set()
                     continue
                 self._by_rid[rid] = p
@@ -177,6 +190,19 @@ class _Scheduler(threading.Thread):
         eng = self.engine
         self.metrics.queue_depth.set(self.queue.qsize())
         self.metrics.live_slots.set(len(eng.slots))
+        # stream incremental tokens for live slots (capped at the
+        # request budget so a truncated tail is never streamed)
+        for req in eng.slots.values():
+            p = self._by_rid.get(req.request_id)
+            if p is None or p.stream_q is None:
+                continue
+            have = len(req.generated)
+            b = self._budget.get(req.request_id)
+            if b is not None:
+                have = min(have, b)
+            if have > p.sent:
+                p.stream_q.put(list(req.generated[p.sent:have]))
+                p.sent = have
         keep: List[GenerationResult] = []
         for r in eng.finished:
             p = self._by_rid.pop(r.request_id, None)
@@ -201,6 +227,11 @@ class _Scheduler(threading.Thread):
             self.metrics.request_seconds.observe(
                 time.monotonic() - p.t0
             )
+            if p.stream_q is not None:
+                if len(r.tokens) > p.sent:
+                    p.stream_q.put(list(r.tokens[p.sent:]))
+                    p.sent = len(r.tokens)
+                p.stream_q.put(r)          # ends the stream
             p.done.set()
         eng.finished = keep
 
@@ -280,8 +311,12 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             self._send(400, {"error": str(e)})
             return
-        pending = _Pending(prompt, max_tokens)
+        pending = _Pending(prompt, max_tokens,
+                           stream=bool(req.get("stream", False)))
         type(self).scheduler.submit(pending)
+        if pending.stream_q is not None:
+            self._stream_response(pending)
+            return
         if not pending.done.wait(type(self).request_timeout):
             pending.timed_out = True
             self._send(503, {"error": "request timed out in queue"})
@@ -303,6 +338,80 @@ class _Handler(BaseHTTPRequestHandler):
             },
         })
 
+
+    def _stream_response(self, pending: _Pending) -> None:
+        """Server-sent events: one ``data:`` chunk of token ids per
+        decode block as the scheduler produces them, a final chunk with
+        the finish reason + usage, then ``data: [DONE]``. A broken
+        socket or stalled stream marks the request timed out, and the
+        scheduler evicts its slot — streaming clients get disconnect
+        cancellation for free."""
+        deadline = time.monotonic() + type(self).request_timeout
+
+        def write(payload) -> None:
+            # bound every blocking socket write by the remaining
+            # deadline: a connected client that stops READING would
+            # otherwise block this thread forever once the send buffer
+            # fills (BaseHTTPRequestHandler sets no socket timeout),
+            # leaking the handler and never tripping eviction
+            self.connection.settimeout(
+                max(deadline - time.monotonic(), 0.001)
+            )
+            data = payload if isinstance(payload, str) else json.dumps(
+                payload
+            )
+            self.wfile.write(f"data: {data}\n\n".encode())
+            self.wfile.flush()
+
+        try:
+            # inside the try: a client that disconnects before the
+            # headers flush must still be flagged for slot eviction
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError
+                try:
+                    item = pending.stream_q.get(timeout=min(remaining, 5))
+                except queue.Empty:
+                    continue
+                if isinstance(item, str):          # pre-admission error
+                    write({"error": item})
+                    write("[DONE]")
+                    return
+                if isinstance(item, GenerationResult):
+                    write({
+                        "object": "text_completion",
+                        "choices": [{
+                            "index": 0,
+                            "token_ids": [],
+                            "finish_reason": item.finished_reason
+                            or "stop",
+                        }],
+                        "usage": {
+                            "prompt_tokens": len(item.prompt),
+                            "completion_tokens": pending.sent,
+                        },
+                    })
+                    write("[DONE]")
+                    return
+                write({
+                    "object": "text_completion",
+                    "choices": [{
+                        "index": 0,
+                        "token_ids": item,
+                        "finish_reason": None,
+                    }],
+                })
+        except (BrokenPipeError, ConnectionError, TimeoutError, OSError):
+            # client hung up or the stream stalled past the deadline:
+            # flag for the scheduler's eviction sweep; the socket is in
+            # an unknown state, so don't let the handler reuse it
+            pending.timed_out = True
+            self.close_connection = True
 
     def do_DELETE(self):
         if self.path.startswith("/v1/prefixes"):

@@ -110,6 +110,74 @@ class TestApiServer:
             got = out["choices"][0]["token_ids"]
             assert got == greedy_reference(m, params, [9, 3, 1], 8)
 
+    def test_streaming_matches_oracle(self, model):
+        import http.client
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        prompt = [5, 9, 2, 7]
+        want = greedy_reference(m, params, prompt, 12)
+        with ApiServer(eng, block_size=4) as srv:
+            host, port = srv.url.replace("http://", "").split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=120)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({"prompt": prompt, "max_tokens": 12,
+                                 "stream": True}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type") == "text/event-stream"
+            events = []
+            buf = b""
+            while b"data: [DONE]" not in buf:
+                chunk = resp.read1(65536)
+                assert chunk, "stream ended without [DONE]"
+                buf += chunk
+            for line in buf.decode().splitlines():
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    events.append(json.loads(line[len("data: "):]))
+            conn.close()
+        got = [t for e in events for t in e["choices"][0]["token_ids"]]
+        assert got == want
+        # multiple incremental chunks (block_size 4 < 12 tokens)
+        assert len(events) >= 3
+        final = events[-1]
+        assert final["choices"][0]["finish_reason"] == "max_new_tokens"
+        assert final["usage"]["completion_tokens"] == 12
+
+    def test_streaming_disconnect_evicts_slot(self, model):
+        import http.client
+        import time as _time
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            host, port = srv.url.replace("http://", "").split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=60)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({"prompt": [5, 9], "max_tokens": 50,
+                                 "stream": True}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read1(64)                 # first chunk arrives…
+            conn.close()                   # …then the client vanishes
+            deadline = _time.monotonic() + 15
+            while _time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                    f"{srv.url}/v1/stats", timeout=10
+                ) as r:
+                    if json.loads(r.read())["live_slots"] == 0:
+                        break
+                _time.sleep(0.05)
+            else:
+                assert False, "disconnected stream still holds its slot"
+
     def test_timed_out_request_evicted_frees_slot(self, model):
         import time as _time
 
