@@ -14,6 +14,9 @@ batched completions over HTTP.
   server-sent events: one ``data:`` chunk of fresh token ids per decode
   block, a final chunk with finish reason + usage, ``data: [DONE]``;
   a client that disconnects mid-stream has its slot evicted.
+  ``"stop"`` takes token-id sequence(s); output truncates before the
+  earliest match (streaming holds back a stop-window of tokens so a
+  boundary-spanning match never over-delivers).
 - ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters.
 - ``POST /v1/prefixes`` with ``{"tokens": [token ids]}`` → prefill the
   shared prefix once; later prompts starting with it skip that prefill
@@ -50,9 +53,11 @@ log = logging.getLogger("instaslice_tpu.serving.api")
 
 class _Pending:
     def __init__(self, prompt: List[int], max_tokens: int,
-                 prefix_op: str = "", stream: bool = False):
+                 prefix_op: str = "", stream: bool = False,
+                 stop: Optional[List[List[int]]] = None):
         self.prompt = prompt
         self.max_tokens = max_tokens
+        self.stop = stop or []         # normalized token-id sequences
         # "register"/"drop" → not a completion: mutate the engine's
         # prefix cache on the scheduler thread (the engine owner)
         self.prefix_op = prefix_op
@@ -119,7 +124,7 @@ class _Scheduler(threading.Thread):
                     p.done.set()
                     continue
                 try:
-                    rid = eng.add_request(p.prompt)
+                    rid = eng.add_request(p.prompt, stop=p.stop)
                 except Exception as e:  # bad prompt (too long, empty…)
                     p.error = f"{type(e).__name__}: {e}"
                     self.metrics.requests.labels(outcome="rejected").inc()
@@ -197,6 +202,11 @@ class _Scheduler(threading.Thread):
             if p is None or p.stream_q is None:
                 continue
             have = len(req.generated)
+            if p.stop:
+                # hold back the longest-stop-minus-one tail: those
+                # tokens could still become part of a stop match
+                # spanning the next block and be truncated away
+                have -= max(len(s) for s in p.stop) - 1
             b = self._budget.get(req.request_id)
             if b is not None:
                 have = min(have, b)
@@ -212,10 +222,15 @@ class _Scheduler(threading.Thread):
             b = self._budget.pop(r.request_id, None)
             if b is not None and len(r.tokens) > b:
                 r.tokens = r.tokens[:b]
-                # the cut can drop the eos the engine finished on — the
-                # client-visible reason must describe the tokens it got
-                if (r.finished_reason == "eos"
-                        and self.engine.eos_id not in r.tokens):
+                # the cut can drop the evidence the engine finished on —
+                # the client-visible reason must describe the tokens it
+                # got: a dropped eos, or a stop match that sat beyond
+                # the budget (stop matches at the original length since
+                # the match itself is excluded), read as plain budget
+                # exhaustion
+                if (r.finished_reason == "stop"
+                        or (r.finished_reason == "eos"
+                            and self.engine.eos_id not in r.tokens)):
                     r.finished_reason = "max_new_tokens"
             p.result = r
             # a request the HTTP layer already 503'd must not read as a
@@ -294,6 +309,7 @@ class _Handler(BaseHTTPRequestHandler):
             max_tokens = int(req.get("max_tokens", 16))
             if max_tokens < 1:
                 raise ValueError("max_tokens must be >= 1")
+            stop = ServingEngine._normalize_stop(req.get("stop"))
             # sampling config is engine-level (slots share one compiled
             # decode program); reject mismatching per-request values
             # instead of silently ignoring them
@@ -312,7 +328,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": str(e)})
             return
         pending = _Pending(prompt, max_tokens,
-                           stream=bool(req.get("stream", False)))
+                           stream=bool(req.get("stream", False)),
+                           stop=stop)
         type(self).scheduler.submit(pending)
         if pending.stream_q is not None:
             self._stream_response(pending)

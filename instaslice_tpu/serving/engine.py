@@ -68,6 +68,12 @@ class _Slot:
     request_id: int
     prompt: List[int]
     generated: List[int]
+    # per-request stop token sequences (host-side check: the device
+    # never needs to know — wasted block-tail tokens are masked stripes)
+    stop: List[List[int]] = dataclasses.field(default_factory=list)
+    # positions before this are already stop-scanned (no match found);
+    # rescans resume a stop-window before it, not from zero
+    stop_scanned: int = 0
 
 
 @dataclasses.dataclass
@@ -507,7 +513,25 @@ class ServingEngine:
         """Free a registered prefix's stored stripe (HBM)."""
         return self.prefixes.pop(tuple(prefix), None) is not None
 
-    def add_request(self, prompt: List[int]) -> int:
+    @staticmethod
+    def _normalize_stop(stop) -> List[List[int]]:
+        """``stop`` → list of non-empty token-id sequences: accepts
+        None, one flat sequence ([1, 2]), or a list of sequences."""
+        if not stop:
+            return []
+        if all(isinstance(t, int) for t in stop):
+            stop = [stop]
+        out = []
+        for seq in stop:
+            if (not isinstance(seq, (list, tuple)) or not seq
+                    or not all(isinstance(t, int) for t in seq)):
+                raise ValueError(
+                    "stop must be a token-id sequence or a list of them"
+                )
+            out.append(list(seq))
+        return out
+
+    def add_request(self, prompt: List[int], stop=None) -> int:
         """Admit a prompt; returns the request id. Raises when the batch
         is full (callers queue) or the prompt cannot fit the cache.
 
@@ -516,7 +540,13 @@ class ServingEngine:
         compiled program, so long prompts cost chunk-count invocations,
         never a recompile. A prompt starting with a registered prefix
         (:meth:`register_prefix`) skips that prefix's chunks: the stored
-        stripe is copied in and prefill resumes at the boundary."""
+        stripe is copied in and prefill resumes at the boundary.
+
+        ``stop``: token-id sequence(s); generation finishes (reason
+        ``"stop"``) when one appears in the output, which is truncated
+        to exclude it. Checked host-side after every step/block — the
+        compiled programs don't change."""
+        stop = self._normalize_stop(stop)
         self._check_prompt_fits(prompt)
         slot = self._first_free_slot("no free slots")
         rid = self._next_id
@@ -537,7 +567,7 @@ class ServingEngine:
         tok = self._sample(last_logits[None])[0]
         self.last_token = self.last_token.at[slot].set(tok)
         self.lengths = self.lengths.at[slot].set(len(prompt))
-        self.slots[slot] = _Slot(rid, list(prompt), [int(tok)])
+        self.slots[slot] = _Slot(rid, list(prompt), [int(tok)], stop)
         self.tokens_generated += 1
         self._maybe_finish(slot)
         return rid
@@ -697,14 +727,43 @@ class ServingEngine:
             self._maybe_finish(slot)
         return out
 
+    @staticmethod
+    def _find_stop(generated: List[int], stops: List[List[int]],
+                   scanned: int = 0) -> int:
+        """Start index of the earliest stop-sequence match in
+        ``generated``, or -1. Resumes a stop-window before ``scanned``
+        (positions the caller already cleared) rather than from zero, so
+        repeated per-block checks stay O(new tokens) while matches split
+        across block boundaries are still found."""
+        best = -1
+        for seq in stops:
+            n = len(seq)
+            for i in range(max(0, scanned - n + 1),
+                           len(generated) - n + 1):
+                if generated[i:i + n] == seq:
+                    if best < 0 or i < best:
+                        best = i
+                    break
+        return best
+
     def _maybe_finish(self, slot: int) -> None:
         req = self.slots[slot]
         total = len(req.prompt) + len(req.generated)
         reason = ""
-        if self.eos_id is not None and req.generated[-1] == self.eos_id:
-            reason = "eos"
-        elif total >= self.max_len - 1:
-            reason = "max_len"
+        if req.stop:
+            cut = self._find_stop(req.generated, req.stop,
+                                  req.stop_scanned)
+            if cut >= 0:
+                # exclude the stop sequence itself (OpenAI semantics)
+                req.generated = req.generated[:cut]
+                reason = "stop"
+            else:
+                req.stop_scanned = len(req.generated)
+        if not reason:
+            if self.eos_id is not None and req.generated[-1] == self.eos_id:
+                reason = "eos"
+            elif total >= self.max_len - 1:
+                reason = "max_len"
         if reason:
             self.finished.append(
                 GenerationResult(
@@ -715,7 +774,7 @@ class ServingEngine:
 
     def generate(
         self, prompts: List[List[int]], max_new_tokens: int,
-        block_size: int = 32,
+        block_size: int = 32, stop=None,
     ) -> List[GenerationResult]:
         """Batch convenience: run all prompts to completion (continuous
         batching: new prompts are admitted as slots free up).
@@ -731,7 +790,7 @@ class ServingEngine:
         while True:
             while pending and self.free_slots():
                 idx, p = pending.pop(0)
-                rid = self.add_request(p)
+                rid = self.add_request(p, stop=stop)
                 want[rid] = idx
                 budget[rid] = max_new_tokens
             # enforce the per-request budget BEFORE decoding (add_request

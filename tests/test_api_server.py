@@ -148,6 +148,84 @@ class TestApiServer:
         assert final["choices"][0]["finish_reason"] == "max_new_tokens"
         assert final["usage"]["completion_tokens"] == 12
 
+    def test_stop_sequences_over_http(self, model):
+        from test_serving import first_match
+
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 12)
+        stop = oracle[3:5]
+        cut = first_match(oracle, stop)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 12, "stop": stop})
+            assert code == 200
+            choice = out["choices"][0]
+            assert choice["token_ids"] == oracle[:cut]
+            assert choice["finish_reason"] == "stop"
+            # malformed stop → 400
+            code, out = post(srv.url, {"prompt": [1, 2],
+                                       "max_tokens": 4, "stop": [[]]})
+            assert code == 400
+
+    def test_streaming_with_stop_never_over_delivers(self, model):
+        import http.client
+
+        from test_serving import first_match
+
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 12)
+        stop = oracle[3:5]
+        cut = first_match(oracle, stop)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            host, port = srv.url.replace("http://", "").split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=120)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({"prompt": [5, 9, 2, 7], "max_tokens": 12,
+                                 "stream": True, "stop": stop}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            buf = b""
+            while b"data: [DONE]" not in buf:
+                chunk = resp.read1(65536)
+                assert chunk, "stream ended without [DONE]"
+                buf += chunk
+            conn.close()
+        events = [json.loads(l[6:]) for l in buf.decode().splitlines()
+                  if l.startswith("data: ") and l != "data: [DONE]"]
+        got = [t for e in events for t in e["choices"][0]["token_ids"]]
+        # the stop-window holdback must prevent streaming tokens that a
+        # later match would truncate: exactly the pre-stop tokens arrive
+        assert got == oracle[:cut]
+        assert events[-1]["choices"][0]["finish_reason"] == "stop"
+        assert events[-1]["usage"]["completion_tokens"] == cut
+
+    def test_budget_cut_rewrites_stop_reason(self, model):
+        """A stop match beyond the request budget is evidence the client
+        never sees — the delivered reason must be max_new_tokens (the
+        spec_step path can overshoot budgets by up to k+1 tokens)."""
+        from instaslice_tpu.serving.api_server import _Pending, _Scheduler
+        from instaslice_tpu.serving.engine import GenerationResult
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        sched = _Scheduler(eng)            # not started: direct _deliver
+        p = _Pending([1, 2], max_tokens=2)
+        sched._by_rid[7] = p
+        sched._budget[7] = 2
+        eng.finished.append(
+            GenerationResult(7, [1, 2], [5, 6, 8], "stop")
+        )
+        sched._deliver()
+        assert p.result.tokens == [5, 6]
+        assert p.result.finished_reason == "max_new_tokens"
+
     def test_streaming_disconnect_evicts_slot(self, model):
         import http.client
         import time as _time

@@ -453,6 +453,72 @@ class TestBoundedAttentionWindow:
         assert a.decode_block(8)[ra] == b.decode_block(8)[rb]
 
 
+def first_match(seq, sub):
+    """Earliest start index of ``sub`` in ``seq`` (test oracle for stop
+    semantics; also imported by test_api_server)."""
+    for i in range(len(seq) - len(sub) + 1):
+        if seq[i:i + len(sub)] == sub:
+            return i
+    raise AssertionError("stop not in oracle")
+
+
+class TestStopSequences:
+    first_match = staticmethod(first_match)
+
+    @pytest.mark.parametrize("k", [0, 3, 4])
+    def test_stop_truncates_at_earliest_match(self, model, k):
+        """Stop = oracle[k:k+2]: generation must end at the EARLIEST
+        occurrence of that pair (the greedy chain may repeat, so the
+        earliest match can precede k), stop excluded, reason "stop" —
+        matches spanning decode-block boundaries included
+        (block_size=4)."""
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 12)
+        stop = oracle[k:k + 2]
+        cut = self.first_match(oracle, stop)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        [res] = eng.generate([[5, 9, 2, 7]], max_new_tokens=12,
+                             block_size=4, stop=stop)
+        assert res.tokens == oracle[:cut]
+        assert res.finished_reason == "stop"
+
+    def test_multiple_stop_sequences_earliest_wins(self, model):
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 12)
+        stops = [oracle[6:8], oracle[2:4]]
+        cut = min(self.first_match(oracle, s) for s in stops)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        [res] = eng.generate(
+            [[5, 9, 2, 7]], max_new_tokens=12, block_size=4,
+            stop=stops,
+        )
+        assert res.tokens == oracle[:cut]
+        assert res.finished_reason == "stop"
+
+    def test_no_match_runs_to_budget(self, model):
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 8)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        # 63 is outside the greedy chain for this seed; never matches
+        assert 63 not in oracle
+        [res] = eng.generate([[5, 9, 2, 7]], max_new_tokens=8,
+                             block_size=4, stop=[[63]])
+        assert res.tokens == oracle
+        assert res.finished_reason == "max_new_tokens"
+
+    def test_malformed_stop_rejected(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        with pytest.raises(ValueError, match="stop"):
+            eng.add_request([1, 2], stop=[[]])
+        with pytest.raises(ValueError, match="stop"):
+            eng.add_request([1, 2], stop=["x"])
+
+
 class TestPrefixCaching:
     PREFIX = list(range(1, 17))            # 16 = one prefill_len chunk
 
