@@ -16,7 +16,10 @@ batched completions over HTTP.
   a client that disconnects mid-stream has its slot evicted.
   ``"stop"`` takes token-id sequence(s); output truncates before the
   earliest match (streaming holds back a stop-window of tokens so a
-  boundary-spanning match never over-delivers).
+  boundary-spanning match never over-delivers). ``"logprobs": true``
+  adds each token's log-probability under the distribution it was
+  sampled from (post temperature/top-k/top-p), 1:1 with ``token_ids``
+  in both sync and streaming responses.
 - ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters.
 - ``POST /v1/prefixes`` with ``{"tokens": [token ids]}`` → prefill the
   shared prefix once; later prompts starting with it skip that prefill
@@ -54,10 +57,12 @@ log = logging.getLogger("instaslice_tpu.serving.api")
 class _Pending:
     def __init__(self, prompt: List[int], max_tokens: int,
                  prefix_op: str = "", stream: bool = False,
-                 stop: Optional[List[List[int]]] = None):
+                 stop: Optional[List[List[int]]] = None,
+                 want_logprobs: bool = False):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.stop = stop or []         # normalized token-id sequences
+        self.want_logprobs = want_logprobs
         # "register"/"drop" → not a completion: mutate the engine's
         # prefix cache on the scheduler thread (the engine owner)
         self.prefix_op = prefix_op
@@ -156,6 +161,7 @@ class _Scheduler(threading.Thread):
                     eng.finished.append(GenerationResult(
                         req.request_id, req.prompt, req.generated[:b],
                         "max_new_tokens",
+                        logprobs=req.logprobs[:b],
                     ))
                     del eng.slots[slot]
             self._deliver()
@@ -211,7 +217,8 @@ class _Scheduler(threading.Thread):
             if b is not None:
                 have = min(have, b)
             if have > p.sent:
-                p.stream_q.put(list(req.generated[p.sent:have]))
+                p.stream_q.put((list(req.generated[p.sent:have]),
+                                list(req.logprobs[p.sent:have])))
                 p.sent = have
         keep: List[GenerationResult] = []
         for r in eng.finished:
@@ -222,6 +229,7 @@ class _Scheduler(threading.Thread):
             b = self._budget.pop(r.request_id, None)
             if b is not None and len(r.tokens) > b:
                 r.tokens = r.tokens[:b]
+                r.logprobs = r.logprobs[:b]
                 # the cut can drop the evidence the engine finished on —
                 # the client-visible reason must describe the tokens it
                 # got: a dropped eos, or a stop match that sat beyond
@@ -244,7 +252,8 @@ class _Scheduler(threading.Thread):
             )
             if p.stream_q is not None:
                 if len(r.tokens) > p.sent:
-                    p.stream_q.put(list(r.tokens[p.sent:]))
+                    p.stream_q.put((list(r.tokens[p.sent:]),
+                                    list(r.logprobs[p.sent:])))
                     p.sent = len(r.tokens)
                 p.stream_q.put(r)          # ends the stream
             p.done.set()
@@ -329,7 +338,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         pending = _Pending(prompt, max_tokens,
                            stream=bool(req.get("stream", False)),
-                           stop=stop)
+                           stop=stop,
+                           want_logprobs=bool(req.get("logprobs", False)))
         type(self).scheduler.submit(pending)
         if pending.stream_q is not None:
             self._stream_response(pending)
@@ -342,13 +352,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": pending.error})
             return
         r = pending.result
+        choice = {
+            "index": 0,
+            "token_ids": r.tokens,
+            "finish_reason": r.finished_reason or "stop",
+        }
+        if pending.want_logprobs:
+            choice["logprobs"] = r.logprobs
         self._send(200, {
             "object": "text_completion",
-            "choices": [{
-                "index": 0,
-                "token_ids": r.tokens,
-                "finish_reason": r.finished_reason or "stop",
-            }],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": len(r.prompt),
                 "completion_tokens": len(r.tokens),
@@ -415,13 +428,17 @@ class _Handler(BaseHTTPRequestHandler):
                     })
                     write("[DONE]")
                     return
+                toks, lps = item
+                chunk = {
+                    "index": 0,
+                    "token_ids": toks,
+                    "finish_reason": None,
+                }
+                if pending.want_logprobs:
+                    chunk["logprobs"] = lps
                 write({
                     "object": "text_completion",
-                    "choices": [{
-                        "index": 0,
-                        "token_ids": item,
-                        "finish_reason": None,
-                    }],
+                    "choices": [chunk],
                 })
         except (BrokenPipeError, ConnectionError, TimeoutError, OSError):
             # client hung up or the stream stalled past the deadline:

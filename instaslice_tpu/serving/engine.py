@@ -52,7 +52,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from instaslice_tpu.models.lm import Params, TpuLM, param_specs
-from instaslice_tpu.serving.sampling import filter_logits
+from instaslice_tpu.serving.sampling import filter_logits, token_logprob
 
 
 @dataclasses.dataclass
@@ -61,6 +61,9 @@ class GenerationResult:
     prompt: List[int]
     tokens: List[int]                 # generated ids (no prompt)
     finished_reason: str = ""         # "eos" | "max_len" | ""
+    # log-probability of each generated token under the distribution it
+    # was sampled from (post temperature/top-k/top-p), 1:1 with tokens
+    logprobs: List[float] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -74,6 +77,8 @@ class _Slot:
     # positions before this are already stop-scanned (no match found);
     # rescans resume a stop-window before it, not from zero
     stop_scanned: int = 0
+    # 1:1 with ``generated``; every cut to generated cuts this too
+    logprobs: List[float] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -332,13 +337,15 @@ class ServingEngine:
                 toks = jax.random.categorical(
                     jax.random.fold_in(rng, i), logits, axis=-1,
                 ).astype(jnp.int32)
-            return (cache, toks, lens + 1), toks
+            # logprob under the distribution actually sampled from
+            lp = token_logprob(logits, toks)
+            return (cache, toks, lens + 1), (toks, lp)
 
-        (cache, last, lengths), toks = jax.lax.scan(
+        (cache, last, lengths), (toks, lps) = jax.lax.scan(
             step, (cache, last_token, lengths),
             jnp.arange(n_steps, dtype=jnp.int32),
         )
-        return cache, last, lengths, toks
+        return cache, last, lengths, toks, lps
 
     def _draft_prefill_impl(self, params, cache, tokens, slot, offset):
         """The draft cache must hold the prompt too before it can
@@ -377,23 +384,29 @@ class ServingEngine:
     def _spec_verify_impl(self, params, cache, inputs, lens):
         """One target forward over (B, k+1) inputs → (B, k+1) greedy
         next-token predictions (position j predicts the token after
-        input j)."""
+        input j) plus their logprobs."""
         logits, cache = self.model.apply_with_cache(
             params, inputs, cache, lens
         )
-        return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return cache, toks, token_logprob(logits, toks)
 
-    def _sample(self, logits: jax.Array) -> jax.Array:
+    def _sample(self, logits: jax.Array):
+        """(tokens, logprobs) for a (B, vocab) logits batch; logprob is
+        under the distribution actually sampled from (post temperature/
+        top-k/top-p filtering)."""
         if self.temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        self._rng, sub = jax.random.split(self._rng)
-        # temperature first, then the nucleus (see _decode_block_impl)
-        logits = filter_logits(
-            logits / self.temperature, self.top_k, self.top_p
-        )
-        return jax.random.categorical(sub, logits, axis=-1).astype(
-            jnp.int32
-        )
+            toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            # temperature first, then the nucleus (_decode_block_impl)
+            logits = filter_logits(
+                logits / self.temperature, self.top_k, self.top_p
+            )
+            toks = jax.random.categorical(sub, logits, axis=-1).astype(
+                jnp.int32
+            )
+        return toks, token_logprob(logits, toks)
 
     # -------------------------------------------------------------- public
 
@@ -564,10 +577,12 @@ class ServingEngine:
             self.prefix_tokens_saved += len(pref.tokens)
         chunk_logits = self._prefill_chunks(slot, prompt, start_chunk)
         last_logits = chunk_logits[(len(prompt) - 1) % self.prefill_len]
-        tok = self._sample(last_logits[None])[0]
+        toks, lps = self._sample(last_logits[None])
+        tok = toks[0]
         self.last_token = self.last_token.at[slot].set(tok)
         self.lengths = self.lengths.at[slot].set(len(prompt))
-        self.slots[slot] = _Slot(rid, list(prompt), [int(tok)], stop)
+        self.slots[slot] = _Slot(rid, list(prompt), [int(tok)], stop,
+                                 logprobs=[float(lps[0])])
         self.tokens_generated += 1
         self._maybe_finish(slot)
         return rid
@@ -591,12 +606,16 @@ class ServingEngine:
         self.cache, logits = self._decode(
             self.params, self.cache, self.last_token, self.lengths
         )
-        toks = self._sample(logits)
+        toks, lps = self._sample(logits)
+        # one combined host round-trip (int(toks[slot]) per slot would
+        # sync the device once per live slot)
+        toks_h, lps_h = jax.device_get((toks, lps))
         out: Dict[int, int] = {}
         for slot, req in list(self.slots.items()):
-            t = int(toks[slot])
+            t = int(toks_h[slot])
             out[req.request_id] = t
             req.generated.append(t)
+            req.logprobs.append(float(lps_h[slot]))
             self.tokens_generated += 1
         self.last_token = toks
         live = jnp.zeros(self.max_batch, jnp.bool_)
@@ -636,7 +655,7 @@ class ServingEngine:
         need = worst + n_steps + 1
         bucket = min(self.max_len, ((need + 255) // 256) * 256)
         attend = bucket if bucket < self.max_len else 0
-        self.cache, self.last_token, self.lengths, toks = (
+        self.cache, self.last_token, self.lengths, toks, lps = (
             self._decode_block(
                 self.params, self.cache, self.last_token, self.lengths,
                 sub, jnp.float32(max(self.temperature, 1e-6)),
@@ -657,13 +676,17 @@ class ServingEngine:
                 self.draft_params, self.draft_cache, consumed,
                 lengths_before,
             )
-        block = jax.device_get(toks)               # single host round-trip
+        # single host round-trip for the block's tokens AND logprobs
+        block, block_lp = jax.device_get((toks, lps))
         out: Dict[int, List[int]] = {}
         for slot, req in list(self.slots.items()):
             seq = [int(t) for t in block[:, slot]]
             if self.eos_id is not None and self.eos_id in seq:
                 seq = seq[: seq.index(self.eos_id) + 1]
             req.generated.extend(seq)
+            req.logprobs.extend(
+                float(x) for x in block_lp[: len(seq), slot]
+            )
             self.tokens_generated += len(seq)
             out[req.request_id] = seq
             self._maybe_finish(slot)
@@ -706,22 +729,28 @@ class ServingEngine:
         )
         d = d_all[:, :k]
         inputs = jnp.concatenate([self.last_token[:, None], d], axis=1)
-        self.cache, t = self._spec_verify(
+        self.cache, t, t_lp = self._spec_verify(
             self.params, self.cache, inputs, self.lengths
         )
         matches = (d == t[:, :k]).astype(jnp.int32)
         accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
         bonus = jnp.take_along_axis(t, accepted[:, None], axis=1)[:, 0]
-        d_h, t_h, a_h = jax.device_get((d, t, accepted))
+        d_h, t_h, a_h, lp_h = jax.device_get((d, t, accepted, t_lp))
         self.last_token = bonus
         self.lengths = self.lengths + accepted + 1
         out: Dict[int, List[int]] = {}
         for slot, req in list(self.slots.items()):
             n = int(a_h[slot])
+            # emitted tokens ARE the target's greedy chain t[:n+1]
+            # (accepted draft tokens equal it), so their logprobs are
+            # the verify pass's logprobs at those positions
             seq = [int(x) for x in d_h[slot, :n]] + [int(t_h[slot, n])]
             if self.eos_id is not None and self.eos_id in seq:
                 seq = seq[: seq.index(self.eos_id) + 1]
             req.generated.extend(seq)
+            req.logprobs.extend(
+                float(x) for x in lp_h[slot, : len(seq)]
+            )
             self.tokens_generated += len(seq)
             out[req.request_id] = seq
             self._maybe_finish(slot)
@@ -756,6 +785,7 @@ class ServingEngine:
             if cut >= 0:
                 # exclude the stop sequence itself (OpenAI semantics)
                 req.generated = req.generated[:cut]
+                req.logprobs = req.logprobs[:cut]
                 reason = "stop"
             else:
                 req.stop_scanned = len(req.generated)
@@ -767,7 +797,8 @@ class ServingEngine:
         if reason:
             self.finished.append(
                 GenerationResult(
-                    req.request_id, req.prompt, req.generated, reason
+                    req.request_id, req.prompt, req.generated, reason,
+                    logprobs=req.logprobs,
                 )
             )
             del self.slots[slot]
@@ -808,6 +839,9 @@ class ServingEngine:
                             req.request_id, req.prompt,
                             req.generated[: budget[req.request_id]],
                             "max_new_tokens",
+                            logprobs=req.logprobs[
+                                : budget[req.request_id]
+                            ],
                         )
                     )
                     del self.slots[slot]

@@ -54,3 +54,12 @@ def filter_logits(
         )
         logits = jnp.where(logits < threshold, _NEG, logits)
     return logits
+
+
+def token_logprob(logits: jax.Array, toks: jax.Array) -> jax.Array:
+    """log p(tok) under softmax(logits): logits (…, V), toks (…) int —
+    returns (…) fp32. Callers pass the FILTERED/tempered logits so the
+    probability is under the distribution actually sampled from."""
+    return jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), toks[..., None], -1
+    )[..., 0]

@@ -205,6 +205,58 @@ class TestApiServer:
         assert events[-1]["choices"][0]["finish_reason"] == "stop"
         assert events[-1]["usage"]["completion_tokens"] == cut
 
+    def test_logprobs_over_http(self, model):
+        import math
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 6,
+                                       "logprobs": True})
+            assert code == 200
+            choice = out["choices"][0]
+            assert len(choice["logprobs"]) == len(choice["token_ids"])
+            assert all(
+                isinstance(x, float) and x <= 0.0 and math.isfinite(x)
+                for x in choice["logprobs"]
+            )
+            # not requested → not in the response
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 4})
+            assert "logprobs" not in out["choices"][0]
+
+    def test_streaming_logprobs_one_per_token(self, model):
+        import http.client
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            host, port = srv.url.replace("http://", "").split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=120)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({"prompt": [5, 9, 2, 7], "max_tokens": 10,
+                                 "stream": True, "logprobs": True}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            buf = b""
+            while b"data: [DONE]" not in buf:
+                chunk = resp.read1(65536)
+                assert chunk
+                buf += chunk
+            conn.close()
+        events = [json.loads(l[6:]) for l in buf.decode().splitlines()
+                  if l.startswith("data: ") and l != "data: [DONE]"]
+        toks = [t for e in events for t in e["choices"][0]["token_ids"]]
+        lps = [x for e in events
+               for x in e["choices"][0].get("logprobs", [])]
+        assert len(toks) == 10
+        assert len(lps) == 10
+
     def test_budget_cut_rewrites_stop_reason(self, model):
         """A stop match beyond the request budget is evidence the client
         never sees — the delivered reason must be max_new_tokens (the

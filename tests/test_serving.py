@@ -420,7 +420,7 @@ class TestBoundedAttentionWindow:
         # jitted impl directly with attend_len=0
         import jax.numpy as jnp
 
-        full.cache, full.last_token, full.lengths, toks = (
+        full.cache, full.last_token, full.lengths, toks, _ = (
             full._decode_block(
                 full.params, full.cache, full.last_token, full.lengths,
                 jax.random.key(0), jnp.float32(1e-6),
@@ -460,6 +460,101 @@ def first_match(seq, sub):
         if seq[i:i + len(sub)] == sub:
             return i
     raise AssertionError("stop not in oracle")
+
+
+class TestLogprobs:
+    def oracle_logprobs(self, model, params, prompt, tokens):
+        """log p(token_i | prompt + tokens[:i]) from the full forward."""
+        out = []
+        ctx = list(prompt)
+        for t in tokens:
+            logits = model.apply(params, jnp.asarray(ctx, jnp.int32)[None])
+            lp = jax.nn.log_softmax(logits[0, -1])
+            out.append(float(lp[t]))
+            ctx.append(t)
+        return out
+
+    def test_block_decode_logprobs_match_full_forward(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        [res] = eng.generate([[5, 9, 2, 7]], max_new_tokens=8,
+                             block_size=4)
+        assert len(res.logprobs) == len(res.tokens) == 8
+        want = self.oracle_logprobs(m, params, [5, 9, 2, 7], res.tokens)
+        assert res.logprobs == pytest.approx(want, abs=1e-3)
+
+    def test_stepwise_and_block_logprobs_agree(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=16)
+        eng.add_request([5, 9, 2, 7])
+        for _ in range(5):
+            eng.step()
+        req = next(iter(eng.slots.values()))
+        step_lps = list(req.logprobs)
+        eng2 = ServingEngine(m, params, max_batch=1, max_len=64,
+                             prefill_len=16)
+        eng2.add_request([5, 9, 2, 7])
+        eng2.decode_block(5)
+        req2 = next(iter(eng2.slots.values()))
+        assert req2.generated == req.generated
+        assert req2.logprobs == pytest.approx(step_lps, abs=1e-3)
+
+    def test_spec_step_logprobs_match_plain(self, model):
+        m, params = model
+        plain = ServingEngine(m, params, max_batch=1, max_len=64,
+                              prefill_len=16)
+        plain.add_request([5, 9, 2, 7])
+        for _ in range(6):
+            plain.step()
+        spec = ServingEngine(m, params, max_batch=1, max_len=64,
+                             prefill_len=16, draft_model=m,
+                             draft_params=params, spec_k=3)
+        spec.add_request([5, 9, 2, 7])
+        while len(next(iter(spec.slots.values())).generated) < 7:
+            spec.spec_step()
+        p_req = next(iter(plain.slots.values()))
+        s_req = next(iter(spec.slots.values()))
+        n = len(p_req.generated)
+        assert s_req.generated[:n] == p_req.generated
+        assert s_req.logprobs[:n] == pytest.approx(
+            p_req.logprobs, abs=1e-3
+        )
+
+    def test_sampled_logprobs_are_post_filter(self, model):
+        """top_k=1 at temperature 1.0 leaves exactly one candidate, so
+        the logprob under the SAMPLED-FROM (filtered) distribution is 0
+        — while the greedy path reports the unfiltered log_softmax.
+        Catches computing lp before filter_logits (or dropping the
+        temperature divide)."""
+        m, params = model
+        sampled = ServingEngine(m, params, max_batch=1, max_len=64,
+                                prefill_len=16, temperature=1.0,
+                                top_k=1)
+        sampled.add_request([5, 9, 2, 7])
+        sampled.decode_block(5)
+        s_req = next(iter(sampled.slots.values()))
+        assert s_req.logprobs == pytest.approx([0.0] * 6, abs=1e-5)
+        greedy = ServingEngine(m, params, max_batch=1, max_len=64,
+                               prefill_len=16)
+        greedy.add_request([5, 9, 2, 7])
+        greedy.decode_block(5)
+        g_req = next(iter(greedy.slots.values()))
+        # same tokens (top_k=1 == argmax), different (real) logprobs
+        assert g_req.generated == s_req.generated
+        assert any(x < -1e-4 for x in g_req.logprobs)
+
+    def test_logprobs_lockstep_with_stop_truncation(self, model):
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 12)
+        stop = oracle[3:5]
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        [res] = eng.generate([[5, 9, 2, 7]], max_new_tokens=12,
+                             block_size=4, stop=stop)
+        assert res.finished_reason == "stop"
+        assert len(res.logprobs) == len(res.tokens)
 
 
 class TestStopSequences:
