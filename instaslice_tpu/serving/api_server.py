@@ -145,7 +145,7 @@ class _Scheduler(threading.Thread):
             for slot, req in list(eng.slots.items()):
                 p = self._by_rid.get(req.request_id)
                 if p is not None and p.timed_out:
-                    del eng.slots[slot]
+                    eng.evict_slot(slot)
                     self._by_rid.pop(req.request_id, None)
                     self._budget.pop(req.request_id, None)
                     self.metrics.requests.labels(outcome="timeout").inc()
@@ -158,12 +158,7 @@ class _Scheduler(threading.Thread):
             for slot, req in list(eng.slots.items()):
                 b = self._budget.get(req.request_id)
                 if b is not None and len(req.generated) >= b:
-                    eng.finished.append(GenerationResult(
-                        req.request_id, req.prompt, req.generated[:b],
-                        "max_new_tokens",
-                        logprobs=req.logprobs[:b],
-                    ))
-                    del eng.slots[slot]
+                    eng.finish_slot(slot, n_keep=b)
             self._deliver()
             if not eng.slots:
                 self.stop_flag.wait(0.005)

@@ -1,0 +1,131 @@
+"""Multi-host serving smoke: the engine running SPMD over a DCN mesh.
+
+Run inside every worker pod of a multi-host grant (or from the
+two-process CPU test in ``tests/test_distributed.py``). Each worker:
+
+1. parses the agent's handoff env and rendezvouses through
+   :func:`initialize_distributed` (same seam as ``parallel/dcn_smoke``),
+2. builds ONE global ``("model",)`` mesh over every process's devices —
+   tensor parallelism spanning hosts: ICI within each host part, DCN
+   between them,
+3. builds an identical :class:`ServingEngine` over that mesh and runs an
+   identical op sequence (admit → block decode). Multi-process JAX is
+   SPMD: every process must execute the same jitted calls in the same
+   order — exactly what the driver/follower op-stream
+   (:mod:`instaslice_tpu.serving.distributed`) guarantees for live
+   traffic; this smoke runs the static equivalent.
+
+Every worker must print the SAME tokens (greedy, deterministic), and
+they must equal the single-process reference for the same seed — a
+wrong collective, a diverged op stream, or a non-replicated readback
+all produce different tokens (or a distributed-runtime error).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    # must happen before the jax backend initializes
+    if os.environ.get("TPUSLICE_SMOKE_FORCE_CPU"):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+
+    n_local = int(os.environ.get("TPUSLICE_SMOKE_CPU_DEVICES", "0"))
+    if n_local:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n_local)
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.parallel.meshenv import (
+        SliceTopology,
+        initialize_distributed,
+    )
+    from instaslice_tpu.serving import ServingEngine
+
+    topo = SliceTopology.from_env()
+    port = int(os.environ.get("TPUSLICE_SMOKE_PORT", "8477"))
+    initialize_distributed(topo, port=port)
+
+    devs = jax.devices()                      # global, post-rendezvous
+    mesh = Mesh(np.array(devs), ("model",))
+
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=len(devs), n_layers=2,
+        d_ff=64, dtype=jax.numpy.float32, remat=False,
+    )
+    eng = ServingEngine(
+        TpuLM(cfg), max_batch=2, max_len=64, prefill_len=8, mesh=mesh,
+    )
+    result = {
+        "worker_id": topo.worker_id,
+        "processes_seen": len({d.process_index for d in devs}),
+        "global_devices": len(devs),
+    }
+
+    if os.environ.get("TPUSLICE_SMOKE_MODE") == "oplog":
+        # dynamic traffic through the driver/follower op stream
+        from instaslice_tpu.serving.distributed import (
+            DistributedEngine,
+            run_follower,
+        )
+
+        oplog_port = int(os.environ["TPUSLICE_OPLOG_PORT"])
+        if topo.worker_id == 0:
+            deng = DistributedEngine(
+                eng, n_followers=topo.num_workers - 1, port=oplog_port,
+            )
+            run_script(deng)
+            deng.shutdown()
+        else:
+            # worker 0's hostname is the driver — the same coordinator
+            # convention meshenv's rendezvous uses (on a real grant this
+            # is worker 0's pod name over the headless Service)
+            run_follower(eng, topo.hostnames[0], oplog_port)
+        result["digest"] = state_digest(eng)
+    else:
+        # static op stream: every worker just runs the same sequence
+        rid = eng.add_request([5, 9, 2, 7])
+        out = eng.decode_block(8)
+        result["tokens"] = [int(t) for t in out[rid]]
+
+    print(json.dumps(result))
+    return 0
+
+
+def run_script(eng) -> None:
+    """The dynamic driver script the test replays single-process:
+    ragged admissions, block decodes, an external budget cut."""
+    eng.add_request([5, 9, 2, 7])
+    eng.decode_block(3)
+    eng.add_request([11, 3], stop=None)        # admitted mid-flight
+    eng.decode_block(3)
+    # external budget cut of the first slot (slot 0), keep 4 tokens
+    eng.finish_slot(0, n_keep=4)
+    eng.decode_block(2)
+
+
+def state_digest(eng) -> dict:
+    """Engine-state fingerprint that must agree across all workers."""
+    return {
+        "finished": [
+            [r.request_id, r.tokens, r.finished_reason]
+            for r in eng.finished
+        ],
+        "live": {
+            str(slot): req.generated
+            for slot, req in sorted(eng.slots.items())
+        },
+        "tokens_generated": eng.tokens_generated,
+    }
+
+
+if __name__ == "__main__":
+    sys.exit(main())

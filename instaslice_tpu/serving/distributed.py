@@ -1,0 +1,254 @@
+"""Multi-host serving: the driver/follower op-stream.
+
+Multi-process JAX is SPMD — every process of a DCN-spanning mesh must
+execute the SAME jitted calls in the SAME order, or the collectives
+deadlock. An HTTP server takes requests on one host only, so serving a
+multi-host slice needs exactly one new mechanism: worker 0 (the
+**driver**) decides the op sequence and broadcasts it; workers 1..N-1
+(**followers**) replay it verbatim on their local :class:`ServingEngine`
+replica. Engines are deterministic given the same op sequence (same
+seed, same host bookkeeping), so every process issues identical compiled
+programs and the tensor-parallel collectives line up. Results are read
+on the driver only — the engine's token outputs are replicated across
+the mesh (``ServingEngine`` forces replicated out-shardings on
+multi-process meshes), so worker 0 fully addresses them.
+
+This is the TPU-native analog of vLLM's driver/worker RPC split, with
+the op-log as the entire protocol: newline-delimited JSON over one TCP
+connection per follower, ops applied strictly in order.
+
+Wire format (one JSON object per line)::
+
+    {"op": "add_request", "prompt": [...], "stop": [[...]]}
+    {"op": "step"} | {"op": "decode_block", "n": 8} | {"op": "spec_step"}
+    {"op": "register_prefix", "tokens": [...]}
+    {"op": "drop_prefix", "tokens": [...]}
+    {"op": "finish_slot", "slot": 0, "n_keep": 5, "reason": "..."}
+    {"op": "evict_slot", "slot": 0}
+    {"op": "shutdown"}
+
+Usage — driver (worker 0)::
+
+    eng = ServingEngine(model, mesh=global_mesh, ...)
+    deng = DistributedEngine(eng, n_followers=topo.num_workers - 1,
+                             port=oplog_port)   # blocks for followers
+    deng.generate(prompts, max_new_tokens=64)   # or ApiServer(deng)
+
+followers (workers 1..N-1)::
+
+    eng = ServingEngine(model, mesh=global_mesh, ...)  # identical args
+    run_follower(eng, driver_host, oplog_port)         # blocks
+
+``ApiServer(deng)`` works unchanged: the scheduler only mutates the
+engine through the public ops this wrapper broadcasts
+(``add_request`` / ``decode_block`` / ``spec_step`` / ``finish_slot`` /
+``evict_slot`` / prefix ops).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import time
+from typing import List, Optional
+
+from instaslice_tpu.serving.engine import ServingEngine
+
+log = logging.getLogger("instaslice_tpu.serving.distributed")
+
+
+class DistributedEngine:
+    """Worker-0 wrapper: broadcast each op to every follower, then
+    apply it locally. Reads (``slots``, ``finished``, counters…)
+    delegate to the local engine untouched."""
+
+    def __init__(self, engine: ServingEngine, n_followers: int,
+                 port: int, bind_host: str = "0.0.0.0",
+                 accept_timeout: float = 120.0) -> None:
+        self.engine = engine
+        self._conns: List[socket.socket] = []
+        if n_followers:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((bind_host, port))
+            srv.listen(n_followers)
+            srv.settimeout(accept_timeout)
+            for _ in range(n_followers):
+                conn, _addr = srv.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns.append(conn)
+            srv.close()
+
+    # ------------------------------------------------------------- plumbing
+
+    def __setattr__(self, name, value):
+        # generate() (run unbound over this wrapper) reassigns engine
+        # attributes like ``finished`` — route them to the engine so the
+        # wrapper never shadows live state
+        if name in ("engine", "_conns"):
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self.engine, name, value)
+
+    def _bcast(self, op: dict) -> None:
+        """Send to every live follower. A dead follower is dropped with
+        a loud log instead of raising into the scheduler thread — on
+        real multi-host its devices are gone from the mesh anyway (a
+        jax.distributed failure), and the local server must keep
+        serving/failing requests rather than silently dying."""
+        line = (json.dumps(op) + "\n").encode()
+        dead = []
+        for c in self._conns:
+            try:
+                c.sendall(line)
+            except OSError as e:
+                log.error("dropping dead follower %s: %s",
+                          c.getpeername() if c.fileno() >= 0 else "?", e)
+                dead.append(c)
+        for c in dead:
+            self._conns.remove(c)
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def __getattr__(self, name):
+        # reads and non-broadcast helpers fall through to the engine
+        return getattr(self.engine, name)
+
+    # ------------------------------------------------------------- the ops
+
+    def add_request(self, prompt: List[int], stop=None) -> int:
+        # host-side validation BEFORE the broadcast: a rejected request
+        # must not enter the op stream at all. (Followers additionally
+        # swallow deterministic validation errors, so even a op that
+        # slips through fails identically on every replica.)
+        stop = ServingEngine._normalize_stop(stop)
+        self.engine._check_prompt_fits(prompt)
+        self.engine._first_free_slot("no free slots")
+        self._bcast({"op": "add_request", "prompt": list(prompt),
+                     "stop": stop})
+        return self.engine.add_request(prompt, stop=stop)
+
+    def step(self):
+        self._bcast({"op": "step"})
+        return self.engine.step()
+
+    def decode_block(self, n_steps: int):
+        self._bcast({"op": "decode_block", "n": n_steps})
+        return self.engine.decode_block(n_steps)
+
+    def spec_step(self):
+        self._bcast({"op": "spec_step"})
+        return self.engine.spec_step()
+
+    def register_prefix(self, prefix: List[int]) -> None:
+        if tuple(prefix) not in self.engine.prefixes:
+            self.engine._validate_prefix(prefix)   # before the broadcast
+        self._bcast({"op": "register_prefix", "tokens": list(prefix)})
+        self.engine.register_prefix(prefix)
+
+    def drop_prefix(self, prefix: List[int]) -> bool:
+        self._bcast({"op": "drop_prefix", "tokens": list(prefix)})
+        return self.engine.drop_prefix(prefix)
+
+    def finish_slot(self, slot: int, n_keep: Optional[int] = None,
+                    reason: str = "max_new_tokens") -> None:
+        self._bcast({"op": "finish_slot", "slot": slot,
+                     "n_keep": n_keep, "reason": reason})
+        self.engine.finish_slot(slot, n_keep=n_keep, reason=reason)
+
+    def evict_slot(self, slot: int) -> None:
+        self._bcast({"op": "evict_slot", "slot": slot})
+        self.engine.evict_slot(slot)
+
+    def generate(self, prompts, max_new_tokens, block_size: int = 32,
+                 stop=None):
+        # ServingEngine.generate drives everything through the public
+        # ops above, so running it unbound with this wrapper as `self`
+        # broadcasts every device-touching step (duck typing is the
+        # point: the wrapper IS engine-shaped)
+        return ServingEngine.generate(
+            self, prompts, max_new_tokens, block_size=block_size,
+            stop=stop,
+        )
+
+    def shutdown(self) -> None:
+        """Release the followers (they return from run_follower)."""
+        self._bcast({"op": "shutdown"})
+        for c in self._conns:
+            c.close()
+        self._conns = []
+
+
+def run_follower(engine: ServingEngine, driver_host: str, port: int,
+                 connect_timeout: float = 120.0) -> int:
+    """Replay the driver's op stream on the local engine replica until
+    shutdown/EOF; returns the number of ops applied.
+
+    Every op triggers the same jitted calls the driver issues, which is
+    what keeps the multi-process collectives aligned. Results are
+    intentionally discarded — the driver owns delivery."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    deadline = time.monotonic() + connect_timeout
+    while True:
+        try:
+            sock.connect((driver_host, port))
+            break
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    applied = 0
+    buf = b""
+    try:
+        while True:
+            nl = buf.find(b"\n")
+            if nl < 0:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    return applied                    # driver went away
+                buf += chunk
+                continue
+            line, buf = buf[:nl], buf[nl + 1:]
+            op = json.loads(line)
+            kind = op["op"]
+            if kind == "shutdown":
+                return applied
+            if kind not in ("add_request", "step", "decode_block",
+                            "spec_step", "register_prefix",
+                            "drop_prefix", "finish_slot", "evict_slot"):
+                # a protocol mismatch is NOT deterministic-skip
+                # territory: replicas are about to diverge — die loudly
+                raise RuntimeError(f"unknown op {kind!r} in op stream")
+            try:
+                if kind == "add_request":
+                    engine.add_request(op["prompt"], stop=op["stop"])
+                elif kind == "step":
+                    engine.step()
+                elif kind == "decode_block":
+                    engine.decode_block(op["n"])
+                elif kind == "spec_step":
+                    engine.spec_step()
+                elif kind == "register_prefix":
+                    engine.register_prefix(op["tokens"])
+                elif kind == "drop_prefix":
+                    engine.drop_prefix(op["tokens"])
+                elif kind == "finish_slot":
+                    engine.finish_slot(op["slot"], n_keep=op["n_keep"],
+                                       reason=op["reason"])
+                elif kind == "evict_slot":
+                    engine.evict_slot(op["slot"])
+            except (ValueError, RuntimeError, KeyError) as e:
+                # deterministic host-side validation failure: the
+                # driver hit (or pre-screened) the exact same error, so
+                # replica state stays aligned by SKIPPING it here too
+                log.warning("skipping op %s: %s", kind, e)
+            # results are the driver's business: drain the follower's
+            # finished list so it can't grow without bound
+            engine.finished.clear()
+            applied += 1
+    finally:
+        sock.close()

@@ -188,26 +188,60 @@ class ServingEngine:
                     )
                 )
 
-        self._prefill = jax.jit(self._prefill_impl)
+        # multi-process (multi-host) mesh: every process executes the
+        # same jitted calls (the driver/follower op-stream,
+        # serving/distributed.py); host-side readbacks then need the
+        # token/logit outputs REPLICATED (a process can only fetch a
+        # global array it fully addresses), and host-created inputs
+        # must be placed as global replicated arrays, not process-local
+        self._multiproc = mesh is not None and len(
+            {d.process_index for d in mesh.devices.flat}
+        ) > 1
+        self._replicated = (
+            NamedSharding(mesh, P()) if mesh is not None else None
+        )
+
+        def rep(tree_of_outputs_spec):
+            # out_shardings pytree: replicate selected outputs, leave
+            # the rest (None) to sharding propagation
+            return tree_of_outputs_spec if self._multiproc else None
+
+        self._prefill = jax.jit(
+            self._prefill_impl,
+            out_shardings=rep((None, self._replicated)),
+        )
         # stripe length is a static shape: one compile per distinct
         # registered-prefix length (chunk multiples keep the set small)
         self._read_stripe = jax.jit(
             self._read_stripe_impl, static_argnames=("length",)
         )
         self._write_stripe = jax.jit(self._write_stripe_impl)
-        self._decode = jax.jit(self._decode_impl)
+        self._decode = jax.jit(
+            self._decode_impl,
+            out_shardings=rep((None, self._replicated)),
+        )
         self._decode_block = jax.jit(
             self._decode_block_impl,
             static_argnames=("n_steps", "greedy", "attend_len",
                              "top_k", "top_p"),
+            out_shardings=rep(
+                (None, self._replicated, self._replicated,
+                 self._replicated, self._replicated)
+            ),
         )
         if draft_model is not None:
             self._draft_prefill = jax.jit(self._draft_prefill_impl)
             self._draft_catchup = jax.jit(self._draft_catchup_impl)
             self._spec_draft = jax.jit(
-                self._spec_draft_impl, static_argnames=("k",)
+                self._spec_draft_impl, static_argnames=("k",),
+                out_shardings=rep((None, self._replicated)),
             )
-            self._spec_verify = jax.jit(self._spec_verify_impl)
+            self._spec_verify = jax.jit(
+                self._spec_verify_impl,
+                out_shardings=rep(
+                    (None, self._replicated, self._replicated)
+                ),
+            )
 
     def _shard_model_state(self, mesh: Mesh, model: TpuLM, params, cache):
         """One model's tensor-parallel layout over the mesh's ``model``
@@ -413,6 +447,30 @@ class ServingEngine:
     def free_slots(self) -> int:
         return self.max_batch - len(self.slots)
 
+    def finish_slot(self, slot: int, n_keep: Optional[int] = None,
+                    reason: str = "max_new_tokens") -> None:
+        """Externally finish a live slot (budget cut, client eviction):
+        move it to ``finished`` with at most ``n_keep`` tokens.
+
+        All EXTERNAL slot removals must go through here — slot
+        occupancy feeds the compiled decode's static attend window, so
+        in multi-process serving this op is part of the broadcast
+        stream (:mod:`instaslice_tpu.serving.distributed`); internal
+        removals (eos/stop/max_len in ``_maybe_finish``) replay
+        deterministically from the op stream and need no broadcast."""
+        req = self.slots.pop(slot)
+        toks = req.generated if n_keep is None else req.generated[:n_keep]
+        lps = req.logprobs if n_keep is None else req.logprobs[:n_keep]
+        self.finished.append(
+            GenerationResult(req.request_id, req.prompt, toks, reason,
+                             logprobs=lps)
+        )
+
+    def evict_slot(self, slot: int) -> None:
+        """Drop a live slot with NO result (abandoned request): the
+        tokens were never delivered to anyone."""
+        self.slots.pop(slot)
+
     def _first_free_slot(self, why: str) -> int:
         """Slot-allocation policy, shared by admission and prefix
         registration so the two cannot drift."""
@@ -492,6 +550,20 @@ class ServingEngine:
         key = tuple(prefix)
         if key in self.prefixes:
             return
+        self._validate_prefix(prefix)
+        slot = self._first_free_slot("no free slots to prefill the prefix")
+        self._prefill_chunks(slot, list(prefix))
+        stripe = self._read_stripe(self.cache, slot, length=len(prefix))
+        draft_stripe = None
+        if self.draft_model is not None:
+            draft_stripe = self._read_stripe(
+                self.draft_cache, slot, length=len(prefix)
+            )
+        self.prefixes[key] = _Prefix(key, stripe, draft_stripe)
+
+    def _validate_prefix(self, prefix: List[int]) -> None:
+        """Host-side registration checks, raised BEFORE any device op
+        (so a multi-host driver can validate before broadcasting)."""
         P = self.prefill_len
         if not prefix or len(prefix) % P:
             raise ValueError(
@@ -512,15 +584,7 @@ class ServingEngine:
                 f"prefix cache full ({self.max_prefixes}); drop_prefix "
                 "one first (each stored stripe pins HBM)"
             )
-        slot = self._first_free_slot("no free slots to prefill the prefix")
-        self._prefill_chunks(slot, list(prefix))
-        stripe = self._read_stripe(self.cache, slot, length=len(prefix))
-        draft_stripe = None
-        if self.draft_model is not None:
-            draft_stripe = self._read_stripe(
-                self.draft_cache, slot, length=len(prefix)
-            )
-        self.prefixes[key] = _Prefix(key, stripe, draft_stripe)
+        self._first_free_slot("no free slots to prefill the prefix")
 
     def drop_prefix(self, prefix: List[int]) -> bool:
         """Free a registered prefix's stored stripe (HBM)."""
@@ -834,17 +898,9 @@ class ServingEngine:
                     req.request_id in budget
                     and len(req.generated) >= budget[req.request_id]
                 ):
-                    self.finished.append(
-                        GenerationResult(
-                            req.request_id, req.prompt,
-                            req.generated[: budget[req.request_id]],
-                            "max_new_tokens",
-                            logprobs=req.logprobs[
-                                : budget[req.request_id]
-                            ],
-                        )
+                    self.finish_slot(
+                        slot, n_keep=budget[req.request_id]
                     )
-                    del self.slots[slot]
             # harvest only our own finished entries; leave results that
             # belong to requests outside this call for their owners
             remaining: List[GenerationResult] = []

@@ -55,50 +55,55 @@ def _worker_envs():
     ]
 
 
+def _spawn_workers(module: str, extra_env=None, timeout=240):
+    """Run ``module`` in one process per worker of the two-host grant;
+    returns each worker's last-stdout-line JSON."""
+    envs = _worker_envs()
+    assert len(envs) == 2
+    port = free_port()
+    procs = []
+    for env in envs:
+        child = dict(os.environ)
+        child.update(env)
+        # pod names resolve over the cluster's headless Service; in
+        # this two-process test both workers are this host
+        child["TPU_WORKER_HOSTNAMES"] = "127.0.0.1,127.0.0.1"
+        child["TPUSLICE_SMOKE_PORT"] = str(port)
+        child["TPUSLICE_SMOKE_FORCE_CPU"] = "1"
+        child["TPUSLICE_SMOKE_CPU_DEVICES"] = str(LOCAL_DEVICES)
+        child.pop("XLA_FLAGS", None)  # no forced 8-dev override
+        # a single-chip TPU tunnel (if the session has one) cannot be
+        # claimed by two processes at once — its interpreter hook
+        # registers at startup and the second claim blocks forever;
+        # these workers are CPU-only by design, so drop the trigger
+        child.pop("PALLAS_AXON_POOL_IPS", None)
+        child["JAX_PLATFORMS"] = "cpu"
+        child.update(extra_env or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", module],
+                env=child,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            stdout, stderr = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("rendezvous hung: worker never completed")
+        assert p.returncode == 0, stderr.decode()[-800:]
+        outs.append(json.loads(stdout.decode().strip().splitlines()[-1]))
+    return outs
+
+
 class TestDcnRendezvous:
     def test_two_process_psum(self):
-        envs = _worker_envs()
-        assert len(envs) == 2
-        port = free_port()
-        procs = []
-        for env in envs:
-            child = dict(os.environ)
-            child.update(env)
-            # pod names resolve over the cluster's headless Service; in
-            # this two-process test both workers are this host
-            child["TPU_WORKER_HOSTNAMES"] = "127.0.0.1,127.0.0.1"
-            child["TPUSLICE_SMOKE_PORT"] = str(port)
-            child["TPUSLICE_SMOKE_FORCE_CPU"] = "1"
-            child["TPUSLICE_SMOKE_CPU_DEVICES"] = str(LOCAL_DEVICES)
-            child.pop("XLA_FLAGS", None)  # no forced 8-dev override
-            # a single-chip TPU tunnel (if the session has one) cannot be
-            # claimed by two processes at once — its interpreter hook
-            # registers at startup and the second claim blocks forever;
-            # these workers are CPU-only by design, so drop the trigger
-            child.pop("PALLAS_AXON_POOL_IPS", None)
-            child["JAX_PLATFORMS"] = "cpu"
-            procs.append(
-                subprocess.Popen(
-                    [sys.executable, "-m",
-                     "instaslice_tpu.parallel.dcn_smoke"],
-                    env=child,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE,
-                )
-            )
-        outs = []
-        for p in procs:
-            try:
-                stdout, stderr = p.communicate(timeout=180)
-            except subprocess.TimeoutExpired:
-                for q in procs:
-                    q.kill()
-                raise AssertionError(
-                    "rendezvous hung: worker never completed"
-                )
-            assert p.returncode == 0, stderr.decode()[-800:]
-            outs.append(json.loads(stdout.decode().strip().splitlines()[-1]))
-
+        outs = _spawn_workers("instaslice_tpu.parallel.dcn_smoke",
+                              timeout=180)
         # every worker saw both processes and all devices
         expected_total = sum(
             (w + 1) * LOCAL_DEVICES for w in range(2)
@@ -110,3 +115,160 @@ class TestDcnRendezvous:
             assert out["local_devices"] == LOCAL_DEVICES
             assert out["psum_total"] == expected_total
         assert sorted(o["worker_id"] for o in outs) == [0, 1]
+
+
+class TestDcnServing:
+    def test_two_process_tensor_parallel_decode(self):
+        """The serving engine running SPMD over a DCN-spanning mesh:
+        both workers execute the identical op stream and must produce
+        identical tokens — equal to a single-process reference."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+        import numpy as np
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine
+
+        outs = _spawn_workers("instaslice_tpu.serving.dcn_serve_smoke")
+        assert all(o["processes_seen"] == 2 for o in outs)
+        assert all(o["global_devices"] == 2 * LOCAL_DEVICES for o in outs)
+        # both workers saw the same chain
+        assert outs[0]["tokens"] == outs[1]["tokens"]
+        assert len(outs[0]["tokens"]) == 8
+        # …and it matches this process's single-mesh reference (same
+        # seed, same config — 8 local CPU devices from conftest)
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=2 * LOCAL_DEVICES,
+            n_layers=2, d_ff=64, dtype=jnp.float32, remat=False,
+        )
+        mesh = Mesh(np.array(jax.devices()[:8]), ("model",))
+        ref = ServingEngine(TpuLM(cfg), max_batch=2, max_len=64,
+                            prefill_len=8, mesh=mesh)
+        rid = ref.add_request([5, 9, 2, 7])
+        want = ref.decode_block(8)[rid]
+        assert outs[0]["tokens"] == want
+
+    def test_two_process_oplog_driver_follower(self):
+        """Dynamic traffic over the driver/follower op stream: worker 0
+        drives ragged admissions + an external budget cut; worker 1
+        replays the broadcast ops. Both engines must land in an
+        identical state, equal to a single-process replay."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+        import numpy as np
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine
+        from instaslice_tpu.serving.dcn_serve_smoke import (
+            run_script,
+            state_digest,
+        )
+
+        outs = _spawn_workers(
+            "instaslice_tpu.serving.dcn_serve_smoke",
+            extra_env={
+                "TPUSLICE_SMOKE_MODE": "oplog",
+                "TPUSLICE_OPLOG_PORT": str(free_port()),
+            },
+        )
+        # …the driver's state equals this process's single-mesh replay
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=2 * LOCAL_DEVICES,
+            n_layers=2, d_ff=64, dtype=jnp.float32, remat=False,
+        )
+        mesh = Mesh(np.array(jax.devices()[:8]), ("model",))
+        ref = ServingEngine(TpuLM(cfg), max_batch=2, max_len=64,
+                            prefill_len=8, mesh=mesh)
+        run_script(ref)
+        # followers drain `finished` (results are the driver's
+        # business); compare the follower on live state only
+        f_digest = dict(outs[1]["digest"], finished=[])
+        d_digest = dict(outs[0]["digest"])
+        assert f_digest == dict(d_digest, finished=[])
+        assert outs[0]["digest"] == state_digest(ref)
+        # the budget-cut request really finished with 4 tokens
+        assert outs[0]["digest"]["finished"][0][1:] == [
+            state_digest(ref)["finished"][0][1],
+            "max_new_tokens",
+        ]
+
+
+class TestApiServerOverDistributedEngine:
+    def test_scheduler_only_mutates_via_broadcast_ops(self):
+        """ApiServer(DistributedEngine) with a same-process follower
+        replica: after live HTTP traffic (including an evicted 503),
+        the follower's replayed state must equal the driver's — any
+        scheduler mutation that bypassed the broadcast surface would
+        diverge the replicas (and, on real multi-host, deadlock)."""
+        import json as _json
+        import threading
+        import time as _time
+        import urllib.request
+
+        import jax
+        import jax.numpy as jnp
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine
+        from instaslice_tpu.serving.api_server import ApiServer
+        from instaslice_tpu.serving.distributed import (
+            DistributedEngine,
+            run_follower,
+        )
+
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            dtype=jnp.float32, remat=False,
+        )
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+        driver_eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                                   prefill_len=8)
+        follower_eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                                     prefill_len=8)
+        port = free_port()
+        follower = threading.Thread(
+            target=run_follower,
+            args=(follower_eng, "127.0.0.1", port),
+            daemon=True,
+        )
+        follower.start()
+        deng = DistributedEngine(driver_eng, n_followers=1, port=port)
+
+        def post(url, payload, timeout=60):
+            req = urllib.request.Request(
+                f"{url}/v1/completions",
+                data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return r.status, _json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, _json.loads(e.read().decode())
+
+        with ApiServer(deng, request_timeout=20) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 6})
+            assert code == 200
+            assert len(out["choices"][0]["token_ids"]) == 6
+            code, _ = post(srv.url, {"prompt": [11, 3],
+                                     "max_tokens": 4})
+            assert code == 200
+            # wait for the scheduler to go idle
+            deadline = _time.monotonic() + 20
+            while _time.monotonic() < deadline and driver_eng.slots:
+                _time.sleep(0.05)
+        deng.shutdown()
+        follower.join(timeout=10)
+        assert not follower.is_alive()
+        # replicas agree on everything that feeds the compiled calls
+        assert follower_eng.slots.keys() == driver_eng.slots.keys()
+        for s in driver_eng.slots:
+            assert (follower_eng.slots[s].generated
+                    == driver_eng.slots[s].generated)
+        assert (follower_eng.tokens_generated
+                == driver_eng.tokens_generated)
