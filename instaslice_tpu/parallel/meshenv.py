@@ -96,7 +96,7 @@ class SliceTopology:
 
 
 def initialize_distributed(
-    topo: Optional[SliceTopology] = None, port: int = 8476
+    topo: Optional[SliceTopology] = None, port: Optional[int] = None
 ) -> None:
     """``jax.distributed.initialize`` for a multi-host slice.
 
@@ -106,6 +106,10 @@ def initialize_distributed(
     construction (SURVEY.md §7 "Multi-host slices ... is new design").
     No-op for single-worker slices.
     """
+    if port is None:
+        # overridable for callers that can't pass a port (the serve
+        # CLI's --from-env path, colocated test workers)
+        port = int(os.environ.get("TPUSLICE_COORDINATOR_PORT", "8476"))
     topo = topo or SliceTopology.from_env()
     if topo.num_workers <= 1:
         return

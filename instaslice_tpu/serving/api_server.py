@@ -557,6 +557,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--from-env", action="store_true",
                     help="build the TP mesh from the granted slice's "
                     "handoff env (TPU_* vars) instead of one device")
+    ap.add_argument("--oplog-port", type=int, default=8478,
+                    help="multi-host grants: TCP port for the driver/"
+                         "follower op stream (worker 0 serves HTTP and "
+                         "broadcasts; other workers replay)")
     return ap
 
 
@@ -641,6 +645,33 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO)
     engine = build_engine(args)
     mesh, quantized = engine.mesh, args.quantize
+    if args.from_env:
+        from instaslice_tpu.parallel.meshenv import SliceTopology
+
+        topo = SliceTopology.from_env()
+        if topo.num_workers > 1:
+            from instaslice_tpu.serving.distributed import (
+                DistributedEngine,
+                run_follower,
+            )
+
+            if topo.worker_id != 0:
+                # followers replay worker 0's op stream until the
+                # driver shuts down, then exit — same lifecycle as the
+                # driver pod (the Deployment restarts both together)
+                log.info(
+                    "worker %d following driver %s:%d",
+                    topo.worker_id, topo.hostnames[0], args.oplog_port,
+                )
+                run_follower(engine, topo.hostnames[0], args.oplog_port)
+                log.info("driver closed the op stream; exiting")
+                return 0
+            log.info("worker 0 driving %d followers on port %d",
+                     topo.num_workers - 1, args.oplog_port)
+            engine = DistributedEngine(
+                engine, n_followers=topo.num_workers - 1,
+                port=args.oplog_port,
+            )
     srv = ApiServer(engine, host=args.host, port=args.port,
                     request_timeout=args.request_timeout).start()
     if args.metrics_port:

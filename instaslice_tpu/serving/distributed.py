@@ -57,6 +57,20 @@ from instaslice_tpu.serving.engine import ServingEngine
 
 log = logging.getLogger("instaslice_tpu.serving.distributed")
 
+#: follower handshake marker (first line on connect)
+HELLO_MAGIC = "tpuslice-oplog-v1"
+
+
+def _recv_line(sock: socket.socket, limit: int = 4096) -> bytes:
+    """Read up to the first newline (handshake use; tiny payload)."""
+    buf = b""
+    while b"\n" not in buf:
+        chunk = sock.recv(1024)
+        if not chunk or len(buf) > limit:
+            raise OSError("connection closed during handshake")
+        buf += chunk
+    return buf.split(b"\n", 1)[0]
+
 
 class DistributedEngine:
     """Worker-0 wrapper: broadcast each op to every follower, then
@@ -67,17 +81,32 @@ class DistributedEngine:
                  port: int, bind_host: str = "0.0.0.0",
                  accept_timeout: float = 120.0) -> None:
         self.engine = engine
-        self._conns: List[socket.socket] = []
+        self._conns: List[tuple] = []       # (socket, peer-addr string)
         if n_followers:
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             srv.bind((bind_host, port))
-            srv.listen(n_followers)
-            srv.settimeout(accept_timeout)
-            for _ in range(n_followers):
-                conn, _addr = srv.accept()
+            srv.listen(n_followers + 4)
+            deadline = time.monotonic() + accept_timeout
+            while len(self._conns) < n_followers:
+                srv.settimeout(max(deadline - time.monotonic(), 0.001))
+                conn, addr = srv.accept()
+                # one-line hello gates the op stream: a stray connector
+                # (port scan, prober) must not consume a follower slot
+                # or receive the broadcast (it carries prompt tokens)
+                try:
+                    conn.settimeout(10.0)
+                    hello = json.loads(_recv_line(conn))
+                    if hello.get("hello") != HELLO_MAGIC:
+                        raise ValueError("bad hello")
+                except (ValueError, OSError):
+                    log.warning("rejecting non-follower connection "
+                                "from %s", addr)
+                    conn.close()
+                    continue
+                conn.settimeout(None)
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._conns.append(conn)
+                self._conns.append((conn, f"{addr[0]}:{addr[1]}"))
             srv.close()
 
     # ------------------------------------------------------------- plumbing
@@ -99,17 +128,20 @@ class DistributedEngine:
         serving/failing requests rather than silently dying."""
         line = (json.dumps(op) + "\n").encode()
         dead = []
-        for c in self._conns:
+        for pair in self._conns:
+            conn, addr = pair
             try:
-                c.sendall(line)
+                conn.sendall(line)
             except OSError as e:
-                log.error("dropping dead follower %s: %s",
-                          c.getpeername() if c.fileno() >= 0 else "?", e)
-                dead.append(c)
-        for c in dead:
-            self._conns.remove(c)
+                # addr captured at accept time: a reset socket raises
+                # ENOTCONN from getpeername(), which would escape this
+                # handler and kill the scheduler thread
+                log.error("dropping dead follower %s: %s", addr, e)
+                dead.append(pair)
+        for pair in dead:
+            self._conns.remove(pair)
             try:
-                c.close()
+                pair[0].close()
             except OSError:
                 pass
 
@@ -177,8 +209,8 @@ class DistributedEngine:
     def shutdown(self) -> None:
         """Release the followers (they return from run_follower)."""
         self._bcast({"op": "shutdown"})
-        for c in self._conns:
-            c.close()
+        for conn, _addr in self._conns:
+            conn.close()
         self._conns = []
 
 
@@ -201,6 +233,7 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                 raise
             time.sleep(0.2)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.sendall((json.dumps({"hello": HELLO_MAGIC}) + "\n").encode())
     applied = 0
     buf = b""
     try:
@@ -241,10 +274,18 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                                        reason=op["reason"])
                 elif kind == "evict_slot":
                     engine.evict_slot(op["slot"])
-            except (ValueError, RuntimeError, KeyError) as e:
+            except (ValueError, KeyError, RuntimeError) as e:
                 # deterministic host-side validation failure: the
                 # driver hit (or pre-screened) the exact same error, so
-                # replica state stays aligned by SKIPPING it here too
+                # replica state stays aligned by SKIPPING it here too.
+                # RuntimeError SUBCLASSES (jaxlib's XlaRuntimeError,
+                # device OOM…) are real per-host failures: skipping
+                # would silently drop a jitted call the driver executed
+                # and deadlock its collectives — die loudly instead so
+                # the pod restarts.
+                if isinstance(e, RuntimeError) and \
+                        type(e) is not RuntimeError:
+                    raise
                 log.warning("skipping op %s: %s", kind, e)
             # results are the driver's business: drain the follower's
             # finished list so it can't grow without bound

@@ -188,17 +188,160 @@ class TestDcnServing:
         d_digest = dict(outs[0]["digest"])
         assert f_digest == dict(d_digest, finished=[])
         assert outs[0]["digest"] == state_digest(ref)
-        # the budget-cut request really finished with 4 tokens
-        assert outs[0]["digest"]["finished"][0][1:] == [
-            state_digest(ref)["finished"][0][1],
-            "max_new_tokens",
-        ]
+        # the budget-cut request really kept exactly 4 tokens (a
+        # literal, so a finish_slot regression can't hide in ref)
+        assert len(outs[0]["digest"]["finished"][0][1]) == 4
+        assert outs[0]["digest"]["finished"][0][2] == "max_new_tokens"
+
+
+class TestServeCliMultiHost:
+    def test_from_env_two_worker_serve(self):
+        """The product path end-to-end: ``tpuslice-serve --from-env``
+        in BOTH worker pods of a two-host grant. Worker 0 rendezvouses,
+        builds the global mesh, drives; worker 1 follows. A completion
+        against worker 0's HTTP port must come back greedy-valid."""
+        import time as _time
+        import urllib.request
+
+        envs = _worker_envs()
+        smoke_port, http_port, oplog_port = (
+            free_port(), free_port(), free_port()
+        )
+        args = ["--from-env", "--port", str(http_port),
+                "--oplog-port", str(oplog_port),
+                "--d-model", "32", "--n-heads", "8", "--n-layers", "2",
+                "--d-ff", "64", "--vocab-size", "64",
+                "--max-batch", "2", "--max-len", "64",
+                "--prefill-len", "8"]
+        procs = []
+        for env in envs:
+            child = dict(os.environ)
+            child.update(env)
+            child["TPU_WORKER_HOSTNAMES"] = "127.0.0.1,127.0.0.1"
+            child["TPUSLICE_COORDINATOR_PORT"] = str(smoke_port)
+            child["XLA_FLAGS"] = (
+                "--xla_force_host_platform_device_count=4"
+            )
+            child.pop("PALLAS_AXON_POOL_IPS", None)
+            child["JAX_PLATFORMS"] = "cpu"
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "instaslice_tpu.serving.api_server"] + args,
+                env=child,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            ))
+        try:
+            url = f"http://127.0.0.1:{http_port}"
+            deadline = _time.monotonic() + 180
+            up = False
+            while _time.monotonic() < deadline:
+                if any(p.poll() is not None for p in procs):
+                    break                   # a worker died — fail below
+                try:
+                    urllib.request.urlopen(url + "/healthz", timeout=2)
+                    up = True
+                    break
+                except OSError:
+                    _time.sleep(1)
+            if not up:
+                errs = []
+                for p in procs:
+                    p.kill()
+                    errs.append(p.communicate()[1].decode()[-400:])
+                raise AssertionError(f"server never came up: {errs}")
+            req = urllib.request.Request(
+                url + "/v1/completions",
+                data=json.dumps({"prompt": [5, 9, 2, 7],
+                                 "max_tokens": 6}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out = json.loads(r.read())
+            toks = out["choices"][0]["token_ids"]
+            assert len(toks) == 6
+            assert all(0 <= t < 64 for t in toks)
+            with urllib.request.urlopen(
+                url + "/v1/stats", timeout=30
+            ) as r:
+                stats = json.loads(r.read())
+            assert stats["mesh"] == {"data": 1, "seq": 1, "model": 8}
+        finally:
+            for p in procs:
+                p.kill()
+                p.communicate()
+
+
+class TestOplogHandshake:
+    def test_stray_connector_rejected(self):
+        """A port-scanner/prober connecting to the oplog port must not
+        consume a follower slot or receive the op stream."""
+        import socket as _socket
+        import threading
+        import time as _time
+
+        import jax
+        import jax.numpy as jnp
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine
+        from instaslice_tpu.serving.distributed import (
+            DistributedEngine,
+            run_follower,
+        )
+
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            dtype=jnp.float32, remat=False,
+        )
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+        driver_eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                                   prefill_len=8)
+        follower_eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                                     prefill_len=8)
+        port = free_port()
+        stray_got = {}
+
+        def stray():
+            s = _socket.socket()
+            deadline = _time.monotonic() + 30
+            while True:
+                try:
+                    s.connect(("127.0.0.1", port))
+                    break
+                except OSError:
+                    if _time.monotonic() > deadline:
+                        return
+                    _time.sleep(0.05)
+            s.sendall(b"GET / HTTP/1.0\r\n\r\n")
+            stray_got["data"] = s.recv(4096)   # b"" == closed on us
+            s.close()
+
+        t_stray = threading.Thread(target=stray, daemon=True)
+        t_stray.start()
+
+        def follower():
+            _time.sleep(0.5)                  # let the stray go first
+            run_follower(follower_eng, "127.0.0.1", port)
+
+        t_follow = threading.Thread(target=follower, daemon=True)
+        t_follow.start()
+        deng = DistributedEngine(driver_eng, n_followers=1, port=port)
+        deng.add_request([5, 9, 2, 7])
+        deng.shutdown()
+        t_follow.join(timeout=15)
+        t_stray.join(timeout=15)
+        assert not t_follow.is_alive()
+        # the real follower replayed the op; the stray got nothing
+        assert 0 in follower_eng.slots
+        assert stray_got.get("data") == b""
 
 
 class TestApiServerOverDistributedEngine:
     def test_scheduler_only_mutates_via_broadcast_ops(self):
         """ApiServer(DistributedEngine) with a same-process follower
-        replica: after live HTTP traffic (including an evicted 503),
+        replica: after live HTTP traffic plus a broadcast eviction,
         the follower's replayed state must equal the driver's — any
         scheduler mutation that bypassed the broadcast surface would
         diverge the replicas (and, on real multi-host, deadlock)."""
@@ -262,6 +405,13 @@ class TestApiServerOverDistributedEngine:
             deadline = _time.monotonic() + 20
             while _time.monotonic() < deadline and driver_eng.slots:
                 _time.sleep(0.05)
+        # broadcast eviction path (what the scheduler's 503 sweep
+        # calls): admit directly through the wrapper, then evict — the
+        # follower must replay both
+        rid = deng.add_request([9, 9])
+        slot = next(s for s, r in driver_eng.slots.items()
+                    if r.request_id == rid)
+        deng.evict_slot(slot)
         deng.shutdown()
         follower.join(timeout=10)
         assert not follower.is_alive()
