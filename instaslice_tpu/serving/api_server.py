@@ -19,7 +19,9 @@ batched completions over HTTP.
   boundary-spanning match never over-delivers). ``"logprobs": true``
   adds each token's log-probability under the distribution it was
   sampled from (post temperature/top-k/top-p), 1:1 with ``token_ids``
-  in both sync and streaming responses.
+  in both sync and streaming responses. ``"n": k`` returns k parallel
+  samples (one prefill, KV-stripe forks; indexed choices; streaming
+  chunks carry their choice index).
 - ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters.
 - ``POST /v1/prefixes`` with ``{"tokens": [token ids]}`` → prefill the
   shared prefix once; later prompts starting with it skip that prefill
@@ -58,27 +60,34 @@ class _Pending:
     def __init__(self, prompt: List[int], max_tokens: int,
                  prefix_op: str = "", stream: bool = False,
                  stop: Optional[List[List[int]]] = None,
-                 want_logprobs: bool = False):
+                 want_logprobs: bool = False, n: int = 1):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.stop = stop or []         # normalized token-id sequences
         self.want_logprobs = want_logprobs
+        self.n = n                     # parallel samples (OpenAI "n")
         # "register"/"drop" → not a completion: mutate the engine's
         # prefix cache on the scheduler thread (the engine owner)
         self.prefix_op = prefix_op
         self.done = threading.Event()
-        self.result: Optional[GenerationResult] = None
+        self.rid_index: Dict[int, int] = {}    # engine rid → choice idx
+        self.results: Dict[int, GenerationResult] = {}  # choice idx → r
         self.error: str = ""
         self.timed_out = False        # set by the HTTP layer on 503,
         #                               or on a broken streaming socket
         self.t0 = time.monotonic()
-        # streaming: the scheduler pushes token chunks (List[int]) after
-        # every decode block; a GenerationResult ends the stream, a str
-        # is a pre-admission error. ``sent`` tracks the delivered count.
+        # streaming: the scheduler pushes dict events after every decode
+        # block ({"kind": "delta"/"final", "index": choice, ...}); a str
+        # is a pre-admission error. ``sent`` tracks per-rid delivery.
         self.stream_q: Optional["queue.Queue"] = (
             queue.Queue() if stream else None
         )
-        self.sent = 0
+        self.sent: Dict[int, int] = {}
+
+    @property
+    def result(self) -> Optional[GenerationResult]:
+        """First choice (the n == 1 common case)."""
+        return self.results.get(0)
 
 
 class _Scheduler(threading.Thread):
@@ -93,6 +102,9 @@ class _Scheduler(threading.Thread):
         self.stop_flag = threading.Event()
         self._by_rid: Dict[int, _Pending] = {}
         self._budget: Dict[int, int] = {}
+        # popped but unadmittable head-of-line request (needs more free
+        # slots than currently available); retried next round, FIFO kept
+        self._head: Optional[_Pending] = None
         if metrics is None:
             from instaslice_tpu.metrics.metrics import ServingMetrics
 
@@ -105,20 +117,26 @@ class _Scheduler(threading.Thread):
     def run(self) -> None:
         eng = self.engine
         while not self.stop_flag.is_set():
-            # admit while there is room
-            while eng.free_slots():
-                try:
-                    p = self.queue.get_nowait()
-                except queue.Empty:
-                    break
+            # admit while there is room (FIFO; a head-of-line request
+            # needing more slots than free waits for the next round)
+            while True:
+                if self._head is not None:
+                    p, self._head = self._head, None
+                else:
+                    try:
+                        p = self.queue.get_nowait()
+                    except queue.Empty:
+                        break
                 if p.timed_out:
                     # queued past its HTTP deadline: the client is gone
                     self.metrics.requests.labels(outcome="timeout").inc()
                     p.done.set()
                     continue
                 if p.prefix_op:
-                    # register needs a free slot to prefill through,
-                    # which the admission loop just guaranteed
+                    # register needs a free slot to prefill through
+                    if not eng.free_slots():
+                        self._head = p
+                        break
                     try:
                         if p.prefix_op == "register":
                             eng.register_prefix(p.prompt)
@@ -128,8 +146,11 @@ class _Scheduler(threading.Thread):
                         p.error = f"{type(e).__name__}: {e}"
                     p.done.set()
                     continue
+                if eng.free_slots() < p.n:
+                    self._head = p
+                    break
                 try:
-                    rid = eng.add_request(p.prompt, stop=p.stop)
+                    rids = eng.add_request_n(p.prompt, p.n, stop=p.stop)
                 except Exception as e:  # bad prompt (too long, empty…)
                     p.error = f"{type(e).__name__}: {e}"
                     self.metrics.requests.labels(outcome="rejected").inc()
@@ -137,8 +158,10 @@ class _Scheduler(threading.Thread):
                         p.stream_q.put(p.error)
                     p.done.set()
                     continue
-                self._by_rid[rid] = p
-                self._budget[rid] = p.max_tokens
+                for i, rid in enumerate(rids):
+                    p.rid_index[rid] = i
+                    self._by_rid[rid] = p
+                    self._budget[rid] = p.max_tokens
             # evict abandoned requests: the HTTP layer already 503'd the
             # client, so decoding the slot to its budget would burn
             # batch capacity producing tokens nobody reads
@@ -148,8 +171,7 @@ class _Scheduler(threading.Thread):
                     eng.evict_slot(slot)
                     self._by_rid.pop(req.request_id, None)
                     self._budget.pop(req.request_id, None)
-                    self.metrics.requests.labels(outcome="timeout").inc()
-                    p.done.set()
+                    self._maybe_complete(p)
             # budget enforcement BEFORE decoding (add_request already
             # produced one token, so a max_tokens=1 arrival is done on
             # admission — decoding first would waste a batch-wide step
@@ -192,9 +214,26 @@ class _Scheduler(threading.Thread):
                 log.exception("decode failed: %s", e)
             self._deliver()
 
+    def _maybe_complete(self, p: _Pending) -> None:
+        """Finalize a pending once NONE of its engine rids are live:
+        metrics count the HTTP request once, waiters wake once."""
+        if p.done.is_set():
+            return
+        if any(rid in self._by_rid for rid in p.rid_index):
+            return
+        # a request the HTTP layer already 503'd must not read as a
+        # success on the dashboard — the client never got the tokens
+        outcome = "timeout" if p.timed_out else "ok"
+        self.metrics.requests.labels(outcome=outcome).inc()
+        self.metrics.request_seconds.observe(time.monotonic() - p.t0)
+        p.done.set()
+
     def _deliver(self) -> None:
         eng = self.engine
-        self.metrics.queue_depth.set(self.queue.qsize())
+        # the parked head-of-line request is queued pressure too
+        self.metrics.queue_depth.set(
+            self.queue.qsize() + (self._head is not None)
+        )
         self.metrics.live_slots.set(len(eng.slots))
         # stream incremental tokens for live slots (capped at the
         # request budget so a truncated tail is never streamed)
@@ -211,10 +250,15 @@ class _Scheduler(threading.Thread):
             b = self._budget.get(req.request_id)
             if b is not None:
                 have = min(have, b)
-            if have > p.sent:
-                p.stream_q.put((list(req.generated[p.sent:have]),
-                                list(req.logprobs[p.sent:have])))
-                p.sent = have
+            sent = p.sent.get(req.request_id, 0)
+            if have > sent:
+                p.stream_q.put({
+                    "kind": "delta",
+                    "index": p.rid_index[req.request_id],
+                    "tokens": list(req.generated[sent:have]),
+                    "logprobs": list(req.logprobs[sent:have]),
+                })
+                p.sent[req.request_id] = have
         keep: List[GenerationResult] = []
         for r in eng.finished:
             p = self._by_rid.pop(r.request_id, None)
@@ -235,23 +279,22 @@ class _Scheduler(threading.Thread):
                         or (r.finished_reason == "eos"
                             and self.engine.eos_id not in r.tokens)):
                     r.finished_reason = "max_new_tokens"
-            p.result = r
-            # a request the HTTP layer already 503'd must not read as a
-            # success on the dashboard — the client never got the tokens
-            outcome = "timeout" if p.timed_out else "ok"
-            self.metrics.requests.labels(outcome=outcome).inc()
+            idx = p.rid_index[r.request_id]
+            p.results[idx] = r
             if not p.timed_out:
                 self.metrics.tokens.inc(len(r.tokens))
-            self.metrics.request_seconds.observe(
-                time.monotonic() - p.t0
-            )
             if p.stream_q is not None:
-                if len(r.tokens) > p.sent:
-                    p.stream_q.put((list(r.tokens[p.sent:]),
-                                    list(r.logprobs[p.sent:])))
-                    p.sent = len(r.tokens)
-                p.stream_q.put(r)          # ends the stream
-            p.done.set()
+                sent = p.sent.get(r.request_id, 0)
+                if len(r.tokens) > sent:
+                    p.stream_q.put({
+                        "kind": "delta", "index": idx,
+                        "tokens": list(r.tokens[sent:]),
+                        "logprobs": list(r.logprobs[sent:]),
+                    })
+                    p.sent[r.request_id] = len(r.tokens)
+                p.stream_q.put({"kind": "final", "index": idx,
+                                "result": r})
+            self._maybe_complete(p)
         eng.finished = keep
 
     def stats(self) -> dict:
@@ -314,6 +357,13 @@ class _Handler(BaseHTTPRequestHandler):
             if max_tokens < 1:
                 raise ValueError("max_tokens must be >= 1")
             stop = ServingEngine._normalize_stop(req.get("stop"))
+            n = int(req.get("n", 1))
+            max_batch = type(self).scheduler.engine.max_batch
+            if not 1 <= n <= max_batch:
+                raise ValueError(
+                    f"n must be in [1, {max_batch}] (the engine's "
+                    "slot count) on this server"
+                )
             # sampling config is engine-level (slots share one compiled
             # decode program); reject mismatching per-request values
             # instead of silently ignoring them
@@ -334,7 +384,8 @@ class _Handler(BaseHTTPRequestHandler):
         pending = _Pending(prompt, max_tokens,
                            stream=bool(req.get("stream", False)),
                            stop=stop,
-                           want_logprobs=bool(req.get("logprobs", False)))
+                           want_logprobs=bool(req.get("logprobs", False)),
+                           n=n)
         type(self).scheduler.submit(pending)
         if pending.stream_q is not None:
             self._stream_response(pending)
@@ -346,20 +397,25 @@ class _Handler(BaseHTTPRequestHandler):
         if pending.error:
             self._send(400, {"error": pending.error})
             return
-        r = pending.result
-        choice = {
-            "index": 0,
-            "token_ids": r.tokens,
-            "finish_reason": r.finished_reason or "stop",
-        }
-        if pending.want_logprobs:
-            choice["logprobs"] = r.logprobs
+        choices = []
+        for idx in sorted(pending.results):
+            r = pending.results[idx]
+            choice = {
+                "index": idx,
+                "token_ids": r.tokens,
+                "finish_reason": r.finished_reason or "stop",
+            }
+            if pending.want_logprobs:
+                choice["logprobs"] = r.logprobs
+            choices.append(choice)
         self._send(200, {
             "object": "text_completion",
-            "choices": [choice],
+            "choices": choices,
             "usage": {
-                "prompt_tokens": len(r.prompt),
-                "completion_tokens": len(r.tokens),
+                "prompt_tokens": len(prompt),
+                "completion_tokens": sum(
+                    len(r.tokens) for r in pending.results.values()
+                ),
             },
         })
 
@@ -395,6 +451,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.end_headers()
+            finals = 0
             while True:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -407,30 +464,39 @@ class _Handler(BaseHTTPRequestHandler):
                     write({"error": item})
                     write("[DONE]")
                     return
-                if isinstance(item, GenerationResult):
+                if item["kind"] == "final":
+                    r = item["result"]
+                    finals += 1
                     write({
                         "object": "text_completion",
                         "choices": [{
-                            "index": 0,
+                            "index": item["index"],
                             "token_ids": [],
-                            "finish_reason": item.finished_reason
-                            or "stop",
+                            "finish_reason": r.finished_reason or "stop",
                         }],
                         "usage": {
-                            "prompt_tokens": len(item.prompt),
-                            "completion_tokens": pending.sent,
+                            "prompt_tokens": len(r.prompt),
+                            # list() snapshots atomically (C-level copy
+                            # under the GIL): the scheduler thread may
+                            # be inserting another choice's result
+                            # during this iteration
+                            "completion_tokens": sum(
+                                len(x.tokens)
+                                for x in list(pending.results.values())
+                            ),
                         },
                     })
-                    write("[DONE]")
-                    return
-                toks, lps = item
+                    if finals == pending.n:        # all choices done
+                        write("[DONE]")
+                        return
+                    continue
                 chunk = {
-                    "index": 0,
-                    "token_ids": toks,
+                    "index": item["index"],
+                    "token_ids": item["tokens"],
                     "finish_reason": None,
                 }
                 if pending.want_logprobs:
-                    chunk["logprobs"] = lps
+                    chunk["logprobs"] = item["logprobs"]
                 write({
                     "object": "text_completion",
                     "choices": [chunk],

@@ -19,7 +19,7 @@ connection per follower, ops applied strictly in order.
 
 Wire format (one JSON object per line)::
 
-    {"op": "add_request", "prompt": [...], "stop": [[...]]}
+    {"op": "add_request", "prompt": [...], "stop": [[...]], "n": 1}
     {"op": "step"} | {"op": "decode_block", "n": 8} | {"op": "spec_step"}
     {"op": "register_prefix", "tokens": [...]}
     {"op": "drop_prefix", "tokens": [...]}
@@ -152,16 +152,20 @@ class DistributedEngine:
     # ------------------------------------------------------------- the ops
 
     def add_request(self, prompt: List[int], stop=None) -> int:
+        return self.add_request_n(prompt, 1, stop=stop)[0]
+
+    def add_request_n(self, prompt: List[int], n: int,
+                      stop=None) -> List[int]:
         # host-side validation BEFORE the broadcast: a rejected request
         # must not enter the op stream at all. (Followers additionally
-        # swallow deterministic validation errors, so even a op that
+        # swallow deterministic validation errors, so even an op that
         # slips through fails identically on every replica.)
         stop = ServingEngine._normalize_stop(stop)
         self.engine._check_prompt_fits(prompt)
-        self.engine._first_free_slot("no free slots")
+        self.engine._check_capacity(n)
         self._bcast({"op": "add_request", "prompt": list(prompt),
-                     "stop": stop})
-        return self.engine.add_request(prompt, stop=stop)
+                     "stop": stop, "n": n})
+        return self.engine.add_request_n(prompt, n, stop=stop)
 
     def step(self):
         self._bcast({"op": "step"})
@@ -258,7 +262,8 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                 raise RuntimeError(f"unknown op {kind!r} in op stream")
             try:
                 if kind == "add_request":
-                    engine.add_request(op["prompt"], stop=op["stop"])
+                    engine.add_request_n(op["prompt"], op.get("n", 1),
+                                         stop=op["stop"])
                 elif kind == "step":
                     engine.step()
                 elif kind == "decode_block":

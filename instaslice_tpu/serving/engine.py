@@ -471,6 +471,16 @@ class ServingEngine:
         tokens were never delivered to anyone."""
         self.slots.pop(slot)
 
+    def _check_capacity(self, n: int) -> None:
+        """Host-side admission capacity check (shared with the
+        multi-host driver's pre-broadcast validation)."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        if self.free_slots() < n:
+            raise RuntimeError(
+                f"need {n} free slots, have {self.free_slots()}"
+            )
+
     def _first_free_slot(self, why: str) -> int:
         """Slot-allocation policy, shared by admission and prefix
         registration so the two cannot drift."""
@@ -623,33 +633,78 @@ class ServingEngine:
         ``"stop"``) when one appears in the output, which is truncated
         to exclude it. Checked host-side after every step/block — the
         compiled programs don't change."""
+        return self.add_request_n(prompt, 1, stop=stop)[0]
+
+    def add_request_n(self, prompt: List[int], n: int,
+                      stop=None) -> List[int]:
+        """Admit ``n`` parallel samples of one prompt (OpenAI ``n``):
+        the prompt is prefilled ONCE, its KV stripe is copied to the
+        other n-1 slots (pure HBM copies — the same stripe kernels
+        prefix caching uses), and each fork samples its own first
+        token. Returns the n request ids; all-or-nothing on capacity.
+
+        With ``temperature == 0`` every fork produces the same greedy
+        chain (allowed, like OpenAI, but pointless); at temperature > 0
+        forks diverge from the first sampled token on (independent
+        Gumbel noise per batch row)."""
         stop = self._normalize_stop(stop)
         self._check_prompt_fits(prompt)
-        slot = self._first_free_slot("no free slots")
-        rid = self._next_id
-        self._next_id += 1
+        self._check_capacity(n)
+        slots = [i for i in range(self.max_batch)
+                 if i not in self.slots][:n]
+        first = slots[0]
         start_chunk = 0
         pref = self._match_prefix(prompt)
         if pref is not None:
-            self.cache = self._write_stripe(self.cache, pref.stripe, slot)
+            self.cache = self._write_stripe(self.cache, pref.stripe,
+                                            first)
             if self.draft_model is not None:
                 self.draft_cache = self._write_stripe(
-                    self.draft_cache, pref.draft_stripe, slot
+                    self.draft_cache, pref.draft_stripe, first
                 )
             start_chunk = len(pref.tokens) // self.prefill_len
             self.prefix_hits += 1
             self.prefix_tokens_saved += len(pref.tokens)
-        chunk_logits = self._prefill_chunks(slot, prompt, start_chunk)
+        chunk_logits = self._prefill_chunks(first, prompt, start_chunk)
         last_logits = chunk_logits[(len(prompt) - 1) % self.prefill_len]
-        toks, lps = self._sample(last_logits[None])
-        tok = toks[0]
-        self.last_token = self.last_token.at[slot].set(tok)
-        self.lengths = self.lengths.at[slot].set(len(prompt))
-        self.slots[slot] = _Slot(rid, list(prompt), [int(tok)], stop,
-                                 logprobs=[float(lps[0])])
-        self.tokens_generated += 1
-        self._maybe_finish(slot)
-        return rid
+        if len(slots) > 1:
+            # fork: copy the prefilled stripe to the other slots — the
+            # stripe is chunk-padded, so reads share prefix caching's
+            # compiled shape family
+            stripe_len = (
+                -(-len(prompt) // self.prefill_len) * self.prefill_len
+            )
+            stripe = self._read_stripe(self.cache, first,
+                                       length=stripe_len)
+            d_stripe = None
+            if self.draft_model is not None:
+                d_stripe = self._read_stripe(self.draft_cache, first,
+                                             length=stripe_len)
+            for s in slots[1:]:
+                self.cache = self._write_stripe(self.cache, stripe, s)
+                if d_stripe is not None:
+                    self.draft_cache = self._write_stripe(
+                        self.draft_cache, d_stripe, s
+                    )
+        # one sample call for all forks: the (n, vocab) rows are
+        # identical, but Gumbel noise is independent per row, so forks
+        # diverge at temperature > 0
+        toks, lps = self._sample(
+            jnp.broadcast_to(last_logits[None],
+                             (len(slots),) + last_logits.shape)
+        )
+        rids = []
+        for i, s in enumerate(slots):
+            rid = self._next_id
+            self._next_id += 1
+            self.last_token = self.last_token.at[s].set(toks[i])
+            self.lengths = self.lengths.at[s].set(len(prompt))
+            self.slots[s] = _Slot(rid, list(prompt), [int(toks[i])],
+                                  list(stop), logprobs=[float(lps[i])])
+            self.tokens_generated += 1
+            self._maybe_finish(s)
+            rids.append(rid)
+        return rids
 
     def step(self) -> Dict[int, int]:
         """One decode step for every live slot; returns request id → new

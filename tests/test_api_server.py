@@ -205,6 +205,60 @@ class TestApiServer:
         assert events[-1]["choices"][0]["finish_reason"] == "stop"
         assert events[-1]["usage"]["completion_tokens"] == cut
 
+    def test_n_choices_over_http(self, model):
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 5)
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 5, "n": 3})
+            assert code == 200
+            assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+            for c in out["choices"]:
+                assert c["token_ids"] == oracle     # greedy: identical
+            assert out["usage"]["completion_tokens"] == 15
+            # n beyond the slot count is rejected up front
+            code, out = post(srv.url, {"prompt": [1, 2],
+                                       "max_tokens": 2, "n": 5})
+            assert code == 400
+            assert "slot count" in out["error"]
+
+    def test_n_streaming_all_choices_complete(self, model):
+        import http.client
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            host, port = srv.url.replace("http://", "").split(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=120)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({"prompt": [5, 9, 2, 7], "max_tokens": 6,
+                                 "stream": True, "n": 2}),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            buf = b""
+            while b"data: [DONE]" not in buf:
+                chunk = resp.read1(65536)
+                assert chunk, "stream ended without [DONE]"
+                buf += chunk
+            conn.close()
+        events = [json.loads(l[6:]) for l in buf.decode().splitlines()
+                  if l.startswith("data: ") and l != "data: [DONE]"]
+        per_index = {0: [], 1: []}
+        finals = set()
+        for e in events:
+            c = e["choices"][0]
+            if c["finish_reason"] is None:
+                per_index[c["index"]].extend(c["token_ids"])
+            else:
+                finals.add(c["index"])
+        assert finals == {0, 1}
+        assert len(per_index[0]) == 6 and len(per_index[1]) == 6
+
     def test_logprobs_over_http(self, model):
         import math
 
@@ -269,12 +323,14 @@ class TestApiServer:
                             prefill_len=8)
         sched = _Scheduler(eng)            # not started: direct _deliver
         p = _Pending([1, 2], max_tokens=2)
+        p.rid_index[7] = 0
         sched._by_rid[7] = p
         sched._budget[7] = 2
         eng.finished.append(
             GenerationResult(7, [1, 2], [5, 6, 8], "stop")
         )
         sched._deliver()
+        assert p.done.is_set()
         assert p.result.tokens == [5, 6]
         assert p.result.finished_reason == "max_new_tokens"
 

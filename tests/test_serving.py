@@ -462,6 +462,56 @@ def first_match(seq, sub):
     raise AssertionError("stop not in oracle")
 
 
+class TestParallelSampling:
+    def test_greedy_forks_match_single_chain(self, model):
+        """n=3 greedy: the forked KV stripes must attend exactly like
+        the prefilled original — every fork reproduces the oracle."""
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 6)
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=16)
+        rids = eng.add_request_n([5, 9, 2, 7], 3)
+        assert len(rids) == 3 and len(eng.slots) == 3
+        eng.decode_block(5)
+        for req in eng.slots.values():
+            assert req.generated == oracle
+
+    def test_sampled_forks_diverge(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=16, temperature=2.0, seed=7)
+        eng.add_request_n([5, 9, 2, 7], 4)
+        eng.decode_block(6)
+        chains = [tuple(r.generated) for r in eng.slots.values()]
+        # independent Gumbel noise per row: at temperature 2 over a
+        # 64-token vocab, four identical chains would mean the forks
+        # share their randomness (the bug this test pins)
+        assert len(set(chains)) > 1
+
+    def test_capacity_all_or_nothing(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=16)
+        eng.add_request([1, 2])
+        with pytest.raises(RuntimeError, match="free slots"):
+            eng.add_request_n([5, 9], 2)
+        assert len(eng.slots) == 1             # nothing admitted
+
+    def test_forks_with_prefix_cache(self, model):
+        m, params = model
+        prefix = list(range(1, 17))
+        prompt = prefix + [40, 41]
+        oracle = greedy_reference(m, params, prompt, 5)
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=16)
+        eng.register_prefix(prefix)
+        eng.add_request_n(prompt, 2)
+        assert eng.prefix_hits == 1            # prefilled once, forked
+        eng.decode_block(4)
+        for req in eng.slots.values():
+            assert req.generated == oracle
+
+
 class TestLogprobs:
     def oracle_logprobs(self, model, params, prompt, tokens):
         """log p(token_i | prompt + tokens[:i]) from the full forward."""
