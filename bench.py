@@ -52,6 +52,7 @@ TPU_PHASES = [
     ("serving_small", 180.0),
     ("serving", 300.0),
     ("serving_quant", 300.0),
+    ("serving_spec", 300.0),
     ("mfu", 300.0),
     ("serving_tp", 300.0),
 ]

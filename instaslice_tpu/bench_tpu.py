@@ -40,7 +40,7 @@ import time
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 PHASES = ("probe", "flash_fwd", "flash_bwd", "serving_small", "serving",
-          "serving_quant", "mfu", "serving_tp")
+          "serving_quant", "serving_spec", "mfu", "serving_tp")
 
 
 def _readback_rtt(reps: int = 7) -> float:
@@ -306,6 +306,49 @@ def bench_serving_quant(out: dict) -> None:
     out["decode_tokens_per_sec_per_chip_int8"] = round(tput, 1)
 
 
+def bench_serving_spec(out: dict) -> None:
+    """Speculative decoding tokens/sec: int8 self-draft (the quantized
+    target proposes, the bf16 target verifies in ONE forward per round)
+    vs the plain greedy block-decode baseline from the ``serving``
+    phase. Lossless by construction, so the interesting number is the
+    accepted-tokens-per-round and the resulting throughput at batch 8
+    (speculation trades batch FLOPs for latency, so it shines at LOW
+    concurrency where decode is weight-bound)."""
+    import jax
+
+    from instaslice_tpu.models.quant import quantize_params
+    from instaslice_tpu.serving import ServingEngine
+
+    cfg, model = _serving_model()
+    params = model.init(jax.random.key(0))
+    eng = ServingEngine(
+        model, params, max_batch=8, max_len=1024, prefill_len=128,
+        draft_model=model, draft_params=quantize_params(params),
+        spec_k=4,
+    )
+    for _ in range(8):
+        eng.add_request([1, 2, 3])
+    eng.spec_step()                                   # compile + warm
+    rtt = _readback_rtt()
+    rounds = 32
+    t0 = time.perf_counter()
+    produced = 0
+    slot_rounds = 0                 # live slots per round: a slot that
+    #                                 finishes mid-bench stops counting
+    for _ in range(rounds):
+        slot_rounds += len(eng.slots)
+        out_map = eng.spec_step()
+        produced += sum(len(v) for v in out_map.values())
+    # every round pays one device→host readback (unlike decode_block's
+    # one per N steps), so subtract the tunnel rtt per round
+    dt = time.perf_counter() - t0 - rounds * rtt
+    dt = max(dt, 1e-6)
+    out["decode_tokens_per_sec_spec_b8"] = round(produced / dt, 1)
+    out["spec_tokens_per_round"] = round(
+        produced / max(1, slot_rounds), 2
+    )
+
+
 def bench_serving_tp(out: dict) -> None:
     """Tensor-parallel decode over every locally visible chip — the
     multi-chip-grant serving path (BASELINE headline: 7B-class on a 2x2
@@ -442,6 +485,8 @@ def run_phase(phase: str, out: dict) -> None:
         bench_serving(out)
     elif phase == "serving_quant":
         bench_serving_quant(out)
+    elif phase == "serving_spec":
+        bench_serving_spec(out)
     elif phase == "mfu":
         bench_train_mfu(out, gen)
     elif phase == "serving_tp":

@@ -302,7 +302,7 @@ class _Scheduler(threading.Thread):
         return {
             "live_slots": len(eng.slots),
             "free_slots": eng.free_slots(),
-            "queued": self.queue.qsize(),
+            "queued": self.queue.qsize() + (self._head is not None),
             "tokens_generated": eng.tokens_generated,
             "max_batch": eng.max_batch,
             "max_len": eng.max_len,
@@ -467,25 +467,27 @@ class _Handler(BaseHTTPRequestHandler):
                 if item["kind"] == "final":
                     r = item["result"]
                     finals += 1
-                    write({
+                    event = {
                         "object": "text_completion",
                         "choices": [{
                             "index": item["index"],
                             "token_ids": [],
                             "finish_reason": r.finished_reason or "stop",
                         }],
-                        "usage": {
+                    }
+                    if finals == pending.n:
+                        # usage only on the LAST final chunk: earlier
+                        # choices' totals would be partial snapshots
+                        # (list() snapshots atomically under the GIL
+                        # against the scheduler's concurrent inserts)
+                        event["usage"] = {
                             "prompt_tokens": len(r.prompt),
-                            # list() snapshots atomically (C-level copy
-                            # under the GIL): the scheduler thread may
-                            # be inserting another choice's result
-                            # during this iteration
                             "completion_tokens": sum(
                                 len(x.tokens)
                                 for x in list(pending.results.values())
                             ),
-                        },
-                    })
+                        }
+                    write(event)
                     if finals == pending.n:        # all choices done
                         write("[DONE]")
                         return

@@ -481,13 +481,17 @@ class ServingEngine:
                 f"need {n} free slots, have {self.free_slots()}"
             )
 
+    def _free_slot_indices(self) -> List[int]:
+        """THE slot-allocation policy (lowest index first) — shared by
+        single admission, fork admission, and prefix registration so
+        the three cannot drift."""
+        return [i for i in range(self.max_batch) if i not in self.slots]
+
     def _first_free_slot(self, why: str) -> int:
-        """Slot-allocation policy, shared by admission and prefix
-        registration so the two cannot drift."""
-        for i in range(self.max_batch):
-            if i not in self.slots:
-                return i
-        raise RuntimeError(why)
+        free = self._free_slot_indices()
+        if not free:
+            raise RuntimeError(why)
+        return free[0]
 
     def _check_prompt_fits(self, prompt: List[int]) -> int:
         """Validate the prompt against the cache; returns chunk count."""
@@ -650,8 +654,7 @@ class ServingEngine:
         stop = self._normalize_stop(stop)
         self._check_prompt_fits(prompt)
         self._check_capacity(n)
-        slots = [i for i in range(self.max_batch)
-                 if i not in self.slots][:n]
+        slots = self._free_slot_indices()[:n]
         first = slots[0]
         start_chunk = 0
         pref = self._match_prefix(prompt)
