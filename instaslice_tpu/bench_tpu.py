@@ -326,27 +326,11 @@ def bench_serving_spec(out: dict) -> None:
         draft_model=model, draft_params=quantize_params(params),
         spec_k=4,
     )
-    for _ in range(8):
-        eng.add_request([1, 2, 3])
-    eng.spec_step()                                   # compile + warm
-    rtt = _readback_rtt()
-    rounds = 32
-    t0 = time.perf_counter()
-    produced = 0
-    slot_rounds = 0                 # live slots per round: a slot that
-    #                                 finishes mid-bench stops counting
-    for _ in range(rounds):
-        slot_rounds += len(eng.slots)
-        out_map = eng.spec_step()
-        produced += sum(len(v) for v in out_map.values())
-    # every round pays one device→host readback (unlike decode_block's
-    # one per N steps), so subtract the tunnel rtt per round
-    dt = time.perf_counter() - t0 - rounds * rtt
-    dt = max(dt, 1e-6)
-    out["decode_tokens_per_sec_spec_b8"] = round(produced / dt, 1)
-    out["spec_tokens_per_round"] = round(
-        produced / max(1, slot_rounds), 2
+    tput, per_round = eng.spec_throughput(
+        rounds=32, overhead_seconds=_readback_rtt()
     )
+    out["decode_tokens_per_sec_spec_b8"] = round(tput, 1)
+    out["spec_tokens_per_round"] = round(per_round, 2)
 
 
 def bench_serving_tp(out: dict) -> None:

@@ -54,6 +54,12 @@ def main(argv=None) -> int:
     sb.add_argument("--max-len", type=int, default=256)
     sb.add_argument("--prefill-len", type=int, default=16)
     sb.add_argument("--steps", type=int, default=30)
+    sb.add_argument("--quantize", action="store_true",
+                    help="int8 weights + int8 KV cache")
+    sb.add_argument("--spec", action="store_true",
+                    help="speculative decoding (int8 self-draft, "
+                         "lossless greedy): reports tokens/sec and "
+                         "accepted tokens per verify round")
 
     args = p.parse_args(argv)
 
@@ -61,6 +67,13 @@ def main(argv=None) -> int:
         p.error(
             f"--prefill-len {args.prefill_len} must be <= --max-len "
             f"{args.max_len}"
+        )
+    if args.cmd == "serve-bench" and args.quantize and args.spec:
+        p.error(
+            "--quantize with --spec would make the draft IDENTICAL to "
+            "the int8 target (guaranteed full acceptance, pure "
+            "overhead); --spec already uses an int8 draft against the "
+            "full-precision target — pick one"
         )
 
     if args.cmd == "serve-bench":
@@ -80,22 +93,40 @@ def main(argv=None) -> int:
             dtype=jnp.bfloat16 if on_tpu else jnp.float32,
             remat=False,
         )
+        model = TpuLM(cfg)
+        params = model.init(jax.random.key(0))
+        kw = {}
+        if args.quantize or args.spec:
+            from instaslice_tpu.models.quant import quantize_params
+
+            qparams = quantize_params(params)
+        if args.quantize:
+            params, kw["kv_quant"] = qparams, True
+        if args.spec:
+            kw.update(draft_model=model, draft_params=qparams, spec_k=4)
         eng = ServingEngine(
-            TpuLM(cfg), max_batch=args.batch, max_len=args.max_len,
-            prefill_len=args.prefill_len,
+            model, params, max_batch=args.batch, max_len=args.max_len,
+            prefill_len=args.prefill_len, **kw,
         )
-        tps = eng.throughput(n_steps=args.steps)
-        print(json.dumps({
+        out = {
             "metric": "serve_decode_tokens_per_sec",
-            "value": round(tps, 1),
             "unit": "tokens/s",
             "backend": jax.default_backend(),
             "batch": args.batch,
+            "quantized": bool(args.quantize),
+            "speculative": bool(args.spec),
             "model": {
                 "dModel": args.d_model, "nLayers": args.n_layers,
                 "nHeads": args.n_heads, "dFF": args.d_ff,
             },
-        }))
+        }
+        if args.spec:
+            tput, per_round = eng.spec_throughput(rounds=args.steps)
+            out["value"] = round(tput, 1)
+            out["spec_tokens_per_round"] = round(per_round, 2)
+        else:
+            out["value"] = round(eng.throughput(n_steps=args.steps), 1)
+        print(json.dumps(out))
         return 0
 
     if args.cmd == "status":

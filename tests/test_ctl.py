@@ -12,6 +12,35 @@ import pytest
 from instaslice_tpu.cli.tpuslicectl import main
 
 
+class TestServeBench:
+    TINY = ["--d-model", "32", "--n-layers", "2", "--n-heads", "2",
+            "--d-ff", "64", "--vocab", "64", "--batch", "2",
+            "--max-len", "64", "--prefill-len", "8", "--steps", "4"]
+
+    @pytest.mark.parametrize("extra,flags", [
+        ([], {"quantized": False, "speculative": False}),
+        (["--quantize"], {"quantized": True, "speculative": False}),
+        (["--spec"], {"quantized": False, "speculative": True}),
+    ])
+    def test_modes_report_throughput(self, capsys, extra, flags):
+        assert main(["serve-bench"] + self.TINY + extra) == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert out["metric"] == "serve_decode_tokens_per_sec"
+        assert out["value"] > 0
+        for k, v in flags.items():
+            assert out[k] == v
+        if "--spec" in extra:
+            # 1.0/round is what spec_step emits with ZERO accepted
+            # draft tokens — the int8 self-draft of this tiny fp32
+            # model must beat that or speculation isn't speculating
+            assert out["spec_tokens_per_round"] > 1.0
+
+    def test_quantize_spec_combination_rejected(self, capsys):
+        with pytest.raises(SystemExit):
+            main(["serve-bench"] + self.TINY + ["--quantize", "--spec"])
+        assert "pick one" in capsys.readouterr().err
+
+
 class TestCatalogAndPlan:
     def test_catalog(self, capsys):
         assert main(["catalog", "v5e"]) == 0
