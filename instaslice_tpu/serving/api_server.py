@@ -334,6 +334,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {"status": "ok"})
         elif self.path.startswith("/v1/stats"):
             self._send(200, type(self).scheduler.stats())
+        elif self.path.rstrip("/").startswith("/v1/models"):
+            # OpenAI-client compatibility probe: one entry describing
+            # the engine's model and serving limits ("created"/
+            # "owned_by" are standard Model fields strict clients
+            # validate)
+            eng = type(self).scheduler.engine
+            cfg = eng.model.cfg
+            entry = {
+                "id": f"tpuslice-lm-{cfg.n_layers}x{cfg.d_model}",
+                "object": "model",
+                "created": 0,
+                "owned_by": "tpuslice",
+                "max_model_len": eng.max_len,
+                "config": {
+                    "d_model": cfg.d_model,
+                    "n_layers": cfg.n_layers,
+                    "n_heads": cfg.n_heads,
+                    "d_ff": cfg.d_ff,
+                    "vocab_size": cfg.vocab_size,
+                },
+            }
+            tail = self.path.rstrip("/")[len("/v1/models"):]
+            if not tail:
+                self._send(200, {"object": "list", "data": [entry]})
+            elif tail == "/" + entry["id"]:
+                self._send(200, entry)     # retrieve-model route
+            else:
+                self._send(404, {"error": f"no model {tail[1:]!r}"})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 

@@ -480,6 +480,35 @@ class TestApiServer:
                                        "max_tokens": 2})
             assert code == 400 and "max_len" in out["error"]
 
+    def test_models_route(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng) as srv:
+            with urllib.request.urlopen(
+                f"{srv.url}/v1/models", timeout=30
+            ) as r:
+                out = json.loads(r.read())
+            [entry] = out["data"]
+            assert entry["object"] == "model"
+            assert entry["max_model_len"] == 64
+            assert entry["config"]["d_model"] == 32
+            assert entry["owned_by"] == "tpuslice"
+            # retrieve-model route returns the single object / 404
+            with urllib.request.urlopen(
+                f"{srv.url}/v1/models/{entry['id']}", timeout=30
+            ) as r:
+                got = json.loads(r.read())
+            assert got["id"] == entry["id"]
+            assert got["object"] == "model"
+            try:
+                urllib.request.urlopen(
+                    f"{srv.url}/v1/models/nope", timeout=30
+                )
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+
     def test_health_and_stats(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=32,

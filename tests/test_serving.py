@@ -801,6 +801,32 @@ class TestPrefixCaching:
         assert eng.prefix_hits == 1
 
 
+class TestSpecThroughput:
+    def test_refills_drained_slots(self, model):
+        """Steady-state methodology: slots that hit max_len mid-run are
+        refilled, so the rate never measures an empty engine."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=32,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        # max_len 32 drains a slot every ~7 rounds of k+1 tokens; 20
+        # rounds forces several refills
+        tput, per_round = eng.spec_throughput(rounds=20)
+        assert tput > 0
+        # draft == target: full acceptance, k+1 per live-slot round
+        assert per_round == pytest.approx(4.0, abs=0.5)
+        # several generations drained AND were replaced (refill ran):
+        # more finished results than the batch could hold at once
+        assert len(eng.finished) > eng.max_batch
+
+    def test_requires_draft(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=32,
+                            prefill_len=8)
+        with pytest.raises(RuntimeError, match="draft_model"):
+            eng.spec_throughput()
+
+
 class TestRandomizedOps:
     """Property test: random interleavings of the engine's public ops
     (admit / fork / block / step / external finish / evict / prefix
