@@ -61,8 +61,13 @@ def main() -> int:
         vocab_size=64, d_model=32, n_heads=len(devs), n_layers=2,
         d_ff=64, dtype=jax.numpy.float32, remat=False,
     )
+    model = TpuLM(cfg)
+    # oplog mode also replays a speculative round, so both replicas
+    # need the (identical) self-draft wiring
+    oplog = os.environ.get("TPUSLICE_SMOKE_MODE") == "oplog"
     eng = ServingEngine(
-        TpuLM(cfg), max_batch=2, max_len=64, prefill_len=8, mesh=mesh,
+        model, max_batch=2, max_len=64, prefill_len=8, mesh=mesh,
+        draft_model=model if oplog else None, spec_k=3,
     )
     result = {
         "worker_id": topo.worker_id,
@@ -70,7 +75,7 @@ def main() -> int:
         "global_devices": len(devs),
     }
 
-    if os.environ.get("TPUSLICE_SMOKE_MODE") == "oplog":
+    if oplog:
         # dynamic traffic through the driver/follower op stream
         from instaslice_tpu.serving.distributed import (
             DistributedEngine,
@@ -102,11 +107,14 @@ def main() -> int:
 
 def run_script(eng) -> None:
     """The dynamic driver script the test replays single-process:
-    ragged admissions, block decodes, an external budget cut."""
+    ragged admissions, block decodes, a speculative round (when the
+    engine carries a draft), an external budget cut."""
     eng.add_request([5, 9, 2, 7])
     eng.decode_block(3)
     eng.add_request([11, 3], stop=None)        # admitted mid-flight
     eng.decode_block(3)
+    if eng.draft_model is not None:
+        eng.spec_step()                        # one speculative round
     # external budget cut of the first slot (slot 0), keep 4 tokens
     eng.finish_slot(0, n_keep=4)
     eng.decode_block(2)

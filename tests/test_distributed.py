@@ -179,8 +179,12 @@ class TestDcnServing:
             n_layers=2, d_ff=64, dtype=jnp.float32, remat=False,
         )
         mesh = Mesh(np.array(jax.devices()[:8]), ("model",))
-        ref = ServingEngine(TpuLM(cfg), max_batch=2, max_len=64,
-                            prefill_len=8, mesh=mesh)
+        # the oplog smoke engines carry a self-draft (run_script
+        # replays one speculative round); the replay must match
+        ref_model = TpuLM(cfg)
+        ref = ServingEngine(ref_model, max_batch=2, max_len=64,
+                            prefill_len=8, mesh=mesh,
+                            draft_model=ref_model, spec_k=3)
         run_script(ref)
         # followers drain `finished` (results are the driver's
         # business); compare the follower on live state only
