@@ -801,6 +801,44 @@ class TestPrefixCaching:
         assert eng.prefix_hits == 1
 
 
+class TestFeatureMatrixCorner:
+    def test_quant_prefix_fork_stop_together(self, model):
+        """The whole feature set in ONE engine: int8 KV cache, a
+        registered prefix, a 3-way fork whose prompt hits it, and a
+        stop sequence — output must equal the same engine's plain
+        single-request run."""
+        m, params = model
+        prefix = list(range(1, 17))
+        prompt = prefix + [40, 41]
+        plain = ServingEngine(m, params, max_batch=4, max_len=64,
+                              prefill_len=16, kv_quant=True)
+        [want] = plain.generate([prompt], max_new_tokens=10)
+        stop = want.tokens[4:6]
+        [want_stopped] = ServingEngine(
+            m, params, max_batch=4, max_len=64, prefill_len=16,
+            kv_quant=True,
+        ).generate([prompt], max_new_tokens=10, stop=stop)
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=16, kv_quant=True)
+        eng.register_prefix(prefix)
+        rids = eng.add_request_n(prompt, 3, stop=stop)
+        assert eng.prefix_hits == 1
+        while eng.slots and all(
+            len(r.generated) < 10 for r in eng.slots.values()
+        ):
+            eng.decode_block(4)
+        done = {r.request_id: r for r in eng.finished}
+        # the stop match sits at generated index 4, well inside the
+        # decode loop: every fork MUST have finished via stop — a
+        # conditional here would pass vacuously on the exact
+        # quant/prefix/fork chain-perturbation this test exists for
+        assert set(rids) <= set(done)
+        for rid in rids:
+            r = done[rid]
+            assert r.tokens == want_stopped.tokens
+            assert r.finished_reason == want_stopped.finished_reason
+
+
 class TestSpecThroughput:
     def test_refills_drained_slots(self, model):
         """Steady-state methodology: slots that hit max_len mid-run are
@@ -834,11 +872,12 @@ class TestRandomizedOps:
     oracle continuation of its prompt — the invariant every feature
     added this round (forks, prefixes, stops, eviction) must preserve."""
 
-    def test_random_interleavings_match_oracle(self, model):
+    @pytest.mark.parametrize("seed", [1234, 99, 2026])
+    def test_random_interleavings_match_oracle(self, model, seed):
         import random
 
         m, params = model
-        rng = random.Random(1234)
+        rng = random.Random(seed)
         prompts = ([5, 9, 2, 7], [11, 3], list(range(1, 9)) + [40],
                    [6, 6, 1])
         # oracle = a SOLO single-slot engine per prompt (slot isolation
