@@ -872,8 +872,15 @@ class TestRandomizedOps:
     oracle continuation of its prompt — the invariant every feature
     added this round (forks, prefixes, stops, eviction) must preserve."""
 
-    @pytest.mark.parametrize("seed", [1234, 99, 2026])
-    def test_random_interleavings_match_oracle(self, model, seed):
+    @pytest.mark.parametrize("seed,spec", [
+        (1234, False), (99, False), (2026, False),
+        # speculative engines: mixed spec_step/decode_block/step
+        # interleavings exercise the draft-cache catch-up machinery,
+        # and losslessness says the chains must STILL match the plain
+        # solo oracle
+        (1234, True), (7, True),
+    ])
+    def test_random_interleavings_match_oracle(self, model, seed, spec):
         import random
 
         m, params = model
@@ -901,13 +908,18 @@ class TestRandomizedOps:
             return chains[tuple(prompt)][:k]
 
         eng = ServingEngine(m, params, max_batch=4, max_len=48,
-                            prefill_len=8)
+                            prefill_len=8,
+                            draft_model=m if spec else None,
+                            draft_params=params if spec else None,
+                            spec_k=3)
         eng.register_prefix(list(range(1, 9)))       # one shared prefix
         rid_prompt = {}
         ok_ops = 0
+        ops = ("add", "fork", "block", "step", "finish", "evict")
+        if spec:
+            ops += ("spec", "spec")                  # weight spec rounds
         for step_no in range(60):
-            op = rng.choice(("add", "fork", "block", "step",
-                             "finish", "evict"))
+            op = rng.choice(ops)
             try:
                 if op == "add":
                     p = rng.choice(prompts)
@@ -920,6 +932,8 @@ class TestRandomizedOps:
                     eng.decode_block(rng.randint(1, 6))
                 elif op == "step":
                     eng.step()
+                elif op == "spec":
+                    eng.spec_step()
                 elif op == "finish" and eng.slots:
                     slot = rng.choice(list(eng.slots))
                     eng.finish_slot(slot, n_keep=rng.randint(1, 3))
