@@ -1,0 +1,182 @@
+"""Serving load generator: end-to-end latency/TTFT against a live server.
+
+The client half of the serving benchmark story (the engine-side numbers
+— decode tokens/sec, MFU — live in ``bench_tpu``): drive a running
+``tpuslice-serve`` endpoint with concurrent requests and report what a
+CLIENT experiences — request latency percentiles, time-to-first-token
+(streaming), aggregate token throughput, error counts. The vLLM
+benchmark-client analog for a granted slice.
+
+Run: ``python -m instaslice_tpu.serving.loadgen --url http://host:8000
+--requests 64 --concurrency 8 [--stream]``. Prints ONE JSON line.
+
+Open-loop vs closed-loop: this is closed-loop at fixed concurrency
+(each worker thread fires its next request when the previous finishes)
+— the right shape for measuring a single slice's capacity; arrival-rate
+sweeps are the caller's loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+from typing import List
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    ys = sorted(xs)
+    i = min(len(ys) - 1, max(0, int(round(q * (len(ys) - 1)))))
+    return ys[i]
+
+
+def _one_request(url: str, prompt: List[int], max_tokens: int,
+                 stream: bool, timeout: float):
+    """Returns (latency_s, ttft_s or None, tokens, error or None)."""
+    body = {"prompt": prompt, "max_tokens": max_tokens}
+    if stream:
+        body["stream"] = True
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            if not stream:
+                out = json.loads(r.read())
+                dt = time.monotonic() - t0
+                toks = sum(len(c["token_ids"]) for c in out["choices"])
+                return dt, None, toks, None
+            ttft = None
+            toks = 0
+            buf = b""
+            while True:
+                chunk = r.read1(65536)
+                if not chunk:
+                    return (time.monotonic() - t0, ttft, toks,
+                            "stream ended without [DONE]")
+                buf += chunk
+                while b"\n\n" in buf:
+                    event, buf = buf.split(b"\n\n", 1)
+                    line = event.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        return time.monotonic() - t0, ttft, toks, None
+                    payload = json.loads(data)
+                    if "error" in payload:
+                        return (time.monotonic() - t0, ttft, toks,
+                                payload["error"])
+                    got = payload["choices"][0]["token_ids"]
+                    if got and ttft is None:
+                        ttft = time.monotonic() - t0
+                    toks += len(got)
+    except Exception as e:  # noqa: BLE001 - a benchmark client must
+        # ACCOUNT for every failure (IncompleteRead from a dropped
+        # body, JSONDecodeError from a proxy's HTML error page, …);
+        # an uncaught exception would kill the worker thread silently
+        # and the run would report fewer requests with zero errors
+        return time.monotonic() - t0, None, 0, f"{type(e).__name__}: {e}"
+
+
+def run(url: str, requests: int, concurrency: int, prompt_len: int,
+        max_tokens: int, vocab: int, stream: bool, timeout: float,
+        seed: int = 0) -> dict:
+    rng = random.Random(seed)
+    prompts = [
+        [rng.randrange(1, vocab) for _ in range(prompt_len)]
+        for _ in range(requests)
+    ]
+    lat: List[float] = []
+    ttfts: List[float] = []
+    errors: List[str] = []
+    tokens = [0]
+    lock = threading.Lock()
+    it = iter(range(requests))
+
+    def worker():
+        while True:
+            with lock:
+                i = next(it, None)
+            if i is None:
+                return
+            dt, ttft, toks, err = _one_request(
+                url, prompts[i], max_tokens, stream, timeout
+            )
+            with lock:
+                if err is None:
+                    lat.append(dt)
+                    tokens[0] += toks
+                    if ttft is not None:
+                        ttfts.append(ttft)
+                else:
+                    errors.append(err)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.monotonic() - t0, 1e-9)
+    out = {
+        "metric": "serve_request_p50_latency",
+        "value": round(_percentile(lat, 0.5), 4),
+        "unit": "seconds",
+        "requests": requests,
+        "concurrency": concurrency,
+        "ok": len(lat),
+        "errors": len(errors),
+        "p95_latency": round(_percentile(lat, 0.95), 4),
+        "mean_latency": round(statistics.mean(lat), 4) if lat else 0.0,
+        "client_tokens_per_sec": round(tokens[0] / wall, 1),
+        "stream": stream,
+    }
+    if stream:
+        out["ttft_p50"] = round(_percentile(ttfts, 0.5), 4)
+        out["ttft_p95"] = round(_percentile(ttfts, 0.95), 4)
+    if errors:
+        out["first_error"] = errors[0][:200]
+    return out
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="tpuslice-loadgen")
+    ap.add_argument("--url", required=True,
+                    help="server base url, e.g. http://127.0.0.1:8000")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=32000,
+                    help="random prompt ids drawn from [1, vocab)")
+    ap.add_argument("--stream", action="store_true",
+                    help="SSE mode: also report time-to-first-token")
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=0)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    out = run(args.url, args.requests, args.concurrency,
+              args.prompt_len, args.max_tokens, args.vocab,
+              args.stream, args.timeout, seed=args.seed)
+    print(json.dumps(out))
+    return 0 if not out["errors"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -480,6 +480,26 @@ class TestApiServer:
                                        "max_tokens": 2})
             assert code == 400 and "max_len" in out["error"]
 
+    def test_loadgen_sync_and_stream(self, model):
+        """The load generator against a live server: all requests
+        succeed, latency/TTFT fields populated, token accounting
+        consistent with the per-request budget."""
+        from instaslice_tpu.serving.loadgen import run
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            out = run(srv.url, requests=6, concurrency=3, prompt_len=6,
+                      max_tokens=5, vocab=64, stream=False, timeout=120)
+            assert out["ok"] == 6 and out["errors"] == 0
+            assert out["value"] > 0
+            assert out["client_tokens_per_sec"] > 0
+            s = run(srv.url, requests=4, concurrency=2, prompt_len=6,
+                    max_tokens=5, vocab=64, stream=True, timeout=120)
+            assert s["ok"] == 4 and s["errors"] == 0
+            assert 0 < s["ttft_p50"] <= s["p95_latency"]
+
     def test_models_route(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=64,
