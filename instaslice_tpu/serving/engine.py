@@ -39,6 +39,18 @@ built TPU-first instead of translated:
   prefill. vLLM's automatic prefix caching made explicit and
   static-shape: prefixes end on chunk boundaries, so admission reuses
   the one compiled prefill program for the remainder.
+- **Parallel sampling**: :meth:`add_request_n` admits n samples of one
+  prompt with ONE prefill — the KV stripe forks to the other slots
+  (HBM copies), and independent per-row Gumbel noise diverges them at
+  temperature > 0.
+- **Stop sequences + logprobs**: host-side incremental stop scanning
+  (the compiled programs never change) and per-token logprobs computed
+  inside the decode scan, both carried 1:1 through every truncation
+  path.
+- **Multi-host**: on a multi-process mesh the engine forces replicated
+  token outputs and is driven by the op-stream broadcast
+  (:mod:`instaslice_tpu.serving.distributed`) so every process issues
+  identical compiled calls.
 """
 
 from __future__ import annotations
