@@ -166,11 +166,45 @@ def build_parser() -> argparse.ArgumentParser:
                     help="SSE mode: also report time-to-first-token")
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sweep", default="",
+                    help="comma-separated concurrency levels (e.g. "
+                         "'1,2,4,8'): run --requests at EACH level and "
+                         "report the capacity curve in one JSON "
+                         "(overrides --concurrency)")
     return ap
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.sweep:
+        try:
+            levels = [int(x) for x in args.sweep.split(",")
+                      if x.strip()]
+        except ValueError:
+            levels = []
+        if not levels or any(c < 1 for c in levels):
+            # scripted callers parse stdout JSON — never a traceback
+            print(json.dumps({"error": f"bad --sweep {args.sweep!r}"}))
+            return 1
+        curve = []
+        for c in levels:
+            r = run(args.url, args.requests, c, args.prompt_len,
+                    args.max_tokens, args.vocab, args.stream,
+                    args.timeout, seed=args.seed)
+            curve.append(r)
+        errors = sum(r["errors"] for r in curve)
+        # headline = the level with the best aggregate throughput; the
+        # knee of the curve is visible in the per-level entries
+        best = max(curve, key=lambda r: r["client_tokens_per_sec"])
+        print(json.dumps({
+            "metric": "serve_capacity_sweep",
+            "value": best["client_tokens_per_sec"],
+            "unit": "tokens/s",
+            "best_concurrency": best["concurrency"],
+            "levels": curve,
+            "errors": errors,
+        }))
+        return 0 if not errors else 1
     out = run(args.url, args.requests, args.concurrency,
               args.prompt_len, args.max_tokens, args.vocab,
               args.stream, args.timeout, seed=args.seed)

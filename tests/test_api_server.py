@@ -500,6 +500,23 @@ class TestApiServer:
             assert s["ok"] == 4 and s["errors"] == 0
             assert 0 < s["ttft_p50"] <= s["p95_latency"]
 
+    def test_loadgen_sweep_cli(self, model, capsys):
+        from instaslice_tpu.serving.loadgen import main as lg_main
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            rc = lg_main(["--url", srv.url, "--requests", "4",
+                          "--sweep", "1,2", "--prompt-len", "6",
+                          "--max-tokens", "4", "--vocab", "64"])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert rc == 0
+        assert out["metric"] == "serve_capacity_sweep"
+        assert [l["concurrency"] for l in out["levels"]] == [1, 2]
+        assert all(l["ok"] == 4 for l in out["levels"])
+        assert out["best_concurrency"] in (1, 2)
+
     def test_models_route(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=64,
