@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import statistics
 import subprocess
 import sys
@@ -93,19 +94,36 @@ def bench_control_plane(transport: str = "inproc") -> float:
 
 def _run_tpu_phase(phase: str, timeout: float, env: dict) -> dict:
     """One phase in its own subprocess; returns its JSON fragment or a
-    ``{"error": ...}`` fragment for timeouts / crashes / no-JSON."""
+    ``{"error": ...}`` fragment for timeouts / crashes / no-JSON.
+
+    Timeout is enforced SIGINT-first: hard-killing a TPU claimant leaves
+    a stale remote claim that wedges the tunnel for hours
+    (``docs/PERF.md``), so a stuck phase first gets a KeyboardInterrupt
+    and a grace window to unwind its backend before SIGKILL."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "instaslice_tpu.bench_tpu",
+         "--phase", phase],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env,
+    )
     try:
-        proc = subprocess.run(
-            [sys.executable, "-m", "instaslice_tpu.bench_tpu",
-             "--phase", phase],
-            capture_output=True,
-            timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            env=env,
+        stdout, stderr = proc.communicate(timeout=timeout)
+        proc = subprocess.CompletedProcess(
+            proc.args, proc.returncode, stdout, stderr
         )
     except subprocess.TimeoutExpired:
+        how = "SIGINT"
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            how = "SIGKILL (ignored SIGINT for 20s)"
+            proc.kill()
+            proc.communicate()
         return {"error": (
-            f"phase exceeded its {timeout:.0f}s cap "
+            f"phase exceeded its {timeout:.0f}s cap, stopped via {how} "
             "(chip unreachable, tunnel hung, or compile too slow)"
         )}
     out: dict = {}

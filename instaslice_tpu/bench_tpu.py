@@ -485,8 +485,23 @@ def main(argv=None) -> int:
                     choices=("all",) + PHASES)
     args = ap.parse_args(argv)
 
-    _enable_compile_cache()
+    from instaslice_tpu.utils.tpulock import TpuBusyError, claim_or_force_cpu
+
     out: dict = {}
+    try:
+        # one-claimant rule, enforced BEFORE the first jax import: a
+        # second concurrent TPU claimant wedges the tunnel for hours
+        # (docs/PERF.md). timeout=5 because a busy chip must fail FAST
+        # here — phases run sequentially, so a legitimate holder is
+        # never a sibling phase; 9 phases × the default 30 s wait would
+        # burn half the bench budget against a foreign claimant.
+        claim = claim_or_force_cpu(timeout=5)
+    except TpuBusyError as e:
+        out["error"] = str(e)
+        print(json.dumps(out))
+        return 2
+
+    _enable_compile_cache()
     try:
         import jax
 
@@ -508,6 +523,9 @@ def main(argv=None) -> int:
         out["error"] = f"{type(e).__name__}: {e}"
         print(json.dumps(out))
         return 2
+    finally:
+        if claim is not None:
+            claim.release()
     print(json.dumps(out))
     return 0
 

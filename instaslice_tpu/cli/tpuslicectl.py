@@ -7,6 +7,60 @@ import json
 import sys
 
 
+def _serve_bench(args) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.serving import ServingEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = ModelConfig(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        n_heads=args.n_heads,
+        n_layers=args.n_layers,
+        d_ff=args.d_ff,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        remat=False,
+    )
+    model = TpuLM(cfg)
+    params = model.init(jax.random.key(0))
+    kw = {}
+    if args.quantize or args.spec:
+        from instaslice_tpu.models.quant import quantize_params
+
+        qparams = quantize_params(params)
+    if args.quantize:
+        params, kw["kv_quant"] = qparams, True
+    if args.spec:
+        kw.update(draft_model=model, draft_params=qparams, spec_k=4)
+    eng = ServingEngine(
+        model, params, max_batch=args.batch, max_len=args.max_len,
+        prefill_len=args.prefill_len, **kw,
+    )
+    out = {
+        "metric": "serve_decode_tokens_per_sec",
+        "unit": "tokens/s",
+        "backend": jax.default_backend(),
+        "batch": args.batch,
+        "quantized": bool(args.quantize),
+        "speculative": bool(args.spec),
+        "model": {
+            "dModel": args.d_model, "nLayers": args.n_layers,
+            "nHeads": args.n_heads, "dFF": args.d_ff,
+        },
+    }
+    if args.spec:
+        tput, per_round = eng.spec_throughput(rounds=args.steps)
+        out["value"] = round(tput, 1)
+        out["spec_tokens_per_round"] = round(per_round, 2)
+    else:
+        out["value"] = round(eng.throughput(n_steps=args.steps), 1)
+    print(json.dumps(out))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tpuslice", description="instaslice_tpu operator CLI"
@@ -77,57 +131,26 @@ def main(argv=None) -> int:
         )
 
     if args.cmd == "serve-bench":
-        import jax
-        import jax.numpy as jnp
-
-        from instaslice_tpu.models.lm import ModelConfig, TpuLM
-        from instaslice_tpu.serving import ServingEngine
-
-        on_tpu = jax.default_backend() == "tpu"
-        cfg = ModelConfig(
-            vocab_size=args.vocab,
-            d_model=args.d_model,
-            n_heads=args.n_heads,
-            n_layers=args.n_layers,
-            d_ff=args.d_ff,
-            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-            remat=False,
+        from instaslice_tpu.utils.tpulock import (
+            TpuBusyError,
+            claim_or_force_cpu,
         )
-        model = TpuLM(cfg)
-        params = model.init(jax.random.key(0))
-        kw = {}
-        if args.quantize or args.spec:
-            from instaslice_tpu.models.quant import quantize_params
 
-            qparams = quantize_params(params)
-        if args.quantize:
-            params, kw["kv_quant"] = qparams, True
-        if args.spec:
-            kw.update(draft_model=model, draft_params=qparams, spec_k=4)
-        eng = ServingEngine(
-            model, params, max_batch=args.batch, max_len=args.max_len,
-            prefill_len=args.prefill_len, **kw,
-        )
-        out = {
-            "metric": "serve_decode_tokens_per_sec",
-            "unit": "tokens/s",
-            "backend": jax.default_backend(),
-            "batch": args.batch,
-            "quantized": bool(args.quantize),
-            "speculative": bool(args.spec),
-            "model": {
-                "dModel": args.d_model, "nLayers": args.n_layers,
-                "nHeads": args.n_heads, "dFF": args.d_ff,
-            },
-        }
-        if args.spec:
-            tput, per_round = eng.spec_throughput(rounds=args.steps)
-            out["value"] = round(tput, 1)
-            out["spec_tokens_per_round"] = round(per_round, 2)
-        else:
-            out["value"] = round(eng.throughput(n_steps=args.steps), 1)
-        print(json.dumps(out))
-        return 0
+        try:
+            # one-claimant rule: this subcommand initializes the host's
+            # accelerator backend, so it must hold the host-wide TPU
+            # claim (or pin CPU in-process when env-forced to cpu)
+            claim = claim_or_force_cpu()
+        except TpuBusyError as e:
+            print(json.dumps({"error": str(e)}))
+            return 3
+        try:
+            return _serve_bench(args)
+        finally:
+            if claim is not None:
+                claim.release()
+
+
 
     if args.cmd == "status":
         from instaslice_tpu import KIND

@@ -739,6 +739,17 @@ def build_engine(args) -> ServingEngine:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    # one-claimant rule: hold the host-wide TPU claim for the server's
+    # whole life — a bench phase or second server racing this process's
+    # backend init would wedge the tunnel for hours (docs/PERF.md).
+    # CPU-forced runs (tests) skip the lock; claim_tpu returns None.
+    from instaslice_tpu.utils.tpulock import TpuBusyError, claim_or_force_cpu
+
+    try:
+        claim = claim_or_force_cpu()
+    except TpuBusyError as e:
+        log.error("%s", e)
+        return 3
     engine = build_engine(args)
     mesh, quantized = engine.mesh, args.quantize
     if args.from_env:
@@ -782,6 +793,9 @@ def main(argv=None) -> int:
         threading.Event().wait()
     except KeyboardInterrupt:
         srv.stop()
+    finally:
+        if claim is not None:
+            claim.release()
     return 0
 
 

@@ -29,15 +29,23 @@ import sys
 
 
 def main() -> int:
-    # must happen before the jax backend initializes
-    if os.environ.get("TPUSLICE_SMOKE_FORCE_CPU"):
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # one-claimant rule, resolved before the jax backend initializes:
+    # CPU modes pin jax in-process; a TPU-bound run holds the host-wide
+    # claim for its whole life (flock drops at process exit)
+    from instaslice_tpu.utils.tpulock import TpuBusyError, claim_or_force_cpu
+
+    n_local = int(os.environ.get("TPUSLICE_SMOKE_CPU_DEVICES", "0"))
+    try:
+        claim_or_force_cpu(force_cpu=bool(
+            n_local or os.environ.get("TPUSLICE_SMOKE_FORCE_CPU")
+        ))
+    except TpuBusyError as e:
+        print(json.dumps({"error": str(e)}))
+        return 3
 
     import jax
 
-    n_local = int(os.environ.get("TPUSLICE_SMOKE_CPU_DEVICES", "0"))
     if n_local:
-        jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", n_local)
 
     import numpy as np

@@ -1,0 +1,199 @@
+"""Host-wide one-claimant lock for the real TPU chip.
+
+The TPU tunnel admits ONE claimant: two concurrent processes initializing
+the TPU backend wedge it for hours, and killing a claimant mid-run leaves
+a stale remote claim (``docs/PERF.md`` "Caveat"). Nothing upstream
+enforces that rule, so this module does, with the same primitive the
+native slice registry uses for crash-safety (``flock`` in
+``native/tpuslice/tpuslice.cpp``): an advisory ``flock(LOCK_EX)`` on a
+well-known host-wide file, taken by every in-repo tool BEFORE it first
+touches the TPU backend — bench phases, ``tpuslice-serve``, smoke mains.
+
+flock semantics give exactly the properties the wedge demands:
+
+- one holder per host, kernel-enforced, no matter how many processes race;
+- a dead or killed holder releases by construction (the kernel drops the
+  lock with the fd) — no stale-lockfile cleanup, no pid-liveness probes;
+- a second claimant FAILS FAST with a clear "who holds it" error instead
+  of silently becoming the second tunnel claimant and wedging the host.
+
+The lock file is never unlinked: removing it while another process holds
+the flock would let a third process lock a *different* inode under the
+same path (split-brain). The file is empty except for a one-line holder
+note (pid + argv) used purely for error messages.
+
+Reference analog: the reference serializes device mutation through a
+single daemonset reconciler per node
+(``/root/reference/internal/controller/daemonset/``); here the shared
+mutable resource is the tunnel's single claim slot, so the serialization
+point is a host lock rather than a singleton controller.
+"""
+
+from __future__ import annotations
+
+import errno
+import fcntl
+import os
+import sys
+import tempfile
+import time
+from typing import Optional
+
+__all__ = ["TpuBusyError", "TpuClaim", "claim_or_force_cpu", "claim_tpu",
+           "force_cpu_in_process", "tpu_is_cpu_forced"]
+
+#: override with TPUSLICE_TPU_LOCK; shared by every claimant on the host.
+DEFAULT_LOCK_PATH = os.path.join(tempfile.gettempdir(), "tpuslice.tpu.lock")
+
+#: how long a claimant waits for the current holder before giving up.
+DEFAULT_TIMEOUT = float(os.environ.get("TPUSLICE_TPU_LOCK_TIMEOUT", "30"))
+
+
+class TpuBusyError(RuntimeError):
+    """Another process holds the TPU claim; caller must not proceed."""
+
+
+def tpu_is_cpu_forced() -> bool:
+    """True when this process is pinned to CPU (``JAX_PLATFORMS=cpu``) —
+    it cannot become a tunnel claimant, so no lock is needed."""
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
+def force_cpu_in_process() -> None:
+    """Pin THIS process's jax to CPU. ``JAX_PLATFORMS=cpu`` in the env is
+    NOT enough under the tunnel environment: its sitecustomize installs a
+    backend hook that initializes the TPU client anyway, and while the
+    tunnel is wedged that init hangs forever (``docs/PERF.md`` caveat;
+    observed live: ``make_c_api_client`` hung under env-cpu). Every
+    CPU-forced entry point must call this before its first jax use —
+    the same pattern tests/conftest.py and the smoke mains use."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+class TpuClaim:
+    """Exclusive host-wide TPU claim, held from :meth:`acquire` until
+    :meth:`release` (or process death — flock releases with the fd)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or os.environ.get(
+            "TPUSLICE_TPU_LOCK", DEFAULT_LOCK_PATH
+        )
+        self._fd: Optional[int] = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def _holder_note(self) -> str:
+        try:
+            with open(self.path, "r") as f:
+                note = f.readline().strip()
+            return note or "unknown holder (no note written)"
+        except OSError:
+            return "unknown holder (lock file unreadable)"
+
+    def acquire(self, timeout: Optional[float] = None,
+                poll_interval: float = 0.2) -> "TpuClaim":
+        """Block up to ``timeout`` seconds (default
+        ``$TPUSLICE_TPU_LOCK_TIMEOUT`` or 30) for the exclusive claim;
+        raise :class:`TpuBusyError` naming the holder if it never frees.
+        ``timeout=0`` fails fast after a single attempt."""
+        if self.held:
+            return self
+        if timeout is None:
+            timeout = DEFAULT_TIMEOUT
+        # O_RDWR (not O_APPEND/O_TRUNC): the file must exist and be
+        # openable by ALL claimants before any of them holds the lock,
+        # and only the holder may rewrite the holder note.
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o666)
+        try:
+            # umask cuts the create mode (022 → 0o644): re-chmod so a
+            # claimant under another uid gets TpuBusyError, not
+            # PermissionError at open. Fails when we're not the owner —
+            # then the owner already ran this chmod.
+            os.fchmod(fd, 0o666)
+        except OSError:
+            pass
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    os.close(fd)
+                    raise
+                if time.monotonic() >= deadline:
+                    holder = self._holder_note()
+                    os.close(fd)
+                    raise TpuBusyError(
+                        f"TPU already claimed by {holder} (lock "
+                        f"{self.path}); a second claimant would wedge "
+                        "the tunnel for hours — wait for the holder to "
+                        "exit, or set JAX_PLATFORMS=cpu for off-chip "
+                        "work"
+                    ) from None
+                time.sleep(poll_interval)
+        # holder note: best-effort, error messages only
+        try:
+            note = f"pid={os.getpid()} argv={' '.join(sys.argv[:4])}\n"
+            os.ftruncate(fd, 0)
+            os.pwrite(fd, note.encode(), 0)
+        except OSError:
+            pass
+        self._fd = fd
+        return self
+
+    def release(self) -> None:
+        """Drop the claim. The file itself is never unlinked (see module
+        docstring); the flock vanishes with the fd."""
+        if self._fd is None:
+            return
+        try:
+            os.ftruncate(self._fd, 0)
+        except OSError:
+            pass
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        finally:
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self) -> "TpuClaim":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+def claim_tpu(timeout: Optional[float] = None,
+              path: Optional[str] = None) -> Optional[TpuClaim]:
+    """Acquire the host-wide TPU claim unless this process is CPU-forced
+    (then return ``None`` — no chip will be touched). Call BEFORE the
+    first jax import so a busy chip fails fast, before any backend
+    initialization can reach the tunnel."""
+    if tpu_is_cpu_forced():
+        return None
+    return TpuClaim(path).acquire(timeout=timeout)
+
+
+def claim_or_force_cpu(timeout: Optional[float] = None,
+                       force_cpu: bool = False) -> Optional[TpuClaim]:
+    """The one-claimant policy for every accelerator-touching entry point
+    (bench phases, ``tpuslice-serve``, ``tpuslice serve-bench``, the DCN
+    smoke mains): either hold the host-wide claim, or be provably unable
+    to touch the chip.
+
+    - CPU-bound (``force_cpu=True`` or ``JAX_PLATFORMS=cpu``): pin jax to
+      CPU **in-process** (env alone is ignored by the tunnel's backend
+      hook) and return ``None`` — no lock needed, no chip reachable.
+    - TPU-bound: acquire and return the claim, or raise
+      :class:`TpuBusyError`. Callers report the error on their own
+      channel (log line, JSON fragment) and exit non-zero.
+    """
+    if force_cpu or tpu_is_cpu_forced():
+        force_cpu_in_process()
+        return None
+    return TpuClaim().acquire(timeout=timeout)
