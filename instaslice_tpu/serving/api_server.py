@@ -75,6 +75,14 @@ class _Pending:
         self.error: str = ""
         self.timed_out = False        # set by the HTTP layer on 503,
         #                               or on a broken streaming socket
+        # serializes the timeout decision against completion: the HTTP
+        # thread may only flag timed_out while done is still unset (via
+        # flag_timeout), and the scheduler decides the metrics outcome +
+        # sets done under the same lock — so a request can never be
+        # 503'd AND counted ok
+        self.lock = threading.Lock()
+        self.server_fault = False     # engine-side failure (HTTP 500),
+        #                               vs a client mistake (HTTP 400)
         self.t0 = time.monotonic()
         # streaming: the scheduler pushes dict events after every decode
         # block ({"kind": "delta"/"final", "index": choice, ...}); a str
@@ -83,6 +91,15 @@ class _Pending:
             queue.Queue() if stream else None
         )
         self.sent: Dict[int, int] = {}
+
+    def flag_timeout(self) -> None:
+        """Mark this request timed out / abandoned — unless it already
+        completed, in which case the scheduler's ok-count stands and
+        the flag stays clear. Every timeout writer (sync wait expiry,
+        broken streaming socket) must come through here."""
+        with self.lock:
+            if not self.done.is_set():
+                self.timed_out = True
 
     @property
     def result(self) -> Optional[GenerationResult]:
@@ -144,6 +161,10 @@ class _Scheduler(threading.Thread):
                             p.error = "ValueError: no such prefix"
                     except Exception as e:
                         p.error = f"{type(e).__name__}: {e}"
+                        # register_prefix prefills through donating jits
+                        if eng.cache_poisoned():
+                            p.server_fault = True
+                            self._recover_engine(e)
                     p.done.set()
                     continue
                 if eng.free_slots() < p.n:
@@ -154,6 +175,13 @@ class _Scheduler(threading.Thread):
                 except Exception as e:  # bad prompt (too long, empty…)
                     p.error = f"{type(e).__name__}: {e}"
                     self.metrics.requests.labels(outcome="rejected").inc()
+                    # admission prefills through DONATING jits: a
+                    # device-side failure mid-prefill consumed the
+                    # cache, and without recovery every later call
+                    # would raise "Array has been deleted" forever
+                    if eng.cache_poisoned():
+                        p.server_fault = True
+                        self._recover_engine(e)
                     if p.stream_q is not None:
                         p.stream_q.put(p.error)
                     p.done.set()
@@ -210,9 +238,33 @@ class _Scheduler(threading.Thread):
                     eng.decode_block(n)
                 else:
                     eng.step()
-            except Exception as e:  # pragma: no cover - engine invariant
+            except Exception as e:  # noqa: BLE001 - recover, keep serving
                 log.exception("decode failed: %s", e)
+                if eng.cache_poisoned():
+                    # the failed call consumed its donated cache buffer:
+                    # carrying on would raise "Array has been deleted"
+                    # on every later decode — reset the device state,
+                    # fail the in-flight requests, keep serving
+                    self._recover_engine(e)
             self._deliver()
+
+    def _recover_engine(self, e: Exception) -> None:
+        """Reset poisoned device state and fail every in-flight request
+        whose KV went with the old cache (500s, not silent drops)."""
+        log.warning("recovering engine after device failure: %s", e)
+        for rid in self.engine.recover():
+            p = self._by_rid.pop(rid, None)
+            self._budget.pop(rid, None)
+            if p is None:
+                continue
+            p.server_fault = True
+            p.error = p.error or (
+                "engine recovered after device failure: "
+                f"{type(e).__name__}: {e}"
+            )
+            if p.stream_q is not None:
+                p.stream_q.put(p.error)
+            self._maybe_complete(p)
 
     def _maybe_complete(self, p: _Pending) -> None:
         """Finalize a pending once NONE of its engine rids are live:
@@ -222,11 +274,15 @@ class _Scheduler(threading.Thread):
         if any(rid in self._by_rid for rid in p.rid_index):
             return
         # a request the HTTP layer already 503'd must not read as a
-        # success on the dashboard — the client never got the tokens
-        outcome = "timeout" if p.timed_out else "ok"
-        self.metrics.requests.labels(outcome=outcome).inc()
-        self.metrics.request_seconds.observe(time.monotonic() - p.t0)
-        p.done.set()
+        # success on the dashboard — the client never got the tokens.
+        # Outcome read + done.set() are atomic under p.lock so the HTTP
+        # thread's expiring wait cannot interleave (503 counted as ok).
+        with p.lock:
+            outcome = ("timeout" if p.timed_out
+                       else "error" if p.error else "ok")
+            self.metrics.requests.labels(outcome=outcome).inc()
+            self.metrics.request_seconds.observe(time.monotonic() - p.t0)
+            p.done.set()
 
     def _deliver(self) -> None:
         eng = self.engine
@@ -418,12 +474,14 @@ class _Handler(BaseHTTPRequestHandler):
         if pending.stream_q is not None:
             self._stream_response(pending)
             return
-        if not pending.done.wait(type(self).request_timeout):
-            pending.timed_out = True
+        if not self._await_or_timeout(pending):
             self._send(503, {"error": "request timed out in queue"})
             return
         if pending.error:
-            self._send(400, {"error": pending.error})
+            # client mistakes are 400s; an engine-side failure that
+            # killed the request is the server's fault
+            self._send(500 if pending.server_fault else 400,
+                       {"error": pending.error})
             return
         choices = []
         for idx in sorted(pending.results):
@@ -448,6 +506,20 @@ class _Handler(BaseHTTPRequestHandler):
         })
 
 
+    def _await_or_timeout(self, pending: _Pending) -> bool:
+        """Wait for completion; on expiry flag the timeout UNDER the
+        pending's lock so the scheduler cannot complete-and-count-ok in
+        the same instant. Returns True when the result was delivered —
+        including the race window where delivery landed between the
+        wait expiring and the flag: then the tokens exist and were
+        counted ok, so the client gets them instead of a lying 503."""
+        if pending.done.wait(type(self).request_timeout):
+            return True
+        pending.flag_timeout()
+        # flag_timeout is a no-op when delivery landed in the window:
+        # then the tokens exist and were counted ok — return them
+        return not pending.timed_out
+
     def _stream_response(self, pending: _Pending) -> None:
         """Server-sent events: one ``data:`` chunk of token ids per
         decode block as the scheduler produces them, a final chunk with
@@ -456,6 +528,7 @@ class _Handler(BaseHTTPRequestHandler):
         scheduler evicts its slot — streaming clients get disconnect
         cancellation for free."""
         deadline = time.monotonic() + type(self).request_timeout
+        broken = False
 
         def write(payload) -> None:
             # bound every blocking socket write by the remaining
@@ -535,8 +608,16 @@ class _Handler(BaseHTTPRequestHandler):
             # client hung up or the stream stalled past the deadline:
             # flag for the scheduler's eviction sweep; the socket is in
             # an unknown state, so don't let the handler reuse it
-            pending.timed_out = True
+            pending.flag_timeout()
+            broken = True
             self.close_connection = True
+        finally:
+            # clean stream (the try exits via return): undo the
+            # shrinking per-write deadline, or a keep-alive follow-up
+            # request on this socket would inherit a residual timeout
+            # on all its reads/writes
+            if not broken:
+                self.connection.settimeout(None)
 
     def do_DELETE(self):
         if self.path.startswith("/v1/prefixes"):
@@ -573,8 +654,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         pending = _Pending(tokens, 0, prefix_op=op)
         type(self).scheduler.submit(pending)
-        if not pending.done.wait(type(self).request_timeout):
-            pending.timed_out = True
+        if not self._await_or_timeout(pending):
             self._send(503, {"error": "request timed out in queue"})
             return
         if pending.error:

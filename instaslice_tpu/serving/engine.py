@@ -161,6 +161,7 @@ class ServingEngine:
         self.mesh = mesh
         self._rng = jax.random.key(seed)
         self._next_id = 0
+        self.kv_quant = kv_quant
         self.cache = model.init_cache(max_batch, max_len, quant=kv_quant)
         self.lengths = jnp.zeros(max_batch, jnp.int32)
         self.last_token = jnp.zeros(max_batch, jnp.int32)
@@ -218,8 +219,16 @@ class ServingEngine:
             # the rest (None) to sharding propagation
             return tree_of_outputs_spec if self._multiproc else None
 
+        # every cache-transforming jit DONATES its cache argument: the
+        # callers all rebind (self.cache = ...), so XLA may alias the
+        # update in place instead of copying the full (L, B, S, H, hd)
+        # buffer per call — without this, admission paths (prefix-cache
+        # hits, parallel-sample forks) pay O(full cache) HBM per written
+        # slot where a stripe write suffices. _read_stripe stays
+        # donation-free: it extracts a copy while the cache lives on.
         self._prefill = jax.jit(
             self._prefill_impl,
+            donate_argnums=(1,),
             out_shardings=rep((None, self._replicated)),
         )
         # stripe length is a static shape: one compile per distinct
@@ -227,29 +236,39 @@ class ServingEngine:
         self._read_stripe = jax.jit(
             self._read_stripe_impl, static_argnames=("length",)
         )
-        self._write_stripe = jax.jit(self._write_stripe_impl)
+        self._write_stripe = jax.jit(
+            self._write_stripe_impl, donate_argnums=(0,)
+        )
         self._decode = jax.jit(
             self._decode_impl,
+            donate_argnums=(1,),
             out_shardings=rep((None, self._replicated)),
         )
         self._decode_block = jax.jit(
             self._decode_block_impl,
             static_argnames=("n_steps", "greedy", "attend_len",
                              "top_k", "top_p"),
+            donate_argnums=(1,),
             out_shardings=rep(
                 (None, self._replicated, self._replicated,
                  self._replicated, self._replicated)
             ),
         )
         if draft_model is not None:
-            self._draft_prefill = jax.jit(self._draft_prefill_impl)
-            self._draft_catchup = jax.jit(self._draft_catchup_impl)
+            self._draft_prefill = jax.jit(
+                self._draft_prefill_impl, donate_argnums=(1,)
+            )
+            self._draft_catchup = jax.jit(
+                self._draft_catchup_impl, donate_argnums=(1,)
+            )
             self._spec_draft = jax.jit(
                 self._spec_draft_impl, static_argnames=("k",),
+                donate_argnums=(1,),
                 out_shardings=rep((None, self._replicated)),
             )
             self._spec_verify = jax.jit(
                 self._spec_verify_impl,
+                donate_argnums=(1,),
                 out_shardings=rep(
                     (None, self._replicated, self._replicated)
                 ),
@@ -482,6 +501,60 @@ class ServingEngine:
         """Drop a live slot with NO result (abandoned request): the
         tokens were never delivered to anyone."""
         self.slots.pop(slot)
+
+    def cache_poisoned(self) -> bool:
+        """True when a donated cache buffer was consumed by a FAILED
+        jitted call — the state :meth:`recover` exists for. Checked
+        instead of assumed so a host-side error (validation bug, bad
+        sampling input) doesn't needlessly nuke live slots."""
+        import jax
+
+        trees = [self.cache]
+        if self.draft_model is not None:
+            trees.append(self.draft_cache)
+        return any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for t in trees for leaf in jax.tree.leaves(t)
+        )
+
+    def recover(self) -> List[int]:
+        """Rebuild device decode state after a failed jitted call.
+
+        The cache-transforming jits donate their cache argument, so a
+        call that raises mid-flight (transient OOM, backend error)
+        leaves ``self.cache`` consumed — without this, every later
+        decode raises "Array has been deleted" forever and a
+        catch-and-continue caller (the API scheduler) spins dead.
+        Drops every live slot (their KV stripes are gone with the old
+        cache) and returns their request ids so the caller can fail
+        those requests; zeroed caches and replicated decode state are
+        rebuilt, already-delivered ``finished`` results and registered
+        prefix stripes survive (stripes are independent copies, never
+        donated). Single-process recovery: a multi-host driver must
+        broadcast the reset through its op stream instead."""
+        import jax.numpy as jnp
+
+        lost = [r.request_id for r in self.slots.values()]
+        self.slots.clear()
+        self.cache = self.model.init_cache(
+            self.max_batch, self.max_len, quant=self.kv_quant
+        )
+        self.lengths = jnp.zeros(self.max_batch, jnp.int32)
+        self.last_token = jnp.zeros(self.max_batch, jnp.int32)
+        if self.draft_model is not None:
+            self.draft_cache = self.draft_model.init_cache(
+                self.max_batch, self.max_len
+            )
+        if self.mesh is not None:
+            self._shard_over(self.mesh)
+            if self.draft_model is not None:
+                self.draft_params, self.draft_cache = (
+                    self._shard_model_state(
+                        self.mesh, self.draft_model, self.draft_params,
+                        self.draft_cache,
+                    )
+                )
+        return lost
 
     def _check_capacity(self, n: int) -> None:
         """Host-side admission capacity check (shared with the
@@ -1010,7 +1083,7 @@ class ServingEngine:
 
     def spec_throughput(
         self, rounds: int = 32, batch: Optional[int] = None,
-        overhead_seconds: float = 0.0,
+        overhead_seconds: float = 0.0, detail: bool = False,
     ):
         """(tokens/sec, accepted tokens/round) over ``rounds``
         speculative rounds at the given concurrency — the spec-decode
@@ -1037,8 +1110,21 @@ class ServingEngine:
             slot_rounds += len(self.slots)
             out = self.spec_step()
             produced += sum(len(v) for v in out.values())
-        dt = time.perf_counter() - t0 - overhead_seconds * rounds
-        dt = max(dt, 1e-6)
+        wall = time.perf_counter() - t0
+        dt = max(wall - overhead_seconds * rounds, 1e-6)
+        if detail:
+            # both sides of the RTT bracket from ONE measurement: raw
+            # (no subtraction — what a tunnel-remote client observes)
+            # and corrected (what the chip sustains); running twice
+            # would double a tunnel-bound phase AND compare runs with
+            # different noise
+            return {
+                "tokens_per_sec": produced / dt,
+                "tokens_per_sec_raw": produced / max(wall, 1e-6),
+                "tokens_per_round": produced / max(1, slot_rounds),
+                "produced": produced,
+                "wall_seconds": round(wall, 3),
+            }
         return produced / dt, produced / max(1, slot_rounds)
 
     def throughput(

@@ -762,3 +762,115 @@ class TestSamplingConfig:
                                        "temperature": 0.9})
             assert code == 200
             assert len(out["choices"][0]["token_ids"]) == 4
+
+
+class TestEngineRecovery:
+    """Donated cache buffers are consumed even by a FAILING jitted call;
+    the scheduler must reset the engine and keep serving instead of
+    spinning on 'Array has been deleted' forever."""
+
+    def test_decode_failure_recovers_and_serves_again(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        real = eng.decode_block
+        calls = {"n": 0}
+
+        def flaky(n):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # consume the donated cache WITHOUT rebinding — exactly
+                # what a jitted call that raises mid-flight leaves
+                # behind — then raise
+                jax.jit(lambda c: c, donate_argnums=(0,))(eng.cache)
+                assert eng.cache_poisoned()
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+            return real(n)
+
+        eng.decode_block = flaky
+        with ApiServer(eng) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2], "max_tokens": 6})
+            assert code == 500
+            assert "engine recovered" in out["error"]
+            # the server survived: a fresh request decodes normally and
+            # matches the oracle (zeroed cache, same params)
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 6})
+            assert code == 200
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [5, 9, 2, 7], 6
+            )
+
+    def test_healthy_cache_host_error_does_not_nuke_slots(self, model):
+        """Recovery is gated on actual poisoning: a host-side bug that
+        raises with the cache intact must not kill live requests."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        real = eng.decode_block
+        calls = {"n": 0}
+
+        def flaky(n):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("host-side bug, cache untouched")
+            return real(n)
+
+        eng.decode_block = flaky
+        with ApiServer(eng) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 6})
+            # the request survives the transient error and completes
+            assert code == 200
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [5, 9, 2, 7], 6
+            )
+
+    def test_admission_poisoning_recovers(self, model):
+        """A prefill failure that consumed the donated cache must also
+        recover — admission, not just decode, goes through donating
+        jits."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        real = eng.add_request_n
+        calls = {"n": 0}
+
+        def flaky(prompt, n, stop=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                jax.jit(lambda c: c, donate_argnums=(0,))(eng.cache)
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+            return real(prompt, n, stop=stop)
+
+        eng.add_request_n = flaky
+        with ApiServer(eng) as srv:
+            code, out = post(srv.url, {"prompt": [5, 9], "max_tokens": 4})
+            assert code == 500          # server fault, not client 400
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 6})
+            assert code == 200
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [5, 9, 2, 7], 6
+            )
+
+    def test_engine_recover_reports_lost_rids_and_keeps_prefixes(
+        self, model
+    ):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        eng.register_prefix([3, 1, 4, 1, 5, 9, 2, 6])
+        rid = eng.add_request([3, 1, 4, 1, 5, 9, 2, 6, 7])
+        assert eng.prefix_hits == 1
+        lost = eng.recover()
+        assert lost == [rid]
+        assert not eng.slots
+        # prefix stripes are independent copies — they survive recovery
+        # and keep accelerating admissions
+        eng.add_request([3, 1, 4, 1, 5, 9, 2, 6, 8])
+        assert eng.prefix_hits == 2
+        # decode still works on the rebuilt cache
+        for _ in range(4):
+            eng.step()
+        assert len(eng.slots[next(iter(eng.slots))].generated) >= 4
