@@ -36,7 +36,11 @@ native-test: native
 # ------------------------------------------------------------------ tests
 
 .PHONY: test
-test:  ## Unit + integration tests (fake kube, fake TPU, virtual CPU mesh)
+test:  ## Fast tier (~2 min): control plane, device, kube, topology
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+.PHONY: test-all
+test-all:  ## Everything, incl. jax-workload + multi-process tiers (~19 min)
 	$(PY) -m pytest tests/ -x -q
 
 .PHONY: test-e2e

@@ -9,8 +9,12 @@ unit-testable against the fake and identical in production:
   inventory, failure injection, dangling-slice seeding.
 - :class:`NativeBackend`  — ctypes over the C++ ``libtpuslice.so``:
   real chip enumeration plus a crash-safe flock'd reservation registry.
+- :class:`CloudTpuBackend` — the GKE/Cloud "driver" (SURVEY.md §2a row
+  1): chips provisioned through the Cloud TPU queued-resources REST
+  API, with the cloud control plane as the durable registry.
 - ``auto`` selection: native when the library and chips are present,
-  fake otherwise.
+  cloudtpu when a queued-resources endpoint is configured, fake
+  otherwise.
 """
 
 from instaslice_tpu.device.backend import (
@@ -20,6 +24,7 @@ from instaslice_tpu.device.backend import (
     NodeInventory,
     Reservation,
 )
+from instaslice_tpu.device.cloudtpu import CloudTpuBackend
 from instaslice_tpu.device.fake import FakeTpuBackend
 from instaslice_tpu.device.native import NativeBackend, find_library
 from instaslice_tpu.device.select import select_backend

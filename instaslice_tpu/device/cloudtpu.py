@@ -1,0 +1,303 @@
+"""Cloud TPU API (queued resources) device backend.
+
+SURVEY.md §2a row 1 names the Cloud TPU queued-resources API as the
+device "driver" on GKE/Cloud — the role the NVML calls play for the
+reference on bare metal (``instaslice_daemonset.go``'s
+CreateGpuInstanceWithPlacement / Destroy): where the native backend
+reserves chips it can see under ``/dev``, this backend asks the cloud
+control plane to provision them, with the CLOUD as the durable registry
+(restart-safety for free — ``list_reservations`` is a server-side list,
+not local state).
+
+Wire surface (the v2 queued-resources REST shape, reduced to what the
+agent uses):
+
+- ``POST   {base}/projects/{p}/locations/{z}/queuedResources``
+  ``?queued_resource_id={uuid}`` — create; the reserved chip ids ride in
+  the node labels (``tpuslice-chips``), the slice uuid doubles as the
+  queued-resource id.
+- ``GET    .../queuedResources/{uuid}`` — poll the state machine
+  (ACCEPTED → PROVISIONING → ACTIVE | FAILED).
+- ``GET    .../queuedResources`` — list (rebuilds reservations).
+- ``DELETE .../queuedResources/{uuid}`` — release.
+
+Mapped errors: duplicate queued_resource_id → 409/alreadyExists →
+:class:`SliceExists`; capacity conflict (the mock models it as a chip
+overlap) → 409 → :class:`ChipsBusy`; unknown id → 404 →
+:class:`SliceNotFound`; a resource that lands in FAILED is deleted
+best-effort and surfaces as :class:`DeviceError` (the agent marks the
+allocation ``failed`` and the controller retries elsewhere — same
+contract as the native backend).
+
+Auth is a bearer token (``TPUSLICE_CLOUDTPU_TOKEN``) — on GKE the
+workload-identity metadata server would mint it; tests validate the
+header end-to-end against the mock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from instaslice_tpu.device.backend import (
+    ChipsBusy,
+    DeviceBackend,
+    DeviceError,
+    NodeInventory,
+    Reservation,
+    SliceExists,
+    SliceNotFound,
+    env_overrides,
+)
+from instaslice_tpu.topology.grid import get_generation
+
+#: node label keys carrying the reservation through the cloud resource
+CHIPS_LABEL = "tpuslice-chips"
+UUID_LABEL = "tpuslice-uuid"
+
+#: queued-resource states (the subset the backend reasons about)
+_LIVE_STATES = frozenset(
+    {"ACCEPTED", "PROVISIONING", "ACTIVE", "CREATING", "WAITING_FOR_RESOURCES"}
+)
+
+
+class CloudTpuBackend(DeviceBackend):
+    name = "cloudtpu"
+
+    def __init__(
+        self,
+        api_base: Optional[str] = None,
+        project: Optional[str] = None,
+        zone: Optional[str] = None,
+        generation: Optional[str] = None,
+        chip_count: Optional[int] = None,
+        token: Optional[str] = None,
+        poll_interval: float = 0.05,
+        provision_timeout: float = 30.0,
+        **hints,
+    ) -> None:
+        self.api_base = (api_base or os.environ.get("TPUSLICE_CLOUDTPU_API",
+                                                    "")).rstrip("/")
+        if not self.api_base:
+            raise DeviceError(
+                "cloudtpu backend needs an API endpoint "
+                "(TPUSLICE_CLOUDTPU_API or api_base=)"
+            )
+        self.project = project or os.environ.get(
+            "TPUSLICE_CLOUDTPU_PROJECT", "proj"
+        )
+        self.zone = zone or os.environ.get(
+            "TPUSLICE_CLOUDTPU_ZONE", "zone-a"
+        )
+        env = env_overrides()
+        self.generation = generation or env.get("generation", "v5e")
+        gen = get_generation(self.generation)
+        self._n = gen.chips_per_host if chip_count is None else chip_count
+        self._host_offset = hints.get(
+            "host_offset", env.get("host_offset", (0, 0, 0))
+        )
+        self._torus_group = hints.get(
+            "torus_group", env.get("torus_group", "")
+        )
+        self.token = token or os.environ.get("TPUSLICE_CLOUDTPU_TOKEN", "")
+        self.poll_interval = poll_interval
+        self.provision_timeout = provision_timeout
+
+    # ------------------------------------------------------------ HTTP
+
+    @property
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _url(self, name: str = "", query: str = "") -> str:
+        url = f"{self.api_base}/{self._parent}/queuedResources"
+        if name:
+            url += f"/{name}"
+        if query:
+            url += f"?{query}"
+        return url
+
+    def _call(self, method: str, url: str,
+              body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read().decode() or "{}")
+            except ValueError:
+                payload = {}
+            err = payload.get("error", {})
+            raise _ApiHttpError(
+                e.code, err.get("status", ""), err.get("message", str(e))
+            ) from None
+        except urllib.error.URLError as e:
+            raise DeviceError(
+                f"cloudtpu API unreachable at {self.api_base}: {e.reason}"
+            ) from None
+
+    # ------------------------------------------------------- DeviceBackend
+
+    def discover(self) -> NodeInventory:
+        # provisioning is cloud-side: the "paths" identify chips within
+        # this node's accelerator config, not /dev nodes
+        return NodeInventory(
+            generation=self.generation,
+            chip_paths={
+                i: f"cloudtpu://{self._parent}/chip{i}"
+                for i in range(self._n)
+            },
+            host_offset=tuple(self._host_offset),
+            torus_group=self._torus_group,
+            source="cloudtpu",
+        )
+
+    def reserve(self, slice_uuid: str, chip_ids: List[int]) -> Reservation:
+        if not slice_uuid:
+            raise DeviceError("slice_uuid must be non-empty")
+        if not chip_ids:
+            raise DeviceError("chip_ids must be non-empty")
+        unknown = [c for c in chip_ids if not 0 <= c < self._n]
+        if unknown:
+            raise DeviceError(
+                f"chips {unknown} not on this host (have 0..{self._n - 1})"
+            )
+        chips = tuple(sorted(set(chip_ids)))
+        body = {
+            "tpu": {
+                "nodeSpec": [{
+                    "parent": self._parent,
+                    "nodeId": f"tpuslice-{slice_uuid}",
+                    "node": {
+                        "acceleratorType": self.generation,
+                        "labels": {
+                            UUID_LABEL: slice_uuid,
+                            CHIPS_LABEL: "_".join(map(str, chips)),
+                        },
+                    },
+                }],
+            },
+        }
+        try:
+            self._call(
+                "POST", self._url(query=f"queued_resource_id={slice_uuid}"),
+                body,
+            )
+        except _ApiHttpError as e:
+            if e.code == 409 and e.status == "ALREADY_EXISTS":
+                raise SliceExists(
+                    f"queued resource {slice_uuid} already exists"
+                ) from None
+            if e.code == 409:
+                raise ChipsBusy(e.message) from None
+            raise DeviceError(
+                f"queued-resource create failed ({e.code}): {e.message}"
+            ) from None
+        self._await_active(slice_uuid)
+        return Reservation(slice_uuid=slice_uuid, chip_ids=chips)
+
+    def _await_active(self, slice_uuid: str) -> None:
+        """Poll the queued-resource state machine to ACTIVE; a FAILED
+        resource is deleted best-effort (so the uuid is reusable after
+        the agent's retry) before the error surfaces."""
+        deadline = time.monotonic() + self.provision_timeout
+        while True:
+            try:
+                res = self._call("GET", self._url(slice_uuid))
+            except _ApiHttpError as e:
+                raise DeviceError(
+                    f"queued resource {slice_uuid} vanished while "
+                    f"provisioning ({e.code}): {e.message}"
+                ) from None
+            state = (res.get("state") or {}).get("state", "")
+            if state == "ACTIVE":
+                return
+            if state in ("FAILED", "SUSPENDED"):
+                try:
+                    self.release(slice_uuid)
+                except DeviceError:
+                    pass
+                raise DeviceError(
+                    f"queued resource {slice_uuid} entered {state}: "
+                    + (res.get("state") or {}).get("error", "no detail")
+                )
+            if time.monotonic() >= deadline:
+                # same cleanup contract as FAILED: the agent saw this
+                # reserve fail, so the resource must not stay live
+                # (SliceExists on retry, chips leaked server-side)
+                try:
+                    self.release(slice_uuid)
+                except DeviceError:
+                    pass
+                raise DeviceError(
+                    f"queued resource {slice_uuid} not ACTIVE within "
+                    f"{self.provision_timeout}s (state={state or '?'})"
+                )
+            time.sleep(self.poll_interval)
+
+    def release(self, slice_uuid: str) -> None:
+        try:
+            self._call("DELETE", self._url(slice_uuid))
+        except _ApiHttpError as e:
+            if e.code == 404:
+                raise SliceNotFound(
+                    f"no queued resource {slice_uuid}"
+                ) from None
+            raise DeviceError(
+                f"queued-resource delete failed ({e.code}): {e.message}"
+            ) from None
+
+    def _list_raw(self) -> List[dict]:
+        out = self._call("GET", self._url())
+        return out.get("queuedResources", [])
+
+    def list_reservations(self) -> List[Reservation]:
+        res = []
+        for qr in self._list_raw():
+            state = (qr.get("state") or {}).get("state", "")
+            if state not in _LIVE_STATES:
+                continue
+            labels = _node_labels(qr)
+            uuid = labels.get(UUID_LABEL) or qr.get("name", "").split("/")[-1]
+            chips_s = labels.get(CHIPS_LABEL, "")
+            chips = tuple(
+                int(c) for c in chips_s.split("_") if c
+            ) if chips_s else ()
+            res.append(Reservation(slice_uuid=uuid, chip_ids=chips))
+        return sorted(res, key=lambda r: r.slice_uuid)
+
+    def chip_health(self) -> Dict[int, bool]:
+        """All configured chips healthy unless a queued resource holding
+        them sits in FAILED — the cloud's signal that the underlying
+        accelerators are bad."""
+        health = {i: True for i in range(self._n)}
+        for qr in self._list_raw():
+            if (qr.get("state") or {}).get("state") != "FAILED":
+                continue
+            for c in _node_labels(qr).get(CHIPS_LABEL, "").split("_"):
+                if c and int(c) in health:
+                    health[int(c)] = False
+        return health
+
+
+class _ApiHttpError(Exception):
+    def __init__(self, code: int, status: str, message: str):
+        super().__init__(f"{code} {status}: {message}")
+        self.code = code
+        self.status = status
+        self.message = message
+
+
+def _node_labels(qr: dict) -> dict:
+    specs = ((qr.get("tpu") or {}).get("nodeSpec")) or [{}]
+    return (specs[0].get("node") or {}).get("labels") or {}

@@ -1,0 +1,239 @@
+"""Mock Cloud TPU queued-resources API server (for tests and demos).
+
+The ``kube/httptest.py`` analog for the device layer's cloud driver:
+:class:`CloudTpuBackend` pointed at this server exercises its full wire
+path — URL building, auth header, JSON verbs, the provisioning state
+machine, error mapping — without a GCP project. The server is
+authoritative the way the real control plane is: duplicate
+queued-resource ids and chip-capacity conflicts are rejected HERE,
+atomically under one lock, so racing clients cannot double-grant.
+
+State machine: a created resource advances ACCEPTED → PROVISIONING →
+ACTIVE one step per GET poll (``provision_polls`` controls how many
+PROVISIONING polls), or lands in FAILED when failure injection says so.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlsplit
+
+from instaslice_tpu.device.cloudtpu import CHIPS_LABEL
+
+_PATH = re.compile(
+    r"^/projects/(?P<proj>[^/]+)/locations/(?P<zone>[^/]+)"
+    r"/queuedResources(?:/(?P<name>[^/]+))?$"
+)
+
+
+class _QueuedResource:
+    def __init__(self, name: str, body: dict, fail: bool,
+                 provision_polls: int):
+        self.name = name
+        self.body = body
+        self.fail = fail
+        # remaining GET polls before ACTIVE (or FAILED): models the
+        # cloud's async provisioning without wall-clock coupling
+        self.polls_left = provision_polls
+        self.state = "ACCEPTED"
+
+    def poll(self) -> str:
+        if self.state in ("ACTIVE", "FAILED"):
+            return self.state
+        if self.polls_left > 0:
+            self.polls_left -= 1
+            self.state = "PROVISIONING"
+        else:
+            self.state = "FAILED" if self.fail else "ACTIVE"
+        return self.state
+
+    def to_json(self, parent: str) -> dict:
+        out = {
+            "name": f"{parent}/queuedResources/{self.name}",
+            "state": {"state": self.state},
+            **self.body,
+        }
+        if self.state == "FAILED":
+            out["state"]["error"] = "injected provisioning failure"
+        return out
+
+
+def _chips_of(body: dict) -> frozenset:
+    specs = ((body.get("tpu") or {}).get("nodeSpec")) or [{}]
+    labels = (specs[0].get("node") or {}).get("labels") or {}
+    return frozenset(
+        int(c) for c in labels.get(CHIPS_LABEL, "").split("_") if c
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_state = None  # type: ignore[assignment]
+    #: when set, requests must carry exactly this Bearer token or 401
+    required_token: Optional[str] = None
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, status: str, message: str) -> None:
+        self._send(code, {"error": {
+            "code": code, "status": status, "message": message,
+        }})
+
+    def _authorized(self) -> bool:
+        want = type(self).required_token
+        if want is None:
+            return True
+        return self.headers.get("Authorization", "") == f"Bearer {want}"
+
+    def _route(self):
+        parts = urlsplit(self.path)
+        m = _PATH.match(parts.path)
+        if not m:
+            self._error(404, "NOT_FOUND", f"no route {parts.path}")
+            return None
+        q = {k: v[0] for k, v in parse_qs(parts.query).items()}
+        return m.group("proj"), m.group("zone"), m.group("name"), q
+
+    def do_GET(self):
+        if not self._authorized():
+            return self._error(401, "UNAUTHENTICATED", "bad token")
+        r = self._route()
+        if r is None:
+            return
+        proj, zone, name, _ = r
+        st = type(self).server_state
+        parent = f"projects/{proj}/locations/{zone}"
+        with st.lock:
+            if name:
+                qr = st.resources.get(name)
+                if qr is None:
+                    return self._error(
+                        404, "NOT_FOUND", f"no queued resource {name}"
+                    )
+                qr.poll()
+                return self._send(200, qr.to_json(parent))
+            # list does NOT advance the state machine (a monitoring
+            # list must not make provisioning complete faster)
+            return self._send(200, {"queuedResources": [
+                qr.to_json(parent)
+                for qr in sorted(st.resources.values(),
+                                 key=lambda q: q.name)
+            ]})
+
+    def do_POST(self):
+        if not self._authorized():
+            return self._error(401, "UNAUTHENTICATED", "bad token")
+        r = self._route()
+        if r is None:
+            return
+        proj, zone, _, q = r
+        name = q.get("queued_resource_id", "")
+        if not name:
+            return self._error(
+                400, "INVALID_ARGUMENT", "queued_resource_id required"
+            )
+        n = int(self.headers.get("Content-Length", "0") or 0)
+        body = json.loads(self.rfile.read(n).decode() or "{}")
+        st = type(self).server_state
+        with st.lock:
+            if name in st.resources:
+                return self._error(
+                    409, "ALREADY_EXISTS",
+                    f"queued resource {name} already exists"
+                )
+            chips = _chips_of(body)
+            for other in st.resources.values():
+                if other.state == "FAILED":
+                    continue
+                overlap = chips & _chips_of(other.body)
+                if overlap:
+                    return self._error(
+                        409, "RESOURCE_EXHAUSTED",
+                        f"chips {sorted(overlap)} already reserved by "
+                        f"{other.name}"
+                    )
+            fail = st.fail_next_creates > 0
+            if fail:
+                st.fail_next_creates -= 1
+            st.resources[name] = _QueuedResource(
+                name, body, fail, st.provision_polls
+            )
+            parent = f"projects/{proj}/locations/{zone}"
+            return self._send(
+                200, st.resources[name].to_json(parent)
+            )
+
+    def do_DELETE(self):
+        if not self._authorized():
+            return self._error(401, "UNAUTHENTICATED", "bad token")
+        r = self._route()
+        if r is None:
+            return
+        _, _, name, _ = r
+        st = type(self).server_state
+        with st.lock:
+            if name not in st.resources:
+                return self._error(
+                    404, "NOT_FOUND", f"no queued resource {name}"
+                )
+            del st.resources[name]
+        return self._send(200, {"done": True})
+
+
+class CloudTpuMockServer:
+    """The queued-resources API behind a real HTTP listener."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 provision_polls: int = 1,
+                 required_token: Optional[str] = None) -> None:
+        self.lock = threading.Lock()
+        self.resources: Dict[str, _QueuedResource] = {}
+        self.provision_polls = provision_polls
+        self.fail_next_creates = 0
+        handler = type(
+            "BoundHandler", (_Handler,),
+            {"server_state": self, "required_token": required_token},
+        )
+        self._srv = ThreadingHTTPServer((host, port), handler)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="cloudtpu-mock",
+            daemon=True,
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._srv.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def fail_next_create(self, count: int = 1) -> None:
+        """The next ``count`` created resources land in FAILED after
+        provisioning (models the cloud failing to deliver capacity)."""
+        with self.lock:
+            self.fail_next_creates += count
+
+    def start(self) -> "CloudTpuMockServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "CloudTpuMockServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

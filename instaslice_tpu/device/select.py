@@ -18,14 +18,21 @@ def _chips_present(root: str = "") -> bool:
 
 
 def select_backend(kind: str = "auto", **kwargs) -> DeviceBackend:
-    """``kind``: auto | fake | native.
+    """``kind``: auto | fake | native | cloudtpu.
 
-    ``auto`` picks native when libtpuslice.so and TPU device nodes are both
-    present, else fake (generation from TPUSLICE_GENERATION, default v5e) —
-    so the same agent image runs on TPU nodes and in CI unchanged.
+    ``auto`` picks native when libtpuslice.so and TPU device nodes are
+    both present, else cloudtpu when a queued-resources endpoint is
+    configured (``TPUSLICE_CLOUDTPU_API`` — the GKE/Cloud "driver",
+    SURVEY.md §2a row 1), else fake (generation from
+    TPUSLICE_GENERATION, default v5e) — so the same agent image runs on
+    TPU metal, on GKE node pools, and in CI unchanged.
     """
     if kind == "native":
         return NativeBackend(**kwargs)
+    if kind == "cloudtpu":
+        from instaslice_tpu.device.cloudtpu import CloudTpuBackend
+
+        return CloudTpuBackend(**kwargs)
     if kind == "fake":
         hints = env_overrides()
         kwargs.setdefault("generation", hints.get("generation", "v5e"))
@@ -36,5 +43,9 @@ def select_backend(kind: str = "auto", **kwargs) -> DeviceBackend:
         root = kwargs.pop("root", "")
         if find_library() and _chips_present(root):
             return NativeBackend(root=root, **kwargs)
+        if os.environ.get("TPUSLICE_CLOUDTPU_API"):
+            return select_backend("cloudtpu", **kwargs)
         return select_backend("fake", **kwargs)
-    raise DeviceError(f"unknown backend kind {kind!r} (auto|fake|native)")
+    raise DeviceError(
+        f"unknown backend kind {kind!r} (auto|fake|native|cloudtpu)"
+    )

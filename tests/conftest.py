@@ -26,6 +26,35 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 
+# ------------------------------------------------------------ fast/slow
+# The jax-workload and multi-process tiers dominate the suite's wall
+# clock (the full run is ~19 min serial); the control-plane modules run
+# in ~2 min. `make test` runs the fast tier (-m "not slow"),
+# `make test-all` everything. Whole modules are marked here, by name,
+# so a new test in a slow module cannot silently join the fast tier.
+
+SLOW_MODULES = {
+    "test_serving",       # jax engine: prefill/decode/spec compiles
+    "test_api_server",    # HTTP server over the jax engine
+    "test_workload",      # train-step / remat / ring-attention compiles
+    "test_distributed",   # 2-process DCN rendezvous + oplog smokes
+    "test_process_e2e",   # real OS processes: mains + election
+    "test_checkpoint",    # orbax save/restore round-trips
+    "test_pipeline",      # GPipe stage compiles over the CPU mesh
+    "test_ops",           # pallas kernel (interpret mode) sweeps
+    "test_bench_tpu",     # chained-timing harness units
+    "test_quant",         # int8 quantization sweeps
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        if item.module.__name__ in SLOW_MODULES:
+            item.add_marker(pytest.mark.slow)
+
+
 # --------------------------------------------------------------- helpers
 # Shared across process-spawning tests (promoted here so fixes reach all
 # copies — review finding r3).

@@ -1,7 +1,9 @@
-"""Device layer tests: one behavioral suite run over BOTH backends (fake
-and native-C++-via-ctypes against a synthetic /dev tree), so the fake can
-never drift from the real device semantics — the fidelity requirement from
-SURVEY.md §7 ("Fake-TPU fidelity so e2e means something without hardware").
+"""Device layer tests: ONE behavioral suite run over all three backends
+(fake, native-C++-via-ctypes against a synthetic /dev tree, and the
+cloudtpu queued-resources client against a mocked API server), so no
+backend can drift from the shared device semantics — the fidelity
+requirement from SURVEY.md §7 ("Fake-TPU fidelity so e2e means
+something without hardware").
 """
 
 import os
@@ -12,12 +14,14 @@ import pytest
 
 from instaslice_tpu.device import (
     ChipsBusy,
+    CloudTpuBackend,
     DeviceError,
     FakeTpuBackend,
     NativeBackend,
     select_backend,
 )
 from instaslice_tpu.device.backend import SliceExists, SliceNotFound
+from instaslice_tpu.device.cloudtpu_mock import CloudTpuMockServer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LIB = os.path.join(REPO, "native", "build", "libtpuslice.so")
@@ -41,17 +45,25 @@ def sim_root(tmp_path):
     return str(tmp_path)
 
 
-def make_backend(kind, native_lib, sim_root):
+@pytest.fixture
+def cloud_mock():
+    with CloudTpuMockServer() as srv:
+        yield srv
+
+
+def make_backend(kind, native_lib, sim_root, cloud_mock=None):
     if kind == "fake":
         return FakeTpuBackend(generation="v5e")
+    if kind == "cloudtpu":
+        return CloudTpuBackend(api_base=cloud_mock.url, generation="v5e")
     return NativeBackend(
         library_path=native_lib, root=sim_root, generation="v5e"
     )
 
 
-@pytest.fixture(params=["fake", "native"])
-def backend(request, native_lib, sim_root):
-    return make_backend(request.param, native_lib, sim_root)
+@pytest.fixture(params=["fake", "native", "cloudtpu"])
+def backend(request, native_lib, sim_root, cloud_mock):
+    return make_backend(request.param, native_lib, sim_root, cloud_mock)
 
 
 class TestBackendContract:
@@ -59,7 +71,9 @@ class TestBackendContract:
         inv = backend.discover()
         assert inv.generation == "v5e"
         assert inv.chip_count == 8
-        assert inv.chip_paths[0].endswith("accel0")
+        # path scheme is backend-specific (/dev node vs cloud resource);
+        # the contract is a stable per-chip identifier
+        assert inv.chip_paths[0].endswith(("accel0", "chip0"))
 
     def test_reserve_release_cycle(self, backend):
         r = backend.reserve("s-1", [0, 1, 2, 3])
@@ -214,6 +228,96 @@ class TestFakeSpecifics:
         b2 = FakeTpuBackend()
         b2.restore(snap)
         assert b2.list_reservations()[0].slice_uuid == "zombie"
+
+class TestCloudTpuSpecifics:
+    def test_registry_is_the_cloud_restart_safe(self, cloud_mock):
+        b1 = CloudTpuBackend(api_base=cloud_mock.url, generation="v5e")
+        b1.reserve("s-1", [0, 1])
+        # "restart": a brand-new client against the same control plane
+        b2 = CloudTpuBackend(api_base=cloud_mock.url, generation="v5e")
+        live = b2.list_reservations()
+        assert [(r.slice_uuid, r.chip_ids) for r in live] == \
+            [("s-1", (0, 1))]
+        with pytest.raises(ChipsBusy):
+            b2.reserve("s-2", [1])
+
+    def test_failed_provisioning_surfaces_and_uuid_reusable(
+        self, cloud_mock
+    ):
+        cloud_mock.fail_next_create()
+        b = CloudTpuBackend(api_base=cloud_mock.url, generation="v5e")
+        with pytest.raises(DeviceError, match="FAILED"):
+            b.reserve("s-1", [0])
+        # the failed resource was cleaned up: the agent's retry with the
+        # same uuid must not hit SliceExists
+        r = b.reserve("s-1", [0])
+        assert r.chip_ids == (0,)
+
+    def test_failed_resource_marks_chips_unhealthy(self, cloud_mock):
+        b = CloudTpuBackend(api_base=cloud_mock.url, generation="v5e")
+        cloud_mock.fail_next_create()
+        # leave the FAILED resource in place (bypass reserve's cleanup)
+        # to model the cloud reporting bad accelerators
+        orig = b.release
+        b.release = lambda uuid: None
+        with pytest.raises(DeviceError):
+            b.reserve("s-bad", [2, 3])
+        b.release = orig
+        h = b.chip_health()
+        assert h[2] is False and h[3] is False and h[0] is True
+        assert len(h) == 8
+
+    def test_provision_timeout_releases_the_resource(self):
+        # provisioning never completes: reserve must fail AND clean up,
+        # or the uuid hits SliceExists on the agent's retry and the
+        # chips stay reserved server-side forever
+        with CloudTpuMockServer(provision_polls=10 ** 6) as srv:
+            b = CloudTpuBackend(api_base=srv.url, generation="v5e",
+                                provision_timeout=0.3, poll_interval=0.02)
+            with pytest.raises(DeviceError, match="not ACTIVE within"):
+                b.reserve("s-stall", [0])
+            assert b.list_reservations() == []
+
+    def test_bearer_token_round_trip(self):
+        with CloudTpuMockServer(required_token="tok-123") as srv:
+            good = CloudTpuBackend(api_base=srv.url, generation="v5e",
+                                   token="tok-123")
+            good.reserve("s-1", [0])
+            assert good.list_reservations()[0].slice_uuid == "s-1"
+            bad = CloudTpuBackend(api_base=srv.url, generation="v5e",
+                                  token="wrong")
+            with pytest.raises(DeviceError, match="401"):
+                bad.reserve("s-2", [1])
+
+    def test_unreachable_api_is_device_error(self):
+        b = CloudTpuBackend(api_base="http://127.0.0.1:1",
+                            generation="v5e", provision_timeout=1)
+        with pytest.raises(DeviceError, match="unreachable"):
+            b.list_reservations()
+        assert b.healthy() is False
+
+    def test_env_configuration(self, cloud_mock, monkeypatch):
+        monkeypatch.setenv("TPUSLICE_CLOUDTPU_API", cloud_mock.url)
+        monkeypatch.setenv("TPUSLICE_GENERATION", "v4")
+        b = select_backend("cloudtpu")
+        inv = b.discover()
+        assert inv.generation == "v4" and inv.chip_count == 4
+        assert inv.source == "cloudtpu"
+
+    def test_auto_prefers_cloudtpu_when_endpoint_set(
+        self, cloud_mock, monkeypatch, tmp_path
+    ):
+        monkeypatch.setenv("TPUSLICE_CLOUDTPU_API", cloud_mock.url)
+        # no /dev chips under this root → native is out, cloudtpu wins
+        (tmp_path / "dev").mkdir()
+        b = select_backend("auto", root=str(tmp_path))
+        assert b.name == "cloudtpu"
+
+    def test_missing_endpoint_fails_clearly(self, monkeypatch):
+        monkeypatch.delenv("TPUSLICE_CLOUDTPU_API", raising=False)
+        with pytest.raises(DeviceError, match="TPUSLICE_CLOUDTPU_API"):
+            select_backend("cloudtpu")
+
 
 class TestSelect:
     def test_select_fake(self, monkeypatch):
