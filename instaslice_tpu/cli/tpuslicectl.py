@@ -19,6 +19,7 @@ def _serve_bench(args) -> int:
         vocab_size=args.vocab,
         d_model=args.d_model,
         n_heads=args.n_heads,
+        n_kv_heads=args.n_kv_heads,
         n_layers=args.n_layers,
         d_ff=args.d_ff,
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
@@ -48,7 +49,8 @@ def _serve_bench(args) -> int:
         "speculative": bool(args.spec),
         "model": {
             "dModel": args.d_model, "nLayers": args.n_layers,
-            "nHeads": args.n_heads, "dFF": args.d_ff,
+            "nHeads": args.n_heads, "nKvHeads": args.n_kv_heads,
+            "dFF": args.d_ff,
         },
     }
     if args.spec:
@@ -102,6 +104,8 @@ def main(argv=None) -> int:
     sb.add_argument("--d-model", type=int, default=512)
     sb.add_argument("--n-layers", type=int, default=4)
     sb.add_argument("--n-heads", type=int, default=8)
+    sb.add_argument("--n-kv-heads", type=int, default=0,
+                    help="grouped-query attention KV heads (0 = MHA)")
     sb.add_argument("--d-ff", type=int, default=2048)
     sb.add_argument("--vocab", type=int, default=32000)
     sb.add_argument("--batch", type=int, default=8)

@@ -45,6 +45,12 @@ class ModelConfig:
     d_ff: int = 2048
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
+    # grouped-query attention (the Llama-3-class serving layout):
+    # 0 = multi-head (KV heads == query heads); k>0 = that many KV
+    # heads shared by n_heads // k query heads each. Shrinks the decode
+    # KV cache — the dominant HBM stream at high concurrency — by
+    # n_heads / k with no change to the weight FLOPs per token.
+    n_kv_heads: int = 0
     # sequence parallelism: shard the sequence axis over the "seq" mesh
     # axis and run ring attention instead of plain attention.
     ring_attention: bool = False
@@ -75,11 +81,23 @@ class ModelConfig:
                 f"unknown remat_policy {self.remat_policy!r} "
                 f"(want one of {REMAT_POLICIES})"
             )
+        if self.n_kv_heads < 0 or (
+            self.n_kv_heads and self.n_heads % self.n_kv_heads
+        ):
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be 0 (MHA) or a "
+                f"positive divisor of n_heads={self.n_heads}"
+            )
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        """KV heads actually stored (== n_heads for plain MHA)."""
+        return self.n_kv_heads or self.n_heads
 
 
 # ---------------------------------------------------------------------------
@@ -153,12 +171,13 @@ def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
     L, D, H, F = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.d_ff
     hd = cfg.head_dim
     keys = jax.random.split(key, 8)
+    Hkv = cfg.kv_heads
     block: Params = {
         "ln1": {"scale": jnp.ones((L, D), jnp.float32)},
         "ln2": {"scale": jnp.ones((L, D), jnp.float32)},
         "wq": _dense_init(keys[0], (L, D, H * hd), dt),
-        "wk": _dense_init(keys[1], (L, D, H * hd), dt),
-        "wv": _dense_init(keys[2], (L, D, H * hd), dt),
+        "wk": _dense_init(keys[1], (L, D, Hkv * hd), dt),
+        "wv": _dense_init(keys[2], (L, D, Hkv * hd), dt),
         "wo": _dense_init(keys[3], (L, H * hd, D), dt),
     }
     if cfg.n_experts:
@@ -215,30 +234,48 @@ def _kv_quantize(t: jax.Array):
 
 
 def _attention(q, k, v, causal: bool = True, impl: str = "xla") -> jax.Array:
-    """Softmax attention; q/k/v: (B, S, H, hd), fp32 logits.
+    """Softmax attention; q: (B, S, H, hd), k/v: (B, S, Hkv, hd) with
+    Hkv dividing H (grouped-query attention; Hkv == H is plain MHA),
+    fp32 logits.
 
     ``impl`` selects the backend (see :class:`ModelConfig.attention_impl`);
-    the pallas flash kernel keeps the (S, S) logits out of HBM.
+    the pallas flash kernel keeps the (S, S) logits out of HBM. The
+    kernel is written for equal head counts, so GQA repeats K/V up to H
+    first — pallas_call inputs are materialized, so the flash path DOES
+    pay MHA-sized K/V HBM during training (GQA's win is not here: it is
+    the decode cache, and :meth:`TpuLM.apply_with_cache` contracts the
+    grouped layout directly, never materializing the repeat).
     """
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    H, Hkv = q.shape[2], k.shape[2]
     if impl == "flash":
         from instaslice_tpu.ops.flash_attention import flash_attention
 
+        if Hkv != H:
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
         return flash_attention(
             q, k, v, causal=causal,
             interpret=jax.default_backend() != "tpu",
         )
+    # grouped contraction: every KV head serves G query heads and no
+    # repeated K/V ever hits memory; MHA is the G == 1 special case
+    # (the trivial group dim is free — XLA collapses it)
     hd = q.shape[-1]
+    B, S = q.shape[:2]
+    G = H // Hkv
+    q5 = q.reshape(B, S, Hkv, G, hd)
     logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqkgd,bskd->bkgqs", q5, k,
+        preferred_element_type=jnp.float32,
     ) * (hd ** -0.5)
     if causal:
-        S = q.shape[1]
         mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
-        logits = jnp.where(mask[None, None], logits, -1e9)
+        logits = jnp.where(mask[None, None, None], logits, -1e9)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+    return out.reshape(B, S, H, hd)
 
 
 def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
@@ -255,9 +292,10 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
                    preferred_element_type=jnp.float32)
     v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"]),
                    preferred_element_type=jnp.float32)
-    q, k, v = (
-        t.astype(cfg.dtype).reshape(B, S, cfg.n_heads, cfg.head_dim)
-        for t in (q, k, v)
+    q = q.astype(cfg.dtype).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k, v = (
+        t.astype(cfg.dtype).reshape(B, S, cfg.kv_heads, cfg.head_dim)
+        for t in (k, v)
     )
     q = _rope(q, positions)
     k = _rope(k, positions)
@@ -337,6 +375,13 @@ class TpuLM:
             from instaslice_tpu.parallel.ring import ring_attention
 
             def attn_fn(q, k, v):
+                if k.shape[2] != q.shape[2]:
+                    # ring's flash-style inner loop assumes equal head
+                    # counts; repeat K/V (GQA's cache win is a decode
+                    # property — training memory is activation-bound)
+                    g = q.shape[2] // k.shape[2]
+                    k = jnp.repeat(k, g, axis=2)
+                    v = jnp.repeat(v, g, axis=2)
                 return jax.shard_map(
                     functools.partial(ring_attention, axis_name="seq"),
                     mesh=mesh,
@@ -421,9 +466,11 @@ class TpuLM:
         ``quant=True`` stores K/V as int8 with one fp32 scale per
         (layer, slot, position, head) — decode streams the whole cache
         every step, so int8 halves its HBM traffic and doubles how many
-        tokens fit; the per-vector scale keeps the error sub-percent."""
+        tokens fit; the per-vector scale keeps the error sub-percent.
+        Under grouped-query attention only ``cfg.kv_heads`` heads are
+        stored — the cache shrinks by n_heads/kv_heads on top."""
         cfg = self.cfg
-        shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+        shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
         if quant:
             return {
                 "k": jnp.zeros(shape, jnp.int8),
@@ -497,9 +544,12 @@ class TpuLM:
                            preferred_element_type=jnp.float32)
             v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"]),
                            preferred_element_type=jnp.float32)
-            q, k, v = (
-                t.astype(cfg.dtype).reshape(B, T, cfg.n_heads, cfg.head_dim)
-                for t in (q, k, v)
+            q = q.astype(cfg.dtype).reshape(B, T, cfg.n_heads,
+                                            cfg.head_dim)
+            k, v = (
+                t.astype(cfg.dtype).reshape(B, T, cfg.kv_heads,
+                                            cfg.head_dim)
+                for t in (k, v)
             )
             q = _rope(q, positions)
             k = _rope(k, positions)
@@ -521,13 +571,19 @@ class TpuLM:
                 kc = write(kc, k, lengths)
                 vc = write(vc, v, lengths)
                 k_read, v_read = kc[:, :S_max], vc[:, :S_max]
+            # grouped-query decode: contract the stored KV heads against
+            # their query-head groups directly — the repeated-KV tensor
+            # the cache shrank away is never materialized, so the HBM
+            # stream is truly 1/G (MHA is the G == 1 special case)
+            G = cfg.n_heads // cfg.kv_heads
+            q5 = q.reshape(B, T, cfg.kv_heads, G, cfg.head_dim)
             logits = jnp.einsum(
-                "bthd,bshd->bhts", q, k_read,
+                "btkgd,bskd->bkgts", q5, k_read,
                 preferred_element_type=jnp.float32,
             ) * (cfg.head_dim ** -0.5)
-            logits = jnp.where(mask[:, None], logits, -1e9)
+            logits = jnp.where(mask[:, None, None], logits, -1e9)
             probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-            attn = jnp.einsum("bhts,bshd->bthd", probs, v_read)
+            attn = jnp.einsum("bkgts,bskd->btkgd", probs, v_read)
             attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
             x = x + jnp.einsum(
                 "bsk,kd->bsd", attn, weight(layer["wo"]),

@@ -407,6 +407,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "d_model": cfg.d_model,
                     "n_layers": cfg.n_layers,
                     "n_heads": cfg.n_heads,
+                    "n_kv_heads": cfg.kv_heads,
                     "d_ff": cfg.d_ff,
                     "vocab_size": cfg.vocab_size,
                 },
@@ -718,6 +719,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--prefill-len", type=int, default=128)
     ap.add_argument("--d-model", type=int, default=2048)
     ap.add_argument("--n-heads", type=int, default=16)
+    ap.add_argument("--n-kv-heads", type=int, default=0,
+                    help="grouped-query attention: KV heads shared by "
+                         "n-heads/n-kv-heads query heads each (0 = "
+                         "multi-head); shrinks the KV cache by the "
+                         "group factor")
     ap.add_argument("--n-layers", type=int, default=16)
     ap.add_argument("--d-ff", type=int, default=8192)
     ap.add_argument("--vocab-size", type=int, default=32000)
@@ -771,7 +777,8 @@ def build_engine(args) -> ServingEngine:
 
     cfg = ModelConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
-        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+        n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff,
         max_seq_len=args.max_len, dtype=jnp.bfloat16, remat=False,
     )
     model = TpuLM(cfg)

@@ -290,6 +290,12 @@ class ServingEngine:
                 f"n_heads={model.cfg.n_heads} not divisible by the "
                 f"mesh's model axis ({tp} devices)"
             )
+        if model.cfg.kv_heads % tp:
+            raise ValueError(
+                f"kv_heads={model.cfg.kv_heads} not divisible by the "
+                f"mesh's model axis ({tp} devices) — the KV cache "
+                "shards over heads"
+            )
         from instaslice_tpu.models.quant import shard_params
 
         params = shard_params(params, mesh, param_specs(model.cfg))

@@ -85,12 +85,17 @@ class TestInitQuantizedParams:
         max_seq_len=64, dtype=jnp.bfloat16, remat=False,
     )
 
-    def test_layout_matches_quantize_params(self):
+    @pytest.mark.parametrize("kv", [0, 2])
+    def test_layout_matches_quantize_params(self, kv):
         """The direct-int8 tree must be indistinguishable (structure,
         shapes, dtypes) from init -> quantize_params, or the model's
-        weight()/embed_lookup paths would diverge."""
-        direct = _init_quantized_params(self.CFG)
-        via = quantize_params(TpuLM(self.CFG).init(jax.random.key(0)))
+        weight()/embed_lookup paths would diverge — for MHA and for the
+        GQA layout the 7B phase serves."""
+        import dataclasses
+
+        cfg = dataclasses.replace(self.CFG, n_kv_heads=kv)
+        direct = _init_quantized_params(cfg)
+        via = quantize_params(TpuLM(cfg).init(jax.random.key(0)))
 
         d_leaves = jax.tree.leaves(direct)
         v_leaves = jax.tree.leaves(via)
