@@ -349,8 +349,12 @@ class TpuLM:
         tokens: jax.Array,
         *,
         mesh: Optional[Mesh] = None,
+        unembed: bool = True,
     ) -> jax.Array:
-        """Logits for ``tokens`` (B, S) → (B, S, vocab).
+        """Logits for ``tokens`` (B, S) → (B, S, vocab); with
+        ``unembed=False`` the final hidden states (B, S, D) instead —
+        the hook for chunked losses that never materialize the full
+        (B, S, V) logits (``models/train.py``).
 
         With ``cfg.ring_attention`` and a ``mesh``, the sequence dim stays
         sharded over the ``"seq"`` axis end to end: activations carry a
@@ -402,6 +406,8 @@ class TpuLM:
             body = apply_remat(block, cfg.remat_policy)
         x, _ = lax.scan(body, x, params["blocks"])
         x = _rmsnorm(x, params["ln_f"]["scale"])
+        if not unembed:
+            return x
         logits = jnp.einsum(
             "bsd,vd->bsv", x, weight(params["embed"]),
             preferred_element_type=jnp.float32,
@@ -416,6 +422,7 @@ class TpuLM:
         mesh: Mesh,
         n_micro: int,
         axis_name: str = "pipe",
+        unembed: bool = True,
     ) -> jax.Array:
         """Pipeline-parallel forward: the layer stack runs as GPipe
         stages over the mesh's ``axis_name`` axis, microbatching the
@@ -450,6 +457,8 @@ class TpuLM:
             remat=cfg.remat, remat_policy=cfg.remat_policy,
         )
         x = _rmsnorm(x, params["ln_f"]["scale"])
+        if not unembed:
+            return x
         return jnp.einsum(
             "bsd,vd->bsv", x, weight(params["embed"]),
             preferred_element_type=jnp.float32,

@@ -41,6 +41,52 @@ jax.tree_util.register_pytree_node(
 )
 
 
+#: sequence-chunk length for the chunked cross-entropy (0 disables).
+#: 512 keeps the unembed matmul MXU-sized while the live (B, 512, V)
+#: logits block stays ~1/4 GiB-class instead of the multi-GiB full
+#: (B, S, V) tensor.
+DEFAULT_LOSS_CHUNK = 512
+
+
+def _chunked_xent(embed_leaf, hidden, targets, mask,
+                  chunk: int) -> jax.Array:
+    """Summed next-token cross-entropy WITHOUT materializing (B, S, V):
+    a rematerialized ``lax.scan`` over sequence chunks unembeds and
+    log-sum-exps one (B, chunk, V) block at a time — peak loss-side
+    activation memory drops by S/chunk (the full-logits loss at the
+    871M bench config is gigabytes of fp32, which is what pushed
+    larger-batch configs into OOM/remat). Chunking the SEQUENCE axis
+    keeps the batch axis's data-parallel sharding intact per block."""
+    from instaslice_tpu.models.quant import weight
+
+    B, S, D = hidden.shape
+    chunk = min(chunk, S)   # short sequences: never pad PAST S (that
+    #                         would cost more than the one-shot loss)
+    n_chunks = -(-S // chunk)
+    pad = n_chunks * chunk - S
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    h = hidden.reshape(B, n_chunks, chunk, D).swapaxes(0, 1)
+    t = targets.reshape(B, n_chunks, chunk).swapaxes(0, 1)
+    m = mask.reshape(B, n_chunks, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(total, xs):
+        hc, tc, mc = xs
+        logits = jnp.einsum(
+            "bnd,vd->bnv", hc, weight(embed_leaf),
+            preferred_element_type=jnp.float32,
+        )
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return total + ((lse - gold) * mc).sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, t, m))
+    return total
+
+
 def loss_fn(
     model: TpuLM,
     params: Params,
@@ -48,27 +94,38 @@ def loss_fn(
     mesh: Optional[Mesh] = None,
     n_micro: int = 0,
     pipe_axis: str = "pipe",
+    loss_chunk: int = DEFAULT_LOSS_CHUNK,
 ) -> jax.Array:
     """Next-token cross-entropy; tokens (B, S) predict tokens[:, 1:].
     With ``n_micro`` > 0 the forward runs pipeline-parallel over the
-    mesh's ``pipe_axis``."""
+    mesh's ``pipe_axis``. ``loss_chunk`` > 0 (the default) computes the
+    loss chunk-by-chunk over the sequence so the full (B, S, V) logits
+    never exist; 0 restores the one-shot formulation. Ring-attention
+    (sequence-sharded) models always use the one-shot path — chunking
+    the sharded axis would reshard every block."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+    chunked = loss_chunk > 0 and not model.cfg.ring_attention
     if n_micro:
         if mesh is None:
             raise ValueError(
                 "pipeline-parallel loss (n_micro > 0) needs the mesh "
                 "carrying the pipe axis"
             )
-        logits = model.apply_pipelined(
+        out = model.apply_pipelined(
             params, tokens, mesh=mesh, n_micro=n_micro,
-            axis_name=pipe_axis,
+            axis_name=pipe_axis, unembed=not chunked,
         )
     else:
-        logits = model.apply(params, tokens, mesh=mesh)  # (B, S, V) fp32
-    targets = jnp.roll(tokens, -1, axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+        out = model.apply(params, tokens, mesh=mesh,
+                          unembed=not chunked)
+    if chunked:
+        total = _chunked_xent(params["embed"], out, targets, mask,
+                              loss_chunk)
+        return total / mask.sum()
+    logp = jax.nn.log_softmax(out, axis=-1)  # (B, S, V) fp32
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     # last position has no target
-    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
     return (nll * mask).sum() / mask.sum()
 
 
@@ -124,6 +181,7 @@ def make_train_step(
     learning_rate: float = 3e-4,
     n_micro: int = 0,
     pipe_axis: str = "pipe",
+    loss_chunk: int = DEFAULT_LOSS_CHUNK,
 ) -> Tuple[Callable, Callable]:
     """Returns ``(init_fn, step_fn)``, both jitted over ``mesh``.
 
@@ -170,6 +228,7 @@ def make_train_step(
             lambda p: loss_fn(
                 model, p, tokens, mesh,
                 n_micro=n_micro, pipe_axis=pipe_axis,
+                loss_chunk=loss_chunk,
             )
         )(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

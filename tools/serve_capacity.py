@@ -1,0 +1,140 @@
+"""One-command serving capacity curve: boot ``tpuslice-serve``, sweep
+concurrency with ``tpuslice-loadgen``, emit the PERF.md table.
+
+The on-chip half of the serving story (VERDICT r3 #8): the engine-side
+bench (``bench_tpu``) measures the decode loop; THIS measures what a
+slice's users experience — queueing + HTTP + scheduling — as a
+throughput/latency curve over concurrency, against a live server on
+whatever accelerator the host has (the server takes the host-wide TPU
+claim itself; run it only when ``python bench.py`` is not running).
+
+Usage::
+
+    python tools/serve_capacity.py                    # 871M bf16, b32
+    python tools/serve_capacity.py --quantize         # int8 W + KV
+    python tools/serve_capacity.py --sweep 1,2,4,8,16,32
+    python tools/serve_capacity.py --markdown >> docs/PERF.md
+
+Prints one JSON line per concurrency level and, with ``--markdown``,
+the ready-to-paste table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+
+def _wait_healthy(url: str, proc: subprocess.Popen,
+                  timeout: float) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server exited rc={proc.returncode} before healthy"
+            )
+        try:
+            urllib.request.urlopen(f"{url}/healthz", timeout=2)
+            return
+        except Exception:
+            time.sleep(1.0)
+    raise RuntimeError(f"server not healthy within {timeout:.0f}s")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="serve_capacity")
+    ap.add_argument("--sweep", default="1,2,4,8,16,32")
+    ap.add_argument("--requests-per-level", type=int, default=48)
+    ap.add_argument("--prompt-len", type=int, default=64)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--port", type=int, default=18400)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--d-model", type=int, default=2048)
+    ap.add_argument("--n-heads", type=int, default=16)
+    ap.add_argument("--n-kv-heads", type=int, default=0)
+    ap.add_argument("--n-layers", type=int, default=16)
+    ap.add_argument("--d-ff", type=int, default=8192)
+    ap.add_argument("--quantize", action="store_true")
+    ap.add_argument("--boot-timeout", type=float, default=600.0,
+                    help="first compiles on a cold chip are slow")
+    ap.add_argument("--markdown", action="store_true",
+                    help="also print the PERF.md table")
+    args = ap.parse_args(argv)
+
+    levels = [int(x) for x in args.sweep.split(",") if x.strip()]
+    url = f"http://127.0.0.1:{args.port}"
+    serve_cmd = [
+        sys.executable, "-m", "instaslice_tpu.serving.api_server",
+        "--host", "127.0.0.1", "--port", str(args.port),
+        "--max-batch", str(args.max_batch),
+        "--d-model", str(args.d_model),
+        "--n-heads", str(args.n_heads),
+        "--n-kv-heads", str(args.n_kv_heads),
+        "--n-layers", str(args.n_layers),
+        "--d-ff", str(args.d_ff),
+    ]
+    if args.quantize:
+        serve_cmd.append("--quantize")
+    log_path = os.environ.get("TPUSLICE_CAPACITY_LOG",
+                              "/tmp/serve_capacity.log")
+    rows = []
+    with open(log_path, "ab") as log:
+        srv = subprocess.Popen(serve_cmd, stdout=log, stderr=log)
+        try:
+            _wait_healthy(url, srv, args.boot_timeout)
+            from instaslice_tpu.serving import loadgen
+
+            # warmup: compile prefill + decode before the first timed
+            # level, or its p95 records the 20-40s compile, not serving
+            loadgen.run(url, requests=2, concurrency=1,
+                        prompt_len=args.prompt_len,
+                        max_tokens=args.max_tokens,
+                        vocab=32000, stream=True, timeout=600.0)
+            for c in levels:
+                # scale request count with concurrency so high levels
+                # still see steady state, capped for wall time
+                n = max(args.requests_per_level, 4 * c)
+                row = loadgen.run(
+                    url, requests=n, concurrency=c,
+                    prompt_len=args.prompt_len,
+                    max_tokens=args.max_tokens,
+                    vocab=32000, stream=True, timeout=300.0,
+                )
+                rows.append(row)
+                # in --markdown mode raw rows go to stderr: the
+                # documented `--markdown >> docs/PERF.md` must capture
+                # ONLY the table
+                print(json.dumps(row), flush=True,
+                      file=sys.stderr if args.markdown else sys.stdout)
+        finally:
+            # SIGINT, not SIGKILL: the server is a TPU claimant and a
+            # hard kill leaves the stale remote claim that wedges the
+            # tunnel (docs/PERF.md)
+            srv.send_signal(signal.SIGINT)
+            try:
+                srv.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                srv.kill()
+                srv.wait()
+    if args.markdown and rows:
+        q = "int8 W+KV" if args.quantize else "bf16"
+        print(f"\n| concurrency | client tok/s | p50 (s) | p95 (s) | "
+              f"TTFT p50 (s) | errors |  <!-- {args.d_model}d x "
+              f"{args.n_layers}L {q}, {args.prompt_len}p+"
+              f"{args.max_tokens}g -->")
+        print("|---|---|---|---|---|---|")
+        for r in rows:
+            print(f"| {r['concurrency']} | {r['client_tokens_per_sec']} "
+                  f"| {r['value']} | {r['p95_latency']} "
+                  f"| {r.get('ttft_p50', '-')} | {r['errors']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
