@@ -1,0 +1,262 @@
+"""``tpuslice-train``: the end-to-end training entry point.
+
+Runs inside a granted slice pod (or anywhere, on CPU, for CI): builds
+the mesh (single-process, or multi-host from the agent's handoff env),
+streams batches from a memory-mapped token dataset
+(:mod:`instaslice_tpu.models.data`), executes the sharded train step
+(:mod:`instaslice_tpu.models.train` — dp/sp/tp, GQA, MoE, remat,
+chunked loss), and checkpoints through
+:class:`instaslice_tpu.models.checkpoint.TrainCheckpointer` with
+bit-identical resume: batches are a pure function of the step number,
+so the restored step counter IS the loader state.
+
+The reference has no training story at all (its samples mount a
+notebook onto the slice); this closes the workload loop the way
+``tpuslice-serve`` closes the serving loop.
+
+SIGINT saves a final checkpoint and exits cleanly — the claimant-unwind
+contract every TPU-touching process in this repo follows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+log = logging.getLogger("instaslice_tpu.train")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="tpuslice-train")
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--data", default="",
+                     help="token file (.npy / .u16 / .u32 flat stream)")
+    src.add_argument("--synthetic", type=int, default=0, metavar="N",
+                     help="train on N random tokens (smoke/benchmark "
+                          "mode — no dataset needed)")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--seq-len", type=int, default=512)
+    ap.add_argument("--global-batch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--seed", type=int, default=0)
+    # model
+    ap.add_argument("--d-model", type=int, default=2048)
+    ap.add_argument("--n-heads", type=int, default=16)
+    ap.add_argument("--n-kv-heads", type=int, default=0)
+    ap.add_argument("--n-layers", type=int, default=16)
+    ap.add_argument("--d-ff", type=int, default=8192)
+    ap.add_argument("--vocab-size", type=int, default=32000)
+    ap.add_argument("--n-experts", type=int, default=0)
+    ap.add_argument("--remat", default="none",
+                    choices=("none", "dots", "full"))
+    ap.add_argument("--ring", action="store_true",
+                    help="ring attention over the seq axis (long "
+                         "context; requires --sp > 1)")
+    # mesh
+    ap.add_argument("--from-env", action="store_true",
+                    help="multi-host: rendezvous + mesh from the "
+                         "agent's handoff env (TPU_* vars)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="model-axis size (heads/ffn sharding)")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="seq-axis size (ring attention)")
+    # checkpoint / logging
+    ap.add_argument("--checkpoint", default="",
+                    help="orbax checkpoint dir (resume if it has one)")
+    ap.add_argument("--save-every", type=int, default=50)
+    ap.add_argument("--max-keep", type=int, default=3)
+    ap.add_argument("--log-every", type=int, default=10)
+    return ap
+
+
+def _build_mesh(args):
+    import jax
+
+    if args.from_env:
+        from instaslice_tpu.parallel.meshenv import (
+            SliceTopology,
+            initialize_distributed,
+            slice_mesh,
+        )
+
+        topo = SliceTopology.from_env()
+        initialize_distributed(topo)
+        devs = jax.devices()[: topo.num_chips]
+        return slice_mesh(
+            axes=("data", "seq", "model"),
+            axis_sizes=(-1, args.sp, args.tp),
+            devices=devs, topo=topo,
+        )
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = len(devs)
+    if n % (args.tp * args.sp):
+        raise SystemExit(
+            f"{n} devices not divisible by tp={args.tp} * sp={args.sp}"
+        )
+    dp = n // (args.tp * args.sp)
+    return Mesh(
+        np.array(devs).reshape(dp, args.sp, args.tp),
+        ("data", "seq", "model"),
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    from instaslice_tpu.utils.tpulock import TpuBusyError, claim_or_force_cpu
+
+    try:
+        claim = claim_or_force_cpu()
+    except TpuBusyError as e:
+        log.error("%s", e)
+        return 3
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from instaslice_tpu.models.checkpoint import (
+        TrainCheckpointer,
+        abstract_train_state,
+    )
+    from instaslice_tpu.models.data import (
+        HostShardedTokens,
+        Prefetcher,
+        TokenDataset,
+        write_token_file,
+    )
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM, batch_spec
+    from instaslice_tpu.models.train import make_train_step
+
+    try:
+        mesh = _build_mesh(args)
+        dp = mesh.shape["data"]
+        if args.global_batch % dp:
+            raise SystemExit(
+                f"--global-batch {args.global_batch} must be divisible "
+                f"by the data-parallel axis ({dp} = {len(jax.devices())} "
+                f"devices / tp {args.tp} / sp {args.sp})"
+            )
+        if args.ring and (args.seq_len + 1) % max(args.sp, 1):
+            # dataset rows are seq_len+1 wide (inputs + shifted target)
+            # and ring shards that dim over the seq axis
+            raise SystemExit(
+                f"--ring shards (seq_len + 1) = {args.seq_len + 1} over "
+                f"sp={args.sp}, which does not divide; use a seq-len "
+                f"of (multiple of {args.sp}) - 1, e.g. "
+                f"{args.sp * ((args.seq_len + 1) // args.sp) - 1}"
+            )
+        cfg = ModelConfig(
+            vocab_size=args.vocab_size, d_model=args.d_model,
+            n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+            n_layers=args.n_layers, d_ff=args.d_ff,
+            max_seq_len=args.seq_len + 1,
+            dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+            else jnp.float32,
+            ring_attention=args.ring, n_experts=args.n_experts,
+            remat=args.remat != "none",
+            remat_policy="dots" if args.remat == "dots" else "full",
+        )
+        model = TpuLM(cfg)
+        init_fn, step_fn = make_train_step(model, mesh,
+                                           learning_rate=args.lr)
+
+        data_path = args.data
+        if args.synthetic:
+            import os
+            import tempfile
+
+            # per-process file: two concurrent synthetic runs must not
+            # rewrite a corpus the other has live-mmap'd (silent wrong
+            # data, or SIGBUS if the file shrinks under the mapping)
+            data_path = os.path.join(
+                tempfile.gettempdir(),
+                f"tpuslice-synthetic-{args.seed}-{os.getpid()}.u16",
+            )
+            rng = np.random.default_rng(args.seed)
+            write_token_file(
+                data_path,
+                rng.integers(1, min(cfg.vocab_size, 65535),
+                             size=args.synthetic),
+            )
+            log.info("synthetic corpus: %d tokens at %s",
+                     args.synthetic, data_path)
+        ds = TokenDataset(data_path, args.seq_len, seed=args.seed)
+        loader = HostShardedTokens(
+            ds, mesh, args.global_batch, spec=batch_spec(cfg)
+        )
+
+        ckpt = None
+        state = None
+        if args.checkpoint:
+            ckpt = TrainCheckpointer(
+                args.checkpoint, max_to_keep=args.max_keep,
+                save_interval_steps=1,
+            )
+            restored = ckpt.restore(abstract_train_state(init_fn))
+            if restored is not None:
+                state = restored
+                log.info("resumed from step %d", int(state.step))
+        if state is None:
+            state = init_fn(jax.random.key(args.seed))
+
+        start = int(state.step)
+        prefetch = Prefetcher(loader.batch_for_step, start_step=start)
+        t0 = time.monotonic()
+        tokens_done = 0
+        last_loss = float("nan")
+        try:
+            for step, batch in prefetch:
+                if step >= args.steps:
+                    break
+                state, loss = step_fn(state, batch)
+                tokens_done += args.global_batch * args.seq_len
+                if (step + 1) % args.log_every == 0 or \
+                        step + 1 == args.steps:
+                    last_loss = float(loss)   # sync point
+                    dt = time.monotonic() - t0
+                    log.info(
+                        "step %d loss %.4f  %.0f tok/s",
+                        step + 1, last_loss,
+                        tokens_done / max(dt, 1e-9),
+                    )
+                if ckpt is not None and (step + 1) % args.save_every == 0:
+                    ckpt.save(state)
+        except KeyboardInterrupt:
+            log.info("interrupted at step %d; saving", int(state.step))
+        finally:
+            prefetch.close()
+            if ckpt is not None:
+                ckpt.save(state)
+                ckpt.close()
+        wall = time.monotonic() - t0
+        print(json.dumps({
+            "metric": "train_tokens_per_sec",
+            "value": round(tokens_done / max(wall, 1e-9), 1),
+            "unit": "tokens/s",
+            "steps": int(state.step),
+            # None (JSON null), not NaN: a resumed run that was already
+            # at --steps does zero work, and bare NaN is invalid JSON
+            "final_loss": (round(last_loss, 4)
+                           if last_loss == last_loss else None),
+            "params_m": round(sum(
+                int(np.prod(l.shape))
+                for l in jax.tree.leaves(state.params)
+            ) / 1e6, 1),
+            "mesh": {k: int(v) for k, v in mesh.shape.items()},
+            "backend": jax.default_backend(),
+        }))
+        return 0
+    finally:
+        if claim is not None:
+            claim.release()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

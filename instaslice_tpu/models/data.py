@@ -1,0 +1,232 @@
+"""Training data: memory-mapped token datasets + the host-sharded loader.
+
+The input pipeline for ``tpuslice-train`` (the role a C++/torch
+DataLoader plays in GPU stacks). TPU LM training wants something much
+simpler and much more deterministic than a worker-pool loader:
+
+- **mmap, not read**: a tokenized corpus is one flat array of token ids
+  on disk (`.npy` or raw little-endian uint16/uint32). ``np.memmap``
+  makes batch assembly a page-cache slice — no copies, no decode work,
+  nothing to parallelize. The OS prefetches sequential pages; a
+  background double-buffer thread hides even the cold-page faults
+  behind the accelerator step.
+- **batches are a pure function of the step number**: batch ``i`` of an
+  epoch is sequence-chunk ``perm[i]`` under a seeded permutation, so
+  resume-from-checkpoint needs NO loader state — the restored
+  ``TrainState.step`` alone reproduces the exact uninterrupted batch
+  stream (bit-identical continuation, same contract as
+  ``models/checkpoint.py``).
+- **host-sharded**: on a multi-host slice every process loads only its
+  ``data``-parallel shard of each global batch
+  (:meth:`HostShardedTokens.batch_for_step` builds the global array via
+  ``jax.make_array_from_process_local_data``), so no host ever
+  materializes — or reads — the full global batch.
+
+The reference has no workload data path at all (its samples mount a
+notebook); this is the missing half of the train story next to
+``models/train.py`` + ``models/checkpoint.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["TokenDataset", "HostShardedTokens", "Prefetcher",
+           "write_token_file"]
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> None:
+    """Write a flat token array as a raw little-endian file the dataset
+    mmaps back (suffix picks the width: .u16 / .u32; .npy also works
+    via ``np.save``)."""
+    tokens = np.asarray(tokens)
+    if path.endswith(".npy"):
+        np.save(path, tokens)
+    elif path.endswith(".u16"):
+        tokens.astype("<u2").tofile(path)
+    elif path.endswith(".u32"):
+        tokens.astype("<u4").tofile(path)
+    else:
+        raise ValueError(f"unknown token-file suffix: {path}")
+
+
+class TokenDataset:
+    """A flat on-disk token stream, viewed as fixed-length sequences.
+
+    ``seq_len + 1`` tokens per row (inputs + the shifted target the
+    loss derives itself), non-overlapping, tail dropped. Deterministic
+    shuffling: epoch ``e`` uses ``default_rng(seed + e).permutation``,
+    so any (step, batch_size) maps to exact rows with no state.
+    """
+
+    def __init__(self, path: str, seq_len: int, seed: int = 0):
+        if path.endswith(".npy"):
+            self._tokens = np.load(path, mmap_mode="r")
+        elif path.endswith(".u16"):
+            self._tokens = np.memmap(path, dtype="<u2", mode="r")
+        elif path.endswith(".u32"):
+            self._tokens = np.memmap(path, dtype="<u4", mode="r")
+        else:
+            raise ValueError(
+                f"unknown token-file suffix: {path} (.npy/.u16/.u32)"
+            )
+        if self._tokens.ndim != 1:
+            raise ValueError(
+                f"token file must be a flat stream, got shape "
+                f"{self._tokens.shape}"
+            )
+        self.seq_len = seq_len
+        self.row = seq_len + 1
+        self.n_rows = len(self._tokens) // self.row
+        if self.n_rows == 0:
+            raise ValueError(
+                f"{path}: {len(self._tokens)} tokens < one "
+                f"{self.row}-token row"
+            )
+        self.seed = seed
+        self._perm_epoch: Optional[int] = None
+        self._perm: Optional[np.ndarray] = None
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        if self._perm_epoch != epoch:
+            self._perm = np.random.default_rng(
+                self.seed + epoch
+            ).permutation(self.n_rows)
+            self._perm_epoch = epoch
+        return self._perm
+
+    def row_at(self, index: int) -> np.ndarray:
+        """Row ``index`` of the infinite shuffled stream (epoch wraps)."""
+        epoch, i = divmod(index, self.n_rows)
+        r = int(self._epoch_perm(epoch)[i])
+        out = self._tokens[r * self.row:(r + 1) * self.row]
+        return np.asarray(out, dtype=np.int32)
+
+    def batch(self, step: int, batch_size: int, offset: int = 0,
+              global_batch: Optional[int] = None) -> np.ndarray:
+        """(batch_size, seq_len + 1) int32 for global step ``step``.
+
+        ``offset``/``global_batch`` carve this host's data-parallel
+        share out of the global batch: the global stream consumes
+        ``global_batch`` rows per step, and this call returns rows
+        ``[offset, offset + batch_size)`` of step's slice — pure
+        indexing, so every host agrees on the global stream without
+        coordination."""
+        gb = global_batch if global_batch is not None else batch_size
+        if offset + batch_size > gb:
+            raise ValueError(
+                f"offset {offset} + batch {batch_size} exceeds "
+                f"global batch {gb}"
+            )
+        base = step * gb + offset
+        return np.stack([
+            self.row_at(base + i) for i in range(batch_size)
+        ])
+
+
+class HostShardedTokens:
+    """Per-process loading of a globally-consistent batch stream.
+
+    ``batch_for_step(step)`` returns a ``jax.Array`` of shape
+    ``(global_batch, seq_len + 1)`` sharded over the mesh's ``data``
+    axis, where this process only ever touched its own rows."""
+
+    def __init__(self, dataset: TokenDataset, mesh,
+                 global_batch: int, spec=None):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.dataset = dataset
+        self.mesh = mesh
+        self.global_batch = global_batch
+        if spec is None:
+            spec = P("data", None)   # ring models pass batch_spec(cfg)
+        self._n_proc = max(
+            len({d.process_index for d in mesh.devices.flat}), 1
+        )
+        if global_batch % self._n_proc:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by "
+                f"{self._n_proc} processes"
+            )
+        self.per_host = global_batch // self._n_proc
+        self._proc = jax.process_index()
+        self._sharding = NamedSharding(mesh, spec)
+        self._jax = jax
+
+    def local_batch(self, step: int) -> np.ndarray:
+        """This process's contiguous block of the step's global batch
+        (process p owns rows [p·per_host, (p+1)·per_host))."""
+        return self.dataset.batch(
+            step, self.per_host,
+            offset=self._proc * self.per_host,
+            global_batch=self.global_batch,
+        )
+
+    def batch_for_step(self, step: int):
+        """Device-ready global array for ``step`` (sharded over data)."""
+        local = self.local_batch(step)
+        if self._n_proc == 1:
+            return self._jax.device_put(local, self._sharding)
+        return self._jax.make_array_from_process_local_data(
+            self._sharding, local,
+            (self.global_batch, local.shape[1]),
+        )
+
+
+class Prefetcher:
+    """Double-buffered background loader: while the accelerator runs
+    step N, the next host batch is being assembled (and its cold pages
+    faulted in) on a thread. ``depth=2`` is enough — batch assembly is
+    a memmap slice, the thread exists to hide page faults, not work."""
+
+    def __init__(self, fetch, start_step: int, depth: int = 2):
+        self._fetch = fetch
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+
+        def run():
+            step = start_step
+            while not self._stop.is_set():
+                try:
+                    item = (step, fetch(step))
+                except BaseException as e:  # surfaced on next()
+                    self._exc = e
+                    self._q.put(None)
+                    return
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                step += 1
+
+        self._thread = threading.Thread(
+            target=run, name="tpuslice-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    def __iter__(self) -> Iterator[Tuple[int, object]]:
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is None:
+            raise self._exc  # type: ignore[misc]
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)

@@ -1,0 +1,153 @@
+"""Token dataset + host-sharded loader + tpuslice-train
+(``models/data.py``, ``cli/train_main.py``).
+
+The loader's contract is determinism: batches are a pure function of
+the step number, so checkpoint resume needs no loader state and an
+interrupted run continues bit-identically (same bar as
+``tests/test_checkpoint.py``).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from instaslice_tpu.models.data import (
+    HostShardedTokens,
+    Prefetcher,
+    TokenDataset,
+    write_token_file,
+)
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(params=[".npy", ".u16", ".u32"])
+def token_file(request, tmp_path):
+    path = str(tmp_path / f"toks{request.param}")
+    rng = np.random.default_rng(7)
+    write_token_file(path, rng.integers(0, 250, size=4000))
+    return path
+
+
+class TestTokenDataset:
+    def test_rows_and_determinism(self, token_file):
+        ds = TokenDataset(token_file, seq_len=16, seed=1)
+        assert ds.n_rows == 4000 // 17
+        b1 = ds.batch(3, 4)
+        b2 = TokenDataset(token_file, seq_len=16, seed=1).batch(3, 4)
+        np.testing.assert_array_equal(b1, b2)
+        assert b1.shape == (4, 17) and b1.dtype == np.int32
+
+    def test_epoch_reshuffles_but_covers(self, token_file):
+        ds = TokenDataset(token_file, seq_len=16, seed=1)
+        n = ds.n_rows
+        epoch0 = [ds.row_at(i)[0] for i in range(n)]
+        epoch1 = [ds.row_at(n + i)[0] for i in range(n)]
+        # same multiset of rows (full coverage), different order
+        assert sorted(epoch0) == sorted(epoch1)
+        assert epoch0 != epoch1
+
+    def test_host_offsets_tile_the_global_batch(self, token_file):
+        ds = TokenDataset(token_file, seq_len=16, seed=1)
+        whole = ds.batch(5, 8)
+        parts = [ds.batch(5, 4, offset=o, global_batch=8)
+                 for o in (0, 4)]
+        np.testing.assert_array_equal(np.concatenate(parts), whole)
+
+    def test_bad_inputs(self, tmp_path, token_file):
+        with pytest.raises(ValueError, match="suffix"):
+            TokenDataset(str(tmp_path / "x.bin"), 8)
+        with pytest.raises(ValueError, match="row"):
+            path = str(tmp_path / "tiny.u16")
+            write_token_file(path, np.arange(4))
+            TokenDataset(path, seq_len=16)
+        ds = TokenDataset(token_file, seq_len=16)
+        with pytest.raises(ValueError, match="exceeds"):
+            ds.batch(0, 8, offset=4, global_batch=8)
+
+
+class TestHostShardedTokens:
+    def test_sharded_batch_matches_dataset(self, token_file):
+        from jax.sharding import Mesh
+
+        ds = TokenDataset(token_file, seq_len=16, seed=1)
+        mesh = Mesh(
+            np.array(jax.devices()[:2]).reshape(2, 1, 1),
+            ("data", "seq", "model"),
+        )
+        loader = HostShardedTokens(ds, mesh, global_batch=4)
+        arr = loader.batch_for_step(2)
+        assert arr.shape == (4, 17)
+        np.testing.assert_array_equal(np.asarray(arr), ds.batch(2, 4))
+        # sharded over the data axis
+        assert arr.sharding.spec[0] == "data"
+
+
+class TestPrefetcher:
+    def test_sequential_and_close(self):
+        pf = Prefetcher(lambda s: s * 10, start_step=3)
+        got = [next(pf) for _ in range(4)]
+        assert got == [(3, 30), (4, 40), (5, 50), (6, 60)]
+        pf.close()
+
+    def test_error_propagates(self):
+        def boom(s):
+            raise RuntimeError("disk gone")
+
+        pf = Prefetcher(boom, start_step=0)
+        with pytest.raises(RuntimeError, match="disk gone"):
+            next(pf)
+        pf.close()
+
+
+class TestTrainCli:
+    # conftest pins 8 virtual CPU devices; default mesh puts all of
+    # them on the data axis, so the global batch must divide by 8
+    ARGS = ["--seq-len", "24", "--global-batch", "8", "--d-model", "32",
+            "--n-heads", "4", "--n-kv-heads", "2", "--n-layers", "2",
+            "--d-ff", "64", "--vocab-size", "128", "--log-every", "100"]
+
+    def _run(self, capsys, extra):
+        from instaslice_tpu.cli.train_main import main
+
+        assert main(extra + self.ARGS) == 0
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        return json.loads(out)
+
+    def test_synthetic_end_to_end(self, capsys):
+        out = self._run(capsys, ["--synthetic", "20000", "--steps", "4"])
+        assert out["steps"] == 4
+        assert out["value"] > 0
+        assert np.isfinite(out["final_loss"])
+
+    def test_checkpoint_resume_is_bit_identical(self, capsys, tmp_path):
+        """3 steps + save, resume for 3 more == 6 uninterrupted steps.
+        Batches derive from the step counter, so the interrupted stream
+        must replay exactly."""
+        data = str(tmp_path / "corpus.u16")
+        write_token_file(
+            data, np.random.default_rng(5).integers(0, 120, size=20000)
+        )
+        ck_a = str(tmp_path / "ck_interrupted")
+        self._run(capsys, ["--data", data, "--steps", "3",
+                           "--checkpoint", ck_a, "--save-every", "100"])
+        resumed = self._run(
+            capsys, ["--data", data, "--steps", "6",
+                     "--checkpoint", ck_a, "--save-every", "100"]
+        )
+        assert resumed["steps"] == 6
+        straight = self._run(capsys, ["--data", data, "--steps", "6"])
+        assert resumed["final_loss"] == pytest.approx(
+            straight["final_loss"], abs=1e-6
+        )
+
+    def test_tp_mesh(self, capsys):
+        out = self._run(
+            capsys,
+            ["--synthetic", "20000", "--steps", "2", "--tp", "2"],
+        )
+        assert out["mesh"]["model"] == 2
+        assert np.isfinite(out["final_loss"])
