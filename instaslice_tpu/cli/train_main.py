@@ -50,6 +50,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--d-ff", type=int, default=8192)
     ap.add_argument("--vocab-size", type=int, default=32000)
     ap.add_argument("--n-experts", type=int, default=0)
+    ap.add_argument("--window", type=int, default=0,
+                    help="sliding-window attention (0 = full causal)")
     ap.add_argument("--remat", default="none",
                     choices=("none", "dots", "full"))
     ap.add_argument("--ring", action="store_true",
@@ -160,6 +162,7 @@ def main(argv=None) -> int:
             dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
             else jnp.float32,
             ring_attention=args.ring, n_experts=args.n_experts,
+            window=args.window,
             remat=args.remat != "none",
             remat_policy="dots" if args.remat == "dots" else "full",
         )

@@ -51,6 +51,11 @@ class ModelConfig:
     # KV cache — the dominant HBM stream at high concurrency — by
     # n_heads / k with no change to the weight FLOPs per token.
     n_kv_heads: int = 0
+    # sliding-window attention (the Mistral-family knob): each position
+    # attends only the last ``window`` positions (0 = full causal).
+    # Bounds attention cost/quality horizon per layer; stacked layers
+    # still see window x n_layers of effective context.
+    window: int = 0
     # sequence parallelism: shard the sequence axis over the "seq" mesh
     # axis and run ring attention instead of plain attention.
     ring_attention: bool = False
@@ -87,6 +92,24 @@ class ModelConfig:
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must be 0 (MHA) or a "
                 f"positive divisor of n_heads={self.n_heads}"
+            )
+        if self.window < 0:
+            raise ValueError(
+                f"window={self.window} must be 0 (full causal) or "
+                "positive"
+            )
+        if self.window and self.ring_attention:
+            raise ValueError(
+                "sliding-window attention cannot combine with ring "
+                "attention (the ring's flash inner loop is full-causal; "
+                "a window already bounds the horizon ring exists to "
+                "extend)"
+            )
+        if self.window and self.attention_impl == "flash":
+            raise ValueError(
+                "attention_impl='flash' cannot honor window="
+                f"{self.window} (the pallas kernel is full-causal); "
+                "use 'auto' or 'xla' for windowed models"
             )
 
     @property
@@ -233,21 +256,27 @@ def _kv_quantize(t: jax.Array):
     return q, scale
 
 
-def _attention(q, k, v, causal: bool = True, impl: str = "xla") -> jax.Array:
+def _attention(q, k, v, causal: bool = True, impl: str = "xla",
+               window: int = 0) -> jax.Array:
     """Softmax attention; q: (B, S, H, hd), k/v: (B, S, Hkv, hd) with
     Hkv dividing H (grouped-query attention; Hkv == H is plain MHA),
-    fp32 logits.
+    fp32 logits. ``window`` > 0 limits each position to the last
+    ``window`` keys (sliding-window attention).
 
     ``impl`` selects the backend (see :class:`ModelConfig.attention_impl`);
     the pallas flash kernel keeps the (S, S) logits out of HBM. The
-    kernel is written for equal head counts, so GQA repeats K/V up to H
-    first — pallas_call inputs are materialized, so the flash path DOES
-    pay MHA-sized K/V HBM during training (GQA's win is not here: it is
-    the decode cache, and :meth:`TpuLM.apply_with_cache` contracts the
-    grouped layout directly, never materializing the repeat).
+    kernel is written for equal head counts and full-causal masks, so
+    GQA repeats K/V up to H first — pallas_call inputs are
+    materialized, so the flash path DOES pay MHA-sized K/V HBM during
+    training (GQA's win is not here: it is the decode cache, and
+    :meth:`TpuLM.apply_with_cache` contracts the grouped layout
+    directly, never materializing the repeat) — and windowed models
+    take the XLA formulation.
     """
     if impl == "auto":
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if window:
+        impl = "xla"   # the kernel has no window support (yet)
     H, Hkv = q.shape[2], k.shape[2]
     if impl == "flash":
         from instaslice_tpu.ops.flash_attention import flash_attention
@@ -270,8 +299,13 @@ def _attention(q, k, v, causal: bool = True, impl: str = "xla") -> jax.Array:
         "bqkgd,bskd->bkgqs", q5, k,
         preferred_element_type=jnp.float32,
     ) * (hd ** -0.5)
-    if causal:
-        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    if causal or window:
+        i = jnp.arange(S)
+        mask = jnp.ones((S, S), jnp.bool_)
+        if causal:
+            mask &= i[None, :] <= i[:, None]
+        if window:
+            mask &= i[:, None] - i[None, :] < window
         logits = jnp.where(mask[None, None, None], logits, -1e9)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
@@ -395,7 +429,8 @@ class TpuLM:
                 )(q, k, v)
         else:
             def attn_fn(q, k, v):
-                return _attention(q, k, v, impl=cfg.attention_impl)
+                return _attention(q, k, v, impl=cfg.attention_impl,
+                                  window=cfg.window)
 
         def block(x, layer):
             return _transformer_block(cfg, layer, x, positions,
@@ -448,7 +483,8 @@ class TpuLM:
             return _transformer_block(
                 cfg, layer, xb, positions,
                 lambda q, k, v: _attention(q, k, v,
-                                           impl=cfg.attention_impl),
+                                           impl=cfg.attention_impl,
+                                           window=cfg.window),
             )
 
         x = pipeline_blocks(
@@ -525,9 +561,45 @@ class TpuLM:
         x = embed_lookup(params["embed"], tokens)         # (B, T, D)
         positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)
 
-        s_idx = jnp.arange(S_max, dtype=jnp.int32)
-        # (B, T, S_max): query t sees cache slot s iff s <= lengths+t
-        mask = s_idx[None, None, :] <= positions[:, :, None]
+        # sliding-window models read only a (window + T - 1)-wide band
+        # of the cache per row (vmapped dynamic_slice at each row's own
+        # offset) instead of the whole [0, S_max) prefix — this is where
+        # the window's HBM win is actually REALIZED at decode time (the
+        # band is the union of every query position's admissible keys).
+        # Taken only when the band is narrower than the attend window
+        # the engine already bucketed to.
+        S_cache = cache["k"].shape[2]
+        win_band = min(cfg.window + T - 1, S_cache) if cfg.window else 0
+        use_window = bool(cfg.window) and win_band < S_max
+        if use_window:
+            start = jnp.clip(
+                lengths - (cfg.window - 1), 0, S_cache - win_band
+            )
+            # (B, win_band) absolute cache positions under each row
+            s_abs = start[:, None] + jnp.arange(win_band,
+                                                dtype=jnp.int32)
+            mask = (s_abs[:, None, :] <= positions[:, :, None]) & (
+                positions[:, :, None] - s_abs[:, None, :] < cfg.window
+            )
+
+            def read_band(c):
+                """(B, S, ...) → (B, win_band, ...) at per-row starts."""
+                return jax.vmap(
+                    lambda cb, st: lax.dynamic_slice_in_dim(
+                        cb, st, win_band, axis=0
+                    )
+                )(c, start)
+        else:
+            s_idx = jnp.arange(S_max, dtype=jnp.int32)
+            # (B, T, S_max): query t sees cache slot s iff s <= lengths+t
+            mask = s_idx[None, None, :] <= positions[:, :, None]
+            if cfg.window:
+                # band not narrower than the bucket: plain prefix read,
+                # window enforced by mask alone
+                mask &= (
+                    positions[:, :, None] - s_idx[None, None, :]
+                    < cfg.window
+                )
 
         def write(cache_l, new, lens):
             """Append (B, T, H, hd) at per-row offsets into (B, S, H, hd)."""
@@ -571,15 +643,25 @@ class TpuLM:
                 vs = write_s(vs, v_sc, lengths)
                 # dequant is an elementwise producer XLA fuses into the
                 # dots: the int8 bytes are what cross HBM; reads bound
-                # to the attend_len window (writes hit the full buffer)
-                k_read = (kc[:, :S_max].astype(jnp.float32)
-                          * ks[:, :S_max, ..., None]).astype(cfg.dtype)
-                v_read = (vc[:, :S_max].astype(jnp.float32)
-                          * vs[:, :S_max, ..., None]).astype(cfg.dtype)
+                # to the attend_len window or the sliding-window band
+                # (writes hit the full buffer)
+                if use_window:
+                    k8r, v8r = read_band(kc), read_band(vc)
+                    ksr, vsr = read_band(ks), read_band(vs)
+                else:
+                    k8r, v8r = kc[:, :S_max], vc[:, :S_max]
+                    ksr, vsr = ks[:, :S_max], vs[:, :S_max]
+                k_read = (k8r.astype(jnp.float32)
+                          * ksr[..., None]).astype(cfg.dtype)
+                v_read = (v8r.astype(jnp.float32)
+                          * vsr[..., None]).astype(cfg.dtype)
             else:
                 kc = write(kc, k, lengths)
                 vc = write(vc, v, lengths)
-                k_read, v_read = kc[:, :S_max], vc[:, :S_max]
+                if use_window:
+                    k_read, v_read = read_band(kc), read_band(vc)
+                else:
+                    k_read, v_read = kc[:, :S_max], vc[:, :S_max]
             # grouped-query decode: contract the stored KV heads against
             # their query-head groups directly — the repeated-KV tensor
             # the cache shrank away is never materialized, so the HBM

@@ -726,6 +726,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "group factor")
     ap.add_argument("--n-layers", type=int, default=16)
     ap.add_argument("--d-ff", type=int, default=8192)
+    ap.add_argument("--window", type=int, default=0,
+                    help="sliding-window attention: each position "
+                         "attends only the last N (0 = full causal)")
     ap.add_argument("--vocab-size", type=int, default=32000)
     ap.add_argument("--checkpoint", default="",
                     help="orbax checkpoint dir to restore params from")
@@ -778,7 +781,7 @@ def build_engine(args) -> ServingEngine:
     cfg = ModelConfig(
         vocab_size=args.vocab_size, d_model=args.d_model,
         n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
-        n_layers=args.n_layers, d_ff=args.d_ff,
+        n_layers=args.n_layers, d_ff=args.d_ff, window=args.window,
         max_seq_len=args.max_len, dtype=jnp.bfloat16, remat=False,
     )
     model = TpuLM(cfg)

@@ -1,0 +1,133 @@
+"""Sliding-window attention (``ModelConfig.window``), Mistral-family.
+
+Semantics bar: position i attends exactly [max(0, i-window+1), i] —
+identical to full causal while S <= window, provably different beyond
+it, and the incremental decode path must agree with the full forward
+token for token (the mask is applied in two different formulations).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.serving import ServingEngine
+
+pytestmark = pytest.mark.slow
+
+
+def cfg_with(window: int) -> ModelConfig:
+    return ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=2, n_layers=2,
+        d_ff=64, dtype=jnp.float32, remat=False, window=window,
+    )
+
+
+def greedy_reference(model, params, prompt, n_new):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = model.apply(params, jnp.asarray(toks, jnp.int32)[None])
+        t = int(jnp.argmax(logits[0, -1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+class TestWindowSemantics:
+    def test_equals_full_causal_within_window(self):
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+        params = TpuLM(cfg_with(0)).init(jax.random.key(0))
+        full = TpuLM(cfg_with(0)).apply(params, toks)
+        win = TpuLM(cfg_with(8)).apply(params, toks)   # S == window
+        assert float(jnp.abs(full - win).max()) < 1e-5
+
+    def test_window_actually_truncates(self):
+        toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+        params = TpuLM(cfg_with(0)).init(jax.random.key(0))
+        full = TpuLM(cfg_with(0)).apply(params, toks)
+        win = TpuLM(cfg_with(4)).apply(params, toks)
+        # early positions (inside every window) agree; late ones differ
+        assert float(jnp.abs(full[:, :4] - win[:, :4]).max()) < 1e-5
+        assert float(jnp.abs(full[:, -1] - win[:, -1]).max()) > 1e-4
+
+    def test_first_window_positions_see_everything_available(self):
+        """Position i < window has fewer than `window` predecessors —
+        the mask must admit all of them (no off-by-one at the start)."""
+        toks = jax.random.randint(jax.random.key(2), (1, 6), 0, 64)
+        params = TpuLM(cfg_with(0)).init(jax.random.key(0))
+        win = TpuLM(cfg_with(3)).apply(params, toks)
+        # recompute position 2 (window exactly covers 0..2) from the
+        # full model on the 3-token prefix: must match
+        full_prefix = TpuLM(cfg_with(0)).apply(params, toks[:, :3])
+        assert float(jnp.abs(win[:, 2] - full_prefix[:, 2]).max()) < 1e-5
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="window"):
+            cfg_with(-1)
+        with pytest.raises(ValueError, match="ring"):
+            ModelConfig(n_heads=4, window=8, ring_attention=True)
+
+
+class TestWindowDecode:
+    def test_incremental_matches_full_forward(self):
+        cfg = cfg_with(5)
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 12), 0, 64)
+        full = m.apply(params, toks)
+        cache = m.init_cache(2, 32)
+        lengths = jnp.zeros(2, jnp.int32)
+        lg, cache = m.apply_with_cache(params, toks[:, :4], cache,
+                                       lengths)
+        assert float(jnp.abs(lg - full[:, :4]).max()) < 1e-4
+        lengths = lengths + 4
+        for t in range(4, 12):
+            lg, cache = m.apply_with_cache(
+                params, toks[:, t:t + 1], cache, lengths
+            )
+            assert float(jnp.abs(lg[:, 0] - full[:, t]).max()) < 1e-4, t
+            lengths = lengths + 1
+
+    def test_banded_read_equals_prefix_read(self):
+        """The windowed band read (vmapped dynamic_slice) is a pure
+        HBM optimization: forcing the full-prefix path via a window as
+        wide as the cache must give identical logits to the banded
+        path of an equivalent narrow-window model."""
+        toks = jax.random.randint(jax.random.key(3), (2, 10), 0, 64)
+        cfg = cfg_with(4)
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+        # banded: window 4, cache 32 → band (4+T-1) < 32 is taken
+        cache = m.init_cache(2, 32)
+        lengths = jnp.zeros(2, jnp.int32)
+        lg_band, cache = m.apply_with_cache(params, toks[:, :10], cache,
+                                            lengths)
+        # full-prefix: same model but attend bucket equal to the band
+        # is unreachable, so recompute via the no-cache forward
+        full = m.apply(params, toks)
+        assert float(jnp.abs(lg_band - full).max()) < 1e-4
+
+    def test_quantized_cache_with_window(self):
+        """int8 KV + banded window reads compose (the band slices the
+        int8 values AND their scales)."""
+        cfg = cfg_with(5)
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, kv_quant=True)
+        prompt = [5, 9, 2, 7]
+        [res] = eng.generate([prompt], max_new_tokens=8)
+        ref = greedy_reference(m, params, prompt, 8)
+        agree = sum(1 for a, b in zip(res.tokens, ref) if a == b)
+        assert agree >= 6, (res.tokens, ref)
+
+    def test_engine_matches_oracle(self):
+        cfg = cfg_with(6)
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        prompt = [5, 9, 2, 7, 11, 3]
+        [res] = eng.generate([prompt], max_new_tokens=10)
+        assert res.tokens == greedy_reference(m, params, prompt, 10)
