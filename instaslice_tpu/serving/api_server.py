@@ -455,7 +455,10 @@ class _Handler(BaseHTTPRequestHandler):
             eng = type(self).scheduler.engine
             for key, have in (("temperature", eng.temperature),
                               ("top_k", eng.top_k),
-                              ("top_p", eng.top_p)):
+                              ("top_p", eng.top_p),
+                              ("min_p", eng.min_p),
+                              ("repetition_penalty",
+                               eng.repetition_penalty)):
                 want = req.get(key)
                 if want is not None and float(want) != float(have):
                     raise ValueError(
@@ -739,6 +742,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "(one compiled program per setting)")
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=1.0)
+    ap.add_argument("--min-p", type=float, default=0.0,
+                    help="keep tokens with prob >= min-p x the top "
+                         "token's prob (entropy-adaptive filter)")
+    ap.add_argument("--repetition-penalty", type=float, default=1.0,
+                    help="HF-style: penalize tokens already in the "
+                         "prompt or generated so far (1.0 = off)")
     ap.add_argument("--from-env", action="store_true",
                     help="build the TP mesh from the granted slice's "
                     "handoff env (TPU_* vars) instead of one device")
@@ -823,6 +832,7 @@ def build_engine(args) -> ServingEngine:
         model, params, max_batch=args.max_batch, max_len=args.max_len,
         prefill_len=args.prefill_len, mesh=mesh, kv_quant=kv_quant,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        min_p=args.min_p, repetition_penalty=args.repetition_penalty,
     )
 
 

@@ -64,7 +64,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from instaslice_tpu.models.lm import Params, TpuLM, param_specs
-from instaslice_tpu.serving.sampling import filter_logits, token_logprob
+from instaslice_tpu.serving.sampling import (
+    apply_repetition_penalty,
+    filter_logits,
+    token_logprob,
+)
 
 
 @dataclasses.dataclass
@@ -121,6 +125,8 @@ class ServingEngine:
         spec_k: int = 4,
         top_k: int = 0,
         top_p: float = 1.0,
+        min_p: float = 0.0,
+        repetition_penalty: float = 1.0,
         max_prefixes: int = 8,
     ) -> None:
         """``kv_quant=True`` stores the KV cache as int8 with per-vector
@@ -155,8 +161,35 @@ class ServingEngine:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if top_k < 0:
             raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if not 0.0 <= min_p <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1], got {min_p}")
+        if repetition_penalty <= 0.0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {repetition_penalty}"
+            )
+        if repetition_penalty != 1.0 and draft_model is not None:
+            raise ValueError(
+                "repetition_penalty cannot combine with speculative "
+                "decoding: the penalty depends on tokens sampled INSIDE "
+                "the verify window, which the one-shot verify forward "
+                "cannot see — acceptance would silently diverge from "
+                "the penalized chain"
+            )
         self.top_k = top_k
         self.top_p = top_p
+        self.min_p = min_p
+        # construction-only (unlike temperature/top_k/top_p/min_p,
+        # which may be mutated between calls): whether the seen-token
+        # set exists at all is decided here, so a mutated penalty would
+        # be silently ignored — the read-only property makes that loud
+        self._repetition_penalty = repetition_penalty
+        # seen-token presence per slot, (B, V) bool on device — only
+        # materialized (and only updated) when the penalty is active
+        self.track_seen = repetition_penalty != 1.0
+        self.seen = (
+            jnp.zeros((max_batch, model.cfg.vocab_size), jnp.bool_)
+            if self.track_seen else None
+        )
         self.eos_id = eos_id
         self.mesh = mesh
         self._rng = jax.random.key(seed)
@@ -247,11 +280,11 @@ class ServingEngine:
         self._decode_block = jax.jit(
             self._decode_block_impl,
             static_argnames=("n_steps", "greedy", "attend_len",
-                             "top_k", "top_p"),
+                             "top_k", "top_p", "min_p", "penalize"),
             donate_argnums=(1,),
             out_shardings=rep(
                 (None, self._replicated, self._replicated,
-                 self._replicated, self._replicated)
+                 self._replicated, self._replicated, self._replicated)
             ),
         )
         if draft_model is not None:
@@ -273,6 +306,12 @@ class ServingEngine:
                     (None, self._replicated, self._replicated)
                 ),
             )
+
+    @property
+    def repetition_penalty(self) -> float:
+        """Construction-only (see __init__); assignment raises instead
+        of being silently ignored."""
+        return self._repetition_penalty
 
     def _shard_model_state(self, mesh: Mesh, model: TpuLM, params, cache):
         """One model's tensor-parallel layout over the mesh's ``model``
@@ -315,6 +354,8 @@ class ServingEngine:
         replicated = NamedSharding(mesh, P())
         self.lengths = jax.device_put(self.lengths, replicated)
         self.last_token = jax.device_put(self.last_token, replicated)
+        if getattr(self, "track_seen", False):
+            self.seen = jax.device_put(self.seen, replicated)
 
     # ------------------------------------------------------------- jitted
 
@@ -376,9 +417,10 @@ class ServingEngine:
         return cache, logits[:, 0]                  # (B, vocab)
 
     def _decode_block_impl(self, params, cache, last_token, lengths, rng,
-                           temperature, *, n_steps: int,
+                           temperature, seen, penalty, *, n_steps: int,
                            greedy: bool, attend_len: int = 0,
-                           top_k: int = 0, top_p: float = 1.0):
+                           top_k: int = 0, top_p: float = 1.0,
+                           min_p: float = 0.0, penalize: bool = False):
         """``n_steps`` decode steps as one ``lax.scan``: each sampled
         token feeds the next step on-device — no host round-trip inside
         the block. Returns the advanced state plus the (n_steps, B) token
@@ -387,15 +429,22 @@ class ServingEngine:
         ``greedy`` is a static (compile-keyed) switch while
         ``temperature`` stays a traced value, so mutating
         ``self.temperature`` between calls behaves like :meth:`step`
-        instead of silently replaying the first trace."""
+        instead of silently replaying the first trace. ``penalize``
+        (static) threads the per-slot seen-token set through the scan —
+        the repetition penalty must observe tokens sampled EARLIER IN
+        THIS BLOCK, not just pre-block state; when off, ``seen`` passes
+        through untouched and XLA eliminates it."""
 
         def step(carry, i):
-            cache, last, lens = carry
+            cache, last, lens, seen = carry
             logits, cache = self.model.apply_with_cache(
                 params, last[:, None], cache, lens,
                 attend_len=attend_len,
             )
             logits = logits[:, 0]
+            if penalize:
+                # BEFORE temperature/filters: the HF order
+                logits = apply_repetition_penalty(logits, seen, penalty)
             if greedy:
                 toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             else:
@@ -403,20 +452,24 @@ class ServingEngine:
                 # defined over the tempered distribution (the standard
                 # order OpenAI/HF clients are calibrated against)
                 logits = filter_logits(
-                    logits / temperature, top_k, top_p
+                    logits / temperature, top_k, top_p, min_p
                 )
                 toks = jax.random.categorical(
                     jax.random.fold_in(rng, i), logits, axis=-1,
                 ).astype(jnp.int32)
+            if penalize:
+                seen = seen.at[
+                    jnp.arange(seen.shape[0]), toks
+                ].set(True)
             # logprob under the distribution actually sampled from
             lp = token_logprob(logits, toks)
-            return (cache, toks, lens + 1), (toks, lp)
+            return (cache, toks, lens + 1, seen), (toks, lp)
 
-        (cache, last, lengths), (toks, lps) = jax.lax.scan(
-            step, (cache, last_token, lengths),
+        (cache, last, lengths, seen), (toks, lps) = jax.lax.scan(
+            step, (cache, last_token, lengths, seen),
             jnp.arange(n_steps, dtype=jnp.int32),
         )
-        return cache, last, lengths, toks, lps
+        return cache, last, lengths, seen, toks, lps
 
     def _draft_prefill_impl(self, params, cache, tokens, slot, offset):
         """The draft cache must hold the prompt too before it can
@@ -462,17 +515,26 @@ class ServingEngine:
         toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return cache, toks, token_logprob(logits, toks)
 
-    def _sample(self, logits: jax.Array):
+    def _sample(self, logits: jax.Array, rows=None):
         """(tokens, logprobs) for a (B, vocab) logits batch; logprob is
-        under the distribution actually sampled from (post temperature/
-        top-k/top-p filtering)."""
+        under the distribution actually sampled from (post penalty/
+        temperature/top-k/top-p/min-p filtering). ``rows`` maps logits
+        rows to slot indices when the batch is a subset (admission
+        forks); None means row i IS slot i (the full-batch decode)."""
+        if self.track_seen:
+            seen = (self.seen if rows is None
+                    else self.seen[jnp.asarray(rows)])
+            logits = apply_repetition_penalty(
+                logits, seen, self.repetition_penalty
+            )
         if self.temperature <= 0.0:
             toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
             self._rng, sub = jax.random.split(self._rng)
             # temperature first, then the nucleus (_decode_block_impl)
             logits = filter_logits(
-                logits / self.temperature, self.top_k, self.top_p
+                logits / self.temperature, self.top_k, self.top_p,
+                self.min_p,
             )
             toks = jax.random.categorical(sub, logits, axis=-1).astype(
                 jnp.int32
@@ -547,6 +609,8 @@ class ServingEngine:
         )
         self.lengths = jnp.zeros(self.max_batch, jnp.int32)
         self.last_token = jnp.zeros(self.max_batch, jnp.int32)
+        if self.track_seen:
+            self.seen = jnp.zeros_like(self.seen)
         if self.draft_model is not None:
             self.draft_cache = self.draft_model.init_cache(
                 self.max_batch, self.max_len
@@ -780,13 +844,25 @@ class ServingEngine:
                     self.draft_cache = self._write_stripe(
                         self.draft_cache, d_stripe, s
                     )
+        if self.track_seen:
+            # fresh slots: clear whatever the previous occupant saw
+            # (the single reset point for every release path), then
+            # the prompt is "seen" before the FIRST token samples —
+            # one vectorized scatter for all forks (they share it)
+            rows = jnp.asarray(slots)
+            pt = jnp.asarray(prompt, jnp.int32)
+            self.seen = self.seen.at[rows].set(False)
+            self.seen = self.seen.at[rows[:, None], pt[None, :]].set(True)
         # one sample call for all forks: the (n, vocab) rows are
         # identical, but Gumbel noise is independent per row, so forks
         # diverge at temperature > 0
         toks, lps = self._sample(
             jnp.broadcast_to(last_logits[None],
-                             (len(slots),) + last_logits.shape)
+                             (len(slots),) + last_logits.shape),
+            rows=slots,
         )
+        if self.track_seen:
+            self.seen = self.seen.at[jnp.asarray(slots), toks].set(True)
         rids = []
         for i, s in enumerate(slots):
             rid = self._next_id
@@ -820,6 +896,10 @@ class ServingEngine:
             self.params, self.cache, self.last_token, self.lengths
         )
         toks, lps = self._sample(logits)
+        if self.track_seen:
+            self.seen = self.seen.at[
+                jnp.arange(self.max_batch), toks
+            ].set(True)
         # one combined host round-trip (int(toks[slot]) per slot would
         # sync the device once per live slot)
         toks_h, lps_h = jax.device_get((toks, lps))
@@ -868,15 +948,22 @@ class ServingEngine:
         need = worst + n_steps + 1
         bucket = min(self.max_len, ((need + 255) // 256) * 256)
         attend = bucket if bucket < self.max_len else 0
-        self.cache, self.last_token, self.lengths, toks, lps = (
+        seen_in = (self.seen if self.track_seen
+                   else jnp.zeros((self.max_batch, 1), jnp.bool_))
+        self.cache, self.last_token, self.lengths, seen_out, toks, lps = (
             self._decode_block(
                 self.params, self.cache, self.last_token, self.lengths,
                 sub, jnp.float32(max(self.temperature, 1e-6)),
+                seen_in,
+                jnp.float32(self.repetition_penalty),
                 n_steps=n_steps, greedy=self.temperature <= 0.0,
                 attend_len=attend, top_k=self.top_k,
-                top_p=float(self.top_p),
+                top_p=float(self.top_p), min_p=float(self.min_p),
+                penalize=self.track_seen,
             )
         )
+        if self.track_seen:
+            self.seen = seen_out
         if self.draft_model is not None:
             # teacher-force the block's inputs ([last, toks[:-1]])
             # through the draft in ONE forward so its cache tracks

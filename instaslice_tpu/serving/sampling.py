@@ -22,16 +22,37 @@ import numpy as np
 _NEG = np.float32(-1e9)
 
 
-def filter_logits(
-    logits: jax.Array, top_k: int = 0, top_p: float = 1.0
+def apply_repetition_penalty(
+    logits: jax.Array, seen: jax.Array, penalty
 ) -> jax.Array:
-    """Mask ``logits`` (…, V) outside the top-k / nucleus to -inf.
+    """HF/vLLM repetition penalty: for tokens already ``seen`` (…, V)
+    bool (prompt + generated so far), positive logits divide by
+    ``penalty`` and negative ones multiply — both push the token down
+    for penalty > 1. Applied BEFORE temperature/filters (the HF order).
+    ``penalty`` may be a traced scalar; callers skip the call entirely
+    when the engine-level penalty is 1.0."""
+    logits = logits.astype(jnp.float32)
+    penalized = jnp.where(
+        logits > 0, logits / penalty, logits * penalty
+    )
+    return jnp.where(seen, penalized, logits)
 
-    ``top_k <= 0`` and ``top_p >= 1`` are no-ops. ``top_p`` keeps the
-    smallest set of tokens whose probabilities sum to at least ``top_p``
-    (the token crossing the threshold is kept, matching the standard
-    nucleus-sampling definition). Filters compose: top-k first, then
-    nucleus over the survivors.
+
+def filter_logits(
+    logits: jax.Array, top_k: int = 0, top_p: float = 1.0,
+    min_p: float = 0.0,
+) -> jax.Array:
+    """Mask ``logits`` (…, V) outside the top-k / nucleus / min-p set
+    to -inf.
+
+    ``top_k <= 0``, ``top_p >= 1`` and ``min_p <= 0`` are no-ops.
+    ``top_p`` keeps the smallest set of tokens whose probabilities sum
+    to at least ``top_p`` (the token crossing the threshold is kept,
+    matching the standard nucleus-sampling definition). ``min_p`` keeps
+    tokens whose probability is at least ``min_p`` × the top token's
+    probability (the entropy-adaptive filter; the argmax always
+    survives). Filters compose: top-k, then nucleus, then min-p, each
+    over the previous survivors.
     """
     logits = logits.astype(jnp.float32)
     if top_k and top_k > 0 and top_k < logits.shape[-1]:
@@ -53,6 +74,11 @@ def filter_logits(
             axis=-1, keepdims=True,
         )
         logits = jnp.where(logits < threshold, _NEG, logits)
+    if min_p > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        floor = min_p * jnp.max(probs, axis=-1, keepdims=True)
+        # the argmax always survives (probs == floor when min_p == 1)
+        logits = jnp.where(probs < floor, _NEG, logits)
     return logits
 
 

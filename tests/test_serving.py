@@ -420,10 +420,11 @@ class TestBoundedAttentionWindow:
         # jitted impl directly with attend_len=0
         import jax.numpy as jnp
 
-        full.cache, full.last_token, full.lengths, toks, _ = (
+        full.cache, full.last_token, full.lengths, _, toks, _ = (
             full._decode_block(
                 full.params, full.cache, full.last_token, full.lengths,
                 jax.random.key(0), jnp.float32(1e-6),
+                jnp.zeros((2, 1), jnp.bool_), jnp.float32(1.0),
                 n_steps=10, greedy=True, attend_len=0,
             )
         )
