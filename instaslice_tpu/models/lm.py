@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -60,8 +61,13 @@ class ModelConfig:
     # axis and run ring attention instead of plain attention.
     ring_attention: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 = that many experts, sharded
-    # over the "model" axis (expert parallelism).
+    # over the "model" axis (expert parallelism). Tokens route to their
+    # expert_top_k experts, each expert bounded by a capacity of
+    # capacity_factor · k · S / E tokens (GShard semantics: overflow
+    # falls through the residual).
     n_experts: int = 0
+    expert_top_k: int = 2
+    expert_capacity_factor: float = 1.25
     remat: bool = True
     # what the block-level jax.checkpoint may KEEP for the backward:
     # "full"  — keep only block inputs, recompute the whole block
@@ -342,7 +348,8 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
     h = _rmsnorm(x, layer["ln2"]["scale"])
     if cfg.n_experts:
         y = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
-                     weight(layer["w_out"]))
+                     weight(layer["w_out"]), top_k=cfg.expert_top_k,
+                     capacity_factor=cfg.expert_capacity_factor)
     else:
         y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
                        preferred_element_type=jnp.float32)
@@ -353,19 +360,69 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
     return x + y
 
 
-def _moe_mlp(x, router_w, w_in, w_out):
-    """Soft-routed MoE (top-1 via straight-through softmax weighting kept
-    dense — compiler-friendly: no gather/scatter, no dynamic shapes).
-    x: (B,S,D); w_in: (E,D,F); w_out: (E,F,D)."""
+def _moe_mlp(x, router_w, w_in, w_out, top_k: int = 2,
+             capacity_factor: float = 1.25):
+    """Top-k routed MoE with capacity, GShard-style: every tensor is
+    static-shaped, dispatch/combine are one-hot einsums (no
+    gather/scatter, no dynamic shapes — the TPU MoE pattern), and each
+    token's hidden state runs through only its top-k experts instead of
+    all E (the soft-dense formulation this replaces paid E× the FF
+    FLOPs).
+
+    x: (B,S,D); w_in: (E,D,F); w_out: (E,F,D). Each expert processes at
+    most ``C = ceil(capacity_factor · k · S / E)`` tokens per batch row;
+    overflow tokens (expert popularity beyond C) are dropped from that
+    expert — their combine weight is zero, so they fall through the
+    residual connection, the standard GShard/Switch behavior. Combine
+    weights renormalize over the selected k. (No load-balancing aux
+    loss yet: acceptable at inference and for the parallelism-plumbing
+    role this model plays; a trainer pushing MoE quality should add
+    the standard fraction·gate aux term.)
+    """
+    B, S, D = x.shape
+    E = router_w.shape[-1]
+    k = min(top_k, E)
+    N = S * k                                     # (token, choice) pairs
+    C = max(1, int(math.ceil(capacity_factor * k * S / E)))
     gates = jax.nn.softmax(
         jnp.einsum("bsd,de->bse", x.astype(jnp.float32), router_w), -1
     )
-    h = jnp.einsum("bsd,edf->bsef", x, w_in,
+    topv, topi = jax.lax.top_k(gates, k)          # (B,S,k)
+    if k > 1:
+        topv = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    # k == 1 keeps the RAW gate (Switch-Transformer): renormalizing
+    # would make the combine weight a constant 1.0 and starve the
+    # router of its only differentiable gradient path
+    # token-major flattening: choice c of token s is row s·k + c, so
+    # earlier tokens claim expert capacity first (deterministic)
+    sel = jax.nn.one_hot(topi, E, dtype=jnp.float32).reshape(B, N, E)
+    # position of each (token, choice) in its expert's buffer
+    pos_e = jnp.cumsum(sel, axis=1) - sel         # (B,N,E)
+    pos = jnp.einsum("bne,bne->bn", pos_e, sel).astype(jnp.int32)
+    # dispatch one-hot (B,N,E,C); over-capacity rows are all-zero by
+    # one_hot's out-of-range semantics — that IS the overflow drop
+    disp = sel[:, :, :, None] * (
+        jax.nn.one_hot(pos, C, dtype=jnp.float32)[:, :, None, :]
+    )
+    comb = disp * topv.reshape(B, N)[:, :, None, None]
+    # contract over (s, choice) against the ORIGINAL x — reshaping the
+    # dispatch instead of repeating the activations k× (a repeated
+    # (B,N,D) tensor is a ~half-GB operand at serving scale)
+    expert_in = jnp.einsum(
+        "bskec,bsd->becd",
+        disp.reshape(B, S, k, E, C).astype(x.dtype), x,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)                             # (B,E,C,D)
+    h = jnp.einsum("becd,edf->becf", expert_in, w_in,
                    preferred_element_type=jnp.float32)
     h = jax.nn.gelu(h).astype(x.dtype)
-    y = jnp.einsum("bsef,efd->bsed", h, w_out,
-                   preferred_element_type=jnp.float32)
-    return jnp.einsum("bsed,bse->bsd", y, gates).astype(x.dtype)
+    y_e = jnp.einsum("becf,efd->becd", h, w_out,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    y = jnp.einsum(
+        "bskec,becd->bsd",
+        comb.reshape(B, S, k, E, C).astype(x.dtype), y_e,
+    )
+    return y.astype(x.dtype)
 
 
 class TpuLM:
@@ -683,7 +740,9 @@ class TpuLM:
             h = _rmsnorm(x, layer["ln2"]["scale"])
             if cfg.n_experts:
                 y = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
-                             weight(layer["w_out"]))
+                             weight(layer["w_out"]),
+                             top_k=cfg.expert_top_k,
+                             capacity_factor=cfg.expert_capacity_factor)
             else:
                 y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
                                preferred_element_type=jnp.float32)

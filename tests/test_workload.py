@@ -120,6 +120,85 @@ class TestModel:
         assert logits.shape == (2, 8, 128)
         assert bool(jnp.isfinite(logits).all())
 
+    def test_moe_top1_matches_per_token_expert(self):
+        """With k=1 and unbounded capacity, every token's MoE output
+        must equal its argmax expert's FF scaled by the RAW top gate
+        (Switch semantics — the gate stays in the output so the router
+        keeps a gradient path)."""
+        from instaslice_tpu.models.lm import _moe_mlp
+
+        E, D, F = 4, 8, 16
+        ks = jax.random.split(jax.random.key(2), 4)
+        x = jax.random.normal(ks[0], (2, 6, D))
+        router = jax.random.normal(ks[1], (D, E))
+        w_in = jax.random.normal(ks[2], (E, D, F)) * 0.2
+        w_out = jax.random.normal(ks[3], (E, F, D)) * 0.2
+        got = _moe_mlp(x, router, w_in, w_out, top_k=1,
+                       capacity_factor=float(E))  # C >= S: no drops
+        gates = jax.nn.softmax(x @ router, -1)
+        eid = jnp.argmax(gates, -1)                       # (B,S)
+        for b in range(2):
+            for s in range(6):
+                e = int(eid[b, s])
+                ref = (jax.nn.gelu(x[b, s] @ w_in[e]) @ w_out[e]
+                       ) * gates[b, s, e]
+                assert float(jnp.abs(got[b, s] - ref).max()) < 1e-4
+
+    def test_moe_top1_router_gets_gradient(self):
+        """The Switch-style raw gate is the router's ONLY gradient
+        path; it must be nonzero (a renormalized top-1 would zero it)."""
+        from instaslice_tpu.models.lm import _moe_mlp
+
+        E, D, F = 4, 8, 16
+        ks = jax.random.split(jax.random.key(4), 4)
+        x = jax.random.normal(ks[0], (2, 6, D))
+        w_in = jax.random.normal(ks[2], (E, D, F)) * 0.2
+        w_out = jax.random.normal(ks[3], (E, F, D)) * 0.2
+
+        def loss(router):
+            y = _moe_mlp(x, router, w_in, w_out, top_k=1,
+                         capacity_factor=float(E))
+            return jnp.mean(y ** 2)
+
+        g = jax.grad(loss)(jax.random.normal(ks[1], (D, E)))
+        assert float(jnp.abs(g).max()) > 0.0
+
+    def test_moe_capacity_drops_overflow_to_zero(self):
+        """Tokens beyond an expert's capacity contribute nothing (the
+        residual carries them) — and earlier tokens win the buffer."""
+        from instaslice_tpu.models.lm import _moe_mlp
+
+        E, D, F = 2, 8, 16
+        ks = jax.random.split(jax.random.key(3), 3)
+        x = jnp.broadcast_to(
+            jax.random.normal(ks[0], (1, 1, D)), (1, 6, D)
+        )  # identical tokens → all route to the same expert
+        router = jax.random.normal(ks[1], (D, E))
+        w_in = jax.random.normal(ks[2], (E, D, F)) * 0.2
+        w_out = jnp.ones((E, F, D)) * 0.1
+        # k=1, capacity_factor chosen so C = ceil(cf*1*6/2) = 2
+        got = _moe_mlp(x, router, w_in, w_out, top_k=1,
+                       capacity_factor=2 / 3)
+        # first 2 tokens served, the other 4 dropped to exactly zero
+        assert float(jnp.abs(got[0, 2:]).max()) == 0.0
+        assert float(jnp.abs(got[0, :2]).min()) > 0.0
+
+    def test_moe_top2_forward_and_grads(self):
+        model = TpuLM(tiny(experts=4))
+        params = model.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+
+        def loss(p):
+            lg = model.apply(p, toks)
+            return jnp.mean(lg.astype(jnp.float32) ** 2)
+
+        val, grads = jax.value_and_grad(loss)(params)
+        assert np.isfinite(float(val))
+        g = grads["blocks"]["w_in"]
+        assert bool(jnp.isfinite(g).all())
+        # routing is sparse, but SOME expert gradient must be nonzero
+        assert float(jnp.abs(g).max()) > 0.0
+
     def test_param_specs_cover_params(self):
         cfg = tiny(experts=2)
         model = TpuLM(cfg)
