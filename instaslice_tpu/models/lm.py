@@ -319,11 +319,13 @@ def _attention(q, k, v, causal: bool = True, impl: str = "xla",
 
 
 def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
-                       positions: jax.Array, attn_fn) -> jax.Array:
+                       positions: jax.Array, attn_fn):
     """One pre-norm block: attention (via ``attn_fn(q, k, v)``) +
     MLP/MoE, shared by the scan stack in :meth:`TpuLM.apply` and the
     pipeline-parallel stage body (:mod:`instaslice_tpu.parallel.pipeline`).
-    x: (B, S, D)."""
+    x: (B, S, D). Returns ``(x, aux)``: the MoE load-balance term
+    (0.0 for dense blocks) rides alongside so training can regularize
+    the router."""
     B, S = x.shape[:2]
     h = _rmsnorm(x, layer["ln1"]["scale"])
     q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"]),
@@ -346,10 +348,12 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
         preferred_element_type=jnp.float32,
     ).astype(cfg.dtype)
     h = _rmsnorm(x, layer["ln2"]["scale"])
+    aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts:
-        y = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
-                     weight(layer["w_out"]), top_k=cfg.expert_top_k,
-                     capacity_factor=cfg.expert_capacity_factor)
+        y, aux = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
+                          weight(layer["w_out"]),
+                          top_k=cfg.expert_top_k,
+                          capacity_factor=cfg.expert_capacity_factor)
     else:
         y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
                        preferred_element_type=jnp.float32)
@@ -357,7 +361,7 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
         y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"]),
                        preferred_element_type=jnp.float32
                        ).astype(cfg.dtype)
-    return x + y
+    return x + y, aux
 
 
 def _moe_mlp(x, router_w, w_in, w_out, top_k: int = 2,
@@ -374,10 +378,14 @@ def _moe_mlp(x, router_w, w_in, w_out, top_k: int = 2,
     overflow tokens (expert popularity beyond C) are dropped from that
     expert — their combine weight is zero, so they fall through the
     residual connection, the standard GShard/Switch behavior. Combine
-    weights renormalize over the selected k. (No load-balancing aux
-    loss yet: acceptable at inference and for the parallelism-plumbing
-    role this model plays; a trainer pushing MoE quality should add
-    the standard fraction·gate aux term.)
+    weights renormalize over the selected k.
+
+    Returns ``(y, aux)`` where ``aux`` is the Switch/GShard
+    load-balance term ``E · Σ_e f_e · P_e`` (f_e: fraction of tokens
+    whose top-1 choice is e; P_e: mean router probability of e) — 1.0
+    at perfect balance, up to E when the router collapses onto one
+    expert. Training adds it to the loss scaled by ``moe_aux_weight``
+    (``models/train.py``); inference ignores it.
     """
     B, S, D = x.shape
     E = router_w.shape[-1]
@@ -422,7 +430,14 @@ def _moe_mlp(x, router_w, w_in, w_out, top_k: int = 2,
         "bskec,becd->bsd",
         comb.reshape(B, S, k, E, C).astype(x.dtype), y_e,
     )
-    return y.astype(x.dtype)
+    # load balance: differentiable through P_e (mean gate), with f_e
+    # (the argmax fraction) acting as the per-expert pressure signal
+    f_e = jnp.mean(
+        jax.nn.one_hot(topi[..., 0], E, dtype=jnp.float32), axis=(0, 1)
+    )
+    p_e = jnp.mean(gates, axis=(0, 1))
+    aux = E * jnp.sum(f_e * p_e)
+    return y.astype(x.dtype), aux
 
 
 class TpuLM:
@@ -441,11 +456,14 @@ class TpuLM:
         *,
         mesh: Optional[Mesh] = None,
         unembed: bool = True,
+        return_aux: bool = False,
     ) -> jax.Array:
         """Logits for ``tokens`` (B, S) → (B, S, vocab); with
         ``unembed=False`` the final hidden states (B, S, D) instead —
         the hook for chunked losses that never materialize the full
-        (B, S, V) logits (``models/train.py``).
+        (B, S, V) logits (``models/train.py``). ``return_aux=True``
+        additionally returns the layer-averaged MoE load-balance term
+        (0.0 for dense models) for the training loss.
 
         With ``cfg.ring_attention`` and a ``mesh``, the sequence dim stays
         sharded over the ``"seq"`` axis end to end: activations carry a
@@ -490,21 +508,21 @@ class TpuLM:
                                   window=cfg.window)
 
         def block(x, layer):
-            return _transformer_block(cfg, layer, x, positions,
-                                      attn_fn), None
+            return _transformer_block(cfg, layer, x, positions, attn_fn)
 
         body = block
         if cfg.remat:
             body = apply_remat(block, cfg.remat_policy)
-        x, _ = lax.scan(body, x, params["blocks"])
+        x, aux_stack = lax.scan(body, x, params["blocks"])
         x = _rmsnorm(x, params["ln_f"]["scale"])
+        aux = jnp.mean(aux_stack)   # per-layer load-balance, averaged
         if not unembed:
-            return x
+            return (x, aux) if return_aux else x
         logits = jnp.einsum(
             "bsd,vd->bsv", x, weight(params["embed"]),
             preferred_element_type=jnp.float32,
         )
-        return logits
+        return (logits, aux) if return_aux else logits
 
     def apply_pipelined(
         self,
@@ -537,12 +555,17 @@ class TpuLM:
         positions = jnp.arange(S, dtype=jnp.int32)
 
         def block_fn(layer, xb):
-            return _transformer_block(
+            # aux is dropped on the pipeline path: stages run under a
+            # manual pipe axis and the load-balance scalar would need
+            # its own cross-stage reduction — train MoE with the scan
+            # stack (tp/dp/sp) when the router needs regularizing
+            xb, _ = _transformer_block(
                 cfg, layer, xb, positions,
                 lambda q, k, v: _attention(q, k, v,
                                            impl=cfg.attention_impl,
                                            window=cfg.window),
             )
+            return xb
 
         x = pipeline_blocks(
             block_fn, params["blocks"], x,
@@ -739,10 +762,11 @@ class TpuLM:
             ).astype(cfg.dtype)
             h = _rmsnorm(x, layer["ln2"]["scale"])
             if cfg.n_experts:
-                y = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
-                             weight(layer["w_out"]),
-                             top_k=cfg.expert_top_k,
-                             capacity_factor=cfg.expert_capacity_factor)
+                y, _ = _moe_mlp(     # aux is a training-only signal
+                    h, layer["router"], weight(layer["w_in"]),
+                    weight(layer["w_out"]), top_k=cfg.expert_top_k,
+                    capacity_factor=cfg.expert_capacity_factor,
+                )
             else:
                 y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
                                preferred_element_type=jnp.float32)

@@ -87,6 +87,10 @@ def _chunked_xent(embed_leaf, hidden, targets, mask,
     return total
 
 
+#: Switch/GShard default weight for the MoE load-balance term.
+DEFAULT_MOE_AUX_WEIGHT = 0.01
+
+
 def loss_fn(
     model: TpuLM,
     params: Params,
@@ -95,6 +99,7 @@ def loss_fn(
     n_micro: int = 0,
     pipe_axis: str = "pipe",
     loss_chunk: int = DEFAULT_LOSS_CHUNK,
+    moe_aux_weight: float = DEFAULT_MOE_AUX_WEIGHT,
 ) -> jax.Array:
     """Next-token cross-entropy; tokens (B, S) predict tokens[:, 1:].
     With ``n_micro`` > 0 the forward runs pipeline-parallel over the
@@ -102,10 +107,18 @@ def loss_fn(
     loss chunk-by-chunk over the sequence so the full (B, S, V) logits
     never exist; 0 restores the one-shot formulation. Ring-attention
     (sequence-sharded) models always use the one-shot path — chunking
-    the sharded axis would reshard every block."""
+    the sharded axis would reshard every block.
+
+    MoE models add ``moe_aux_weight`` × the router load-balance term
+    (Switch: without it top-k routing collapses onto a few experts and
+    the capacity drops eat the batch). The pipeline path has no aux
+    (see ``TpuLM.apply_pipelined``)."""
     targets = jnp.roll(tokens, -1, axis=1)
     mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
     chunked = loss_chunk > 0 and not model.cfg.ring_attention
+    want_aux = bool(model.cfg.n_experts) and moe_aux_weight > 0 \
+        and not n_micro
+    aux = 0.0
     if n_micro:
         if mesh is None:
             raise ValueError(
@@ -118,15 +131,22 @@ def loss_fn(
         )
     else:
         out = model.apply(params, tokens, mesh=mesh,
-                          unembed=not chunked)
+                          unembed=not chunked, return_aux=want_aux)
+        if want_aux:
+            out, aux = out
     if chunked:
         total = _chunked_xent(params["embed"], out, targets, mask,
                               loss_chunk)
-        return total / mask.sum()
-    logp = jax.nn.log_softmax(out, axis=-1)  # (B, S, V) fp32
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    # last position has no target
-    return (nll * mask).sum() / mask.sum()
+        xent = total / mask.sum()
+    else:
+        logp = jax.nn.log_softmax(out, axis=-1)  # (B, S, V) fp32
+        nll = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1
+        )[..., 0]
+        # last position has no target
+        xent = (nll * mask).sum() / mask.sum()
+    # aux is 0.0 unless want_aux set it — no guard needed
+    return xent + moe_aux_weight * aux
 
 
 def state_shardings(
@@ -182,6 +202,7 @@ def make_train_step(
     n_micro: int = 0,
     pipe_axis: str = "pipe",
     loss_chunk: int = DEFAULT_LOSS_CHUNK,
+    moe_aux_weight: float = DEFAULT_MOE_AUX_WEIGHT,
 ) -> Tuple[Callable, Callable]:
     """Returns ``(init_fn, step_fn)``, both jitted over ``mesh``.
 
@@ -228,7 +249,7 @@ def make_train_step(
             lambda p: loss_fn(
                 model, p, tokens, mesh,
                 n_micro=n_micro, pipe_axis=pipe_axis,
-                loss_chunk=loss_chunk,
+                loss_chunk=loss_chunk, moe_aux_weight=moe_aux_weight,
             )
         )(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

@@ -133,8 +133,11 @@ class TestModel:
         router = jax.random.normal(ks[1], (D, E))
         w_in = jax.random.normal(ks[2], (E, D, F)) * 0.2
         w_out = jax.random.normal(ks[3], (E, F, D)) * 0.2
-        got = _moe_mlp(x, router, w_in, w_out, top_k=1,
-                       capacity_factor=float(E))  # C >= S: no drops
+        got, aux = _moe_mlp(x, router, w_in, w_out, top_k=1,
+                            capacity_factor=float(E))  # C>=S: no drops
+        # E·Σ f_e·p_e is bounded by (0, E]; 1.0 is only the value AT
+        # perfect balance, not a lower bound (f and p can anti-correlate)
+        assert 0.0 < float(aux) <= E
         gates = jax.nn.softmax(x @ router, -1)
         eid = jnp.argmax(gates, -1)                       # (B,S)
         for b in range(2):
@@ -156,8 +159,8 @@ class TestModel:
         w_out = jax.random.normal(ks[3], (E, F, D)) * 0.2
 
         def loss(router):
-            y = _moe_mlp(x, router, w_in, w_out, top_k=1,
-                         capacity_factor=float(E))
+            y, _ = _moe_mlp(x, router, w_in, w_out, top_k=1,
+                            capacity_factor=float(E))
             return jnp.mean(y ** 2)
 
         g = jax.grad(loss)(jax.random.normal(ks[1], (D, E)))
@@ -177,8 +180,8 @@ class TestModel:
         w_in = jax.random.normal(ks[2], (E, D, F)) * 0.2
         w_out = jnp.ones((E, F, D)) * 0.1
         # k=1, capacity_factor chosen so C = ceil(cf*1*6/2) = 2
-        got = _moe_mlp(x, router, w_in, w_out, top_k=1,
-                       capacity_factor=2 / 3)
+        got, _ = _moe_mlp(x, router, w_in, w_out, top_k=1,
+                          capacity_factor=2 / 3)
         # first 2 tokens served, the other 4 dropped to exactly zero
         assert float(jnp.abs(got[0, 2:]).max()) == 0.0
         assert float(jnp.abs(got[0, :2]).min()) > 0.0
@@ -197,6 +200,26 @@ class TestModel:
         g = grads["blocks"]["w_in"]
         assert bool(jnp.isfinite(g).all())
         # routing is sparse, but SOME expert gradient must be nonzero
+        assert float(jnp.abs(g).max()) > 0.0
+
+    def test_moe_aux_reaches_the_loss_and_router_grad(self):
+        """The load-balance term must show up in loss_fn (loss differs
+        with/without it) and give the router a gradient path even
+        through the top-2 renormalized combine."""
+        from instaslice_tpu.models.train import loss_fn
+
+        model = TpuLM(tiny(experts=4))
+        params = model.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+        with_aux = float(loss_fn(model, params, toks,
+                                 moe_aux_weight=0.01))
+        without = float(loss_fn(model, params, toks,
+                                moe_aux_weight=0.0))
+        # aux in (0, E] scaled by the weight bounds the difference
+        assert 0.0 < with_aux - without <= 0.01 * 4.0
+        g = jax.grad(
+            lambda p: loss_fn(model, p, toks, moe_aux_weight=0.01)
+        )(params)["blocks"]["router"]
         assert float(jnp.abs(g).max()) > 0.0
 
     def test_param_specs_cover_params(self):
