@@ -22,6 +22,7 @@ def _serve_bench(args) -> int:
         n_kv_heads=args.n_kv_heads,
         n_layers=args.n_layers,
         d_ff=args.d_ff,
+        window=args.window,
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         remat=False,
     )
@@ -50,7 +51,7 @@ def _serve_bench(args) -> int:
         "model": {
             "dModel": args.d_model, "nLayers": args.n_layers,
             "nHeads": args.n_heads, "nKvHeads": args.n_kv_heads,
-            "dFF": args.d_ff,
+            "dFF": args.d_ff, "window": args.window,
         },
     }
     if args.spec:
@@ -112,6 +113,8 @@ def main(argv=None) -> int:
     sb.add_argument("--max-len", type=int, default=256)
     sb.add_argument("--prefill-len", type=int, default=16)
     sb.add_argument("--steps", type=int, default=30)
+    sb.add_argument("--window", type=int, default=0,
+                    help="sliding-window attention (0 = full causal)")
     sb.add_argument("--quantize", action="store_true",
                     help="int8 weights + int8 KV cache")
     sb.add_argument("--spec", action="store_true",
