@@ -25,6 +25,8 @@ Prints ONE JSON line. The required keys ({"metric", "value", "unit",
 
 from __future__ import annotations
 
+import argparse
+import datetime
 import json
 import os
 import signal
@@ -34,6 +36,26 @@ import sys
 import time
 
 BASELINE_S = 60.0
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: incremental on-chip results store, shared by every capture path: the
+#: normal bench run and the ``--watchdog`` both persist each phase's
+#: fragment here the moment it lands, so a mid-run wedge keeps
+#: everything already measured, and a recovery window between runs
+#: accumulates coverage. The normal run folds this store into its output
+#: when its own probe fails — numbers captured earlier in the round
+#: still reach the driver's artifact (with provenance).
+RESULTS_STORE = os.environ.get(
+    "TPUSLICE_BENCH_STORE", os.path.join(_HERE, "BENCH_TPU_RESULTS.json")
+)
+
+#: chip-health journal: one JSON line {ts, alive, rtt_ms|error} per
+#: probe, appended by the watchdog (and by normal runs' probe phase) —
+#: the committed evidence of when the tunnel answered this round.
+HEALTH_JOURNAL = os.environ.get(
+    "TPUSLICE_TPU_HEALTH_JOURNAL", os.path.join(_HERE, "TPU_HEALTH.jsonl")
+)
 # mixed load from BASELINE.json configs[3]: 8 concurrent pods, mixed
 # {1x1, 2x1, 2x2} on one v5e-16 (two hosts, 4x4 torus); run 3 waves.
 # 14 of 16 chips per wave — concurrent but not a perfect-packing puzzle.
@@ -97,21 +119,27 @@ def bench_control_plane(transport: str = "inproc") -> float:
     return statistics.median(grants)
 
 
-def _run_tpu_phase(phase: str, timeout: float, env: dict) -> dict:
+def _run_tpu_phase(phase: str, timeout: float, env: dict,
+                   pass_fds=()) -> dict:
     """One phase in its own subprocess; returns its JSON fragment or a
     ``{"error": ...}`` fragment for timeouts / crashes / no-JSON.
 
     Timeout is enforced SIGINT-first: hard-killing a TPU claimant leaves
     a stale remote claim that wedges the tunnel for hours
     (``docs/PERF.md``), so a stuck phase first gets a KeyboardInterrupt
-    and a grace window to unwind its backend before SIGKILL."""
+    and a grace window to unwind its backend before SIGKILL.
+
+    ``pass_fds`` carries the watchdog's locked flock fd down to the
+    child (with ``TPUSLICE_TPU_LOCK_FD`` in ``env``) so the whole
+    probe→phases burst runs under ONE held claim."""
     proc = subprocess.Popen(
         [sys.executable, "-m", "instaslice_tpu.bench_tpu",
          "--phase", phase],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
+        cwd=_HERE,
         env=env,
+        pass_fds=pass_fds,
     )
     try:
         stdout, stderr = proc.communicate(timeout=timeout)
@@ -130,7 +158,7 @@ def _run_tpu_phase(phase: str, timeout: float, env: dict) -> dict:
         return {"error": (
             f"phase exceeded its {timeout:.0f}s cap, stopped via {how} "
             "(chip unreachable, tunnel hung, or compile too slow)"
-        )}
+        ), "timed_out": True}
     out: dict = {}
     parsed = False
     lines = (proc.stdout or b"").decode().strip().splitlines()
@@ -156,51 +184,332 @@ def _run_tpu_phase(phase: str, timeout: float, env: dict) -> dict:
     return out
 
 
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+#: stored phases older than this are dropped at load: the store file is
+#: committed, so without an age gate the NEXT round's bench would fold
+#: last round's numbers while claiming they were "captured earlier in
+#: the round" — and its watchdog would see nothing missing and exit.
+#: A round is ~12 h; 14 h keeps everything from this round only.
+STORE_MAX_AGE_H = float(os.environ.get("TPUSLICE_BENCH_STORE_MAX_AGE_H",
+                                       "14"))
+
+
+def _load_store() -> dict:
+    try:
+        with open(RESULTS_STORE) as f:
+            store = json.load(f)
+        if not (isinstance(store, dict)
+                and isinstance(store.get("phases"), dict)):
+            raise ValueError("not a store")
+    except (OSError, ValueError):
+        return {"phases": {}, "phase_ts": {}}
+    cutoff = (datetime.datetime.now(datetime.timezone.utc)
+              - datetime.timedelta(hours=STORE_MAX_AGE_H))
+    fresh: dict = {"phases": {}, "phase_ts": {}}
+    for phase, frag in store["phases"].items():
+        ts = store.get("phase_ts", {}).get(phase, "")
+        try:
+            when = datetime.datetime.strptime(
+                ts, "%Y-%m-%dT%H:%M:%SZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+        except (TypeError, ValueError):
+            continue      # unstamped/mistyped = untrusted: drop
+        if when >= cutoff:
+            fresh["phases"][phase] = frag
+            fresh["phase_ts"][phase] = ts
+    return fresh
+
+
+def _save_store(store: dict) -> None:
+    """Atomic write: a wedge (or SIGKILL) mid-save must not destroy the
+    phases already captured."""
+    store["updated"] = _utcnow()
+    tmp = RESULTS_STORE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(store, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, RESULTS_STORE)
+
+
+def _journal(event: dict) -> None:
+    """Append one line to the chip-health journal, flushed immediately."""
+    event = {"ts": _utcnow(), **event}
+    with open(HEALTH_JOURNAL, "a") as f:
+        f.write(json.dumps(event) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _tpu_env() -> dict:
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(_HERE, ".jax_cache")
+    )
+    return env
+
+
+def _record_phase(phase: str, frag: dict) -> dict:
+    """Persist one phase fragment with a fresh-load merge: the store is
+    re-read immediately before the write so a fragment another process
+    persisted since our last load is never clobbered by a whole-file
+    rewrite. (Capture bursts hold the host flock, so two writers cannot
+    actually burst concurrently — this guards the load-before-lock and
+    crash-recovery windows.)"""
+    store = _load_store()
+    store["phases"][phase] = frag
+    store["phase_ts"][phase] = _utcnow()
+    _save_store(store)
+    return store
+
+
 def bench_tpu() -> dict:
     """Run each on-chip phase in its own subprocess under its own cap and
     a shared total budget. Fragments merge incrementally; per-phase
     failures land as ``tpu_<phase>_error`` keys so one hung phase cannot
-    forfeit the others' numbers (the round-2 failure mode)."""
-    here = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ)
-    env.setdefault(
-        "JAX_COMPILATION_CACHE_DIR", os.path.join(here, ".jax_cache")
+    forfeit the others' numbers (the round-2 failure mode). Every
+    successful fragment is ALSO persisted to :data:`RESULTS_STORE` the
+    moment it lands, and when the probe finds the chip dead, numbers a
+    watchdog (or an earlier run) already captured this round are folded
+    in from the store — with ``tpu_results_provenance`` naming their
+    capture times — instead of reporting nothing for the fourth round
+    running."""
+    from instaslice_tpu.utils.tpulock import (
+        INHERITED_FD_ENV, TpuBusyError, TpuClaim, tpu_is_cpu_forced,
     )
-    deadline = time.monotonic() + TPU_BENCH_TIMEOUT
+
+    env = _tpu_env()
     out: dict = {}
-    for phase, cap in TPU_PHASES:
-        remaining = deadline - time.monotonic()
-        if remaining < 15:
-            out[f"tpu_{phase}_error"] = (
-                f"skipped: total bench budget ({TPU_BENCH_TIMEOUT:.0f}s) "
-                "exhausted by earlier phases"
-            )
-            continue
-        frag = _run_tpu_phase(phase, min(cap, remaining), env)
-        err = frag.pop("error", None)
-        out.update(frag)
-        if err is not None:
-            err = err or "phase failed with empty error message"
-            out[f"tpu_{phase}_error"] = err
-            print(f"[bench] {phase}: ERROR {err}", file=sys.stderr)
+    claim = None
+    pass_fds = ()
+    if not tpu_is_cpu_forced():
+        # hold the host flock for the WHOLE bench, handing the fd to
+        # each phase child: a looping watchdog can then never slip in
+        # between two phases and burn the bench's budget on lock-busy
+        # errors. If something else (a watchdog mid-burst) holds it,
+        # wait it out — its burst fills the same store we fold from.
+        try:
+            claim = TpuClaim().acquire(timeout=300)
+            env[INHERITED_FD_ENV] = str(claim.fd)
+            pass_fds = (claim.fd,)
+        except TpuBusyError as e:
+            out["tpu_error"] = f"TPU lock busy for 300s: {e}"
+            for phase, _ in TPU_PHASES:
+                out[f"tpu_{phase}_error"] = "skipped: TPU lock busy"
+            _fold_store(out, _load_store())
+            return out
+    try:
+        deadline = time.monotonic() + TPU_BENCH_TIMEOUT
+        for phase, cap in TPU_PHASES:
+            remaining = deadline - time.monotonic()
+            if remaining < 15:
+                out[f"tpu_{phase}_error"] = (
+                    f"skipped: total bench budget "
+                    f"({TPU_BENCH_TIMEOUT:.0f}s) exhausted by earlier "
+                    "phases"
+                )
+                continue
+            frag = _run_tpu_phase(phase, min(cap, remaining), env,
+                                  pass_fds=pass_fds)
+            err = frag.pop("error", None)
+            frag.pop("timed_out", None)
+            out.update(frag)
             if phase == "probe":
-                # the probe exists so a dead/missing chip fails CHEAPLY;
-                # grinding the expensive phases against it would just
-                # drain the budget into guaranteed timeouts
-                out["tpu_error"] = err
-                for rest, _ in TPU_PHASES:
-                    if rest != "probe" and f"tpu_{rest}_error" not in out:
-                        out[f"tpu_{rest}_error"] = (
-                            "skipped: probe failed (chip dead or "
-                            "unreachable)"
-                        )
-                break
-        else:
-            print(f"[bench] {phase}: {json.dumps(frag)}", file=sys.stderr)
+                _journal({
+                    "alive": err is None,
+                    "rtt_ms": frag.get("readback_rtt_ms"),
+                    **({"error": err[:200]} if err else {}),
+                    "source": "bench",
+                })
+            if err is not None:
+                err = err or "phase failed with empty error message"
+                out[f"tpu_{phase}_error"] = err
+                print(f"[bench] {phase}: ERROR {err}", file=sys.stderr)
+                if phase == "probe":
+                    # the probe exists so a dead/missing chip fails
+                    # CHEAPLY; grinding the expensive phases against it
+                    # would drain the budget into guaranteed timeouts
+                    out["tpu_error"] = err
+                    for rest, _ in TPU_PHASES:
+                        if rest != "probe" \
+                                and f"tpu_{rest}_error" not in out:
+                            out[f"tpu_{rest}_error"] = (
+                                "skipped: probe failed (chip dead or "
+                                "unreachable)"
+                            )
+                    break
+            else:
+                print(f"[bench] {phase}: {json.dumps(frag)}",
+                      file=sys.stderr)
+                if frag:
+                    _record_phase(phase, frag)
+    finally:
+        if claim is not None:
+            claim.release()
+    _fold_store(out, _load_store())
     return out
 
 
-def main() -> int:
+def _fold_store(out: dict, store: dict) -> None:
+    """Fill any phase this run did NOT measure live (its
+    ``tpu_<phase>_error`` key is set — probe dead, lock busy, budget
+    exhausted, or a phase-specific failure) from the store, when the
+    chip answered earlier in the round: ship what was actually
+    captured, with provenance naming each phase's capture time. Phases
+    measured live this run have no error key and are never touched."""
+    recovered = []
+    for phase, frag in store["phases"].items():
+        if f"tpu_{phase}_error" not in out:
+            continue              # measured live this run: keep that
+        out.update(frag)
+        out.pop(f"tpu_{phase}_error", None)
+        recovered.append(f"{phase}@{store['phase_ts'].get(phase, '?')}")
+    if recovered:
+        out["tpu_results_provenance"] = (
+            "phases not measurable at bench time were filled from "
+            "captures made live earlier in the round (watchdog or a "
+            "previous run — see TPU_HEALTH.jsonl for the chip-health "
+            "timeline): " + ", ".join(sorted(recovered))
+        )
+
+
+#: watchdog phase priority — what a SHORT recovery window should record
+#: first: proof-of-life + RTT, the kernel headline, the 7B serving
+#: headline, the training headline, then the rest.
+WATCHDOG_PRIORITY = [
+    "probe", "flash_fwd", "serving_7b", "mfu", "flash_bwd", "serving",
+    "serving_quant", "serving_spec", "serving_small", "serving_tp",
+]
+_PHASE_CAPS = dict(TPU_PHASES)
+
+
+def watchdog(interval: float, max_hours: float, once: bool) -> int:
+    """Wait out a wedged tunnel cheaply; capture greedily on recovery.
+
+    Loop: take the host-wide flock, fire the short-cap probe subprocess
+    (co-holding the claim via the inherited locked fd), journal
+    ``{ts, alive, rtt_ms}``; when the chip answers, run the remaining
+    phases in :data:`WATCHDOG_PRIORITY` order, persisting each fragment
+    to :data:`RESULTS_STORE` as it lands — a wedge mid-burst keeps
+    everything already measured, and the next recovery window resumes
+    with the phases still missing. The flock is held only for the
+    burst, then released for the sleep, so a driver-launched
+    ``python bench.py`` never finds the chip "busy" because of a
+    sleeping watchdog. Exits 0 once every phase has a stored fragment
+    (or after one cycle with ``once``); exits 3 when ``max_hours``
+    elapse with phases still missing."""
+    from instaslice_tpu.utils.tpulock import (
+        INHERITED_FD_ENV, TpuBusyError, TpuClaim,
+    )
+
+    env = _tpu_env()
+    deadline = time.monotonic() + max_hours * 3600
+
+    def _missing() -> list:
+        phases = _load_store()["phases"]
+        return [p for p in WATCHDOG_PRIORITY
+                if p != "probe" and p not in phases]
+
+    while True:
+        if not _missing():
+            print("[watchdog] all phases captured; exiting",
+                  file=sys.stderr)
+            return 0
+        claim = None
+        try:
+            try:
+                claim = TpuClaim().acquire(timeout=10)
+            except TpuBusyError as e:
+                # a real claimant (e.g. the driver's bench) is on the
+                # chip — that is itself proof of life worth journaling
+                _journal({"alive": None, "source": "watchdog",
+                          "error": f"lock busy: {e}"})
+                raise
+            env[INHERITED_FD_ENV] = str(claim.fd)
+            frag = _run_tpu_phase("probe", _PHASE_CAPS["probe"], env,
+                                  pass_fds=(claim.fd,))
+            err = frag.get("error")
+            _journal({
+                "alive": err is None,
+                "rtt_ms": frag.get("readback_rtt_ms"),
+                **({"error": err[:200]} if err else {}),
+                "source": "watchdog",
+            })
+            if err is None:
+                _record_phase("probe", {
+                    k: v for k, v in frag.items()
+                    if k not in ("error", "timed_out")
+                })
+                # re-list under the held lock: another capture path may
+                # have landed phases since the top-of-loop check
+                missing = _missing()
+                print(f"[watchdog] chip ALIVE "
+                      f"(rtt {frag.get('readback_rtt_ms')} ms); "
+                      f"capturing {len(missing)} missing phases",
+                      file=sys.stderr)
+                for phase in missing:
+                    frag = _run_tpu_phase(
+                        phase, _PHASE_CAPS[phase], env,
+                        pass_fds=(claim.fd,),
+                    )
+                    err = frag.pop("error", None)
+                    if err is not None:
+                        _journal({"phase": phase, "error": err[:200],
+                                  "source": "watchdog"})
+                        print(f"[watchdog] {phase}: ERROR {err}",
+                              file=sys.stderr)
+                        if frag.get("timed_out"):
+                            break     # mid-burst wedge: back to probing
+                        continue      # phase-specific failure: next one
+                    _record_phase(phase, frag)
+                    _journal({"phase": phase, "captured": True,
+                              "source": "watchdog"})
+                    print(f"[watchdog] {phase}: {json.dumps(frag)}",
+                          file=sys.stderr)
+        except TpuBusyError:
+            pass
+        finally:
+            if claim is not None:
+                env.pop(INHERITED_FD_ENV, None)
+                claim.release()
+        if not _missing():
+            # completion beats the deadline/sleep: a burst that just
+            # captured the last phase must exit 0 NOW, not sleep an
+            # interval (or worse, hit the deadline and report failure)
+            print("[watchdog] all phases captured; exiting",
+                  file=sys.stderr)
+            return 0
+        if once:
+            return 0
+        if time.monotonic() >= deadline:
+            print("[watchdog] max-hours elapsed; exiting", file=sys.stderr)
+            return 3
+        time.sleep(interval)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench.py",
+        description="control-plane + on-chip bench; --watchdog waits out "
+        "a wedged TPU tunnel and captures phases on recovery",
+    )
+    ap.add_argument("--watchdog", action="store_true",
+                    help="run the chip-health watchdog loop instead of "
+                    "the one-shot bench")
+    ap.add_argument("--interval", type=float, default=900.0,
+                    help="watchdog: seconds between probes (default 900)")
+    ap.add_argument("--max-hours", type=float, default=11.0,
+                    help="watchdog: give up after this long")
+    ap.add_argument("--once", action="store_true",
+                    help="watchdog: one probe cycle, then exit")
+    args = ap.parse_args(argv)
+    if args.watchdog:
+        return watchdog(args.interval, args.max_hours, args.once)
+
     try:
         p50 = bench_control_plane()
     except Exception as e:

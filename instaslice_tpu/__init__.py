@@ -16,7 +16,7 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 2. ``api``        — the ``TpuSlice`` CR data model + state machine
                     (``api/v1alpha1/instaslice_types.go:23-102`` analog).
 3. ``device``     — device layer: fake TPU backend for CI, C++ libtpuslice
-                    via ctypes, sysfs/Cloud-TPU backends (go-nvml analog).
+                    via ctypes, fake/Cloud-TPU backends (go-nvml analog).
 4. ``agent``      — per-node agent realizing allocations on hardware
                     (``instaslice_daemonset.go`` analog).
 5. ``controller`` — cluster controller gating/allocating/ungating pods

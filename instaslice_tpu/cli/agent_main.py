@@ -17,7 +17,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="this node's name (downward API NODE_NAME)")
     p.add_argument("--namespace", default="instaslice-tpu-system")
     p.add_argument("--backend", default="auto",
-                   help="device backend: auto|fake|native|sysfs")
+                   choices=["auto", "fake", "native", "cloudtpu"],
+                   help="device backend (see instaslice_tpu.device.select)")
     p.add_argument("--metrics-bind-address", default=":8084")
     p.add_argument("--health-probe-bind-address", default=":8085")
     p.add_argument("--kubeconfig", default="")

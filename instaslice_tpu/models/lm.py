@@ -64,7 +64,11 @@ class ModelConfig:
     # over the "model" axis (expert parallelism). Tokens route to their
     # expert_top_k experts, each expert bounded by a capacity of
     # capacity_factor · k · S / E tokens (GShard semantics: overflow
-    # falls through the residual).
+    # falls through the residual). Activation-memory note: the one-hot
+    # dispatch/combine tensors are (B, S·k, E, C) in the compute dtype,
+    # i.e. ≈ 2·B·S·k·E·C·itemsize bytes live per MoE layer — scale
+    # n_experts / expert_capacity_factor with that in mind (at
+    # B8 S2048 k2 E16 bf16 that is ~270 MB per layer).
     n_experts: int = 0
     expert_top_k: int = 2
     expert_capacity_factor: float = 1.25
@@ -407,18 +411,23 @@ def _moe_mlp(x, router_w, w_in, w_out, top_k: int = 2,
     # position of each (token, choice) in its expert's buffer
     pos_e = jnp.cumsum(sel, axis=1) - sel         # (B,N,E)
     pos = jnp.einsum("bne,bne->bn", pos_e, sel).astype(jnp.int32)
-    # dispatch one-hot (B,N,E,C); over-capacity rows are all-zero by
-    # one_hot's out-of-range semantics — that IS the overflow drop
-    disp = sel[:, :, :, None] * (
-        jax.nn.one_hot(pos, C, dtype=jnp.float32)[:, :, None, :]
+    # dispatch one-hot (B,N,E,C) in the COMPUTE dtype: 0/1 (and the
+    # renormalized gates) are what these tensors hold, and at training
+    # shapes (B8 S2048 k2 E16) the fp32 version is a multi-hundred-MB
+    # per-layer intermediate that dominates MoE activation memory —
+    # bf16 halves it with no effect on the 0/1 structure. Over-capacity
+    # rows are all-zero by one_hot's out-of-range semantics — that IS
+    # the overflow drop.
+    disp = sel.astype(x.dtype)[:, :, :, None] * (
+        jax.nn.one_hot(pos, C, dtype=x.dtype)[:, :, None, :]
     )
-    comb = disp * topv.reshape(B, N)[:, :, None, None]
+    comb = disp * topv.reshape(B, N)[:, :, None, None].astype(x.dtype)
     # contract over (s, choice) against the ORIGINAL x — reshaping the
     # dispatch instead of repeating the activations k× (a repeated
     # (B,N,D) tensor is a ~half-GB operand at serving scale)
     expert_in = jnp.einsum(
         "bskec,bsd->becd",
-        disp.reshape(B, S, k, E, C).astype(x.dtype), x,
+        disp.reshape(B, S, k, E, C), x,
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)                             # (B,E,C,D)
     h = jnp.einsum("becd,edf->becf", expert_in, w_in,
@@ -428,7 +437,7 @@ def _moe_mlp(x, router_w, w_in, w_out, top_k: int = 2,
                      preferred_element_type=jnp.float32).astype(x.dtype)
     y = jnp.einsum(
         "bskec,becd->bsd",
-        comb.reshape(B, S, k, E, C).astype(x.dtype), y_e,
+        comb.reshape(B, S, k, E, C), y_e,
     )
     # load balance: differentiable through P_e (mean gate), with f_e
     # (the argmax fraction) acting as the per-expert pressure signal

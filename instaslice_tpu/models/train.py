@@ -10,6 +10,7 @@ state, bf16 compute — the standard TPU mixed-precision recipe.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -118,6 +119,20 @@ def loss_fn(
     chunked = loss_chunk > 0 and not model.cfg.ring_attention
     want_aux = bool(model.cfg.n_experts) and moe_aux_weight > 0 \
         and not n_micro
+    if bool(model.cfg.n_experts) and moe_aux_weight > 0 and n_micro:
+        # Silent router collapse is worse than a noisy run: without the
+        # aux term top-k routing degenerates and capacity drops eat the
+        # batch with no loss-curve signal. Pipelined MoE training should
+        # set moe_aux_weight=0 explicitly (acknowledging the risk) until
+        # apply_pipelined threads aux through its stages.
+        warnings.warn(
+            "MoE + pipeline parallelism (n_micro > 0) drops the router "
+            "load-balance aux loss: apply_pipelined does not return aux. "
+            "The router can silently collapse. Set moe_aux_weight=0 to "
+            "acknowledge, or train this config without the pipeline.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     aux = 0.0
     if n_micro:
         if mesh is None:

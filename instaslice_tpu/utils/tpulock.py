@@ -40,10 +40,46 @@ import time
 from typing import Optional
 
 __all__ = ["TpuBusyError", "TpuClaim", "claim_or_force_cpu", "claim_tpu",
-           "force_cpu_in_process", "tpu_is_cpu_forced"]
+           "force_cpu_in_process", "inherited_claim", "tpu_is_cpu_forced",
+           "INHERITED_FD_ENV"]
 
-#: override with TPUSLICE_TPU_LOCK; shared by every claimant on the host.
-DEFAULT_LOCK_PATH = os.path.join(tempfile.gettempdir(), "tpuslice.tpu.lock")
+#: a parent already holding the flock hands it to a child subprocess by
+#: exporting the locked fd number here (plus ``pass_fds``): flock lives
+#: on the open file description, which survives exec, so the child is a
+#: genuine co-holder — no second acquire, no self-deadlock.
+INHERITED_FD_ENV = "TPUSLICE_TPU_LOCK_FD"
+
+#: root-provisioned lock directory; when it exists, all uids share one
+#: lock file there (true host-wide exclusion across users).
+RUN_LOCK_DIR = "/run/tpuslice"
+
+
+def _default_lock_path() -> str:
+    """Prefer a root-provisioned ``/run/tpuslice`` (host-wide across
+    uids); otherwise a per-uid file in tempdir. A world-writable file at
+    a fixed /tmp path would let any local user pre-create or hold it and
+    deny TPU access to everyone (advisory-lock DoS), so the fallback is
+    per-uid and 0600 with an ownership check at acquire.
+
+    THE PER-UID FALLBACK ASSUMES A SINGLE-OPERATOR HOST: two uids
+    running claimants without ``/run/tpuslice`` get two disjoint lock
+    files — i.e. two simultaneous tunnel claimants, the wedge this
+    module exists to prevent. Multi-user hosts MUST either provision
+    ``/run/tpuslice`` (root: ``install -d -m 1777 /run/tpuslice``) or
+    point every claimant at one shared path via ``TPUSLICE_TPU_LOCK``
+    (the escape hatch — the env override skips the ownership check's
+    same-uid requirement only if the file's owner provisioned it
+    group/world-accessible themselves)."""
+    if os.path.isdir(RUN_LOCK_DIR):
+        # No writability probe: a uid that cannot open the lock there
+        # must FAIL at acquire (loudly), not silently fall back to a
+        # per-uid file — that would split the claim domain and allow
+        # two simultaneous tunnel claimants, the exact wedge this
+        # module exists to prevent.
+        return os.path.join(RUN_LOCK_DIR, "tpu.lock")
+    return os.path.join(
+        tempfile.gettempdir(), f"tpuslice.tpu.{os.getuid()}.lock"
+    )
 
 #: how long a claimant waits for the current holder before giving up.
 DEFAULT_TIMEOUT = float(os.environ.get("TPUSLICE_TPU_LOCK_TIMEOUT", "30"))
@@ -77,10 +113,15 @@ class TpuClaim:
     :meth:`release` (or process death — flock releases with the fd)."""
 
     def __init__(self, path: Optional[str] = None):
-        self.path = path or os.environ.get(
-            "TPUSLICE_TPU_LOCK", DEFAULT_LOCK_PATH
+        env_path = os.environ.get("TPUSLICE_TPU_LOCK", "")
+        self.path = path or env_path or _default_lock_path()
+        #: ownership check applies only to the implicit per-uid default;
+        #: explicit paths (arg or env) are the caller's claim domain.
+        self._check_owner = not (path or env_path) and not self.path.startswith(
+            RUN_LOCK_DIR + os.sep
         )
         self._fd: Optional[int] = None
+        self._inherited = False
 
     @property
     def held(self) -> bool:
@@ -107,15 +148,30 @@ class TpuClaim:
         # O_RDWR (not O_APPEND/O_TRUNC): the file must exist and be
         # openable by ALL claimants before any of them holds the lock,
         # and only the holder may rewrite the holder note.
-        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o666)
-        try:
-            # umask cuts the create mode (022 → 0o644): re-chmod so a
-            # claimant under another uid gets TpuBusyError, not
-            # PermissionError at open. Fails when we're not the owner —
-            # then the owner already ran this chmod.
-            os.fchmod(fd, 0o666)
-        except OSError:
-            pass
+        mode = 0o600 if self._check_owner else 0o666
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, mode)
+        if self._check_owner:
+            # per-uid default path: a file someone else planted there is
+            # a denial, not a peer — refuse rather than contend on it.
+            st = os.fstat(fd)
+            if st.st_uid != os.getuid():
+                os.close(fd)
+                raise TpuBusyError(
+                    f"lock file {self.path} is owned by uid {st.st_uid}, "
+                    f"not us (uid {os.getuid()}); refusing to contend on "
+                    "a planted lock — remove it or set TPUSLICE_TPU_LOCK "
+                    "to a shared path all claimants agree on"
+                )
+        else:
+            try:
+                # shared-path mode (/run/tpuslice or explicit override):
+                # umask cuts the create mode (022 → 0o644); re-chmod so
+                # a claimant under another uid gets TpuBusyError, not
+                # PermissionError at open. Fails when we're not the
+                # owner — then the owner already ran this chmod.
+                os.fchmod(fd, 0o666)
+            except OSError:
+                pass
         deadline = time.monotonic() + timeout
         while True:
             try:
@@ -146,10 +202,26 @@ class TpuClaim:
         self._fd = fd
         return self
 
+    @property
+    def fd(self) -> int:
+        """The locked fd, for handing to a child via ``pass_fds`` +
+        :data:`INHERITED_FD_ENV`. Raises if the claim is not held."""
+        if self._fd is None:
+            raise RuntimeError("claim not held; no fd to inherit")
+        return self._fd
+
     def release(self) -> None:
         """Drop the claim. The file itself is never unlinked (see module
-        docstring); the flock vanishes with the fd."""
+        docstring); the flock vanishes with the fd.
+
+        An INHERITED claim only closes its fd copy: LOCK_UN here would
+        release the shared open file description's lock out from under
+        the parent that handed it down."""
         if self._fd is None:
+            return
+        if self._inherited:
+            os.close(self._fd)
+            self._fd = None
             return
         try:
             os.ftruncate(self._fd, 0)
@@ -168,6 +240,41 @@ class TpuClaim:
         self.release()
 
 
+def inherited_claim(path: Optional[str] = None) -> Optional[TpuClaim]:
+    """The claim a parent watchdog handed down via
+    :data:`INHERITED_FD_ENV` + ``pass_fds``, or ``None``. The fd shares
+    the parent's open file description, so the flock is already held —
+    acquiring again would self-deadlock (flock is per-description, and a
+    fresh ``open`` of the same path makes a NEW description that blocks
+    on the parent's). A stale or closed fd number falls through to
+    ``None`` so the caller does a normal acquire.
+
+    An explicit ``path`` is honored: the inherited fd only counts when
+    it IS that file (inode match) — a caller locking some other claim
+    domain must never be handed the TPU lock instead."""
+    raw = os.environ.get(INHERITED_FD_ENV, "")
+    if not raw:
+        return None
+    path = path or os.environ.get("TPUSLICE_TPU_LOCK", "") \
+        or _default_lock_path()
+    try:
+        fd = int(raw)
+        fst = os.fstat(fd)
+        pst = os.stat(path)
+        # the fd must BE the lock file (same inode), not whatever else
+        # happens to be open at that number in this process
+        if (fst.st_dev, fst.st_ino) != (pst.st_dev, pst.st_ino):
+            return None
+    except (ValueError, OSError):
+        return None
+    c = TpuClaim.__new__(TpuClaim)
+    c.path = path
+    c._check_owner = False
+    c._fd = fd
+    c._inherited = True
+    return c
+
+
 def claim_tpu(timeout: Optional[float] = None,
               path: Optional[str] = None) -> Optional[TpuClaim]:
     """Acquire the host-wide TPU claim unless this process is CPU-forced
@@ -176,6 +283,9 @@ def claim_tpu(timeout: Optional[float] = None,
     initialization can reach the tunnel."""
     if tpu_is_cpu_forced():
         return None
+    ih = inherited_claim(path)
+    if ih is not None:
+        return ih
     return TpuClaim(path).acquire(timeout=timeout)
 
 
@@ -196,4 +306,7 @@ def claim_or_force_cpu(timeout: Optional[float] = None,
     if force_cpu or tpu_is_cpu_forced():
         force_cpu_in_process()
         return None
+    ih = inherited_claim()
+    if ih is not None:
+        return ih
     return TpuClaim().acquire(timeout=timeout)

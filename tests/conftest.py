@@ -44,6 +44,11 @@ SLOW_MODULES = {
     "test_ops",           # pallas kernel (interpret mode) sweeps
     "test_bench_tpu",     # chained-timing harness units
     "test_quant",         # int8 quantization sweeps
+    "test_gqa",           # GQA attention compiles across the stack
+    "test_window",        # sliding-window attention + banded cache reads
+    "test_sampling_extras",  # repetition-penalty / min-p sampling compiles
+    "test_data",          # mmap dataset + training-input pipelines
+    "test_tpulock",       # cross-process holder spawn/kill round-trips
 }
 
 

@@ -335,3 +335,16 @@ class TestElectionFencing:
         finally:
             a._stop.set()
             b.release()
+
+
+class TestAgentMainArgs:
+    def test_backend_choices_reject_bad_kind(self):
+        from instaslice_tpu.cli.agent_main import build_parser
+
+        p = build_parser()
+        with pytest.raises(SystemExit):
+            p.parse_args(["--node-name", "n0", "--backend", "sysfs"])
+        for kind in ("auto", "fake", "native", "cloudtpu"):
+            assert p.parse_args(
+                ["--node-name", "n0", "--backend", kind]
+            ).backend == kind

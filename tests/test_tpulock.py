@@ -153,3 +153,87 @@ def test_lock_file_survives_release(tmp_path):
     lock = str(tmp_path / "tpu.lock")
     TpuClaim(lock).acquire(timeout=0).release()
     assert os.path.exists(lock)
+
+
+def test_default_path_refuses_planted_lock(monkeypatch, tmp_path):
+    """The implicit per-uid default must not contend on a file planted by
+    another uid (advisory-lock DoS surface) — it refuses with a clear
+    error instead. Explicit paths skip the check: they are the caller's
+    declared claim domain."""
+    import instaslice_tpu.utils.tpulock as tl
+
+    planted = tmp_path / "tpu.lock"
+    planted.touch()
+    if os.getuid() != 0:
+        pytest.skip("needs root to chown a planted lock file")
+    os.chown(planted, 1234, 1234)
+    monkeypatch.setattr(tl, "_default_lock_path", lambda: str(planted))
+    monkeypatch.delenv("TPUSLICE_TPU_LOCK", raising=False)
+    with pytest.raises(TpuBusyError, match="planted"):
+        TpuClaim().acquire(timeout=0)
+    # explicit path: contends normally (and wins, nobody holds it)
+    TpuClaim(str(planted)).acquire(timeout=0).release()
+
+
+INHERIT_CHILD = r"""
+import os, sys
+from instaslice_tpu.utils.tpulock import claim_tpu, TpuClaim, TpuBusyError
+claim = claim_tpu(timeout=0)
+assert claim is not None and claim.held, "inherited claim not recognized"
+assert claim._inherited, "should have taken the inherited-fd path"
+# an INDEPENDENT open of the same path must still see the flock held
+try:
+    TpuClaim(os.environ["TPUSLICE_TPU_LOCK"]).acquire(timeout=0)
+    print("INDEPENDENT-ACQUIRED")          # would be a bug
+except TpuBusyError:
+    print("INDEPENDENT-BLOCKED")
+claim.release()                            # closes the fd copy only
+print("CHILD-OK")
+"""
+
+
+def test_inherited_claim_shares_parent_flock(tmp_path):
+    """A child handed the locked fd (watchdog burst pattern) co-holds the
+    claim: it does not re-acquire (which would self-deadlock), an
+    independent claimant stays blocked, and the child's release must NOT
+    drop the parent's lock (flock is per open-file-description)."""
+    from instaslice_tpu.utils.tpulock import INHERITED_FD_ENV
+
+    lock = str(tmp_path / "tpu.lock")
+    parent = TpuClaim(lock).acquire(timeout=0)
+    try:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)  # cpu-forced skips claims entirely
+        env["TPUSLICE_TPU_LOCK"] = lock
+        env[INHERITED_FD_ENV] = str(parent.fd)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + env.get("PYTHONPATH", "").split(os.pathsep)
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", INHERIT_CHILD],
+            env=env, pass_fds=(parent.fd,),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "INDEPENDENT-BLOCKED" in out.stdout
+        assert "CHILD-OK" in out.stdout
+        # child exited (fd copy closed) — the parent must STILL hold it
+        with pytest.raises(TpuBusyError):
+            TpuClaim(lock).acquire(timeout=0)
+    finally:
+        parent.release()
+    TpuClaim(lock).acquire(timeout=0).release()   # now free
+
+
+def test_stale_inherited_fd_falls_through(monkeypatch, tmp_path):
+    """A stale/closed TPUSLICE_TPU_LOCK_FD must not be trusted: claim
+    falls through to a normal acquire."""
+    from instaslice_tpu.utils import tpulock as tl
+
+    lock = str(tmp_path / "tpu.lock")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv(tl.INHERITED_FD_ENV, "963")  # nothing open there
+    c = tl.claim_tpu(timeout=0, path=lock)
+    assert c is not None and c.held and not c._inherited
+    c.release()

@@ -5,6 +5,8 @@ for a granted multi-chip slice (SURVEY.md §4 "BASELINE.json configs[0]
 ... CPU emulator OK").
 """
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -222,6 +224,29 @@ class TestModel:
         )(params)["blocks"]["router"]
         assert float(jnp.abs(g).max()) > 0.0
 
+    def test_moe_pipeline_drops_aux_warns(self):
+        """MoE + pipeline silently loses the load-balance aux term
+        (apply_pipelined has no aux path) — that must be NOISY, not a
+        docstring footnote: the router can collapse with no loss-curve
+        signal."""
+        from instaslice_tpu.models.train import loss_fn
+
+        model = TpuLM(tiny(experts=4))
+        params = model.init(jax.random.key(0))
+        toks = jax.random.randint(jax.random.key(1), (2, 8), 0, 128)
+        with pytest.warns(RuntimeWarning, match="load-balance aux"):
+            with pytest.raises(ValueError, match="pipe"):
+                # mesh=None keeps the test cheap: the warning fires
+                # before the mesh requirement is enforced
+                loss_fn(model, params, toks, n_micro=2,
+                        moe_aux_weight=0.01)
+        # explicit opt-out (moe_aux_weight=0) stays silent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            with pytest.raises(ValueError, match="pipe"):
+                loss_fn(model, params, toks, n_micro=2,
+                        moe_aux_weight=0.0)
+
     def test_param_specs_cover_params(self):
         cfg = tiny(experts=2)
         model = TpuLM(cfg)
@@ -389,13 +414,13 @@ class TestGraftEntry:
         ge.dryrun_multichip(8)
 
 
-class TestWorkloadCompatShim:
-    def test_old_import_paths_still_work(self):
-        from instaslice_tpu.workload import ModelConfig as MC1
-        from instaslice_tpu.workload.model import ModelConfig as MC2
-        from instaslice_tpu.workload.meshenv import slice_mesh as sm
-        from instaslice_tpu.workload.ring import ring_attention as ra
-        from instaslice_tpu.models.lm import ModelConfig as MC3
+class TestWorkloadImports:
+    def test_canonical_import_paths(self):
+        from instaslice_tpu.models.lm import ModelConfig
+        from instaslice_tpu.models.train import make_train_step
+        from instaslice_tpu.parallel.meshenv import slice_mesh
+        from instaslice_tpu.parallel.ring import ring_attention
 
-        assert MC1 is MC2 is MC3
-        assert callable(sm) and callable(ra)
+        assert callable(slice_mesh) and callable(ring_attention)
+        assert callable(make_train_step)
+        assert ModelConfig is not None
