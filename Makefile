@@ -47,6 +47,10 @@ test-all:  ## Everything, incl. jax-workload + multi-process tiers (~19 min)
 test-e2e:  ## Full in-process cluster lifecycle tier
 	$(PY) -m pytest tests/test_e2e_lifecycle.py -q
 
+.PHONY: test-e2e-kind
+test-e2e-kind:  ## Real-cluster e2e on KinD (skips cleanly without docker/kind)
+	./deploy/e2e_kind.sh
+
 .PHONY: bench
 bench:  ## Headline benchmark: slice-grant p50 latency (one JSON line)
 	$(PY) bench.py

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# One-command KinD e2e (reference analog: test/e2e/e2e_test.go:32-122 +
+# test/utils/utils.go:42-116 — create cluster, deploy operator, apply a
+# workload, poll it to Running): bootstrap the cluster via
+# deploy/setup.sh kind, apply the gated sample pod, assert the grant
+# (scheduling gate removed → Running, handoff ConfigMap published),
+# then delete the pod.
+#
+# SKIPS CLEANLY (exit 0, "SKIP:" on stdout) when the host has no
+# docker/kind/kubectl or no running docker daemon, so `make
+# test-e2e-kind` is safe in any CI; the run path is ready the day a
+# cluster-capable host appears.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+POD=jax-devicecount-smoke     # samples/test-pod.yaml
+TIMEOUT="${TIMEOUT:-180}"
+
+for tool in docker kind kubectl; do
+  if ! command -v "$tool" >/dev/null 2>&1; then
+    echo "SKIP: $tool not installed (kind e2e needs docker + kind + kubectl)"
+    exit 0
+  fi
+done
+if ! docker info >/dev/null 2>&1; then
+  echo "SKIP: docker daemon not reachable"
+  exit 0
+fi
+
+./deploy/setup.sh kind
+
+kubectl apply -f samples/test-pod.yaml
+trap 'kubectl delete -f samples/test-pod.yaml --ignore-not-found --wait=false' EXIT
+
+phase=""
+deadline=$((SECONDS + TIMEOUT))
+while [ "$SECONDS" -lt "$deadline" ]; do
+  phase=$(kubectl get pod "$POD" -o jsonpath='{.status.phase}' 2>/dev/null || true)
+  [ "$phase" = "Running" ] && break
+  sleep 2
+done
+if [ "$phase" != "Running" ]; then
+  echo "FAIL: pod $POD never reached Running (phase=${phase:-none})"
+  kubectl describe pod "$POD" || true
+  kubectl -n instaslice-tpu-system logs deploy/instaslice-tpu-controller-manager --tail=50 || true
+  exit 1
+fi
+
+chips=$(kubectl get configmap "$POD" -o jsonpath='{.data.TPU_VISIBLE_CHIPS}' 2>/dev/null || true)
+if [ -z "$chips" ]; then
+  echo "FAIL: handoff ConfigMap $POD missing TPU_VISIBLE_CHIPS"
+  exit 1
+fi
+
+echo "PASS: kind e2e — pod Running with TPU_VISIBLE_CHIPS=$chips"

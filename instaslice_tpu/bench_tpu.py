@@ -40,7 +40,7 @@ import time
 PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 PHASES = ("probe", "flash_fwd", "flash_bwd", "serving_small", "serving",
-          "serving_quant", "serving_spec", "serving_7b", "mfu",
+          "serving_quant", "serving_spec", "serving_7b", "mfu", "moe",
           "serving_tp")
 
 
@@ -755,6 +755,76 @@ def bench_train_mfu(out: dict, generation: str) -> None:
     out["train_loss_finite"] = ev["loss_finite"]
 
 
+def bench_moe(out: dict, *, d_model: int = 2048, n_heads: int = 16,
+              n_layers: int = 8, dense_ff: int = 8192, n_experts: int = 8,
+              top_k: int = 2, batch: int = 8, seq: int = 1024,
+              vocab: int = 8192, chain_budget_s: float = 60.0) -> None:
+    """GShard dispatch/combine overhead vs the dense MLP at MATCHED
+    active FLOPs (``models/lm.py:_moe_mlp`` — the one model feature
+    with no perf evidence until this phase).
+
+    Per-expert ``d_ff = dense_ff / top_k``, so each token's top-k
+    experts together do exactly the dense MLP's FF work; attention,
+    embedding, and every other FLOP are identical between the two
+    models. The measured per-step delta is therefore the cost of the
+    MoE machinery itself: router softmax/top-k, the (B, S·k, E, C)
+    one-hot dispatch/combine einsums, and the capacity bookkeeping.
+
+    Timing uses the chained-forward trick: step = apply → argmax →
+    tokens maps (B, S) int tokens to (B, S) int tokens with a true
+    data dependence, so :func:`_chained_per_call`'s RTT-guarded chain
+    applies to a forward pass, not just x→x math. Keyword shape
+    arguments exist so the test tier can run the whole phase on the
+    CPU path with tiny dims."""
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+
+    if dense_ff % top_k:
+        raise ValueError("dense_ff must divide by top_k for FLOP parity")
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    tokens0 = jax.random.randint(
+        jax.random.key(11), (batch, seq), 0, vocab
+    )
+    times: dict = {}
+    for kind in ("dense", "moe"):
+        cfg = ModelConfig(
+            vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+            n_layers=n_layers,
+            d_ff=dense_ff if kind == "dense" else dense_ff // top_k,
+            max_seq_len=seq, dtype=dtype, remat=False,
+            n_experts=0 if kind == "dense" else n_experts,
+            expert_top_k=top_k,
+        )
+        model = TpuLM(cfg)
+        params = model.init(jax.random.key(12))
+
+        def step(toks, _model=model, _params=params):
+            # default-arg binding: each kind's step closes over ITS
+            # model/params, not the loop's last iteration
+            logits = _model.apply(_params, toks)
+            return jnp.argmax(logits, -1).astype(toks.dtype)
+
+        stats: dict = {}
+        t = _chained_per_call(step, tokens0, n=2, stats=stats,
+                              budget_s=chain_budget_s)
+        times[kind] = t
+        out[f"moe_bench_{kind}_fwd_seconds"] = round(t, 5)
+        out[f"moe_bench_{kind}_fwd_seconds_timing"] = dict(stats)
+    # the two models run identical active FLOPs by construction, so the
+    # ratio is pure dispatch machinery
+    out["moe_bench_overhead_pct"] = round(
+        100.0 * (times["moe"] - times["dense"]) / times["dense"], 1
+    )
+    out["moe_bench_config"] = (
+        f"L{n_layers} d{d_model} ff{dense_ff} B{batch} S{seq} vs "
+        f"E{n_experts} top{top_k} expert_ff{dense_ff // top_k} "
+        "(matched active FLOPs)"
+    )
+
+
 def _enable_compile_cache() -> None:
     """Persistent compile cache shared across phase subprocesses (and
     bench re-runs): first compiles are 20-40 s each, cached reloads are
@@ -792,6 +862,8 @@ def run_phase(phase: str, out: dict) -> None:
         bench_serving_7b(out)
     elif phase == "mfu":
         bench_train_mfu(out, gen)
+    elif phase == "moe":
+        bench_moe(out)
     elif phase == "serving_tp":
         bench_serving_tp(out)
     else:

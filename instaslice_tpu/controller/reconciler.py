@@ -88,6 +88,14 @@ class Controller:
         self.metrics = metrics
         self._pending_lock = threading.Lock()
         self._pending: set = set()
+        #: pod_uid → {node: monotonic deadline}: nodes whose device
+        #: layer just failed this pod's allocation. The retry placement
+        #: avoids them (falling back to ANY capacity when nothing else
+        #: fits — a single-node cluster must still retry in place), so
+        #: a node with a persistently failing device API cannot capture
+        #: a pod in a fail→re-place-same-node loop.
+        self._failed_nodes: Dict[str, Dict[str, float]] = {}
+        self.failed_node_avoid_seconds = 120.0
         self.manager = Manager(
             name="controller",
             client=client,
@@ -321,6 +329,32 @@ class Controller:
                     "allocation %s failed (%s); tearing down for retry",
                     alloc.alloc_id, alloc.message,
                 )
+                # only the node(s) whose OWN CR copy reports FAILED are
+                # at fault — a healthy peer of a multi-host allocation
+                # must stay placeable or the retry can be squeezed back
+                # onto the failing node
+                failing = {
+                    ts.name
+                    for ts in holders
+                    for a in ts.spec.allocations.values()
+                    if a.alloc_id == alloc.alloc_id
+                    and a.status == AllocationStatus.FAILED
+                } or set(alloc.parts)
+                now = time.monotonic()
+                deadline = now + self.failed_node_avoid_seconds
+                for ref in alloc.pods:
+                    avoid = self._failed_nodes.setdefault(ref.pod_uuid, {})
+                    for node in failing:
+                        avoid[node] = deadline
+                # global prune on write: uids that never re-place again
+                # must not pin expired entries forever
+                for uid in list(self._failed_nodes):
+                    live = {n: dl for n, dl
+                            in self._failed_nodes[uid].items() if dl > now}
+                    if live:
+                        self._failed_nodes[uid] = live
+                    else:
+                        del self._failed_nodes[uid]
                 self._mark_deleted(alloc)
                 return 0.5
             if alloc.status == AllocationStatus.UNGATED:
@@ -408,7 +442,13 @@ class Controller:
             )
             return None
 
-        placement = self._place(profile, slices)
+        avoid = self._avoid_nodes_for(pod_uid)
+        placement = self._place(profile, slices, avoid=avoid)
+        if placement is None and avoid:
+            # nothing fits elsewhere — the failed node may be the only
+            # capacity (single-node cluster): retry in place rather
+            # than starving the pod
+            placement = self._place(profile, slices)
         if placement is None:
             self._set_pending(self._pod_key(pod), True)
             return self.no_capacity_requeue
@@ -466,8 +506,26 @@ class Controller:
                 peers.append(p)
         return sorted(peers, key=lambda p: p["metadata"]["name"])
 
+    def _avoid_nodes_for(self, pod_uid: str) -> frozenset:
+        """Nodes whose device layer recently failed this pod's
+        allocation (entries expire after ``failed_node_avoid_seconds``,
+        pruned here)."""
+        avoid = self._failed_nodes.get(pod_uid)
+        if not avoid:
+            return frozenset()
+        now = time.monotonic()
+        live = {n for n, dl in avoid.items() if dl > now}
+        if not live:
+            del self._failed_nodes[pod_uid]
+            return frozenset()
+        self._failed_nodes[pod_uid] = {
+            n: dl for n, dl in avoid.items() if dl > now
+        }
+        return frozenset(live)
+
     def _place(
-        self, profile: TopologyProfile, slices: List[TpuSlice]
+        self, profile: TopologyProfile, slices: List[TpuSlice],
+        avoid: frozenset = frozenset(),
     ) -> Optional[Placement]:
         for gid, (group, members) in sorted(
             self._torus_groups(slices).items()
@@ -479,6 +537,15 @@ class Controller:
             except ValueError as e:
                 log.warning("group %s occupancy corrupt: %s", gid, e)
                 continue
+            for m in members:
+                if m.name in avoid:
+                    # blocked, not occupied: the tile may legitimately
+                    # hold other pods' live boxes
+                    hb = group.generation.host_bounds
+                    occ.block(Box(
+                        anchor=tuple(m.spec.host_offset),  # type: ignore
+                        shape=hb,
+                    ).coords())
             placement = self.policy.choose(group, profile, occ)
             if placement is not None:
                 return placement
@@ -715,6 +782,8 @@ class Controller:
         instaslice_controller.go:89-142; SURVEY.md §3.3)."""
         md = pod["metadata"]
         self._set_pending(self._pod_key(pod), False)
+        # the pod is going away: its failed-node memory goes with it
+        self._failed_nodes.pop(md.get("uid", ""), None)
         finalizers = md.get("finalizers", []) or []
         if FINALIZER not in finalizers:
             return None

@@ -47,6 +47,7 @@ class SimCluster:
         metrics=None,
         device_plugins: bool = False,
         transport: str = "inproc",
+        backend: str = "fake",
     ) -> None:
         """``transport="inproc"`` wires every component straight to the
         in-process FakeKube. ``transport="http"`` puts the store behind
@@ -55,7 +56,20 @@ class SimCluster:
         connection — the full wire path (URL building, JSON verbs,
         streaming watch parsing, timestamp round-tripping) between every
         component, the way separate processes would talk to a real API
-        server."""
+        server.
+
+        ``backend="fake"`` gives every node an in-process
+        :class:`FakeTpuBackend`. ``backend="cloudtpu"`` gives every node
+        its own :class:`CloudTpuMockServer` (the mock's chip-capacity
+        ledger is server-wide — one server per node models per-host
+        accelerator pools) and a :class:`CloudTpuBackend` talking real
+        HTTP to it, so the lifecycle tiers drive the same
+        gate→grant→handoff→teardown contract through the cloud
+        queued-resources wire path the agent would use on GKE. The
+        servers ride in ``self.mock_servers[node]`` for failure
+        injection (``fail_next_create`` → FAILED queued resource →
+        allocation ``failed`` → controller retry, the
+        ``instaslice_daemonset.go:95-231`` error contract)."""
         self.backing = FakeKube()
         self.server = None
         if transport == "http":
@@ -77,6 +91,9 @@ class SimCluster:
         hb = gen.host_bounds
         self.backends: Dict[str, FakeTpuBackend] = {}
         self.agents: Dict[str, NodeAgent] = {}
+        self.mock_servers: Dict[str, object] = {}
+        if backend not in ("fake", "cloudtpu"):
+            raise ValueError(f"unknown sim backend {backend!r}")
         group = "sim-torus" if shared_torus and n_nodes > 1 else ""
         for i in range(n_nodes):
             node = f"node-{i}"
@@ -89,14 +106,32 @@ class SimCluster:
                     "status": {"capacity": {}, "allocatable": {}},
                 },
             )
-            backend = FakeTpuBackend(
-                generation=generation,
-                host_offset=(i * hb[0], 0, 0) if group else (0, 0, 0),
-                torus_group=group,
-            )
-            self.backends[node] = backend
+            host_offset = (i * hb[0], 0, 0) if group else (0, 0, 0)
+            if backend == "cloudtpu":
+                from instaslice_tpu.device.cloudtpu import CloudTpuBackend
+                from instaslice_tpu.device.cloudtpu_mock import (
+                    CloudTpuMockServer,
+                )
+
+                srv = CloudTpuMockServer(provision_polls=1).start()
+                self.mock_servers[node] = srv
+                node_backend = CloudTpuBackend(
+                    api_base=srv.url,
+                    generation=generation,
+                    host_offset=host_offset,
+                    torus_group=group,
+                    poll_interval=0.01,
+                    provision_timeout=5.0,
+                )
+            else:
+                node_backend = FakeTpuBackend(
+                    generation=generation,
+                    host_offset=host_offset,
+                    torus_group=group,
+                )
+            self.backends[node] = node_backend
             self.agents[node] = NodeAgent(
-                self._client_for(), backend, node, namespace,
+                self._client_for(), node_backend, node, namespace,
                 metrics=metrics, health_interval=health_interval,
             )
         self.controller = Controller(
@@ -147,6 +182,8 @@ class SimCluster:
         for agent in self.agents.values():
             agent.stop()
         self.backing.stop_watches()
+        for srv in self.mock_servers.values():
+            srv.stop()
         if self.server is not None:
             self.server.stop()
         self._sched.join(timeout=2)

@@ -240,3 +240,43 @@ class TestWedgeResilientBench:
         store = mod._load_store()
         assert set(store["phases"]) == {"mfu"}
         assert store["phase_ts"]["mfu"] == new
+
+
+class TestMoeBenchPhase:
+    def test_phase_runs_on_cpu_with_tiny_dims(self):
+        """The whole moe phase end-to-end on the CPU path: both models
+        compile, the chained-forward timing runs, and the output carries
+        the matched-FLOPs evidence keys the artifact needs."""
+        from instaslice_tpu.bench_tpu import bench_moe
+
+        out = {}
+        bench_moe(out, d_model=32, n_heads=4, n_layers=2, dense_ff=64,
+                  n_experts=4, top_k=2, batch=2, seq=16, vocab=64,
+                  chain_budget_s=5.0)
+        assert out["moe_bench_dense_fwd_seconds"] > 0
+        assert out["moe_bench_moe_fwd_seconds"] > 0
+        for kind in ("dense", "moe"):
+            ev = out[f"moe_bench_{kind}_fwd_seconds_timing"]
+            assert set(ev) == {"chain_n", "rtt_ms", "wall_median_s",
+                               "spread_pct"}
+        assert "moe_bench_overhead_pct" in out
+        assert "matched active FLOPs" in out["moe_bench_config"]
+
+    def test_flop_parity_is_enforced(self):
+        from instaslice_tpu.bench_tpu import bench_moe
+
+        with pytest.raises(ValueError, match="parity"):
+            bench_moe({}, dense_ff=63, top_k=2)
+
+    def test_phase_registered_everywhere(self):
+        """A phase missing from any of the three registries (subprocess
+        dispatch, driver caps, watchdog priority) silently never runs."""
+        from instaslice_tpu.bench_tpu import PHASES
+
+        mod = self._bench_mod()
+        assert "moe" in PHASES
+        assert "moe" in dict(mod.TPU_PHASES)
+        assert "moe" in mod.WATCHDOG_PRIORITY
+        assert set(mod.WATCHDOG_PRIORITY) == set(dict(mod.TPU_PHASES))
+
+    _bench_mod = TestWedgeResilientBench._bench_mod

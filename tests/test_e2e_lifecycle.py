@@ -12,10 +12,16 @@ from instaslice_tpu import GATE_NAME, POD_RESOURCE_PREFIX
 from instaslice_tpu.sim import SimCluster
 
 
-@pytest.fixture
-def cluster():
+@pytest.fixture(params=["fake", "cloudtpu"])
+def cluster(request):
+    """Single-node cluster, parameterized over the device backend: the
+    whole lifecycle tier runs once against the in-process fake and once
+    against the Cloud TPU queued-resources wire path (real HTTP to a
+    per-node mock API server) — the same gate→grant→handoff→teardown
+    contract through both device drivers."""
     c = SimCluster(n_nodes=1, generation="v5e",
-                   deletion_grace_seconds=0.3).start()
+                   deletion_grace_seconds=0.3,
+                   backend=request.param).start()
     yield c
     c.stop()
 
@@ -135,10 +141,34 @@ class TestTeardown:
 
 class TestFailureHandling:
     def test_device_failure_marks_failed_then_retries(self, cluster):
-        cluster.backends["node-0"].inject_failures("reserve", 1)
+        if cluster.mock_servers:
+            # cloudtpu: the queued resource lands in FAILED after
+            # provisioning — the agent must map that to allocation
+            # `failed` exactly like a fake reserve error
+            cluster.mock_servers["node-0"].fail_next_create(1)
+        else:
+            cluster.backends["node-0"].inject_failures("reserve", 1)
         cluster.submit("demo", "v5e-1x1")
         # failed → torn down → retried → eventually Running
         assert cluster.wait_phase("demo", "Running", timeout=15)
+
+    def test_cloudtpu_failed_resource_retried_elsewhere(self):
+        """The FAILED queued-resource contract end-to-end across nodes:
+        node-0's cloud API fails every create, so the controller's
+        failed-allocation repair must re-place the pod on node-1
+        (reference error contract:
+        ``instaslice_daemonset.go:95-231,233-270``)."""
+        c = SimCluster(n_nodes=2, generation="v5e", shared_torus=True,
+                       deletion_grace_seconds=0.3,
+                       backend="cloudtpu").start()
+        try:
+            c.mock_servers["node-0"].fail_next_create(100)
+            c.submit("demo", "v5e-1x1")
+            assert c.wait_phase("demo", "Running", timeout=25)
+            assert c.backends["node-1"].list_reservations()
+            assert c.backends["node-0"].list_reservations() == []
+        finally:
+            c.stop()
 
     def test_force_deleted_pod_reaped(self, cluster):
         cluster.submit("demo", "v5e-2x2")

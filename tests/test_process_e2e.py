@@ -130,11 +130,21 @@ class _MiniScheduler(threading.Thread):
                 self.last_error = f"{type(e).__name__}: {e}"
 
 
-@pytest.fixture
-def wired_processes():
+@pytest.fixture(params=["fake", "cloudtpu"])
+def wired_processes(request):
     """FakeApiServer + controller & agent as real subprocesses, their
     stdout/stderr captured to log files (PIPE would deadlock on chatty
-    children and lose diagnostics)."""
+    children and lose diagnostics). Parameterized over the device
+    backend: the cloudtpu leg starts a queued-resources mock API in the
+    test process and points the agent subprocess at it via
+    ``TPUSLICE_CLOUDTPU_API`` — the whole OS-process stack driving the
+    cloud wire path."""
+    backend = request.param
+    mock = None
+    if backend == "cloudtpu":
+        from instaslice_tpu.device.cloudtpu_mock import CloudTpuMockServer
+
+        mock = CloudTpuMockServer(provision_polls=1).start()
     store = FakeKube()
     store.create("Node", {
         "apiVersion": "v1", "kind": "Node",
@@ -152,6 +162,8 @@ def wired_processes():
             "PYTHONPATH": str(Path(__file__).resolve().parent.parent),
             "NODE_NAME": "node-0",
         }
+        if mock is not None:
+            env["TPUSLICE_CLOUDTPU_API"] = mock.url
         logs = {}
         procs = []
         for name, cmd in (
@@ -164,7 +176,7 @@ def wired_processes():
             ("agent",
              [sys.executable, "-m", "instaslice_tpu.cli.agent_main",
               "--kubeconfig", kc, "--namespace", NS,
-              "--node-name", "node-0", "--backend", "fake",
+              "--node-name", "node-0", "--backend", backend,
               "--metrics-bind-address", "127.0.0.1:0",
               "--health-probe-bind-address", f"127.0.0.1:{a_probe}"]),
         ):
@@ -196,6 +208,8 @@ def wired_processes():
                     p.kill()
             for f in logs.values():
                 f.close()
+            if mock is not None:
+                mock.stop()
 
 
 class TestProcessE2E:
