@@ -41,6 +41,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--seq-len", type=int, default=512)
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="micro-batches per optimizer update (activation "
+                         "memory scales with batch/accum)")
+    ap.add_argument("--grad-clip", type=float, default=1.0,
+                    help="global L2 gradient-norm clip (0 disables)")
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help=">0: linear warmup then cosine decay to 10%% "
+                         "over --steps")
     ap.add_argument("--seed", type=int, default=0)
     # model
     ap.add_argument("--d-model", type=int, default=2048)
@@ -65,6 +73,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="model-axis size (heads/ffn sharding)")
     ap.add_argument("--sp", type=int, default=1,
                     help="seq-axis size (ring attention)")
+    ap.add_argument("--param-dtype", default="float32",
+                    choices=["float32", "same"],
+                    help="weight storage dtype on TPU (float32 = master "
+                         "weights, the mixed-precision recipe; same = "
+                         "store in the bf16 compute dtype)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard Adam moments over the data axis (ZeRO "
+                         "stage 1): ~2/3 of optimizer+param state "
+                         "divided by dp size, identical step math")
     # checkpoint / logging
     ap.add_argument("--checkpoint", default="",
                     help="orbax checkpoint dir (resume if it has one)")
@@ -154,21 +171,34 @@ def main(argv=None) -> int:
                 f"of (multiple of {args.sp}) - 1, e.g. "
                 f"{args.sp * ((args.seq_len + 1) // args.sp) - 1}"
             )
+        on_tpu = jax.default_backend() == "tpu"
         cfg = ModelConfig(
             vocab_size=args.vocab_size, d_model=args.d_model,
             n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
             n_layers=args.n_layers, d_ff=args.d_ff,
             max_seq_len=args.seq_len + 1,
-            dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
-            else jnp.float32,
+            dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+            # mixed-precision training default: bf16 compute on the
+            # MXU, fp32 master weights so sub-bf16-ulp Adam updates
+            # are never lost (--param-dtype same opts out when memory
+            # is tighter than late-training convergence)
+            param_dtype=(jnp.float32 if args.param_dtype == "float32"
+                         else None) if on_tpu else None,
             ring_attention=args.ring, n_experts=args.n_experts,
             window=args.window,
             remat=args.remat != "none",
             remat_policy="dots" if args.remat == "dots" else "full",
         )
         model = TpuLM(cfg)
-        init_fn, step_fn = make_train_step(model, mesh,
-                                           learning_rate=args.lr)
+        init_fn, step_fn = make_train_step(
+            model, mesh,
+            learning_rate=args.lr,
+            zero1=args.zero1,
+            grad_accum=args.grad_accum,
+            grad_clip=args.grad_clip,
+            warmup_steps=args.warmup_steps,
+            decay_steps=args.steps if args.warmup_steps else 0,
+        )
 
         data_path = args.data
         if args.synthetic:

@@ -46,6 +46,16 @@ class ModelConfig:
     d_ff: int = 2048
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
+    # storage dtype for the trainable weights; None = same as ``dtype``.
+    # The mixed-precision training recipe sets dtype=bf16 (compute hits
+    # the MXU) with param_dtype=fp32 (master weights: Adam updates
+    # smaller than a bf16 ulp — common late in training — would
+    # otherwise be lost entirely). Weights are cast to ``dtype`` at
+    # every use (``weight(leaf, cfg.dtype)``), so activations and
+    # matmuls are identical either way; only the stored copy and the
+    # update math gain precision. Serving keeps the default (None):
+    # inference has no update to protect.
+    param_dtype: Any = None
     # grouped-query attention (the Llama-3-class serving layout):
     # 0 = multi-head (KV heads == query heads); k>0 = that many KV
     # heads shared by n_heads // k query heads each. Shrinks the decode
@@ -132,6 +142,12 @@ class ModelConfig:
         """KV heads actually stored (== n_heads for plain MHA)."""
         return self.n_kv_heads or self.n_heads
 
+    @property
+    def stored_dtype(self):
+        """The dtype weights are stored in (master copy)."""
+        return self.param_dtype if self.param_dtype is not None \
+            else self.dtype
+
 
 # ---------------------------------------------------------------------------
 # Sharding rules: logical param tree → PartitionSpec tree.
@@ -200,7 +216,7 @@ def _dense_init(key, shape, dtype, scale=None):
 
 
 def init_params(key: jax.Array, cfg: ModelConfig) -> Params:
-    dt = cfg.dtype
+    dt = cfg.stored_dtype
     L, D, H, F = cfg.n_layers, cfg.d_model, cfg.n_heads, cfg.d_ff
     hd = cfg.head_dim
     keys = jax.random.split(key, 8)
@@ -332,11 +348,11 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
     the router."""
     B, S = x.shape[:2]
     h = _rmsnorm(x, layer["ln1"]["scale"])
-    q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"]),
+    q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"], cfg.dtype),
                    preferred_element_type=jnp.float32)
-    k = jnp.einsum("bsd,dk->bsk", h, weight(layer["wk"]),
+    k = jnp.einsum("bsd,dk->bsk", h, weight(layer["wk"], cfg.dtype),
                    preferred_element_type=jnp.float32)
-    v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"]),
+    v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"], cfg.dtype),
                    preferred_element_type=jnp.float32)
     q = q.astype(cfg.dtype).reshape(B, S, cfg.n_heads, cfg.head_dim)
     k, v = (
@@ -348,21 +364,21 @@ def _transformer_block(cfg: ModelConfig, layer: Params, x: jax.Array,
     attn = attn_fn(q, k, v)
     attn = attn.reshape(B, S, cfg.n_heads * cfg.head_dim)
     x = x + jnp.einsum(
-        "bsk,kd->bsd", attn, weight(layer["wo"]),
+        "bsk,kd->bsd", attn, weight(layer["wo"], cfg.dtype),
         preferred_element_type=jnp.float32,
     ).astype(cfg.dtype)
     h = _rmsnorm(x, layer["ln2"]["scale"])
     aux = jnp.zeros((), jnp.float32)
     if cfg.n_experts:
-        y, aux = _moe_mlp(h, layer["router"], weight(layer["w_in"]),
-                          weight(layer["w_out"]),
+        y, aux = _moe_mlp(h, layer["router"], weight(layer["w_in"], cfg.dtype),
+                          weight(layer["w_out"], cfg.dtype),
                           top_k=cfg.expert_top_k,
                           capacity_factor=cfg.expert_capacity_factor)
     else:
-        y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
+        y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"], cfg.dtype),
                        preferred_element_type=jnp.float32)
         y = jax.nn.gelu(y).astype(cfg.dtype)
-        y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"]),
+        y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"], cfg.dtype),
                        preferred_element_type=jnp.float32
                        ).astype(cfg.dtype)
     return x + y, aux
@@ -484,7 +500,7 @@ class TpuLM:
         cfg = self.cfg
         ring = cfg.ring_attention and mesh is not None
         B, S = tokens.shape
-        x = embed_lookup(params["embed"], tokens)  # (B, S, D) bf16
+        x = embed_lookup(params["embed"], tokens).astype(cfg.dtype)
         if ring:
             from jax.sharding import NamedSharding
 
@@ -528,7 +544,7 @@ class TpuLM:
         if not unembed:
             return (x, aux) if return_aux else x
         logits = jnp.einsum(
-            "bsd,vd->bsv", x, weight(params["embed"]),
+            "bsd,vd->bsv", x, weight(params["embed"], cfg.dtype),
             preferred_element_type=jnp.float32,
         )
         return (logits, aux) if return_aux else logits
@@ -560,7 +576,7 @@ class TpuLM:
                 "pipeline parallelism for this model, not both"
             )
         B, S = tokens.shape
-        x = embed_lookup(params["embed"], tokens)
+        x = embed_lookup(params["embed"], tokens).astype(cfg.dtype)
         positions = jnp.arange(S, dtype=jnp.int32)
 
         def block_fn(layer, xb):
@@ -585,7 +601,7 @@ class TpuLM:
         if not unembed:
             return x
         return jnp.einsum(
-            "bsd,vd->bsv", x, weight(params["embed"]),
+            "bsd,vd->bsv", x, weight(params["embed"], cfg.dtype),
             preferred_element_type=jnp.float32,
         )
 
@@ -647,7 +663,7 @@ class TpuLM:
         quant = "k_s" in cache                        # int8 KV storage
         B, T = tokens.shape
         S_max = attend_len or cache["k"].shape[2]
-        x = embed_lookup(params["embed"], tokens)         # (B, T, D)
+        x = embed_lookup(params["embed"], tokens).astype(cfg.dtype)
         positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)
 
         # sliding-window models read only a (window + T - 1)-wide band
@@ -708,11 +724,11 @@ class TpuLM:
             else:
                 layer, kc, vc = xs                    # kc: (B,S,H,hd)
             h = _rmsnorm(x, layer["ln1"]["scale"])
-            q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"]),
+            q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"], cfg.dtype),
                            preferred_element_type=jnp.float32)
-            k = jnp.einsum("bsd,dk->bsk", h, weight(layer["wk"]),
+            k = jnp.einsum("bsd,dk->bsk", h, weight(layer["wk"], cfg.dtype),
                            preferred_element_type=jnp.float32)
-            v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"]),
+            v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"], cfg.dtype),
                            preferred_element_type=jnp.float32)
             q = q.astype(cfg.dtype).reshape(B, T, cfg.n_heads,
                                             cfg.head_dim)
@@ -766,21 +782,21 @@ class TpuLM:
             attn = jnp.einsum("bkgts,bskd->btkgd", probs, v_read)
             attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
             x = x + jnp.einsum(
-                "bsk,kd->bsd", attn, weight(layer["wo"]),
+                "bsk,kd->bsd", attn, weight(layer["wo"], cfg.dtype),
                 preferred_element_type=jnp.float32,
             ).astype(cfg.dtype)
             h = _rmsnorm(x, layer["ln2"]["scale"])
             if cfg.n_experts:
                 y, _ = _moe_mlp(     # aux is a training-only signal
-                    h, layer["router"], weight(layer["w_in"]),
-                    weight(layer["w_out"]), top_k=cfg.expert_top_k,
+                    h, layer["router"], weight(layer["w_in"], cfg.dtype),
+                    weight(layer["w_out"], cfg.dtype), top_k=cfg.expert_top_k,
                     capacity_factor=cfg.expert_capacity_factor,
                 )
             else:
-                y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"]),
+                y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"], cfg.dtype),
                                preferred_element_type=jnp.float32)
                 y = jax.nn.gelu(y).astype(cfg.dtype)
-                y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"]),
+                y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"], cfg.dtype),
                                preferred_element_type=jnp.float32
                                ).astype(cfg.dtype)
             return x + y, (kc, vc, ks, vs) if quant else (kc, vc)
@@ -791,7 +807,7 @@ class TpuLM:
         x, new = lax.scan(block, x, xs_in)
         x = _rmsnorm(x, params["ln_f"]["scale"])
         logits = jnp.einsum(
-            "bsd,vd->bsv", x, weight(params["embed"]),
+            "bsd,vd->bsv", x, weight(params["embed"], cfg.dtype),
             preferred_element_type=jnp.float32,
         )
         out_cache = {"k": new[0], "v": new[1]}

@@ -77,7 +77,7 @@ def _chunked_xent(embed_leaf, hidden, targets, mask,
     def body(total, xs):
         hc, tc, mc = xs
         logits = jnp.einsum(
-            "bnd,vd->bnv", hc, weight(embed_leaf),
+            "bnd,vd->bnv", hc, weight(embed_leaf, hc.dtype),
             preferred_element_type=jnp.float32,
         )
         lse = jax.nn.logsumexp(logits, axis=-1)
@@ -167,44 +167,62 @@ def loss_fn(
 def state_shardings(
     mesh: Mesh, cfg: ModelConfig, opt_state_shape: Any,
     pipe_axis: str = "",
+    zero1: bool = False,
 ) -> TrainState:
-    """NamedShardings for a TrainState (optimizer state follows params)."""
+    """NamedShardings for a TrainState (optimizer state follows params).
+
+    ``zero1=True`` additionally shards every param-shaped optimizer leaf
+    (the Adam ``mu``/``nu`` moments) over the ``"data"`` mesh axis —
+    ZeRO stage 1. Params stay replicated across data (each dp rank
+    needs them every forward), but the moments are only touched at the
+    update, so XLA reduce-scatters the grads into the local moment
+    shard and all-gathers the resulting update — the scaling-book
+    recipe: annotate the sharding, let the partitioner place the
+    collectives. Memory: Adam moments are 2× params in fp32, the
+    dominant at-scale training state; dp-sharding divides that by the
+    data-axis size. A leaf dimension is sharded only when the data axis
+    divides it (first such unsharded dim wins); indivisible leaves stay
+    replicated — correct, just not savings."""
     pspecs = param_specs(cfg, pipe_axis=pipe_axis)
 
     def ns(spec):
         return NamedSharding(mesh, spec)
 
     params_sh = jax.tree.map(ns, pspecs, is_leaf=lambda x: isinstance(x, P))
-    # adamw state: (ScaleByAdamState(count, mu, nu), EmptyState) — mu/nu
-    # mirror the param tree, so reuse params_sh where shapes match.
-    flat_p, _ = jax.tree.flatten(params_sh)
-
-    def match(leaf):
-        shape = getattr(leaf, "shape", ())
-        if not shape:
-            return ns(P())
-        return None
-
-    opt_sh = jax.tree.map(
-        lambda leaf: match(leaf), opt_state_shape
+    dp = mesh.shape.get("data", 1) if zero1 else 1
+    flat_spec, _ = jax.tree.flatten(
+        pspecs, is_leaf=lambda x: isinstance(x, P)
     )
-    # Replace None entries (param-shaped) positionally: mu and nu each have
-    # exactly the param tree's structure.
-    flat_o, tdef = jax.tree.flatten(opt_sh, is_leaf=lambda x: x is None)
+
+    def moment_spec(spec: P, shape) -> P:
+        if dp <= 1:
+            return spec
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        for i, ax in enumerate(parts):
+            if ax is None and shape[i] % dp == 0 and shape[i] >= dp:
+                parts[i] = "data"
+                return P(*parts)
+        return spec
+
+    # adamw state: (ScaleByAdamState(count, mu, nu), EmptyState) — mu/nu
+    # mirror the param tree, so pair leaves with param specs positionally.
+    flat_o, tdef = jax.tree.flatten(opt_state_shape)
     pi = 0
     out = []
     for leaf in flat_o:
-        if leaf is None:
-            out.append(flat_p[pi % len(flat_p)])
-            pi += 1
+        shape = getattr(leaf, "shape", ())
+        if not shape:
+            out.append(ns(P()))
         else:
-            out.append(leaf)
-    if pi % len(flat_p) != 0:
+            spec = flat_spec[pi % len(flat_spec)]
+            pi += 1
+            out.append(ns(moment_spec(spec, shape)))
+    if pi % len(flat_spec) != 0:
         raise ValueError(
             f"optimizer state has {pi} param-shaped leaves, not a whole "
-            f"multiple of the {len(flat_p)} params — positional sharding "
-            "match would be wrong; adjust state_shardings for this optax "
-            "transform"
+            f"multiple of the {len(flat_spec)} params — positional "
+            "sharding match would be wrong; adjust state_shardings for "
+            "this optax transform"
         )
     opt_sh = jax.tree.unflatten(tdef, out)
     return TrainState(step=ns(P()), params=params_sh, opt_state=opt_sh)
@@ -218,6 +236,11 @@ def make_train_step(
     pipe_axis: str = "pipe",
     loss_chunk: int = DEFAULT_LOSS_CHUNK,
     moe_aux_weight: float = DEFAULT_MOE_AUX_WEIGHT,
+    zero1: bool = False,
+    grad_accum: int = 1,
+    grad_clip: float = 0.0,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
 ) -> Tuple[Callable, Callable]:
     """Returns ``(init_fn, step_fn)``, both jitted over ``mesh``.
 
@@ -229,6 +252,26 @@ def make_train_step(
     run GPipe-style over the mesh's ``pipe_axis`` with that many
     microbatches, and the stacked layer weights (plus their optimizer
     moments) shard one stage per device along it.
+
+    ``zero1=True`` shards the Adam moments over the data axis (ZeRO
+    stage 1 — see :func:`state_shardings`); step math is unchanged,
+    only the sharding annotations differ, so losses are bitwise the
+    math of the replicated form.
+
+    ``grad_accum`` > 1 splits the batch into that many equal
+    micro-batches and runs forward/backward per micro-batch inside a
+    ``lax.scan``, averaging the gradients before the single optimizer
+    update — activation memory scales with the micro-batch while the
+    update sees the full global batch. The scan carry holds one grads
+    tree (fp32, param-shaped), so the overhead is one extra
+    params-sized buffer. Composes with zero1 and remat; mutually
+    exclusive with pipeline parallelism (``n_micro`` already
+    micro-batches the pipeline).
+
+    ``grad_clip`` > 0 clips gradients to that global L2 norm before
+    Adam (the standard divergence guard); ``warmup_steps`` /
+    ``decay_steps`` turn the constant rate into linear warmup + cosine
+    decay to 10% (the standard LM schedule).
     """
     cfg = model.cfg
     if n_micro and pipe_axis not in mesh.axis_names:
@@ -236,10 +279,36 @@ def make_train_step(
             f"n_micro={n_micro} but mesh has no {pipe_axis!r} axis "
             f"(axes: {mesh.axis_names})"
         )
+    if grad_accum > 1 and n_micro:
+        raise ValueError(
+            "grad_accum and n_micro are both micro-batching schemes; "
+            "pipeline parallelism already accumulates over its "
+            "microbatches — use one or the other"
+        )
     # "auto" resolves inside _attention: the pallas flash kernel on TPU
     # (forward AND backward are blockwise — ops/flash_attention.py), the
     # XLA formulation elsewhere. No training-time downgrade needed.
-    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.01)
+    if warmup_steps or decay_steps:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(decay_steps, warmup_steps + 1),
+            end_value=learning_rate * 0.1,
+        )
+    else:
+        lr = learning_rate
+    chain = []
+    if grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    chain.append(optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01))
+    # no gratuitous chain wrapper when clipping is off: the opt_state
+    # pytree structure is what orbax checkpoints, and wrapping the bare
+    # adamw state in a 1-tuple would break resume of every pre-clip
+    # checkpoint. NOTE: toggling grad_clip between runs still changes
+    # the structure (the clip transform carries state) — resume with
+    # the same grad_clip setting the checkpoint was written with.
+    tx = chain[0] if len(chain) == 1 else optax.chain(*chain)
 
     def init(rng):
         params = model.init(rng)
@@ -254,19 +323,59 @@ def make_train_step(
     sh = state_shardings(
         mesh, cfg, state_shape.opt_state,
         pipe_axis=pipe_axis if n_micro else "",
+        zero1=zero1,
     )
     tok_sharding = NamedSharding(mesh, batch_spec(cfg))
 
     init_fn = jax.jit(init, out_shardings=sh)
 
-    def step(state: TrainState, tokens: jax.Array):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(
-                model, p, tokens, mesh,
-                n_micro=n_micro, pipe_axis=pipe_axis,
-                loss_chunk=loss_chunk, moe_aux_weight=moe_aux_weight,
+    def loss_of(p, toks):
+        return loss_fn(
+            model, p, toks, mesh,
+            n_micro=n_micro, pipe_axis=pipe_axis,
+            loss_chunk=loss_chunk, moe_aux_weight=moe_aux_weight,
+        )
+
+    def grads_of(p, tokens):
+        if grad_accum <= 1:
+            return jax.value_and_grad(loss_of)(p, tokens)
+        B = tokens.shape[0]
+        if B % grad_accum:
+            raise ValueError(
+                f"batch {B} not divisible by grad_accum={grad_accum}"
             )
-        )(state.params)
+        # (accum, B/accum, S): the micro-batch axis keeps the batch's
+        # data sharding; the accum axis is the (unsharded) scan axis
+        micro = tokens.reshape(grad_accum, B // grad_accum, -1)
+        micro = jax.lax.with_sharding_constraint(
+            micro, NamedSharding(mesh, P(None, *batch_spec(cfg)))
+        )
+
+        def body(carry, toks):
+            acc_loss, acc_grads = carry
+            loss, grads = jax.value_and_grad(loss_of)(p, toks)
+            return (
+                acc_loss + loss,
+                jax.tree.map(jnp.add, acc_grads, grads),
+            ), None
+
+        # fp32 carry regardless of param dtype: jnp.add promotes bf16
+        # micro-grads into it, so summing 2+ micro-batches never drops
+        # sub-ulp contributions (the whole point of accumulating)
+        zero = (
+            jnp.zeros((), jnp.float32),
+            jax.tree.map(
+                lambda l: jnp.zeros(l.shape, jnp.float32), p
+            ),
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(body, zero, micro)
+        inv = 1.0 / grad_accum
+        return loss_sum * inv, jax.tree.map(
+            lambda g: g * inv, grad_sum
+        )
+
+    def step(state: TrainState, tokens: jax.Array):
+        loss, grads = grads_of(state.params, tokens)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (

@@ -290,6 +290,151 @@ class TestTrainStep:
         assert int(state.step) == 2
         assert np.isfinite(float(loss))
 
+    def test_zero1_shards_moments_and_matches_replicated_losses(self):
+        """ZeRO-1: the Adam mu/nu moments must actually land sharded
+        over the data axis (that's the memory win), params must stay
+        replicated across it (every dp rank forwards with them), and
+        the loss trajectory must match the replicated-optimizer run —
+        the sharding annotation changes WHERE the update math runs,
+        never what it computes."""
+        devs = jax.devices()[:8]
+        mesh = Mesh(np.array(devs).reshape(4, 1, 2),
+                    ("data", "seq", "model"))
+        model = TpuLM(tiny())
+        tokens = jax.random.randint(
+            jax.random.key(1), (4, 32), 0, 128, jnp.int32
+        )
+
+        losses = {}
+        for z in (False, True):
+            init_fn, step_fn = make_train_step(model, mesh, zero1=z)
+            state = init_fn(jax.random.key(0))
+            if z:
+                def find_mu(s):
+                    if hasattr(s, "mu"):
+                        return s.mu
+                    if isinstance(s, (tuple, list)):
+                        for sub in s:
+                            r = find_mu(sub)
+                            if r is not None:
+                                return r
+                    return None
+
+                mu = find_mu(state.opt_state)
+                assert mu is not None, "no ScaleByAdamState found"
+                sharded = [
+                    leaf for leaf in jax.tree.leaves(mu)
+                    if "data" in tuple(leaf.sharding.spec)
+                ]
+                assert sharded, "no moment leaf sharded over data"
+                for leaf in jax.tree.leaves(state.params):
+                    assert "data" not in tuple(leaf.sharding.spec), (
+                        "params must stay replicated over data"
+                    )
+            seq = []
+            for _ in range(3):
+                state, loss = step_fn(state, tokens)
+                seq.append(float(loss))
+            losses[z] = seq
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-5)
+
+    def test_grad_accum_matches_full_batch(self):
+        """Micro-batched accumulation is pure memory restructuring: the
+        averaged micro-batch gradients equal the full-batch gradient
+        (equal token counts per micro-batch), so the loss trajectory
+        must match the accum=1 run."""
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs).reshape(2, 1, 2),
+                    ("data", "seq", "model"))
+        model = TpuLM(tiny())
+        tokens = jax.random.randint(
+            jax.random.key(1), (4, 32), 0, 128, jnp.int32
+        )
+        losses = {}
+        for accum in (1, 2):
+            init_fn, step_fn = make_train_step(model, mesh,
+                                               grad_accum=accum)
+            state = init_fn(jax.random.key(0))
+            seq = []
+            for _ in range(3):
+                state, loss = step_fn(state, tokens)
+                seq.append(float(loss))
+            losses[accum] = seq
+        np.testing.assert_allclose(losses[2], losses[1], rtol=1e-4)
+
+    def test_grad_accum_rejects_pipeline_combo(self):
+        devs = jax.devices()[:4]
+        mesh = Mesh(np.array(devs).reshape(2, 1, 1, 2),
+                    ("pipe", "data", "seq", "model"))
+        with pytest.raises(ValueError, match="micro-batching"):
+            make_train_step(TpuLM(tiny()), mesh, grad_accum=2, n_micro=2)
+
+    def test_warmup_schedule_starts_at_zero_lr(self):
+        """warmup_cosine: step 0 runs at lr=0, so the first update must
+        leave params untouched (the schedule is actually wired into the
+        optimizer, not just accepted)."""
+        devs = jax.devices()[:2]
+        mesh = Mesh(np.array(devs).reshape(1, 1, 2),
+                    ("data", "seq", "model"))
+        model = TpuLM(tiny())
+        init_fn, step_fn = make_train_step(
+            model, mesh, warmup_steps=5, decay_steps=20, grad_clip=1.0,
+        )
+        state = init_fn(jax.random.key(0))
+        before = jax.tree.map(np.asarray, state.params)
+        tokens = jax.random.randint(
+            jax.random.key(1), (2, 32), 0, 128, jnp.int32
+        )
+        state, _ = step_fn(state, tokens)
+        for a, b in zip(jax.tree.leaves(before),
+                        jax.tree.leaves(state.params)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        # second step: lr > 0, params must move
+        state, _ = step_fn(state, tokens)
+        moved = any(
+            not np.array_equal(a, np.asarray(b))
+            for a, b in zip(jax.tree.leaves(before),
+                            jax.tree.leaves(state.params))
+        )
+        assert moved
+
+    def test_fp32_master_weights(self):
+        """param_dtype=fp32 + dtype=bf16 (the mixed-precision recipe):
+        weights store in fp32, compute casts to bf16 at use — so the
+        forward is bit-identical to storing bf16 (init casts the same
+        fp32 draw), while updates smaller than a bf16 ulp survive in
+        the master copy."""
+        import dataclasses
+
+        base = ModelConfig(
+            vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            dtype=jnp.bfloat16, remat=False,
+        )
+        mixed = dataclasses.replace(base, param_dtype=jnp.float32)
+        toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+
+        p_bf = TpuLM(base).init(jax.random.key(0))
+        p_mx = TpuLM(mixed).init(jax.random.key(0))
+        assert p_bf["blocks"]["wq"].dtype == jnp.bfloat16
+        assert p_mx["blocks"]["wq"].dtype == jnp.float32
+        # ln scales are fp32 in both layouts
+        assert p_mx["blocks"]["ln1"]["scale"].dtype == jnp.float32
+
+        out_bf = TpuLM(base).apply(p_bf, toks)
+        out_mx = TpuLM(mixed).apply(p_mx, toks)
+        assert out_bf.dtype == out_mx.dtype
+        np.testing.assert_array_equal(np.asarray(out_bf, np.float32),
+                                      np.asarray(out_mx, np.float32))
+
+        # the reason master weights exist: a sub-ulp update vanishes in
+        # bf16 storage but persists in fp32
+        delta = jnp.float32(1e-4)          # < bf16 ulp at 1.0 (~0.0078)
+        one_bf = jnp.ones((), jnp.bfloat16)
+        assert float((one_bf + delta.astype(jnp.bfloat16))
+                     .astype(jnp.float32)) == 1.0
+        assert float(jnp.float32(1.0) + delta) > 1.0
+
     def test_remat_policies_agree(self):
         """remat none / full / dots are pure memory-vs-FLOPs trades —
         the loss (and thus gradients up to fp reassociation) must match."""
