@@ -78,6 +78,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="weight storage dtype on TPU (float32 = master "
                          "weights, the mixed-precision recipe; same = "
                          "store in the bf16 compute dtype)")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help=">0: LoRA fine-tuning — train rank-R adapters "
+                         "over a frozen base (int8 base = QLoRA); the "
+                         "checkpoint then holds adapters only")
+    ap.add_argument("--lora-alpha", type=float, default=16.0)
+    ap.add_argument("--lora-targets", default="wq,wv",
+                    help="comma list of adapted weights "
+                         "(wq,wk,wv,wo,w_in,w_out)")
+    ap.add_argument("--base-checkpoint", default="",
+                    help="LoRA: restore the frozen base params from "
+                         "this full-training checkpoint dir (default: "
+                         "fresh init — smoke tests only)")
+    ap.add_argument("--quantize-base", action="store_true",
+                    help="LoRA: int8-quantize the frozen base before "
+                         "training (QLoRA — ~half the base-weight HBM)")
     ap.add_argument("--zero1", action="store_true",
                     help="shard Adam moments over the data axis (ZeRO "
                          "stage 1): ~2/3 of optimizer+param state "
@@ -190,15 +205,87 @@ def main(argv=None) -> int:
             remat_policy="dots" if args.remat == "dots" else "full",
         )
         model = TpuLM(cfg)
-        init_fn, step_fn = make_train_step(
-            model, mesh,
-            learning_rate=args.lr,
-            zero1=args.zero1,
-            grad_accum=args.grad_accum,
-            grad_clip=args.grad_clip,
-            warmup_steps=args.warmup_steps,
-            decay_steps=args.steps if args.warmup_steps else 0,
-        )
+        if args.lora_rank:
+            from jax.sharding import NamedSharding
+
+            from instaslice_tpu.models.lm import param_specs
+            from instaslice_tpu.models.lora import (
+                LoraConfig,
+                make_lora_train_step,
+            )
+            from jax.sharding import PartitionSpec as P
+
+            if args.zero1:
+                raise SystemExit(
+                    "--zero1 has nothing to shard in a LoRA run (the "
+                    "adapter moments are ~0.1% of the base); remove it"
+                )
+            lcfg = LoraConfig(
+                rank=args.lora_rank, alpha=args.lora_alpha,
+                targets=tuple(
+                    t for t in args.lora_targets.split(",") if t
+                ),
+            )
+            if args.base_checkpoint:
+                # the restore skeleton must match the base run's
+                # opt_state STRUCTURE, which depends on the optimizer
+                # flags (clip adds a transform state, warmup adds a
+                # schedule count): pass the same flags the base was
+                # trained with
+                full_init, _ = make_train_step(
+                    model, mesh,
+                    zero1=args.zero1,
+                    grad_clip=args.grad_clip,
+                    warmup_steps=args.warmup_steps,
+                    decay_steps=args.steps if args.warmup_steps else 0,
+                )
+                with TrainCheckpointer(
+                    args.base_checkpoint, max_to_keep=1,
+                ) as bc:
+                    restored = bc.restore(
+                        abstract_train_state(full_init)
+                    )
+                if restored is None:
+                    raise SystemExit(
+                        f"--base-checkpoint {args.base_checkpoint} has "
+                        "no restorable checkpoint"
+                    )
+                base_params = restored.params
+                # the restored Adam moments (2x params) must not stay
+                # referenced for the whole fine-tune — that would undo
+                # the LoRA memory win. (They do transiently exist at
+                # restore; a params-only partial restore would avoid
+                # even that peak.)
+                del restored
+            else:
+                psh = jax.tree.map(
+                    lambda s: NamedSharding(mesh, s), param_specs(cfg),
+                    is_leaf=lambda x: isinstance(x, P),
+                )
+                base_params = jax.jit(
+                    model.init, out_shardings=psh,
+                )(jax.random.key(args.seed))
+            if args.quantize_base:
+                from instaslice_tpu.models.quant import quantize_params
+
+                base_params = quantize_params(base_params)
+            init_fn, step_fn = make_lora_train_step(
+                model, mesh, base_params, lcfg,
+                learning_rate=args.lr, grad_clip=args.grad_clip,
+                grad_accum=args.grad_accum,
+                warmup_steps=args.warmup_steps,
+                decay_steps=args.steps if args.warmup_steps else 0,
+            )
+        else:
+            init_fn, step_fn = make_train_step(
+                model, mesh,
+                learning_rate=args.lr,
+                zero1=args.zero1,
+                grad_accum=args.grad_accum,
+                grad_clip=args.grad_clip,
+                warmup_steps=args.warmup_steps,
+                decay_steps=args.steps if args.warmup_steps else 0,
+            )
 
         data_path = args.data
         if args.synthetic:

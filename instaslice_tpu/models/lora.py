@@ -1,0 +1,241 @@
+"""LoRA: low-rank adapter fine-tuning over a frozen base model.
+
+The PEFT path the reference's ecosystem serves through vLLM/PEFT
+adapters (the reference itself schedules the pods; the workload stack
+here is where adapters live, ``SURVEY.md`` §1 workload role). TPU-first
+shape choices:
+
+- Adapters are **merged, not injected**: the train step materializes
+  ``w + (alpha/rank) · A @ B`` per target and runs the unmodified
+  forward, so every matmul stays a full-size MXU op and every existing
+  feature (ring attention, remat, GQA, sliding window, chunked loss)
+  composes with LoRA for free. The merge is ``L·D·r·K`` FLOPs per
+  target — noise next to the ``B·S`` forward for any real batch.
+- **Only the adapters train**: gradients flow to ``A``/``B`` through
+  the merge (autodiff), the base tree is a frozen closure capture, and
+  the Adam moments exist only for the adapter tree — the optimizer
+  memory drops from 2× base params to 2× adapter params (``~0.1%`` at
+  rank 8 on a 7B model).
+- **QLoRA for free**: a :class:`~instaslice_tpu.models.quant
+  .QuantizedTensor` base leaf dequantizes inside the merge
+  (``weight()``), so an int8-quantized base trains adapters at ~1/2
+  the base-weight HBM of bf16 — the QLoRA recipe without a separate
+  code path.
+- ``B`` starts at zero (the standard init): step 0 computes exactly
+  the base model, so a LoRA run's first loss equals the frozen-base
+  loss — asserted in tests.
+
+Serving: :func:`merge_lora` folds a trained adapter into plain params
+once, after which the unmodified :class:`ServingEngine` serves it at
+full speed (no per-token adapter cost, the single-adapter case). A
+multi-adapter batch would key the merge per slot; out of scope until a
+workload needs it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from instaslice_tpu.models.lm import ModelConfig, batch_spec, param_specs
+from instaslice_tpu.models.quant import weight
+
+Params = Dict[str, Any]
+
+#: targets that are plain (L, in, out) stacked dense weights in
+#: init_params' tree — the shapes LoRA's two-matrix factorization fits.
+_DENSE_TARGETS = ("wq", "wk", "wv", "wo", "w_in", "w_out")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    #: which block weights get adapters; ("wq", "wv") is the classic
+    #: LoRA-paper attention choice, all six approaches full fine-tune
+    targets: Tuple[str, ...] = ("wq", "wv")
+
+    def __post_init__(self) -> None:
+        if self.rank <= 0:
+            raise ValueError(f"rank={self.rank} must be positive")
+        if not self.targets:
+            raise ValueError(
+                "targets is empty — a LoRA run with no adapters would "
+                "train nothing and silently checkpoint an empty tree"
+            )
+        bad = [t for t in self.targets if t not in _DENSE_TARGETS]
+        if bad:
+            raise ValueError(
+                f"unsupported LoRA targets {bad} (supported: "
+                f"{_DENSE_TARGETS}; MoE expert weights are not)"
+            )
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _target_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, int, int]]:
+    """(L, fan_in, fan_out) for each adaptable stacked weight."""
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    K = cfg.n_heads * cfg.head_dim
+    Kkv = cfg.kv_heads * cfg.head_dim
+    shapes = {
+        "wq": (L, D, K),
+        "wk": (L, D, Kkv),
+        "wv": (L, D, Kkv),
+        "wo": (L, K, D),
+    }
+    if not cfg.n_experts:
+        shapes["w_in"] = (L, D, F)
+        shapes["w_out"] = (L, F, D)
+    return shapes
+
+
+def init_lora(key: jax.Array, cfg: ModelConfig,
+              lcfg: LoraConfig) -> Params:
+    """Adapter tree ``{blocks: {t: {"a": (L, in, r), "b": (L, r, out)}}}``.
+
+    ``a`` is Kaiming-ish scaled normal, ``b`` is ZERO — so the merged
+    model starts exactly at the base model and the adapter learns a
+    delta from there (standard LoRA init). Stored fp32: adapters are
+    tiny, and their updates are exactly the sub-ulp-sensitive case
+    master weights exist for."""
+    shapes = _target_shapes(cfg)
+    missing = [t for t in lcfg.targets if t not in shapes]
+    if missing:
+        raise ValueError(
+            f"targets {missing} not adaptable for this config "
+            f"(MoE models only adapt attention: {list(shapes)})"
+        )
+    keys = jax.random.split(key, len(lcfg.targets))
+    blocks = {}
+    for k, t in zip(keys, sorted(lcfg.targets)):
+        L, fin, fout = shapes[t]
+        blocks[t] = {
+            "a": (jax.random.normal(k, (L, fin, lcfg.rank), jnp.float32)
+                  * fin ** -0.5),
+            "b": jnp.zeros((L, lcfg.rank, fout), jnp.float32),
+        }
+    return {"blocks": blocks}
+
+
+def lora_specs(cfg: ModelConfig, lcfg: LoraConfig) -> Params:
+    """PartitionSpecs for the adapter tree: ``b``'s output dim shards
+    exactly like the base weight's output dim (both feed the same
+    einsum), ``a`` replicates (rank is far below any shard size)."""
+    base = param_specs(cfg)["blocks"]
+    blocks = {}
+    for t in sorted(lcfg.targets):
+        out_axis = base[t][-1] if len(base[t]) else None
+        blocks[t] = {
+            "a": P(None, None, None),
+            "b": P(None, None, out_axis),
+        }
+    return {"blocks": blocks}
+
+
+def merge_lora(params: Params, lora: Params, cfg: ModelConfig,
+               lcfg: LoraConfig) -> Params:
+    """Base params with every adapted leaf replaced by
+    ``weight(w) + scale · a @ b`` (dequantizing int8 bases — QLoRA).
+    Differentiable in ``lora``; the returned tree feeds the unmodified
+    forward/loss."""
+    merged = dict(params)
+    merged["blocks"] = dict(params["blocks"])
+    for t, ab in lora["blocks"].items():
+        w = weight(params["blocks"][t], cfg.dtype)
+        delta = jnp.einsum(
+            "lir,lro->lio", ab["a"], ab["b"],
+            preferred_element_type=jnp.float32,
+        ) * lcfg.scale
+        merged["blocks"][t] = (w.astype(jnp.float32) + delta).astype(
+            cfg.dtype
+        )
+    return merged
+
+
+def make_lora_train_step(
+    model,
+    mesh: Mesh,
+    base_params: Params,
+    lcfg: LoraConfig,
+    learning_rate: float = 1e-4,
+    loss_chunk: int = 512,
+    grad_clip: float = 1.0,
+    grad_accum: int = 1,
+    warmup_steps: int = 0,
+    decay_steps: int = 0,
+):
+    """(init_fn, step_fn) training ONLY the adapter tree.
+
+    ``base_params`` is captured frozen (place it on the mesh first —
+    ``quant.shard_params`` or the model's own placement); the train
+    state holds just the adapters and their Adam moments. The loss is
+    the same next-token ``loss_fn`` the full trainer uses, over the
+    merged weights. ``grad_accum`` / ``grad_clip`` / ``warmup_steps``
+    behave exactly as in :func:`~instaslice_tpu.models.train
+    .make_train_step` (shared implementations)."""
+    import optax
+
+    from instaslice_tpu.models.train import (
+        TrainState,
+        accumulated_grads,
+        loss_fn,
+        make_optimizer,
+        opt_shardings_like,
+    )
+
+    cfg = model.cfg
+    # weight_decay=0: decaying A/B shrinks the delta toward the base —
+    # the standard LoRA choice (the base carries the regularization)
+    tx = make_optimizer(learning_rate, grad_clip=grad_clip,
+                        warmup_steps=warmup_steps,
+                        decay_steps=decay_steps, weight_decay=0.0)
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    lspecs = lora_specs(cfg, lcfg)
+    lora_sh = jax.tree.map(ns, lspecs, is_leaf=lambda x: isinstance(x, P))
+
+    def init(rng):
+        lora = init_lora(rng, cfg, lcfg)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=lora,
+            opt_state=tx.init(lora),
+        )
+
+    state_shape = jax.eval_shape(init, jax.random.key(0))
+    flat_l, _ = jax.tree.flatten(lora_sh)
+    opt_sh = opt_shardings_like(state_shape.opt_state, flat_l, ns(P()))
+    sh = TrainState(step=ns(P()), params=lora_sh, opt_state=opt_sh)
+    tok_sharding = ns(batch_spec(cfg))
+
+    init_fn = jax.jit(init, out_shardings=sh)
+
+    def step(state: TrainState, tokens: jax.Array):
+        def loss_of(lora, toks):
+            merged = merge_lora(base_params, lora, cfg, lcfg)
+            return loss_fn(model, merged, toks, mesh,
+                           loss_chunk=loss_chunk)
+
+        loss, grads = accumulated_grads(
+            loss_of, state.params, tokens, grad_accum, mesh, cfg,
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return TrainState(state.step + 1, new_params, new_opt), loss
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(sh, tok_sharding),
+        out_shardings=(sh, ns(P())),
+        donate_argnums=(0,),
+    )
+    return init_fn, step_fn

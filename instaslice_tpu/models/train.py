@@ -228,6 +228,103 @@ def state_shardings(
     return TrainState(step=ns(P()), params=params_sh, opt_state=opt_sh)
 
 
+def make_optimizer(learning_rate, grad_clip: float = 0.0,
+                   warmup_steps: int = 0, decay_steps: int = 0,
+                   weight_decay: float = 0.01):
+    """The training optimizer both the full trainer and the LoRA
+    trainer build: optional global-norm clip → adamw, with optional
+    linear-warmup + cosine-decay-to-10% in place of the constant rate.
+
+    No gratuitous chain wrapper when clipping is off: the opt_state
+    pytree structure is what orbax checkpoints, and wrapping the bare
+    adamw state in a 1-tuple would break resume of every pre-clip
+    checkpoint. NOTE: toggling grad_clip (or warmup) between runs
+    still changes the structure (those transforms carry state) —
+    resume with the same settings the checkpoint was written with."""
+    if warmup_steps or decay_steps:
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=learning_rate,
+            warmup_steps=max(warmup_steps, 1),
+            decay_steps=max(decay_steps, warmup_steps + 1),
+            end_value=learning_rate * 0.1,
+        )
+    else:
+        lr = learning_rate
+    chain = []
+    if grad_clip > 0:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    chain.append(optax.adamw(lr, b1=0.9, b2=0.95,
+                             weight_decay=weight_decay))
+    return chain[0] if len(chain) == 1 else optax.chain(*chain)
+
+
+def accumulated_grads(loss_of, p, tokens, grad_accum: int,
+                      mesh: Mesh, cfg) -> Tuple[jax.Array, Any]:
+    """(loss, grads) for ``loss_of(p, tokens)``, micro-batched when
+    ``grad_accum`` > 1: a ``lax.scan`` over ``grad_accum`` equal batch
+    slices with an fp32 carry (jnp.add promotes bf16 micro-grads into
+    it, so summing never drops sub-ulp contributions — the point of
+    accumulating), averaged at the end. Activation memory scales with
+    the micro-batch; the result matches the full-batch computation."""
+    if grad_accum <= 1:
+        return jax.value_and_grad(loss_of)(p, tokens)
+    B = tokens.shape[0]
+    if B % grad_accum:
+        raise ValueError(
+            f"batch {B} not divisible by grad_accum={grad_accum}"
+        )
+    # (accum, B/accum, S): the micro-batch axis keeps the batch's
+    # data sharding; the accum axis is the (unsharded) scan axis
+    micro = tokens.reshape(grad_accum, B // grad_accum, -1)
+    micro = jax.lax.with_sharding_constraint(
+        micro, NamedSharding(mesh, P(None, *batch_spec(cfg)))
+    )
+
+    def body(carry, toks):
+        acc_loss, acc_grads = carry
+        loss, grads = jax.value_and_grad(loss_of)(p, toks)
+        return (
+            acc_loss + loss,
+            jax.tree.map(jnp.add, acc_grads, grads),
+        ), None
+
+    zero = (
+        jnp.zeros((), jnp.float32),
+        jax.tree.map(lambda l: jnp.zeros(l.shape, jnp.float32), p),
+    )
+    (loss_sum, grad_sum), _ = jax.lax.scan(body, zero, micro)
+    inv = 1.0 / grad_accum
+    return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
+
+
+def opt_shardings_like(opt_state_shape, flat_param_shardings,
+                       scalar_sharding):
+    """Shardings for an optimizer state whose param-shaped leaves (the
+    Adam moments) mirror a param tree: pair them with
+    ``flat_param_shardings`` positionally, scalars (schedule/clip
+    counts) get ``scalar_sharding``. Raises when the param-shaped
+    leaves are not a whole multiple of the params — positional pairing
+    would silently mis-shard under a different optax transform."""
+    flat_o, tdef = jax.tree.flatten(opt_state_shape)
+    pi = 0
+    out = []
+    for leaf in flat_o:
+        if getattr(leaf, "shape", ()):
+            out.append(flat_param_shardings[pi % len(flat_param_shardings)])
+            pi += 1
+        else:
+            out.append(scalar_sharding)
+    if pi % len(flat_param_shardings) != 0:
+        raise ValueError(
+            f"optimizer state has {pi} param-shaped leaves, not a whole "
+            f"multiple of the {len(flat_param_shardings)} params — "
+            "positional sharding match would be wrong; adjust the "
+            "sharding builder for this optax transform"
+        )
+    return jax.tree.unflatten(tdef, out)
+
+
 def make_train_step(
     model: TpuLM,
     mesh: Mesh,
@@ -288,27 +385,9 @@ def make_train_step(
     # "auto" resolves inside _attention: the pallas flash kernel on TPU
     # (forward AND backward are blockwise — ops/flash_attention.py), the
     # XLA formulation elsewhere. No training-time downgrade needed.
-    if warmup_steps or decay_steps:
-        lr = optax.warmup_cosine_decay_schedule(
-            init_value=0.0,
-            peak_value=learning_rate,
-            warmup_steps=max(warmup_steps, 1),
-            decay_steps=max(decay_steps, warmup_steps + 1),
-            end_value=learning_rate * 0.1,
-        )
-    else:
-        lr = learning_rate
-    chain = []
-    if grad_clip > 0:
-        chain.append(optax.clip_by_global_norm(grad_clip))
-    chain.append(optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.01))
-    # no gratuitous chain wrapper when clipping is off: the opt_state
-    # pytree structure is what orbax checkpoints, and wrapping the bare
-    # adamw state in a 1-tuple would break resume of every pre-clip
-    # checkpoint. NOTE: toggling grad_clip between runs still changes
-    # the structure (the clip transform carries state) — resume with
-    # the same grad_clip setting the checkpoint was written with.
-    tx = chain[0] if len(chain) == 1 else optax.chain(*chain)
+    tx = make_optimizer(learning_rate, grad_clip=grad_clip,
+                        warmup_steps=warmup_steps,
+                        decay_steps=decay_steps)
 
     def init(rng):
         params = model.init(rng)
@@ -337,42 +416,7 @@ def make_train_step(
         )
 
     def grads_of(p, tokens):
-        if grad_accum <= 1:
-            return jax.value_and_grad(loss_of)(p, tokens)
-        B = tokens.shape[0]
-        if B % grad_accum:
-            raise ValueError(
-                f"batch {B} not divisible by grad_accum={grad_accum}"
-            )
-        # (accum, B/accum, S): the micro-batch axis keeps the batch's
-        # data sharding; the accum axis is the (unsharded) scan axis
-        micro = tokens.reshape(grad_accum, B // grad_accum, -1)
-        micro = jax.lax.with_sharding_constraint(
-            micro, NamedSharding(mesh, P(None, *batch_spec(cfg)))
-        )
-
-        def body(carry, toks):
-            acc_loss, acc_grads = carry
-            loss, grads = jax.value_and_grad(loss_of)(p, toks)
-            return (
-                acc_loss + loss,
-                jax.tree.map(jnp.add, acc_grads, grads),
-            ), None
-
-        # fp32 carry regardless of param dtype: jnp.add promotes bf16
-        # micro-grads into it, so summing 2+ micro-batches never drops
-        # sub-ulp contributions (the whole point of accumulating)
-        zero = (
-            jnp.zeros((), jnp.float32),
-            jax.tree.map(
-                lambda l: jnp.zeros(l.shape, jnp.float32), p
-            ),
-        )
-        (loss_sum, grad_sum), _ = jax.lax.scan(body, zero, micro)
-        inv = 1.0 / grad_accum
-        return loss_sum * inv, jax.tree.map(
-            lambda g: g * inv, grad_sum
-        )
+        return accumulated_grads(loss_of, p, tokens, grad_accum, mesh, cfg)
 
     def step(state: TrainState, tokens: jax.Array):
         loss, grads = grads_of(state.params, tokens)

@@ -49,6 +49,7 @@ SLOW_MODULES = {
     "test_sampling_extras",  # repetition-penalty / min-p sampling compiles
     "test_data",          # mmap dataset + training-input pipelines
     "test_tpulock",       # cross-process holder spawn/kill round-trips
+    "test_lora",          # adapter train-step compiles
 }
 
 

@@ -1,0 +1,155 @@
+"""LoRA adapter fine-tuning (``models/lora.py``): zero-init equivalence,
+adapter-only training, sharded merge under tp, and the QLoRA path over
+an int8 base. Runs on the virtual 8-device CPU mesh from conftest.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.models.lora import (
+    LoraConfig,
+    init_lora,
+    lora_specs,
+    make_lora_train_step,
+    merge_lora,
+)
+from instaslice_tpu.models.quant import quantize_params
+from instaslice_tpu.models.train import loss_fn
+
+
+def tiny(**kw):
+    return ModelConfig(
+        vocab_size=128, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False, **kw,
+    )
+
+
+def mesh2():
+    devs = jax.devices()[:2]
+    return Mesh(np.array(devs).reshape(1, 1, 2), ("data", "seq", "model"))
+
+
+class TestLoraInit:
+    def test_zero_b_merge_is_identity(self):
+        """B = 0 ⇒ merged weights equal the base exactly — a fresh LoRA
+        model IS the base model."""
+        cfg = tiny()
+        lcfg = LoraConfig(rank=4)
+        params = TpuLM(cfg).init(jax.random.key(0))
+        lora = init_lora(jax.random.key(1), cfg, lcfg)
+        merged = merge_lora(params, lora, cfg, lcfg)
+        for t in lcfg.targets:
+            np.testing.assert_array_equal(
+                np.asarray(merged["blocks"][t]),
+                np.asarray(params["blocks"][t]),
+            )
+        # untargeted leaves are the same objects, not copies
+        assert merged["blocks"]["wo"] is params["blocks"]["wo"]
+
+    def test_bad_target_rejected(self):
+        with pytest.raises(ValueError, match="unsupported"):
+            LoraConfig(targets=("router",))
+        with pytest.raises(ValueError, match="rank"):
+            LoraConfig(rank=0)
+
+    def test_moe_model_rejects_mlp_targets(self):
+        cfg = tiny(n_experts=4)
+        with pytest.raises(ValueError, match="not adaptable"):
+            init_lora(jax.random.key(0), cfg,
+                      LoraConfig(targets=("w_in",)))
+        # attention targets remain fine on MoE models
+        init_lora(jax.random.key(0), cfg, LoraConfig(targets=("wq",)))
+
+    def test_b_spec_follows_base_output_axis(self):
+        cfg = tiny()
+        specs = lora_specs(cfg, LoraConfig(targets=("wq", "wo", "w_in")))
+        assert specs["blocks"]["wq"]["b"] == P(None, None, "model")
+        assert specs["blocks"]["w_in"]["b"] == P(None, None, "model")
+        # wo's base spec is P("model", None): output dim unsharded
+        assert specs["blocks"]["wo"]["b"] == P(None, None, None)
+
+
+class TestLoraTrain:
+    def test_first_loss_is_base_loss_then_decreases(self):
+        cfg = tiny()
+        lcfg = LoraConfig(rank=4)
+        model = TpuLM(cfg)
+        params = model.init(jax.random.key(0))
+        mesh = mesh2()
+        toks = jax.random.randint(jax.random.key(1), (2, 32), 0, 128)
+
+        base_loss = float(loss_fn(model, params, toks))
+        init_fn, step_fn = make_lora_train_step(
+            model, mesh, params, lcfg, learning_rate=3e-3,
+        )
+        state = init_fn(jax.random.key(2))
+        state, first = step_fn(state, toks)
+        # the step's loss is computed BEFORE the update, with B=0
+        np.testing.assert_allclose(float(first), base_loss, rtol=1e-5)
+        for _ in range(5):
+            state, loss = step_fn(state, toks)
+        assert float(loss) < base_loss
+
+    def test_only_adapters_train(self):
+        """The train state holds adapters only — and after steps, B has
+        actually moved off zero (gradients reach it through the
+        merge)."""
+        cfg = tiny()
+        lcfg = LoraConfig(rank=4)
+        model = TpuLM(cfg)
+        params = model.init(jax.random.key(0))
+        init_fn, step_fn = make_lora_train_step(
+            model, mesh2(), params, lcfg, learning_rate=3e-3,
+        )
+        state = init_fn(jax.random.key(2))
+        leaves = jax.tree.leaves(state.params)
+        n_adapter = sum(l.size for l in leaves)
+        n_base = sum(l.size for l in jax.tree.leaves(params))
+        assert n_adapter < n_base / 5      # the PEFT point
+        toks = jax.random.randint(jax.random.key(1), (2, 32), 0, 128)
+        state, _ = step_fn(state, toks)
+        state, _ = step_fn(state, toks)
+        b = state.params["blocks"]["wq"]["b"]
+        assert float(jnp.abs(b).max()) > 0.0
+
+    def test_qlora_int8_base(self):
+        """QuantizedTensor base leaves dequantize inside the merge: the
+        int8 base trains adapters with finite decreasing loss."""
+        cfg = tiny()
+        lcfg = LoraConfig(rank=4)
+        model = TpuLM(cfg)
+        qparams = quantize_params(model.init(jax.random.key(0)))
+        init_fn, step_fn = make_lora_train_step(
+            model, mesh2(), qparams, lcfg, learning_rate=3e-3,
+        )
+        state = init_fn(jax.random.key(2))
+        toks = jax.random.randint(jax.random.key(1), (2, 32), 0, 128)
+        state, first = step_fn(state, toks)
+        for _ in range(5):
+            state, loss = step_fn(state, toks)
+        assert np.isfinite(float(loss))
+        assert float(loss) < float(first)
+
+    def test_merged_adapter_serves_like_plain_params(self):
+        """merge_lora output is a plain params tree: the unmodified
+        forward accepts it — the single-adapter serving path."""
+        cfg = tiny()
+        lcfg = LoraConfig(rank=4)
+        model = TpuLM(cfg)
+        params = model.init(jax.random.key(0))
+        lora = init_lora(jax.random.key(1), cfg, lcfg)
+        # make the adapter nonzero so the test is not the identity case
+        lora["blocks"]["wq"]["b"] = (
+            jnp.ones_like(lora["blocks"]["wq"]["b"]) * 0.01
+        )
+        merged = merge_lora(params, lora, cfg, lcfg)
+        toks = jax.random.randint(jax.random.key(2), (2, 16), 0, 128)
+        out = model.apply(merged, toks)
+        base = model.apply(params, toks)
+        assert bool(jnp.isfinite(out).all())
+        assert float(jnp.abs(out - base).max()) > 0.0
