@@ -735,6 +735,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--vocab-size", type=int, default=32000)
     ap.add_argument("--checkpoint", default="",
                     help="orbax checkpoint dir to restore params from")
+    ap.add_argument("--lora", default="",
+                    help="LoRA adapter checkpoint dir (from tpuslice-"
+                         "train --lora-rank) merged into the weights at "
+                         "startup; rank and targets are read from the "
+                         "adapter tree itself")
+    ap.add_argument("--lora-alpha", type=float, default=16.0,
+                    help="alpha the adapter was trained with (not "
+                         "recoverable from the tree)")
     ap.add_argument("--quantize", action="store_true",
                     help="serve int8 weights + int8 KV cache")
     ap.add_argument("--temperature", type=float, default=0.0,
@@ -756,6 +764,28 @@ def build_parser() -> argparse.ArgumentParser:
                          "follower op stream (worker 0 serves HTTP and "
                          "broadcasts; other workers replay)")
     return ap
+
+
+def _restore_params_half(path: str):
+    """The params half of whatever TrainState a trainer checkpointed at
+    ``path`` (template-free restore — serving never needs the optimizer
+    state). Works for full-model AND LoRA-adapter checkpoints: both
+    save a TrainState whose ``params`` is the tree of interest."""
+    from instaslice_tpu.models.checkpoint import TrainCheckpointer
+
+    with TrainCheckpointer(path) as ckpt:
+        restored = ckpt.restore(None)
+    if restored is None:
+        raise SystemExit(f"no checkpoint found under {path}")
+    if isinstance(restored, dict) and "params" in restored:
+        return restored["params"]
+    if hasattr(restored, "params"):
+        return restored.params
+    if isinstance(restored, (list, tuple)) and len(restored) == 3:
+        # a template-free restore flattens TrainState into its
+        # children (step, params, opt_state)
+        return restored[1]
+    raise SystemExit(f"unrecognized checkpoint layout in {path}")
 
 
 def build_engine(args) -> ServingEngine:
@@ -795,33 +825,34 @@ def build_engine(args) -> ServingEngine:
     )
     model = TpuLM(cfg)
     if args.checkpoint:
-        from instaslice_tpu.models.checkpoint import TrainCheckpointer
-
-        with TrainCheckpointer(args.checkpoint) as ckpt:
-            # template-free restore: serving only needs the params half
-            # of whatever TrainState the trainer saved
-            restored = ckpt.restore(None)
-            if restored is None:
-                raise SystemExit(
-                    f"no checkpoint found under {args.checkpoint}"
-                )
-            if isinstance(restored, dict) and "params" in restored:
-                params = restored["params"]
-            elif hasattr(restored, "params"):
-                params = restored.params
-            elif isinstance(restored, (list, tuple)) and len(restored) == 3:
-                # a template-free restore flattens TrainState into its
-                # children (step, params, opt_state)
-                params = restored[1]
-            else:
-                raise SystemExit(
-                    f"unrecognized checkpoint layout in {args.checkpoint}"
-                )
+        params = _restore_params_half(args.checkpoint)
     else:
         # only init when there is nothing to restore: a 7B-class init
         # tree alive NEXT TO the restored one would double weight memory
         # exactly on the chips that can barely fit the model once
         params = model.init(jax.random.key(0))
+    if args.lora:
+        from instaslice_tpu.models.lora import LoraConfig, merge_lora
+
+        lora = _restore_params_half(args.lora)
+        blocks = lora.get("blocks") if isinstance(lora, dict) else None
+        if not blocks or not all(
+            isinstance(ab, dict) and set(ab) == {"a", "b"}
+            for ab in blocks.values()
+        ):
+            raise SystemExit(
+                f"{args.lora} is not a LoRA adapter checkpoint "
+                "(expected a {'blocks': {target: {'a', 'b'}}} tree — a "
+                "full-model checkpoint belongs in --checkpoint)"
+            )
+        # rank and targets live in the tree; only alpha needs a flag
+        first = next(iter(blocks.values()))
+        lcfg = LoraConfig(
+            rank=int(first["a"].shape[-1]),
+            alpha=args.lora_alpha,
+            targets=tuple(sorted(blocks)),
+        )
+        params = merge_lora(params, lora, cfg, lcfg)
     kv_quant = False
     if args.quantize:
         from instaslice_tpu.models.quant import quantize_params
