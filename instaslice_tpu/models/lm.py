@@ -640,12 +640,19 @@ class TpuLM:
         cache: Params,
         lengths: jax.Array,
         attend_len: int = 0,
+        lora: Optional[Params] = None,
+        adapter_idx: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, Params]:
         """Incremental forward: run ``tokens`` (B, T) through the model
         with each row appended at its own cache offset ``lengths`` (B,).
 
         Covers both prefill (T = padded prompt length, lengths = 0) and
         decode (T = 1). Returns (logits (B, T, vocab), updated cache).
+
+        ``lora`` + ``adapter_idx`` enable multi-LoRA batching: row ``b``
+        additionally flows through adapter ``adapter_idx[b]`` of the
+        stacked tree (``models/lora.py: stack_adapters``), all rows in
+        the ONE compiled program.
         Rows may sit at different offsets — the mask admits cache position
         ``s`` for query ``t`` iff ``s <= lengths[b] + t``, so padded
         prefill garbage beyond a row's true length is never attended (it
@@ -665,6 +672,37 @@ class TpuLM:
         S_max = attend_len or cache["k"].shape[2]
         x = embed_lookup(params["embed"], tokens).astype(cfg.dtype)
         positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)
+
+        # multi-LoRA: per-row adapter deltas batched into the shared
+        # decode program. ``lora["blocks"][t]`` holds (L, N, in, r) /
+        # (L, N, r, out) stacks; ``adapter_idx`` (B,) picks each row's
+        # adapter. ``sel`` folds the one-hot pick and the per-adapter
+        # alpha/rank scale into one (B, N) matrix, so gathering a row's
+        # (in, r) adapter is a single einsum — TPU-friendly static
+        # shapes, no scatter/gather ops (same trick as _moe_mlp's
+        # dispatch). Index 0 is conventionally the all-zero base
+        # adapter (see serving.engine), making "no adapter" a zero
+        # delta rather than a second compiled program.
+        use_lora = lora is not None and adapter_idx is not None
+        if use_lora:
+            n_adapters = lora["scales"].shape[0]
+            pick = jax.nn.one_hot(adapter_idx, n_adapters,
+                                  dtype=cfg.dtype)
+            # scale folded into the A gather ONLY (the delta is linear
+            # in the product — scaling both gathers would square it)
+            sel = pick * lora["scales"].astype(cfg.dtype)[None, :]
+
+        def lora_delta(h_in, ab):
+            """(B, T, out) delta for one target: row b uses adapter
+            ``adapter_idx[b]``'s (in, r) @ (r, out), scaled."""
+            a_b = jnp.einsum("bn,nir->bir", sel,
+                             ab["a"].astype(cfg.dtype))
+            b_b = jnp.einsum("bn,nro->bro", pick,
+                             ab["b"].astype(cfg.dtype))
+            xa = jnp.einsum("bti,bir->btr", h_in, a_b,
+                            preferred_element_type=jnp.float32)
+            return jnp.einsum("btr,bro->bto", xa.astype(cfg.dtype), b_b,
+                              preferred_element_type=jnp.float32)
 
         # sliding-window models read only a (window + T - 1)-wide band
         # of the cache per row (vmapped dynamic_slice at each row's own
@@ -719,17 +757,28 @@ class TpuLM:
             )(scale_l, new, lens)
 
         def block(x, xs):
+            if use_lora:
+                xs, lblocks = xs[:-1], xs[-1]
+            else:
+                lblocks = {}
             if quant:
                 layer, kc, vc, ks, vs = xs            # kc int8, ks f32
             else:
                 layer, kc, vc = xs                    # kc: (B,S,H,hd)
+
+            def proj(h_in, name, w, out_fp32=False):
+                """Base einsum + this row's adapter delta (if adapted)."""
+                y = jnp.einsum("bsd,dk->bsk", h_in,
+                               weight(w, cfg.dtype),
+                               preferred_element_type=jnp.float32)
+                if name in lblocks:
+                    y = y + lora_delta(h_in, lblocks[name])
+                return y if out_fp32 else y.astype(cfg.dtype)
+
             h = _rmsnorm(x, layer["ln1"]["scale"])
-            q = jnp.einsum("bsd,dk->bsk", h, weight(layer["wq"], cfg.dtype),
-                           preferred_element_type=jnp.float32)
-            k = jnp.einsum("bsd,dk->bsk", h, weight(layer["wk"], cfg.dtype),
-                           preferred_element_type=jnp.float32)
-            v = jnp.einsum("bsd,dk->bsk", h, weight(layer["wv"], cfg.dtype),
-                           preferred_element_type=jnp.float32)
+            q = proj(h, "wq", layer["wq"], out_fp32=True)
+            k = proj(h, "wk", layer["wk"], out_fp32=True)
+            v = proj(h, "wv", layer["wv"], out_fp32=True)
             q = q.astype(cfg.dtype).reshape(B, T, cfg.n_heads,
                                             cfg.head_dim)
             k, v = (
@@ -781,10 +830,7 @@ class TpuLM:
             probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
             attn = jnp.einsum("bkgts,bskd->btkgd", probs, v_read)
             attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
-            x = x + jnp.einsum(
-                "bsk,kd->bsd", attn, weight(layer["wo"], cfg.dtype),
-                preferred_element_type=jnp.float32,
-            ).astype(cfg.dtype)
+            x = x + proj(attn, "wo", layer["wo"])
             h = _rmsnorm(x, layer["ln2"]["scale"])
             if cfg.n_experts:
                 y, _ = _moe_mlp(     # aux is a training-only signal
@@ -793,17 +839,16 @@ class TpuLM:
                     capacity_factor=cfg.expert_capacity_factor,
                 )
             else:
-                y = jnp.einsum("bsd,df->bsf", h, weight(layer["w_in"], cfg.dtype),
-                               preferred_element_type=jnp.float32)
+                y = proj(h, "w_in", layer["w_in"], out_fp32=True)
                 y = jax.nn.gelu(y).astype(cfg.dtype)
-                y = jnp.einsum("bsf,fd->bsd", y, weight(layer["w_out"], cfg.dtype),
-                               preferred_element_type=jnp.float32
-                               ).astype(cfg.dtype)
+                y = proj(y, "w_out", layer["w_out"])
             return x + y, (kc, vc, ks, vs) if quant else (kc, vc)
 
         xs_in = (params["blocks"], cache["k"], cache["v"])
         if quant:
             xs_in += (cache["k_s"], cache["v_s"])
+        if use_lora:
+            xs_in += (lora["blocks"],)
         x, new = lax.scan(block, x, xs_in)
         x = _rmsnorm(x, params["ln_f"]["scale"])
         logits = jnp.einsum(

@@ -159,6 +159,58 @@ def merge_lora(params: Params, lora: Params, cfg: ModelConfig,
     return merged
 
 
+def stack_adapters(adapters, cfg: ModelConfig,
+                   alphas=None) -> Params:
+    """Stack adapter trees for multi-LoRA serving: returns
+    ``{"blocks": {t: {"a": (L, N+1, in, r), "b": ...}}, "scales":
+    (N+1,)}`` with an ALL-ZERO adapter prepended at index 0 — "no
+    adapter" rows select it and get an exactly-zero delta inside the
+    same compiled program (no second code path, no recompile).
+
+    Every adapter must share rank and targets (one static shape per
+    stack — the TPU constraint); ``alphas`` defaults to 16.0 each. The
+    layer axis leads so the model's layer ``lax.scan`` slices the
+    stacks alongside the base weights."""
+    if not adapters:
+        raise ValueError("need at least one adapter to stack")
+    first = adapters[0]["blocks"]
+    targets = tuple(sorted(first))
+    rank = int(first[targets[0]]["a"].shape[-1])
+    for i, ad in enumerate(adapters):
+        if tuple(sorted(ad["blocks"])) != targets:
+            raise ValueError(
+                f"adapter {i} targets {sorted(ad['blocks'])} != "
+                f"{list(targets)} — one static stack needs one target "
+                "set; retrain or serve separately"
+            )
+        r = int(ad["blocks"][targets[0]]["a"].shape[-1])
+        if r != rank:
+            raise ValueError(
+                f"adapter {i} rank {r} != {rank} — one static stack "
+                "needs one rank"
+            )
+    if alphas is None:
+        alphas = [16.0] * len(adapters)
+    if len(alphas) != len(adapters):
+        raise ValueError("alphas must match adapters 1:1")
+    blocks = {}
+    for t in targets:
+        a_list = [jnp.zeros_like(first[t]["a"])] + [
+            ad["blocks"][t]["a"] for ad in adapters
+        ]
+        b_list = [jnp.zeros_like(first[t]["b"])] + [
+            ad["blocks"][t]["b"] for ad in adapters
+        ]
+        blocks[t] = {
+            "a": jnp.stack(a_list, axis=1),   # (L, N+1, in, r)
+            "b": jnp.stack(b_list, axis=1),   # (L, N+1, r, out)
+        }
+    scales = jnp.asarray(
+        [0.0] + [float(al) / rank for al in alphas], jnp.float32
+    )
+    return {"blocks": blocks, "scales": scales}
+
+
 def make_lora_train_step(
     model,
     mesh: Mesh,

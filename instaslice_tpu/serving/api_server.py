@@ -45,6 +45,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -60,12 +61,14 @@ class _Pending:
     def __init__(self, prompt: List[int], max_tokens: int,
                  prefix_op: str = "", stream: bool = False,
                  stop: Optional[List[List[int]]] = None,
-                 want_logprobs: bool = False, n: int = 1):
+                 want_logprobs: bool = False, n: int = 1,
+                 adapter: int = 0):
         self.prompt = prompt
         self.max_tokens = max_tokens
         self.stop = stop or []         # normalized token-id sequences
         self.want_logprobs = want_logprobs
         self.n = n                     # parallel samples (OpenAI "n")
+        self.adapter = adapter         # LoRA adapter id (0 = base)
         # "register"/"drop" → not a completion: mutate the engine's
         # prefix cache on the scheduler thread (the engine owner)
         self.prefix_op = prefix_op
@@ -171,7 +174,8 @@ class _Scheduler(threading.Thread):
                     self._head = p
                     break
                 try:
-                    rids = eng.add_request_n(p.prompt, p.n, stop=p.stop)
+                    rids = eng.add_request_n(p.prompt, p.n, stop=p.stop,
+                                             adapter=p.adapter)
                 except Exception as e:  # bad prompt (too long, empty…)
                     p.error = f"{type(e).__name__}: {e}"
                     self.metrics.requests.labels(outcome="rejected").inc()
@@ -449,10 +453,30 @@ class _Handler(BaseHTTPRequestHandler):
                     f"n must be in [1, {max_batch}] (the engine's "
                     "slot count) on this server"
                 )
+            eng = type(self).scheduler.engine
+            adapter = 0
+            want_adapter = req.get("adapter")
+            if want_adapter is not None:
+                names = getattr(eng, "adapter_names", {})
+                if want_adapter not in names:
+                    merged = getattr(eng, "merged_adapter", "")
+                    if merged and want_adapter == merged:
+                        raise ValueError(
+                            f"adapter {merged!r} was MERGED into the "
+                            "weights at startup (single --lora): it is "
+                            "always active — omit the adapter field"
+                        )
+                    have = (sorted(names) if names
+                            else "none — start with two or more "
+                                 "--lora dirs")
+                    raise ValueError(
+                        f"unknown adapter {want_adapter!r} "
+                        f"(serving: {have})"
+                    )
+                adapter = names[want_adapter]
             # sampling config is engine-level (slots share one compiled
             # decode program); reject mismatching per-request values
             # instead of silently ignoring them
-            eng = type(self).scheduler.engine
             for key, have in (("temperature", eng.temperature),
                               ("top_k", eng.top_k),
                               ("top_p", eng.top_p),
@@ -473,7 +497,7 @@ class _Handler(BaseHTTPRequestHandler):
                            stream=bool(req.get("stream", False)),
                            stop=stop,
                            want_logprobs=bool(req.get("logprobs", False)),
-                           n=n)
+                           n=n, adapter=adapter)
         type(self).scheduler.submit(pending)
         if pending.stream_q is not None:
             self._stream_response(pending)
@@ -735,13 +759,21 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--vocab-size", type=int, default=32000)
     ap.add_argument("--checkpoint", default="",
                     help="orbax checkpoint dir to restore params from")
-    ap.add_argument("--lora", default="",
+    ap.add_argument("--lora", action="append", default=[],
+                    metavar="DIR[:ALPHA]",
                     help="LoRA adapter checkpoint dir (from tpuslice-"
-                         "train --lora-rank) merged into the weights at "
-                         "startup; rank and targets are read from the "
-                         "adapter tree itself")
+                         "train --lora-rank); rank and targets are read "
+                         "from the adapter tree itself, alpha from the "
+                         ":ALPHA suffix (default --lora-alpha). Given "
+                         "ONCE, the adapter merges into the weights "
+                         "(zero runtime cost). Given MULTIPLE times, "
+                         "the engine serves all of them batched "
+                         "(multi-LoRA): requests pick one via "
+                         "\"adapter\": \"<dir basename>\" (omitted = "
+                         "base model)")
     ap.add_argument("--lora-alpha", type=float, default=16.0,
-                    help="alpha the adapter was trained with (not "
+                    help="default alpha for adapters without a :ALPHA "
+                         "suffix (alpha is a training-time choice, not "
                          "recoverable from the tree)")
     ap.add_argument("--quantize", action="store_true",
                     help="serve int8 weights + int8 KV cache")
@@ -831,40 +863,69 @@ def build_engine(args) -> ServingEngine:
         # tree alive NEXT TO the restored one would double weight memory
         # exactly on the chips that can barely fit the model once
         params = model.init(jax.random.key(0))
-    if args.lora:
-        from instaslice_tpu.models.lora import LoraConfig, merge_lora
-
-        lora = _restore_params_half(args.lora)
+    adapters = []
+    alphas = []
+    names = []
+    merged_name = ""
+    for spec in args.lora:
+        path, _, alpha_s = spec.rpartition(":")
+        if path and alpha_s.replace(".", "", 1).isdigit():
+            alpha = float(alpha_s)
+        else:
+            path, alpha = spec, args.lora_alpha
+        lora = _restore_params_half(path)
         blocks = lora.get("blocks") if isinstance(lora, dict) else None
         if not blocks or not all(
             isinstance(ab, dict) and set(ab) == {"a", "b"}
             for ab in blocks.values()
         ):
             raise SystemExit(
-                f"{args.lora} is not a LoRA adapter checkpoint "
+                f"{path} is not a LoRA adapter checkpoint "
                 "(expected a {'blocks': {target: {'a', 'b'}}} tree — a "
                 "full-model checkpoint belongs in --checkpoint)"
             )
-        # rank and targets live in the tree; only alpha needs a flag
+        name = os.path.basename(os.path.normpath(path))
+        if name in names:
+            raise SystemExit(
+                f"two --lora dirs share the basename {name!r}; "
+                "adapter names must be unique"
+            )
+        names.append(name)
+        alphas.append(alpha)
+        adapters.append(lora)
+    if len(adapters) == 1:
+        # single adapter: merge once — full speed, zero runtime cost
+        from instaslice_tpu.models.lora import LoraConfig, merge_lora
+
+        blocks = adapters[0]["blocks"]
         first = next(iter(blocks.values()))
         lcfg = LoraConfig(
             rank=int(first["a"].shape[-1]),
-            alpha=args.lora_alpha,
+            alpha=alphas[0],
             targets=tuple(sorted(blocks)),
         )
-        params = merge_lora(params, lora, cfg, lcfg)
+        params = merge_lora(params, adapters[0], cfg, lcfg)
+        merged_name = names[0]
+        adapters, alphas, names = [], [], []
     kv_quant = False
     if args.quantize:
         from instaslice_tpu.models.quant import quantize_params
 
         params = quantize_params(params)
         kv_quant = True
-    return ServingEngine(
+    eng = ServingEngine(
         model, params, max_batch=args.max_batch, max_len=args.max_len,
         prefill_len=args.prefill_len, mesh=mesh, kv_quant=kv_quant,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         min_p=args.min_p, repetition_penalty=args.repetition_penalty,
+        lora_adapters=adapters or None,
+        lora_alphas=alphas or None,
+        lora_names=names or None,
     )
+    #: single-adapter merge: remember the name so a request naming it
+    #: gets a helpful error (the adapter is always on; omit the field)
+    eng.merged_adapter = merged_name
+    return eng
 
 
 def main(argv=None) -> int:

@@ -19,7 +19,8 @@ connection per follower, ops applied strictly in order.
 
 Wire format (one JSON object per line)::
 
-    {"op": "add_request", "prompt": [...], "stop": [[...]], "n": 1}
+    {"op": "add_request", "prompt": [...], "stop": [[...]], "n": 1,
+     "adapter": 0}
     {"op": "step"} | {"op": "decode_block", "n": 8} | {"op": "spec_step"}
     {"op": "register_prefix", "tokens": [...]}
     {"op": "drop_prefix", "tokens": [...]}
@@ -151,11 +152,13 @@ class DistributedEngine:
 
     # ------------------------------------------------------------- the ops
 
-    def add_request(self, prompt: List[int], stop=None) -> int:
-        return self.add_request_n(prompt, 1, stop=stop)[0]
+    def add_request(self, prompt: List[int], stop=None,
+                    adapter: int = 0) -> int:
+        return self.add_request_n(prompt, 1, stop=stop,
+                                  adapter=adapter)[0]
 
     def add_request_n(self, prompt: List[int], n: int,
-                      stop=None) -> List[int]:
+                      stop=None, adapter: int = 0) -> List[int]:
         # host-side validation BEFORE the broadcast: a rejected request
         # must not enter the op stream at all. (Followers additionally
         # swallow deterministic validation errors, so even an op that
@@ -163,9 +166,13 @@ class DistributedEngine:
         stop = ServingEngine._normalize_stop(stop)
         self.engine._check_prompt_fits(prompt)
         self.engine._check_capacity(n)
+        # adapter rides the op stream: a follower replaying through the
+        # base model while the driver used an adapter would silently
+        # diverge the replicas
         self._bcast({"op": "add_request", "prompt": list(prompt),
-                     "stop": stop, "n": n})
-        return self.engine.add_request_n(prompt, n, stop=stop)
+                     "stop": stop, "n": n, "adapter": adapter})
+        return self.engine.add_request_n(prompt, n, stop=stop,
+                                         adapter=adapter)
 
     def step(self):
         self._bcast({"op": "step"})
@@ -263,7 +270,8 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
             try:
                 if kind == "add_request":
                     engine.add_request_n(op["prompt"], op.get("n", 1),
-                                         stop=op["stop"])
+                                         stop=op["stop"],
+                                         adapter=op.get("adapter", 0))
                 elif kind == "step":
                     engine.step()
                 elif kind == "decode_block":

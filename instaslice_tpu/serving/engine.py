@@ -128,11 +128,23 @@ class ServingEngine:
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
         max_prefixes: int = 8,
+        lora_adapters=None,
+        lora_alphas=None,
+        lora_names=None,
     ) -> None:
         """``kv_quant=True`` stores the KV cache as int8 with per-vector
         scales (``TpuLM.init_cache(quant=True)``): decode streams the
         whole cache every step, so this halves the dominant HBM traffic
         at high concurrency and doubles cache capacity.
+
+        ``lora_adapters`` (a list of adapter trees from
+        ``models/lora.py``) enables MULTI-LoRA serving: every request
+        picks an adapter (``add_request(..., adapter=k)``, 1-based; 0 =
+        the unadapted base) and all of them decode in the ONE compiled
+        program — the per-row delta rides a one-hot-gathered (in, r) @
+        (r, out) pair (``TpuLM.apply_with_cache``). Adapters must share
+        rank and target set (one static stack); ``lora_alphas`` gives
+        each its training alpha (default 16).
 
         ``draft_model`` (+ ``draft_params``) enables greedy speculative
         decoding (:meth:`spec_step`): the draft proposes ``spec_k``
@@ -195,6 +207,38 @@ class ServingEngine:
         self._rng = jax.random.key(seed)
         self._next_id = 0
         self.kv_quant = kv_quant
+        self.lora = None
+        self.n_adapters = 0
+        if lora_adapters:
+            if draft_model is not None:
+                raise ValueError(
+                    "multi-LoRA cannot combine with speculative "
+                    "decoding: the draft proposes from the UNADAPTED "
+                    "base, so acceptance would collapse for adapted "
+                    "rows — serve adapters and spec-decode separately"
+                )
+            from instaslice_tpu.models.lora import stack_adapters
+
+            self.lora = stack_adapters(lora_adapters, model.cfg,
+                                       alphas=lora_alphas)
+            self.n_adapters = len(lora_adapters)
+            if lora_names is not None and (
+                len(lora_names) != self.n_adapters
+                or len(set(lora_names)) != self.n_adapters
+            ):
+                raise ValueError(
+                    "lora_names must be unique and match "
+                    "lora_adapters 1:1"
+                )
+        #: request-facing name → 1-based engine adapter id (the mapping
+        #: is engine state: it must stay consistent with the stacking
+        #: order, so it lives here, not in whoever built the engine)
+        self.adapter_names = (
+            {n: i + 1 for i, n in enumerate(lora_names)}
+            if lora_names else {}
+        )
+        #: per-slot adapter id (0 = base); read by every decode/prefill
+        self.slot_adapter = jnp.zeros(max_batch, jnp.int32)
         self.cache = model.init_cache(max_batch, max_len, quant=kv_quant)
         self.lengths = jnp.zeros(max_batch, jnp.int32)
         self.last_token = jnp.zeros(max_batch, jnp.int32)
@@ -354,12 +398,21 @@ class ServingEngine:
         replicated = NamedSharding(mesh, P())
         self.lengths = jax.device_put(self.lengths, replicated)
         self.last_token = jax.device_put(self.last_token, replicated)
+        self.slot_adapter = jax.device_put(self.slot_adapter, replicated)
+        if self.lora is not None:
+            # adapter stacks replicate: at rank ≤ 64 they are a few MB
+            # per target (vs the GB-scale tp-sharded base), and the
+            # per-row gather contracts the whole (in, r)/(r, out) pair
+            # anyway — sharding them would trade a broadcast for
+            # collectives inside every decode step
+            self.lora = jax.device_put(self.lora, replicated)
         if getattr(self, "track_seen", False):
             self.seen = jax.device_put(self.seen, replicated)
 
     # ------------------------------------------------------------- jitted
 
-    def _prefill_stripe(self, model, params, cache, tokens, slot, offset):
+    def _prefill_stripe(self, model, params, cache, tokens, slot, offset,
+                        aidx=None):
         """Prefill one (1, prefill_len) chunk into a slot's cache stripe
         at ``offset``; returns (cache, chunk logits (prefill_len, vocab)).
         Shared by the target and draft prefills.
@@ -373,9 +426,12 @@ class ServingEngine:
             lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
             cache,
         )
+        use_lora = self.lora is not None and model is self.model
         logits, stripe = model.apply_with_cache(
             params, tokens, stripe,
             jnp.full((1,), offset, jnp.int32),
+            lora=self.lora if use_lora else None,
+            adapter_idx=aidx if use_lora else None,
         )
         cache = jax.tree.map(
             lambda c, s: jax.lax.dynamic_update_slice_in_dim(
@@ -385,9 +441,9 @@ class ServingEngine:
         )
         return cache, logits[0]
 
-    def _prefill_impl(self, params, cache, tokens, slot, offset):
+    def _prefill_impl(self, params, cache, tokens, slot, offset, aidx):
         return self._prefill_stripe(
-            self.model, params, cache, tokens, slot, offset
+            self.model, params, cache, tokens, slot, offset, aidx=aidx
         )
 
     def _read_stripe_impl(self, cache, slot, *, length: int):
@@ -410,14 +466,17 @@ class ServingEngine:
 
         return jax.tree.map(wr, cache, stripe)
 
-    def _decode_impl(self, params, cache, last_token, lengths):
+    def _decode_impl(self, params, cache, last_token, lengths, aidx):
         logits, cache = self.model.apply_with_cache(
-            params, last_token[:, None], cache, lengths
+            params, last_token[:, None], cache, lengths,
+            lora=self.lora,
+            adapter_idx=aidx if self.lora is not None else None,
         )
         return cache, logits[:, 0]                  # (B, vocab)
 
     def _decode_block_impl(self, params, cache, last_token, lengths, rng,
-                           temperature, seen, penalty, *, n_steps: int,
+                           temperature, seen, penalty, aidx, *,
+                           n_steps: int,
                            greedy: bool, attend_len: int = 0,
                            top_k: int = 0, top_p: float = 1.0,
                            min_p: float = 0.0, penalize: bool = False):
@@ -440,6 +499,8 @@ class ServingEngine:
             logits, cache = self.model.apply_with_cache(
                 params, last[:, None], cache, lens,
                 attend_len=attend_len,
+                lora=self.lora,
+                adapter_idx=aidx if self.lora is not None else None,
             )
             logits = logits[:, 0]
             if penalize:
@@ -664,19 +725,22 @@ class ServingEngine:
         return n_chunks
 
     def _prefill_chunks(self, slot: int, prompt: List[int],
-                        start_chunk: int = 0):
+                        start_chunk: int = 0, adapter: int = 0):
         """Run chunks [start_chunk, n) of ``prompt`` into a slot's cache
         stripe (target + draft); returns the last chunk's logits."""
         P = self.prefill_len
         n_chunks = -(-len(prompt) // P)
         chunk_logits = None
+        aidx = jnp.full((1,), adapter, jnp.int32)
+        # NB: registered-prefix stripes are base-model KV; admission
+        # skips prefix reuse for adapter requests (add_request_n)
         for i in range(start_chunk, n_chunks):
             chunk = prompt[i * P:(i + 1) * P]
             padded = jnp.asarray(
                 chunk + [0] * (P - len(chunk)), jnp.int32
             )[None]
             self.cache, chunk_logits = self._prefill(
-                self.params, self.cache, padded, slot, i * P
+                self.params, self.cache, padded, slot, i * P, aidx
             )
             if self.draft_model is not None:
                 self.draft_cache = self._draft_prefill(
@@ -777,7 +841,8 @@ class ServingEngine:
             out.append(list(seq))
         return out
 
-    def add_request(self, prompt: List[int], stop=None) -> int:
+    def add_request(self, prompt: List[int], stop=None,
+                    adapter: int = 0) -> int:
         """Admit a prompt; returns the request id. Raises when the batch
         is full (callers queue) or the prompt cannot fit the cache.
 
@@ -791,11 +856,16 @@ class ServingEngine:
         ``stop``: token-id sequence(s); generation finishes (reason
         ``"stop"``) when one appears in the output, which is truncated
         to exclude it. Checked host-side after every step/block — the
-        compiled programs don't change."""
-        return self.add_request_n(prompt, 1, stop=stop)[0]
+        compiled programs don't change.
+
+        ``adapter``: which LoRA adapter this request flows through
+        (1-based into the engine's ``lora_adapters``; 0 = the base
+        model). Requires the engine to have been built with adapters."""
+        return self.add_request_n(prompt, 1, stop=stop,
+                                  adapter=adapter)[0]
 
     def add_request_n(self, prompt: List[int], n: int,
-                      stop=None) -> List[int]:
+                      stop=None, adapter: int = 0) -> List[int]:
         """Admit ``n`` parallel samples of one prompt (OpenAI ``n``):
         the prompt is prefilled ONCE, its KV stripe is copied to the
         other n-1 slots (pure HBM copies — the same stripe kernels
@@ -807,12 +877,24 @@ class ServingEngine:
         forks diverge from the first sampled token on (independent
         Gumbel noise per batch row)."""
         stop = self._normalize_stop(stop)
+        if not 0 <= adapter <= self.n_adapters:
+            raise ValueError(
+                f"adapter {adapter} out of range (engine has "
+                f"{self.n_adapters} adapter(s); 0 = base)"
+            )
         self._check_prompt_fits(prompt)
         self._check_capacity(n)
         slots = self._free_slot_indices()[:n]
         first = slots[0]
+        if self.lora is not None:
+            self.slot_adapter = self.slot_adapter.at[
+                jnp.asarray(slots)
+            ].set(adapter)
         start_chunk = 0
-        pref = self._match_prefix(prompt)
+        # registered-prefix stripes hold BASE-model KV: an adapter
+        # request must recompute its whole prompt through the adapter
+        # (reusing base KV would serve a silent base/adapter hybrid)
+        pref = self._match_prefix(prompt) if adapter == 0 else None
         if pref is not None:
             self.cache = self._write_stripe(self.cache, pref.stripe,
                                             first)
@@ -823,7 +905,8 @@ class ServingEngine:
             start_chunk = len(pref.tokens) // self.prefill_len
             self.prefix_hits += 1
             self.prefix_tokens_saved += len(pref.tokens)
-        chunk_logits = self._prefill_chunks(first, prompt, start_chunk)
+        chunk_logits = self._prefill_chunks(first, prompt, start_chunk,
+                                            adapter=adapter)
         last_logits = chunk_logits[(len(prompt) - 1) % self.prefill_len]
         if len(slots) > 1:
             # fork: copy the prefilled stripe to the other slots — the
@@ -893,7 +976,8 @@ class ServingEngine:
         # (the prompt's last token sits at lengths-1; sampled continuation
         # enters the cache when it is fed back as input here)
         self.cache, logits = self._decode(
-            self.params, self.cache, self.last_token, self.lengths
+            self.params, self.cache, self.last_token, self.lengths,
+            self.slot_adapter,
         )
         toks, lps = self._sample(logits)
         if self.track_seen:
@@ -956,6 +1040,7 @@ class ServingEngine:
                 sub, jnp.float32(max(self.temperature, 1e-6)),
                 seen_in,
                 jnp.float32(self.repetition_penalty),
+                self.slot_adapter,
                 n_steps=n_steps, greedy=self.temperature <= 0.0,
                 attend_len=attend, top_k=self.top_k,
                 top_p=float(self.top_p), min_p=float(self.min_p),

@@ -836,12 +836,12 @@ class TestEngineRecovery:
         real = eng.add_request_n
         calls = {"n": 0}
 
-        def flaky(prompt, n, stop=None):
+        def flaky(prompt, n, stop=None, adapter=0):
             calls["n"] += 1
             if calls["n"] == 1:
                 jax.jit(lambda c: c, donate_argnums=(0,))(eng.cache)
                 raise RuntimeError("RESOURCE_EXHAUSTED: injected")
-            return real(prompt, n, stop=stop)
+            return real(prompt, n, stop=stop, adapter=adapter)
 
         eng.add_request_n = flaky
         with ApiServer(eng) as srv:

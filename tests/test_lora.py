@@ -230,3 +230,144 @@ class TestLoraServing:
             build_engine(build_parser().parse_args(
                 cfg_args + ["--lora", str(tmp_path)]
             ))
+
+
+class TestMultiLoraServing:
+    def _mk(self, **kw):
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.float32, remat=False,
+        )
+        return cfg, TpuLM(cfg)
+
+    def _adapter(self, cfg, key, scale=0.05):
+        lcfg = LoraConfig(rank=4)
+        ad = init_lora(jax.random.key(key), cfg, lcfg)
+        for t in lcfg.targets:
+            ad["blocks"][t]["b"] = (
+                jax.random.normal(jax.random.key(key + 50),
+                                  ad["blocks"][t]["b"].shape) * scale
+            )
+        return lcfg, ad
+
+    def test_batched_adapters_match_per_adapter_merged_engines(self):
+        """THE multi-LoRA contract: three requests on three adapters
+        (base, ad1, ad2) decode in ONE batched engine, and each stream
+        is token-identical to a dedicated engine serving that adapter
+        merged into the weights."""
+        from instaslice_tpu.serving import ServingEngine
+
+        cfg, model = self._mk()
+        params = model.init(jax.random.key(0))
+        lcfg1, ad1 = self._adapter(cfg, 1, scale=0.4)
+        lcfg2, ad2 = self._adapter(cfg, 2, scale=1.0)
+        prompt = [5, 9, 3, 7]
+
+        eng = ServingEngine(model, params, max_batch=4, max_len=32,
+                            prefill_len=8,
+                            lora_adapters=[ad1, ad2])
+        rids = {
+            a: eng.add_request(prompt, adapter=a) for a in (0, 1, 2)
+        }
+        got = eng.decode_block(6)
+
+        for a, (lc, ad) in ((0, (None, None)), (1, (lcfg1, ad1)),
+                            (2, (lcfg2, ad2))):
+            p = params if ad is None else merge_lora(params, ad, cfg, lc)
+            ref = ServingEngine(model, p, max_batch=4, max_len=32,
+                                prefill_len=8)
+            rr = ref.add_request(prompt)
+            want = ref.decode_block(6)[rr]
+            assert got[rids[a]] == want, (
+                f"adapter {a}: batched {got[rids[a]]} != merged {want}"
+            )
+        # distinct adapters must actually produce distinct streams
+        # (otherwise the test proves nothing)
+        assert len({tuple(v) for v in got.values()}) >= 2
+
+    def test_adapter_out_of_range_rejected(self):
+        from instaslice_tpu.serving import ServingEngine
+
+        cfg, model = self._mk()
+        _, ad = self._adapter(cfg, 1)
+        eng = ServingEngine(model, model.init(jax.random.key(0)),
+                            max_batch=2, max_len=32, prefill_len=8,
+                            lora_adapters=[ad])
+        with pytest.raises(ValueError, match="out of range"):
+            eng.add_request([1, 2], adapter=2)
+        # no adapters configured: only 0 is legal
+        eng2 = ServingEngine(model, model.init(jax.random.key(0)),
+                             max_batch=2, max_len=32, prefill_len=8)
+        with pytest.raises(ValueError, match="out of range"):
+            eng2.add_request([1, 2], adapter=1)
+
+    def test_lora_plus_spec_decode_rejected(self):
+        from instaslice_tpu.serving import ServingEngine
+
+        cfg, model = self._mk()
+        _, ad = self._adapter(cfg, 1)
+        with pytest.raises(ValueError, match="speculative"):
+            ServingEngine(model, model.init(jax.random.key(0)),
+                          max_batch=2, max_len=32, prefill_len=8,
+                          lora_adapters=[ad], draft_model=model)
+
+    def test_mismatched_ranks_rejected_at_stack(self):
+        from instaslice_tpu.models.lora import stack_adapters
+
+        cfg, _ = self._mk()
+        _, a1 = self._adapter(cfg, 1)
+        a2 = init_lora(jax.random.key(9), cfg, LoraConfig(rank=8))
+        with pytest.raises(ValueError, match="rank"):
+            stack_adapters([a1, a2], cfg)
+
+    def test_build_engine_multi_lora(self, tmp_path):
+        """Two --lora dirs: the server engine keeps the BASE weights
+        and registers both adapters by dir basename (1-based engine
+        ids); one --lora dir still merges (no runtime adapters)."""
+        from instaslice_tpu.models.checkpoint import TrainCheckpointer
+        from instaslice_tpu.serving.api_server import (
+            build_engine,
+            build_parser,
+        )
+
+        cfg = ModelConfig(
+            vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+            max_seq_len=64, dtype=jnp.bfloat16, remat=False,
+        )
+        model = TpuLM(cfg)
+        mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                    ("data", "seq", "model"))
+        base = model.init(jax.random.key(0))
+        for sub in ("billing", "support"):
+            init_fn, step_fn = make_lora_train_step(
+                model, mesh, base, LoraConfig(rank=4),
+                learning_rate=1e-2,
+            )
+            state = init_fn(jax.random.key(hash(sub) % 100))
+            state, _ = step_fn(
+                state,
+                jax.random.randint(jax.random.key(1), (2, 16), 0, 64),
+            )
+            with TrainCheckpointer(str(tmp_path / sub)) as ckpt:
+                assert ckpt.save(state)
+
+        cfg_args = ["--d-model", "32", "--n-heads", "2", "--n-layers",
+                    "2", "--d-ff", "64", "--vocab-size", "64",
+                    "--max-len", "64", "--prefill-len", "8"]
+        eng = build_engine(build_parser().parse_args(
+            cfg_args + ["--lora", str(tmp_path / "billing"),
+                        "--lora", str(tmp_path / "support")]
+        ))
+        assert eng.n_adapters == 2
+        assert eng.adapter_names == {"billing": 1, "support": 2}
+        # base weights untouched (runtime adapters, not a merge)
+        np.testing.assert_array_equal(
+            np.asarray(eng.params["blocks"]["wq"], np.float32),
+            np.asarray(base["blocks"]["wq"], np.float32),
+        )
+        r0 = eng.add_request([3, 1, 4])
+        r1 = eng.add_request([3, 1, 4], adapter=1)
+        r2 = eng.add_request([3, 1, 4], adapter=2)
+        out = eng.decode_block(4)
+        assert all(len(v) == 4 for v in out.values())
+        assert set(out) == {r0, r1, r2}

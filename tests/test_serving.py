@@ -425,6 +425,7 @@ class TestBoundedAttentionWindow:
                 full.params, full.cache, full.last_token, full.lengths,
                 jax.random.key(0), jnp.float32(1e-6),
                 jnp.zeros((2, 1), jnp.bool_), jnp.float32(1.0),
+                full.slot_adapter,
                 n_steps=10, greedy=True, attend_len=0,
             )
         )
