@@ -81,6 +81,7 @@ TPU_PHASES = [
     ("mfu", 300.0),
     ("serving_7b", 420.0),
     ("moe", 300.0),
+    ("serving_lora", 300.0),
     ("serving_spec", 300.0),
     ("serving_small", 180.0),
     ("serving_tp", 120.0),
@@ -383,7 +384,8 @@ def _fold_store(out: dict, store: dict) -> None:
 #: headline, the training headline, then the rest.
 WATCHDOG_PRIORITY = [
     "probe", "flash_fwd", "serving_7b", "mfu", "flash_bwd", "serving",
-    "serving_quant", "moe", "serving_spec", "serving_small", "serving_tp",
+    "serving_quant", "moe", "serving_lora", "serving_spec",
+    "serving_small", "serving_tp",
 ]
 _PHASE_CAPS = dict(TPU_PHASES)
 

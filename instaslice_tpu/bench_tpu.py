@@ -41,7 +41,7 @@ PEAK_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
 
 PHASES = ("probe", "flash_fwd", "flash_bwd", "serving_small", "serving",
           "serving_quant", "serving_spec", "serving_7b", "mfu", "moe",
-          "serving_tp")
+          "serving_lora", "serving_tp")
 
 
 def _readback_rtt(reps: int = 7) -> float:
@@ -825,6 +825,80 @@ def bench_moe(out: dict, *, d_model: int = 2048, n_heads: int = 16,
     )
 
 
+def bench_serving_lora(out: dict, *, n_adapters: int = 4, rank: int = 8,
+                       d_model: int = 1024, n_heads: int = 8,
+                       n_layers: int = 8, d_ff: int = 4096,
+                       vocab: int = 32000, batch: int = 16,
+                       max_len: int = 512, prefill_len: int = 64,
+                       n_steps: int = 128) -> None:
+    """Multi-LoRA decode overhead: the same model served plain vs with
+    ``n_adapters`` rank-``rank`` adapters spread round-robin across the
+    batch (every request on a different adapter — the worst case for
+    the one-hot gather). The delta is the cost of the per-row
+    (in, r) @ (r, out) adapter path in ``TpuLM.apply_with_cache``;
+    perf evidence for the feature from day one (the MoE phase lacked
+    it for a round and got flagged). Keyword dims exist so the test
+    tier runs the whole phase on the CPU path."""
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.models.lora import LoraConfig, init_lora
+    from instaslice_tpu.serving import ServingEngine
+
+    cfg = ModelConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_ff=d_ff, max_seq_len=max_len,
+        dtype=jnp.bfloat16 if jax.default_backend() == "tpu"
+        else jnp.float32,
+        remat=False,
+    )
+    model = TpuLM(cfg)
+    params = model.init(jax.random.key(0))
+    lcfg = LoraConfig(rank=rank)
+    adapters = []
+    for i in range(n_adapters):
+        ad = init_lora(jax.random.key(100 + i), cfg, lcfg)
+        for t in lcfg.targets:   # nonzero B: no dead-code shortcuts
+            ad["blocks"][t]["b"] = jax.random.normal(
+                jax.random.key(200 + i), ad["blocks"][t]["b"].shape,
+            ) * 0.01
+        adapters.append(ad)
+    rtt = _readback_rtt()
+
+    def tput(eng, with_adapters: bool) -> float:
+        for i in range(batch):
+            eng.add_request(
+                [1, 2, 3],
+                adapter=(i % (n_adapters + 1)) if with_adapters else 0,
+            )
+        n = min(n_steps, max(1, (max_len - 8) // 2))
+        eng.decode_block(n)                      # compile + warm
+        live = len(eng.slots)
+        t0 = time.perf_counter()
+        eng.decode_block(n)
+        wall = max(time.perf_counter() - t0 - rtt, 1e-9)
+        return n * live / wall
+
+    base = tput(ServingEngine(model, params, max_batch=batch,
+                              max_len=max_len, prefill_len=prefill_len),
+                with_adapters=False)
+    lora = tput(ServingEngine(model, params, max_batch=batch,
+                              max_len=max_len, prefill_len=prefill_len,
+                              lora_adapters=adapters),
+                with_adapters=True)
+    out["serving_lora_base_tokens_per_sec"] = round(base, 1)
+    out["serving_lora_tokens_per_sec"] = round(lora, 1)
+    out["serving_lora_overhead_pct"] = round(
+        100.0 * (base - lora) / base, 1
+    )
+    out["serving_lora_rtt_ms"] = round(rtt * 1000, 1)
+    out["serving_lora_config"] = (
+        f"{n_adapters} adapters rank {rank}, batch {batch} round-robin "
+        f"(incl. base rows), d{d_model} L{n_layers}"
+    )
+
+
 def _enable_compile_cache() -> None:
     """Persistent compile cache shared across phase subprocesses (and
     bench re-runs): first compiles are 20-40 s each, cached reloads are
@@ -864,6 +938,8 @@ def run_phase(phase: str, out: dict) -> None:
         bench_train_mfu(out, gen)
     elif phase == "moe":
         bench_moe(out)
+    elif phase == "serving_lora":
+        bench_serving_lora(out)
     elif phase == "serving_tp":
         bench_serving_tp(out)
     else:

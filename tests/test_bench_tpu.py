@@ -280,3 +280,26 @@ class TestMoeBenchPhase:
         assert set(mod.WATCHDOG_PRIORITY) == set(dict(mod.TPU_PHASES))
 
     _bench_mod = TestWedgeResilientBench._bench_mod
+
+
+class TestServingLoraBenchPhase:
+    def test_phase_runs_on_cpu_with_tiny_dims(self):
+        from instaslice_tpu.bench_tpu import bench_serving_lora
+
+        out = {}
+        bench_serving_lora(out, n_adapters=2, rank=2, d_model=32,
+                           n_heads=4, n_layers=2, d_ff=64, vocab=64,
+                           batch=3, max_len=64, prefill_len=8,
+                           n_steps=8)
+        assert out["serving_lora_base_tokens_per_sec"] > 0
+        assert out["serving_lora_tokens_per_sec"] > 0
+        assert "serving_lora_overhead_pct" in out
+        assert "2 adapters rank 2" in out["serving_lora_config"]
+
+    def test_phase_registered_everywhere(self):
+        from instaslice_tpu.bench_tpu import PHASES
+
+        mod = TestWedgeResilientBench._bench_mod(self)
+        assert "serving_lora" in PHASES
+        assert "serving_lora" in dict(mod.TPU_PHASES)
+        assert "serving_lora" in mod.WATCHDOG_PRIORITY
