@@ -25,6 +25,7 @@ import statistics
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import List
 
@@ -38,9 +39,11 @@ def _percentile(xs: List[float], q: float) -> float:
 
 
 def _one_request(url: str, prompt: List[int], max_tokens: int,
-                 stream: bool, timeout: float):
+                 stream: bool, timeout: float, adapter: str = ""):
     """Returns (latency_s, ttft_s or None, tokens, error or None)."""
     body = {"prompt": prompt, "max_tokens": max_tokens}
+    if adapter:
+        body["adapter"] = adapter
     if stream:
         body["stream"] = True
     req = urllib.request.Request(
@@ -82,6 +85,15 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
                     if got and ttft is None:
                         ttft = time.monotonic() - t0
                     toks += len(got)
+    except urllib.error.HTTPError as e:
+        # carry the server's error BODY, not just the status line —
+        # "unknown adapter 'x' (serving: ...)" beats "400 Bad Request"
+        try:
+            detail = json.loads(e.read().decode()).get("error", "")
+        except Exception:  # noqa: BLE001 - body unreadable/not ours
+            detail = ""
+        msg = f"HTTPError {e.code}: {detail or e.reason}"
+        return time.monotonic() - t0, None, 0, msg
     except Exception as e:  # noqa: BLE001 - a benchmark client must
         # ACCOUNT for every failure (IncompleteRead from a dropped
         # body, JSONDecodeError from a proxy's HTML error page, …);
@@ -92,7 +104,10 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
 
 def run(url: str, requests: int, concurrency: int, prompt_len: int,
         max_tokens: int, vocab: int, stream: bool, timeout: float,
-        seed: int = 0) -> dict:
+        seed: int = 0, adapters: List[str] = ()) -> dict:
+    """``adapters``: multi-LoRA names assigned round-robin across
+    requests ("" rides the base model) — load-tests the batched
+    per-request adapter path."""
     rng = random.Random(seed)
     prompts = [
         [rng.randrange(1, vocab) for _ in range(prompt_len)]
@@ -112,7 +127,8 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
             if i is None:
                 return
             dt, ttft, toks, err = _one_request(
-                url, prompts[i], max_tokens, stream, timeout
+                url, prompts[i], max_tokens, stream, timeout,
+                adapter=adapters[i % len(adapters)] if adapters else "",
             )
             with lock:
                 if err is None:
@@ -144,6 +160,8 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
         "client_tokens_per_sec": round(tokens[0] / wall, 1),
         "stream": stream,
     }
+    if adapters:
+        out["adapters"] = list(adapters)
     if stream:
         out["ttft_p50"] = round(_percentile(ttfts, 0.5), 4)
         out["ttft_p95"] = round(_percentile(ttfts, 0.95), 4)
@@ -166,6 +184,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="SSE mode: also report time-to-first-token")
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--adapters", default="",
+                    help="comma-separated multi-LoRA adapter names "
+                         "assigned round-robin across requests (an "
+                         "empty entry rides the base model, e.g. "
+                         "',billing,support')")
     ap.add_argument("--sweep", default="",
                     help="comma-separated concurrency levels (e.g. "
                          "'1,2,4,8'): run --requests at EACH level and "
@@ -176,6 +199,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    adapters = ([a.strip() for a in args.adapters.split(",")]
+                if args.adapters else [])
     if args.sweep:
         try:
             levels = [int(x) for x in args.sweep.split(",")
@@ -190,7 +215,7 @@ def main(argv=None) -> int:
         for c in levels:
             r = run(args.url, args.requests, c, args.prompt_len,
                     args.max_tokens, args.vocab, args.stream,
-                    args.timeout, seed=args.seed)
+                    args.timeout, seed=args.seed, adapters=adapters)
             curve.append(r)
         errors = sum(r["errors"] for r in curve)
         # headline = the level with the best aggregate throughput; the
@@ -207,7 +232,8 @@ def main(argv=None) -> int:
         return 0 if not errors else 1
     out = run(args.url, args.requests, args.concurrency,
               args.prompt_len, args.max_tokens, args.vocab,
-              args.stream, args.timeout, seed=args.seed)
+              args.stream, args.timeout, seed=args.seed,
+              adapters=adapters)
     print(json.dumps(out))
     return 0 if not out["errors"] else 1
 

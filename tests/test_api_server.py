@@ -517,6 +517,33 @@ class TestApiServer:
         assert all(l["ok"] == 4 for l in out["levels"])
         assert out["best_concurrency"] in (1, 2)
 
+    def test_loadgen_multi_lora_round_robin(self, model):
+        """--adapters: requests round-robin across named adapters (and
+        the base via the empty name) over real HTTP — the multi-LoRA
+        serving path under client load; unknown names surface as
+        errors, not silent base-model traffic."""
+        from instaslice_tpu.models.lora import LoraConfig, init_lora
+        from instaslice_tpu.serving.loadgen import run
+
+        m, params = model
+        ads = [init_lora(jax.random.key(i), m.cfg, LoraConfig(rank=2))
+               for i in (1, 2)]
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, lora_adapters=ads,
+                            lora_names=["billing", "support"])
+        with ApiServer(eng, block_size=4) as srv:
+            out = run(srv.url, requests=6, concurrency=2, prompt_len=6,
+                      max_tokens=4, vocab=64, stream=False,
+                      timeout=120,
+                      adapters=["", "billing", "support"])
+            assert out["ok"] == 6 and out["errors"] == 0
+            assert out["adapters"] == ["", "billing", "support"]
+            bad = run(srv.url, requests=2, concurrency=1, prompt_len=6,
+                      max_tokens=4, vocab=64, stream=False,
+                      timeout=120, adapters=["nonexistent"])
+            assert bad["errors"] == 2
+            assert "unknown adapter" in bad["first_error"]
+
     def test_models_route(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=64,
