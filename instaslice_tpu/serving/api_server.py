@@ -416,11 +416,32 @@ class _Handler(BaseHTTPRequestHandler):
                     "vocab_size": cfg.vocab_size,
                 },
             }
+            # multi-LoRA: each adapter lists as its own model entry
+            # (the OpenAI-ecosystem convention — clients pick adapters
+            # from the model list), flagged with "parent" = the base
+            adapters = [
+                {
+                    "id": name,
+                    "object": "model",
+                    "created": 0,
+                    "owned_by": "tpuslice",
+                    "parent": entry["id"],
+                    "adapter": True,
+                }
+                for name in sorted(
+                    getattr(eng, "adapter_names", {}) or {}
+                )
+            ]
             tail = self.path.rstrip("/")[len("/v1/models"):]
             if not tail:
-                self._send(200, {"object": "list", "data": [entry]})
+                self._send(200, {"object": "list",
+                                 "data": [entry] + adapters})
             elif tail == "/" + entry["id"]:
                 self._send(200, entry)     # retrieve-model route
+            elif any(tail == "/" + a["id"] for a in adapters):
+                self._send(200, next(
+                    a for a in adapters if tail == "/" + a["id"]
+                ))
             else:
                 self._send(404, {"error": f"no model {tail[1:]!r}"})
         else:

@@ -517,6 +517,35 @@ class TestApiServer:
         assert all(l["ok"] == 4 for l in out["levels"])
         assert out["best_concurrency"] in (1, 2)
 
+    def test_models_route_lists_adapters(self, model):
+        """Multi-LoRA servers list each adapter as a model entry
+        (parent = the base id, adapter flag set) — how OpenAI-ecosystem
+        clients discover what they can put in the adapter field."""
+        from instaslice_tpu.models.lora import LoraConfig, init_lora
+
+        m, params = model
+        ads = [init_lora(jax.random.key(i), m.cfg, LoraConfig(rank=2))
+               for i in (1, 2)]
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, lora_adapters=ads,
+                            lora_names=["billing", "support"])
+        with ApiServer(eng) as srv:
+            with urllib.request.urlopen(
+                f"{srv.url}/v1/models", timeout=30
+            ) as r:
+                out = json.loads(r.read())
+            ids = [e["id"] for e in out["data"]]
+            assert ids[0].startswith("tpuslice-lm-")
+            assert set(ids[1:]) == {"billing", "support"}
+            assert all(e["adapter"] and e["parent"] == ids[0]
+                       for e in out["data"][1:])
+            # retrieve-model works for an adapter id too
+            with urllib.request.urlopen(
+                f"{srv.url}/v1/models/billing", timeout=30
+            ) as r:
+                one = json.loads(r.read())
+            assert one["id"] == "billing" and one["adapter"] is True
+
     def test_loadgen_multi_lora_round_robin(self, model):
         """--adapters: requests round-robin across named adapters (and
         the base via the empty name) over real HTTP — the multi-LoRA
