@@ -26,7 +26,9 @@ Prints ONE JSON line. The required keys ({"metric", "value", "unit",
 from __future__ import annotations
 
 import argparse
+import contextlib
 import datetime
+import fcntl
 import json
 import os
 import signal
@@ -229,13 +231,29 @@ def _load_store() -> dict:
 
 def _save_store(store: dict) -> None:
     """Atomic write: a wedge (or SIGKILL) mid-save must not destroy the
-    phases already captured."""
+    phases already captured. The tmp name is per-pid — two writers
+    sharing one tmp path would rename each other's file away
+    mid-write."""
     store["updated"] = _utcnow()
-    tmp = RESULTS_STORE + ".tmp"
+    tmp = f"{RESULTS_STORE}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(store, f, indent=1)
         f.write("\n")
     os.replace(tmp, RESULTS_STORE)
+
+
+@contextlib.contextmanager
+def _store_lock():
+    """Serialize store read-modify-write across processes: a sidecar
+    flock (released with the fd even on SIGKILL; the file is never
+    unlinked — removing it would let a third writer lock a different
+    inode under the same path)."""
+    fd = os.open(RESULTS_STORE + ".lock", os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX)
+        yield
+    finally:
+        os.close(fd)
 
 
 def _journal(event: dict) -> None:
@@ -262,10 +280,11 @@ def _record_phase(phase: str, frag: dict) -> dict:
     rewrite. (Capture bursts hold the host flock, so two writers cannot
     actually burst concurrently — this guards the load-before-lock and
     crash-recovery windows.)"""
-    store = _load_store()
-    store["phases"][phase] = frag
-    store["phase_ts"][phase] = _utcnow()
-    _save_store(store)
+    with _store_lock():
+        store = _load_store()
+        store["phases"][phase] = frag
+        store["phase_ts"][phase] = _utcnow()
+        _save_store(store)
     return store
 
 

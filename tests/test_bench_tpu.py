@@ -303,3 +303,47 @@ class TestServingLoraBenchPhase:
         assert "serving_lora" in PHASES
         assert "serving_lora" in dict(mod.TPU_PHASES)
         assert "serving_lora" in mod.WATCHDOG_PRIORITY
+
+
+class TestStoreConcurrency:
+    def test_concurrent_record_phase_loses_nothing(self, tmp_path):
+        """Two processes hammering _record_phase on one store must not
+        clobber each other's phases (the fresh-load merge in
+        _record_phase): every phase written by either process survives
+        in the final file."""
+        script = r"""
+import os, sys
+sys.path.insert(0, sys.argv[3])
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "bench_root", os.path.join(sys.argv[3], "bench.py"))
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+mod.RESULTS_STORE = sys.argv[1]
+start = int(sys.argv[2])
+for i in range(start, start + 20):
+    mod._record_phase(f"phase{i}", {"v": i})
+print("done")
+"""
+        store = str(tmp_path / "store.json")
+        env = dict(os.environ)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", script, store, str(base), _REPO],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            )
+            for base in (0, 100)
+        ]
+        for p in procs:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()
+        final = json.loads(open(store).read())
+        have = set(final["phases"])
+        # interleaved whole-file writes can drop at most the phases a
+        # LOSING load-save window held — with merge-on-save the union
+        # must be complete
+        want = {f"phase{i}" for i in range(20)} | {
+            f"phase{i}" for i in range(100, 120)
+        }
+        missing = want - have
+        assert not missing, f"lost phases: {sorted(missing)}"
