@@ -120,3 +120,75 @@ class TestChaos:
                 assert b.list_reservations() == [], node
         finally:
             c.stop()
+
+    def test_randomized_ops_converge_cloudtpu(self):
+        """The same hammering through the Cloud TPU queued-resources
+        wire path (real HTTP to per-node mock APIs): randomized
+        submissions, deletions, and injected FAILED provisioning. The
+        cloud is the durable registry, so the invariants read IT — no
+        chip double-reserved server-side, full drain leaves no queued
+        resources behind."""
+        rng = random.Random(SEED + 1)
+        c = SimCluster(n_nodes=2, generation="v5e", shared_torus=True,
+                       deletion_grace_seconds=0.1,
+                       health_interval=0.1,
+                       backend="cloudtpu").start()
+        try:
+            live = []
+            n = 0
+            deadline = time.monotonic() + DURATION_S
+            while time.monotonic() < deadline:
+                op = rng.random()
+                if op < 0.5:
+                    name = f"q{n}"
+                    n += 1
+                    c.submit(name, rng.choice(PROFILES))
+                    live.append(name)
+                elif op < 0.75 and live:
+                    victim = live.pop(rng.randrange(len(live)))
+                    try:
+                        c.delete_pod(victim)
+                    except Exception:
+                        pass
+                else:
+                    node = rng.choice(list(c.mock_servers))
+                    c.mock_servers[node].fail_next_create(1)
+                _no_double_grant(c)
+                time.sleep(rng.uniform(0.0, 0.05))
+
+            deadline = time.monotonic() + 25
+            prev, stable = None, 0
+            phases = {}
+            while time.monotonic() < deadline:
+                _no_double_grant(c)
+                phases = {p: c.pod_phase(p) for p in live}
+                stable = stable + 1 if phases == prev else 0
+                prev = phases
+                if stable >= 5 and not any(
+                    ph == "Pending" for ph in phases.values()
+                ):
+                    break
+                time.sleep(0.2)
+            bad = {p: ph for p, ph in phases.items()
+                   if ph not in ("Running", "Pending", "Gone")}
+            assert not bad, f"pods wedged mid-grant after settle: {bad}"
+
+            for name in live:
+                try:
+                    c.delete_pod(name)
+                except Exception:
+                    pass
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                leftover = sum(
+                    len(b.list_reservations())
+                    for b in c.backends.values()
+                )
+                if not c.allocations() and leftover == 0:
+                    break
+                time.sleep(0.2)
+            assert c.allocations() == {}, c.allocations()
+            for node, b in c.backends.items():
+                assert b.list_reservations() == [], node
+        finally:
+            c.stop()
