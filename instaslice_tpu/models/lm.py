@@ -558,6 +558,7 @@ class TpuLM:
         n_micro: int,
         axis_name: str = "pipe",
         unembed: bool = True,
+        return_aux: bool = False,
     ) -> jax.Array:
         """Pipeline-parallel forward: the layer stack runs as GPipe
         stages over the mesh's ``axis_name`` axis, microbatching the
@@ -565,7 +566,12 @@ class TpuLM:
         Embedding/unembedding stay outside the pipeline (replicated).
         Composes with tensor parallelism — the stage body's einsums keep
         their ``model``-axis sharding; ring attention (a second manual
-        axis) is not supported inside a pipeline stage."""
+        axis) is not supported inside a pipeline stage.
+
+        ``return_aux=True`` additionally returns the MoE load-balance
+        term, summed per stage over its valid ticks and psum'd over the
+        pipe axis (layer- and microbatch-averaged — see
+        ``pipeline_blocks`` on the microbatch-mean estimator)."""
         from instaslice_tpu.parallel.pipeline import pipeline_blocks
 
         cfg = self.cfg
@@ -580,30 +586,29 @@ class TpuLM:
         positions = jnp.arange(S, dtype=jnp.int32)
 
         def block_fn(layer, xb):
-            # aux is dropped on the pipeline path: stages run under a
-            # manual pipe axis and the load-balance scalar would need
-            # its own cross-stage reduction — train MoE with the scan
-            # stack (tp/dp/sp) when the router needs regularizing
-            xb, _ = _transformer_block(
+            xb, aux = _transformer_block(
                 cfg, layer, xb, positions,
                 lambda q, k, v: _attention(q, k, v,
                                            impl=cfg.attention_impl,
                                            window=cfg.window),
             )
-            return xb
+            return (xb, aux) if return_aux else xb
 
-        x = pipeline_blocks(
+        out = pipeline_blocks(
             block_fn, params["blocks"], x,
             mesh=mesh, axis_name=axis_name, n_micro=n_micro,
             remat=cfg.remat, remat_policy=cfg.remat_policy,
+            with_aux=return_aux,
         )
+        x, aux = out if return_aux else (out, None)
         x = _rmsnorm(x, params["ln_f"]["scale"])
         if not unembed:
-            return x
-        return jnp.einsum(
+            return (x, aux) if return_aux else x
+        logits = jnp.einsum(
             "bsd,vd->bsv", x, weight(params["embed"], cfg.dtype),
             preferred_element_type=jnp.float32,
         )
+        return (logits, aux) if return_aux else logits
 
     # ------------------------------------------------------------ KV cache
 

@@ -10,7 +10,6 @@ state, bf16 compute — the standard TPU mixed-precision recipe.
 from __future__ import annotations
 
 import dataclasses
-import warnings
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -112,27 +111,13 @@ def loss_fn(
 
     MoE models add ``moe_aux_weight`` × the router load-balance term
     (Switch: without it top-k routing collapses onto a few experts and
-    the capacity drops eat the batch). The pipeline path has no aux
-    (see ``TpuLM.apply_pipelined``)."""
+    the capacity drops eat the batch) — on the pipeline path too, where
+    the per-stage sums psum over the pipe axis (the microbatch-mean
+    estimator; see ``pipeline_blocks``)."""
     targets = jnp.roll(tokens, -1, axis=1)
     mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
     chunked = loss_chunk > 0 and not model.cfg.ring_attention
-    want_aux = bool(model.cfg.n_experts) and moe_aux_weight > 0 \
-        and not n_micro
-    if bool(model.cfg.n_experts) and moe_aux_weight > 0 and n_micro:
-        # Silent router collapse is worse than a noisy run: without the
-        # aux term top-k routing degenerates and capacity drops eat the
-        # batch with no loss-curve signal. Pipelined MoE training should
-        # set moe_aux_weight=0 explicitly (acknowledging the risk) until
-        # apply_pipelined threads aux through its stages.
-        warnings.warn(
-            "MoE + pipeline parallelism (n_micro > 0) drops the router "
-            "load-balance aux loss: apply_pipelined does not return aux. "
-            "The router can silently collapse. Set moe_aux_weight=0 to "
-            "acknowledge, or train this config without the pipeline.",
-            RuntimeWarning,
-            stacklevel=2,
-        )
+    want_aux = bool(model.cfg.n_experts) and moe_aux_weight > 0
     aux = 0.0
     if n_micro:
         if mesh is None:
@@ -143,12 +128,13 @@ def loss_fn(
         out = model.apply_pipelined(
             params, tokens, mesh=mesh, n_micro=n_micro,
             axis_name=pipe_axis, unembed=not chunked,
+            return_aux=want_aux,
         )
     else:
         out = model.apply(params, tokens, mesh=mesh,
                           unembed=not chunked, return_aux=want_aux)
-        if want_aux:
-            out, aux = out
+    if want_aux:
+        out, aux = out
     if chunked:
         total = _chunked_xent(params["embed"], out, targets, mask,
                               loss_chunk)

@@ -73,6 +73,7 @@ def pipeline_blocks(
     axis_name: str = "pipe",
     remat: bool = True,
     remat_policy: str = "full",
+    with_aux: bool = False,
 ) -> jax.Array:
     """Apply ``L`` stacked layers to ``x`` (B, S, D), pipelined.
 
@@ -82,6 +83,18 @@ def pipeline_blocks(
     layer. ``B`` must be divisible by ``n_micro``. Returns the (B, S, D)
     result identical (up to fp reassociation) to scanning the layers on
     one device.
+
+    ``with_aux=True`` changes the block contract to
+    ``block_fn(layer_params, x) -> (x, aux_scalar)`` and returns
+    ``(out, aux)``, where ``aux`` is the per-layer scalars averaged
+    over layers AND microbatches: each stage sums its layers' aux for
+    its VALID ticks only (warm-up/drain ticks run on wraparound
+    garbage and are masked out), a ``psum`` over the pipe axis totals
+    the stages, and the result divides by ``L·M``. Note the estimator
+    difference from the unpipelined scan: MoE load-balance aux is
+    nonlinear in the batch (``E·Σ f_e·P_e`` over batch-mean f/P), so
+    the mean of per-microbatch auxes ≠ the full-batch aux — the same
+    (standard) estimator shift gradient accumulation makes.
     """
     n_pipe = mesh.shape[axis_name]
     leaves = jax.tree.leaves(stacked_params)
@@ -110,12 +123,17 @@ def pipeline_blocks(
         perm = [(i, (i + 1) % n_pipe) for i in range(n_pipe)]
 
         def run_layers(h):
+            if with_aux:
+                out, auxs = lax.scan(
+                    lambda c, p: layer_body(p, c), h, params_local
+                )
+                return out, jnp.sum(auxs)
             return lax.scan(
                 lambda c, p: (layer_body(p, c), None), h, params_local
-            )[0]
+            )[0], jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            prev, acc = carry
+            prev, acc, aux_acc = carry
             # activation from the upstream stage's previous tick; the
             # wraparound edge (last → 0) carries garbage that the s == 0
             # select below discards
@@ -124,7 +142,12 @@ def pipeline_blocks(
             first = lax.dynamic_index_in_dim(x_mb, idx_in, 0,
                                              keepdims=False)
             inp = jnp.where(s == 0, first, recv)
-            out = run_layers(inp)
+            out, aux_t = run_layers(inp)
+            # stage s processes REAL microbatches only at ticks
+            # [s, s + M); warm-up/drain ticks chew wraparound garbage
+            # whose aux must not pollute the total
+            valid = jnp.logical_and(t >= s, t < s + M)
+            aux_acc = aux_acc + jnp.where(valid, aux_t, 0.0)
             # stage P-1 finishes microbatch t-(P-1) at tick t
             idx_out = jnp.clip(t - (n_pipe - 1), 0, M - 1)
             take = jnp.logical_and(s == n_pipe - 1, t >= n_pipe - 1)
@@ -133,23 +156,27 @@ def pipeline_blocks(
             acc = lax.dynamic_update_index_in_dim(
                 acc, jnp.where(take, out, cur), idx_out, 0
             )
-            return (out, acc), None
+            return (out, acc, aux_acc), None
 
         zero = jnp.zeros_like(x_mb[0])
         acc0 = jnp.zeros_like(x_mb)
+        aux0 = jnp.zeros((), jnp.float32)
         # mark carries device-varying over the pipe axis so the scan's
         # varying-manual-axes annotation is consistent from step 0 (the
         # tick body makes them varying via axis_index/ppermute)
         _vary = getattr(lax, "pcast", None)
         if _vary is not None:
-            zero, acc0 = (
-                _vary(t, (axis_name,), to="varying") for t in (zero, acc0)
+            zero, acc0, aux0 = (
+                _vary(t, (axis_name,), to="varying")
+                for t in (zero, acc0, aux0)
             )
         else:  # pragma: no cover - older jax
-            zero, acc0 = (lax.pvary(t, (axis_name,)) for t in (zero, acc0))
-        (_, acc), _ = lax.scan(
+            zero, acc0, aux0 = (
+                lax.pvary(t, (axis_name,)) for t in (zero, acc0, aux0)
+            )
+        (_, acc, aux_acc), _ = lax.scan(
             tick,
-            (zero, acc0),
+            (zero, acc0, aux0),
             jnp.arange(M + n_pipe - 1, dtype=jnp.int32),
         )
         # only the last stage's accumulator holds the result; mask +
@@ -158,16 +185,22 @@ def pipeline_blocks(
             jnp.where(s == n_pipe - 1, acc, jnp.zeros_like(acc)),
             axis_name,
         )
-        return acc
+        # every stage contributes its layers' aux: the psum totals the
+        # whole depth × all microbatches
+        aux_total = lax.psum(aux_acc, axis_name)
+        return acc, aux_total
 
-    out = jax.shard_map(
+    out, aux_total = jax.shard_map(
         stage,
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(axis_name), staged),
             P(),
         ),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={axis_name},
     )(staged, x_mb)
-    return out.reshape(x.shape)
+    out = out.reshape(x.shape)
+    if with_aux:
+        return out, aux_total / (n_layers * M)
+    return out
