@@ -82,7 +82,10 @@ TPU_PHASES = [
     ("serving_quant", 300.0),
     ("mfu", 300.0),
     ("serving_7b", 420.0),
-    ("moe", 300.0),
+    # two fresh model compiles (dense + MoE with the one-hot dispatch
+    # einsums) over a tunnel: 300s was hit twice on 2026-07-31 when a
+    # code edit invalidated the compile cache mid-round
+    ("moe", 480.0),
     ("serving_lora", 300.0),
     ("serving_spec", 300.0),
     ("serving_small", 180.0),
@@ -528,7 +531,29 @@ def main(argv=None) -> int:
                     help="watchdog: give up after this long")
     ap.add_argument("--once", action="store_true",
                     help="watchdog: one probe cycle, then exit")
+    ap.add_argument("--drop-phases", default="",
+                    help="comma-separated phase names to remove from the "
+                    "results store so the next watchdog cycle (or bench "
+                    "run) re-captures them — e.g. after a code change "
+                    "that invalidates their numbers")
     args = ap.parse_args(argv)
+    if args.drop_phases:
+        names = [n.strip() for n in args.drop_phases.split(",") if n.strip()]
+        unknown = [n for n in names if n not in _PHASE_CAPS]
+        if unknown:
+            print(f"unknown phases: {unknown}; valid: "
+                  f"{list(_PHASE_CAPS)}", file=sys.stderr)
+            return 2
+        with _store_lock():
+            store = _load_store()
+            dropped = [n for n in names if store["phases"].pop(n, None)
+                       is not None]
+            for n in dropped:
+                store["phase_ts"].pop(n, None)
+            _save_store(store)
+        print(f"dropped {dropped}; store now holds "
+              f"{sorted(store['phases'])}")
+        return 0
     if args.watchdog:
         return watchdog(args.interval, args.max_hours, args.once)
 

@@ -412,6 +412,12 @@ def bench_serving_quant(out: dict) -> None:
     )
     tput = eng.throughput(n_steps=256, overhead_seconds=_readback_rtt())
     out["decode_tokens_per_sec_per_chip_int8"] = round(tput, 1)
+    # provenance: whether decode streamed int8 weight bytes through the
+    # pallas w8a16 kernel or the XLA dequant path (ops/quant_matmul.py)
+    from instaslice_tpu.models.quant import _kernel_enabled
+    out["serving_quant_w8a16_kernel"] = bool(
+        _kernel_enabled() and eng._quant_kernel
+    )
 
 
 def _init_quantized_params(cfg):
@@ -500,6 +506,7 @@ def bench_serving_7b(out: dict) -> None:
     out["serving_7b_init_seconds"] = round(time.perf_counter() - t0, 1)
     model = TpuLM(cfg)
     batches = (8, 16, 32)
+    kernel_routed = None          # set from the engine actually measured
     for bi, batch in enumerate(batches):
         if time.monotonic() >= deadline:
             out[f"serving_7b_b{batch}"] = "skipped: phase budget exhausted"
@@ -523,6 +530,7 @@ def bench_serving_7b(out: dict) -> None:
             ttft_raw = time.perf_counter() - t0
             ttft = max(ttft_raw - rtt, 0.0)
             tput = eng.throughput(n_steps=128, overhead_seconds=rtt)
+            kernel_routed = eng._quant_kernel
         except Exception as e:  # noqa: BLE001 - OOM is a RESULT here
             if not _is_oom(e):
                 raise
@@ -542,6 +550,16 @@ def bench_serving_7b(out: dict) -> None:
         out[f"serving_7b_rtt_ms_b{batch}"] = round(rtt * 1000, 1)
     out["serving_7b_quant"] = "int8 weights + int8 KV cache"
     out["serving_7b_arch"] = "GQA 32q/8kv heads, d4096, L32, ff20480"
+    # provenance: pallas w8a16 kernel vs XLA dequant path (the latter
+    # materializes bf16 dot operands — ~5 bytes/param/step, the
+    # pre-kernel 2026-07-31 capture's bottleneck). Only recorded when a
+    # decode was actually measured; ANDed with the engine's own routing
+    # decision, not just the env kill-switch.
+    if kernel_routed is not None:
+        from instaslice_tpu.models.quant import _kernel_enabled
+        out["serving_7b_w8a16_kernel"] = bool(
+            _kernel_enabled() and kernel_routed
+        )
 
 
 def bench_serving_spec(out: dict) -> None:

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from instaslice_tpu.models.quant import embed_lookup, weight
+from instaslice_tpu.models.quant import embed_lookup, qdot, weight
 from instaslice_tpu.parallel.pipeline import REMAT_POLICIES, apply_remat
 
 Params = Dict[str, Any]
@@ -647,6 +647,7 @@ class TpuLM:
         attend_len: int = 0,
         lora: Optional[Params] = None,
         adapter_idx: Optional[jax.Array] = None,
+        quant_kernel: bool = True,
     ) -> Tuple[jax.Array, Params]:
         """Incremental forward: run ``tokens`` (B, T) through the model
         with each row appended at its own cache offset ``lengths`` (B,).
@@ -662,6 +663,11 @@ class TpuLM:
         ``s`` for query ``t`` iff ``s <= lengths[b] + t``, so padded
         prefill garbage beyond a row's true length is never attended (it
         is progressively overwritten by later decode steps).
+
+        ``quant_kernel`` (static) permits the pallas w8a16 path for
+        quantized weights at decode-sized row counts; the engine passes
+        False under a multi-device mesh (pallas_call does not
+        auto-partition — see ``quant.qdot``).
 
         ``attend_len`` (static) bounds the attended cache window:
         attention reads only positions [0, attend_len) instead of the
@@ -772,10 +778,14 @@ class TpuLM:
                 layer, kc, vc = xs                    # kc: (B,S,H,hd)
 
             def proj(h_in, name, w, out_fp32=False):
-                """Base einsum + this row's adapter delta (if adapted)."""
-                y = jnp.einsum("bsd,dk->bsk", h_in,
-                               weight(w, cfg.dtype),
-                               preferred_element_type=jnp.float32)
+                """Base contraction + this row's adapter delta (if
+                adapted). Routed through :func:`quant.qdot`: quantized
+                weights at decode-sized row counts take the pallas w8a16
+                kernel so only int8 bytes cross HBM."""
+                y = qdot(
+                    h_in.reshape(B * T, -1), w, compute_dtype=cfg.dtype,
+                    kernel_ok=quant_kernel,
+                ).reshape(B, T, -1)
                 if name in lblocks:
                     y = y + lora_delta(h_in, lblocks[name])
                 return y if out_fp32 else y.astype(cfg.dtype)
@@ -856,10 +866,13 @@ class TpuLM:
             xs_in += (lora["blocks"],)
         x, new = lax.scan(block, x, xs_in)
         x = _rmsnorm(x, params["ln_f"]["scale"])
-        logits = jnp.einsum(
-            "bsd,vd->bsv", x, weight(params["embed"], cfg.dtype),
-            preferred_element_type=jnp.float32,
-        )
+        # embedding table is (vocab, d): contract d via transpose_w; a
+        # quantized table at decode row counts takes the w8a16 kernel
+        logits = qdot(
+            x.reshape(B * T, -1), params["embed"],
+            compute_dtype=cfg.dtype, transpose_w=True,
+            kernel_ok=quant_kernel,
+        ).reshape(B, T, -1)
         out_cache = {"k": new[0], "v": new[1]}
         if quant:
             out_cache["k_s"], out_cache["v_s"] = new[2], new[3]

@@ -5,9 +5,12 @@ all weights, so storing them as int8 (+ a per-output-channel fp32 scale)
 halves the bytes the matmuls stream versus bf16 — the classic
 weight-only-quant serving trade (accuracy cost is small because
 activations stay bf16 and the scale is per-channel symmetric). On TPU
-the dequantize (convert + channel-scale multiply) is an elementwise
-producer that XLA fuses into the dot's operand load, so the int8 bytes
-are what actually cross HBM.
+XLA does NOT fuse the dequantize into the dot — dot operands are
+materialized, so the naive quantized path streams int8 + 2× bf16 bytes
+(measured: the 2026-07-31 7B capture's 36 ms decode step). :func:`qdot`
+therefore routes decode-sized contractions through the pallas w8a16
+kernel (``ops/quant_matmul.py``), where the int8 bytes are the only
+weight HBM traffic.
 
 Usage::
 
@@ -24,6 +27,7 @@ and ``s`` together via prefix-tree semantics.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
@@ -139,6 +143,41 @@ def shard_params(params: Params, mesh, specs: Params) -> Params:
         place, params, specs,
         is_leaf=lambda x: isinstance(x, QuantizedTensor),
     )
+
+
+#: row-count ceiling for routing a contraction through the pallas w8a16
+#: kernel. Decode (M = batch ≤ 64) is HBM-bound on the weight stream and
+#: wins ~5× bytes; prefill (M in the thousands) is compute-bound and the
+#: XLA path's materialized dequant amortizes over the rows.
+_QDOT_MAX_M = 256
+
+
+def _kernel_enabled() -> bool:
+    return os.environ.get("TPUSLICE_QUANT_KERNEL", "1") != "0"
+
+
+def qdot(x2: jax.Array, leaf, *, compute_dtype=None,
+         transpose_w: bool = False, kernel_ok: bool = True) -> jax.Array:
+    """(M, K) contraction against a params leaf → fp32 (M, N).
+
+    A :class:`QuantizedTensor` at decode-sized M routes through the
+    pallas w8a16 kernel (``ops/quant_matmul.py``) so only int8 bytes
+    cross HBM — XLA materializes dequantized dot operands, which costs
+    ~5 bytes/param/step and was the measured 7B decode bottleneck
+    (2026-07-31 capture: 36 ms/step ≈ the materialized-path bytes at
+    v5e bandwidth). Everything else takes dequantize-then-einsum.
+    ``TPUSLICE_QUANT_KERNEL=0`` is the kill switch (trace-time);
+    ``kernel_ok=False`` is the caller's static opt-out — pallas_call
+    does not auto-partition, so tensor-parallel programs (engine with a
+    multi-device mesh) must take the einsum path XLA can shard.
+    """
+    if (kernel_ok and isinstance(leaf, QuantizedTensor)
+            and _kernel_enabled() and x2.shape[0] <= _QDOT_MAX_M):
+        from instaslice_tpu.ops.quant_matmul import quant_matmul
+        return quant_matmul(x2, leaf.q, leaf.s, transpose_w=transpose_w)
+    w = weight(leaf, compute_dtype)
+    sub = "mk,nk->mn" if transpose_w else "mk,kn->mn"
+    return jnp.einsum(sub, x2, w, preferred_element_type=jnp.float32)
 
 
 def weight(leaf, dtype=None) -> jax.Array:

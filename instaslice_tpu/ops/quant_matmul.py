@@ -1,0 +1,146 @@
+"""int8-weight × float-activation matmul as a pallas TPU kernel (w8a16).
+
+Why a kernel: weight-only int8 halves the bytes a decode step streams
+only if the int8 bytes are what actually cross HBM. XLA cannot fuse an
+elementwise producer into a ``dot`` operand — the dequantized bf16
+weight is materialized in HBM first, so the quantized path costs
+int8-read + bf16-write + bf16-read ≈ 5 bytes/param/step instead of 1.
+The 2026-07-31 on-chip capture showed exactly that: the 7B int8 decode
+step took ~36 ms at batch 32 ≈ the 34 GB the materialized path streams
+at v5e's ~819 GB/s, not the ~8.4 ms the int8 bytes alone would take.
+
+This kernel streams int8 weight tiles HBM→VMEM, converts to the
+activation dtype inside VMEM (exact: int8 values are integers ≤ 127),
+feeds the MXU with fp32 accumulation, and applies the per-output-channel
+fp32 scale once to the accumulated output block — mathematically
+identical to dequantize-then-dot because the scale is constant along the
+contraction:  Σ_k x_k (q_kn s_n) = s_n Σ_k x_k q_kn.  Only the int8
+bytes ever cross HBM. (Slightly *more* accurate than the XLA fallback,
+which rounds q·s to bf16 before the dot; here the scale stays fp32.)
+
+Decode is the target: M = batch (8–64) rows against (K, N) weights of
+4k–20k, purely bandwidth-bound, so the win is the 5×→1× byte ratio.
+Prefill (M in the thousands) is compute-bound and stays on the XLA path
+— the materialized dequant amortizes over thousands of rows there.
+
+Reference analog: the reference operator has no compute kernels at all
+(SURVEY.md §1 — no ops layer); this belongs to the TPU-first serving
+stack built around the granted slices.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def quant_matmul_ref(x: jax.Array, q: jax.Array, s: jax.Array,
+                     transpose_w: bool = False) -> jax.Array:
+    """Reference formulation: dequantize (fp32 scale) then dot. Used as
+    the numerical oracle in tests and the fallback for shapes the kernel
+    does not tile."""
+    w = q.astype(jnp.float32) * s.astype(jnp.float32)
+    w = w.astype(x.dtype)
+    sub = "mk,nk->mn" if transpose_w else "mk,kn->mn"
+    return jnp.einsum(sub, x, w, preferred_element_type=jnp.float32)
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, *, transpose_w: bool):
+    """One (M, block_n) output block accumulated over the k grid axis.
+
+    The output block is revisited across k steps (its index map ignores
+    the k program id); step 0 zeroes it, the last step applies the
+    per-column scale to the finished fp32 accumulator.
+    """
+    kj = pl.program_id(1)
+
+    @pl.when(kj == 0)
+    def _zero():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    x = x_ref[...]                               # (M, bk) activations
+    w = w_ref[...].astype(x.dtype)               # int8 → exact in bf16
+    contract = ((1,), (1,)) if transpose_w else ((1,), (0,))
+    o_ref[...] += jax.lax.dot_general(
+        x, w, (contract, ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kj == pl.num_programs(1) - 1)
+    def _scale():
+        o_ref[...] = o_ref[...] * s_ref[...]     # (1, bn) fp32
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("transpose_w", "block_k", "block_n", "interpret"),
+)
+def _qmm_call(x, q, s, transpose_w, block_k, block_n, interpret):
+    M, K = x.shape
+    N = q.shape[0] if transpose_w else q.shape[1]
+    if transpose_w:
+        w_spec = pl.BlockSpec((block_n, block_k), lambda n, k: (n, k))
+    else:
+        w_spec = pl.BlockSpec((block_k, block_n), lambda n, k: (k, n))
+    return pl.pallas_call(
+        functools.partial(_qmm_kernel, transpose_w=transpose_w),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+        # n outer (parallel output tiles), k inner (accumulation)
+        grid=(N // block_n, K // block_k),
+        in_specs=[
+            pl.BlockSpec((M, block_k), lambda n, k: (0, k)),
+            w_spec,
+            pl.BlockSpec((1, block_n), lambda n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((M, block_n), lambda n, k: (0, n)),
+        interpret=interpret,
+    )(x, q, s)
+
+
+def _fit_block(pref: int, size: int) -> int:
+    """Largest block ≤ ``pref`` dividing ``size`` (halving), floor 128 =
+    the TPU lane tile; 0 when none fits (caller falls back to XLA)."""
+    b = min(pref, size)
+    while b >= 128 and size % b:
+        b //= 2
+    return b if b >= 128 and size % b == 0 else 0
+
+
+def quant_matmul(
+    x: jax.Array,
+    q: jax.Array,
+    s: jax.Array,
+    *,
+    transpose_w: bool = False,
+    block_k: int = 1024,
+    block_n: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x @ dequant(q, s)`` with int8 bytes as the only weight HBM
+    traffic. Returns fp32 (M, N), matching the model's
+    ``preferred_element_type`` convention.
+
+    ``x``: (M, K) activations (bf16/f32). ``q``: int8 weight, (K, N) —
+    or (N, K) with ``transpose_w=True`` (the embedding-table layout).
+    ``s``: per-output-channel scale, any shape with N total elements.
+    Shapes whose K/N no 128-multiple block divides fall back to the XLA
+    reference path rather than failing.
+    """
+    M, K = x.shape
+    if transpose_w:
+        N, Kw = q.shape
+    else:
+        Kw, N = q.shape
+    if Kw != K:
+        raise ValueError(f"contraction mismatch: x K={K}, w K={Kw}")
+    bk = _fit_block(block_k, K)
+    bn = _fit_block(block_n, N)
+    if not bk or not bn:
+        return quant_matmul_ref(x, q, s, transpose_w)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    s2 = s.astype(jnp.float32).reshape(1, N)
+    return _qmm_call(x, q, s2, transpose_w, bk, bn, interpret)

@@ -204,6 +204,11 @@ class ServingEngine:
         )
         self.eos_id = eos_id
         self.mesh = mesh
+        # pallas w8a16 decode kernel: single-device programs only —
+        # pallas_call does not auto-partition, so a tensor-parallel
+        # engine must leave quantized matmuls on the einsum path XLA
+        # can shard (quant.qdot's kernel_ok)
+        self._quant_kernel = mesh is None or mesh.size == 1
         self._rng = jax.random.key(seed)
         self._next_id = 0
         self.kv_quant = kv_quant
@@ -432,6 +437,7 @@ class ServingEngine:
             jnp.full((1,), offset, jnp.int32),
             lora=self.lora if use_lora else None,
             adapter_idx=aidx if use_lora else None,
+            quant_kernel=self._quant_kernel,
         )
         cache = jax.tree.map(
             lambda c, s: jax.lax.dynamic_update_slice_in_dim(
@@ -471,6 +477,7 @@ class ServingEngine:
             params, last_token[:, None], cache, lengths,
             lora=self.lora,
             adapter_idx=aidx if self.lora is not None else None,
+            quant_kernel=self._quant_kernel,
         )
         return cache, logits[:, 0]                  # (B, vocab)
 
@@ -501,6 +508,7 @@ class ServingEngine:
                 attend_len=attend_len,
                 lora=self.lora,
                 adapter_idx=aidx if self.lora is not None else None,
+                quant_kernel=self._quant_kernel,
             )
             logits = logits[:, 0]
             if penalize:
@@ -546,7 +554,8 @@ class ServingEngine:
         decode_block() on a draft-enabled engine) — otherwise those
         positions would be zero-holes the draft attends forever."""
         _, cache = self.draft_model.apply_with_cache(
-            params, inputs, cache, lens
+            params, inputs, cache, lens,
+            quant_kernel=self._quant_kernel,
         )
         return cache
 
@@ -556,7 +565,8 @@ class ServingEngine:
         def step(carry, _):
             cache, last, lens = carry
             logits, cache = self.draft_model.apply_with_cache(
-                params, last[:, None], cache, lens
+                params, last[:, None], cache, lens,
+                quant_kernel=self._quant_kernel,
             )
             toks = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
             return (cache, toks, lens + 1), toks
@@ -571,7 +581,8 @@ class ServingEngine:
         next-token predictions (position j predicts the token after
         input j) plus their logprobs."""
         logits, cache = self.model.apply_with_cache(
-            params, inputs, cache, lens
+            params, inputs, cache, lens,
+            quant_kernel=self._quant_kernel,
         )
         toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return cache, toks, token_logprob(logits, toks)

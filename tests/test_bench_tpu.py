@@ -8,6 +8,7 @@ and the direct-int8 init used by the 7B serving phase produces a tree
 the model actually runs (matching ``quantize_params`` layout).
 """
 
+import datetime
 import json
 import os
 import subprocess
@@ -216,6 +217,38 @@ class TestWedgeResilientBench:
         assert "ts" in lines[0]
         # nothing captured → no store written
         assert not (tmp_path / "store.json").exists()
+
+    def test_drop_phases_flag(self, tmp_path):
+        """--drop-phases removes named fragments (so the next watchdog
+        cycle re-captures them after a code change) and rejects unknown
+        names loudly."""
+        env = dict(os.environ)
+        env["TPUSLICE_BENCH_STORE"] = str(tmp_path / "store.json")
+        # fresh timestamps: the store's max-age gate drops old phases
+        # at load, which would vacuously pass the removal assertions
+        now = datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y-%m-%dT%H:%M:%SZ")
+        (tmp_path / "store.json").write_text(json.dumps({
+            "phases": {"probe": {"readback_rtt_ms": 1.0},
+                       "serving_7b": {"serving_7b_tokens_per_sec_b8": 9}},
+            "phase_ts": {"probe": now, "serving_7b": now},
+        }))
+        bench = os.path.join(_REPO, "bench.py")
+        out = subprocess.run(
+            [sys.executable, bench, "--drop-phases", "serving_7b"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        store = json.loads((tmp_path / "store.json").read_text())
+        assert "serving_7b" not in store["phases"]
+        assert "serving_7b" not in store["phase_ts"]
+        assert "probe" in store["phases"]
+        bad = subprocess.run(
+            [sys.executable, bench, "--drop-phases", "nonsense"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert bad.returncode == 2
+        assert "unknown phases" in bad.stderr
 
     def test_store_drops_stale_and_unstamped_phases(self, tmp_path,
                                                     monkeypatch):
