@@ -31,7 +31,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from instaslice_tpu.models.quant import embed_lookup, qdot, weight
+from instaslice_tpu.models.quant import (
+    QuantizedTensor,
+    kernel_enabled,
+    embed_lookup,
+    qdot,
+    qdot_stacked,
+    weight,
+)
 from instaslice_tpu.parallel.pipeline import REMAT_POLICIES, apply_remat
 
 Params = Dict[str, Any]
@@ -767,33 +774,65 @@ class TpuLM:
                 lambda c, n, p: lax.dynamic_update_slice(c, n, (p, 0))
             )(scale_l, new, lens)
 
+        # stacked-kernel mode: the big projection weights stay WHOLE
+        # (closed over, layer picked inside the pallas kernel via
+        # scalar-prefetch index maps) instead of riding the scan's xs —
+        # a scan-sliced pallas operand must materialize, costing an
+        # extra write+read of the full int8 bytes every layer (measured
+        # +16.6 ms/step on the 7B stack; see quant.qdot_stacked).
+        # MoE layers keep the xs formulation (4-D expert stacks).
+        big_names = ("wq", "wk", "wv", "wo", "w_in", "w_out")
+        # gated on the kernel opt-in too (trace-time): with the kernel
+        # off, qdot_stacked would only ever hit its gather-dequant
+        # fallback — the scan-xs formulation below is the measured
+        # default path and must stay it
+        use_stacked = (
+            quant_kernel and kernel_enabled() and not cfg.n_experts
+            and all(isinstance(params["blocks"].get(nm), QuantizedTensor)
+                    for nm in big_names)
+        )
+
         def block(x, xs):
             if use_lora:
                 xs, lblocks = xs[:-1], xs[-1]
             else:
                 lblocks = {}
-            if quant:
-                layer, kc, vc, ks, vs = xs            # kc int8, ks f32
+            if use_stacked:
+                layer, idx = xs[0], xs[1]     # small per-layer tree, index
+                rest = xs[2:]
             else:
-                layer, kc, vc = xs                    # kc: (B,S,H,hd)
+                layer, idx = xs[0], None
+                rest = xs[1:]
+            if quant:
+                kc, vc, ks, vs = rest                 # kc int8, ks f32
+            else:
+                kc, vc = rest                         # kc: (B,S,H,hd)
 
             def proj(h_in, name, w, out_fp32=False):
                 """Base contraction + this row's adapter delta (if
-                adapted). Routed through :func:`quant.qdot`: quantized
-                weights at decode-sized row counts take the pallas w8a16
-                kernel so only int8 bytes cross HBM."""
-                y = qdot(
-                    h_in.reshape(B * T, -1), w, compute_dtype=cfg.dtype,
-                    kernel_ok=quant_kernel,
-                ).reshape(B, T, -1)
+                adapted). Routed through :func:`quant.qdot` (or the
+                layer-indexed :func:`quant.qdot_stacked`): quantized
+                weights at decode-sized row counts take the pallas
+                w8a16 kernel so only int8 bytes cross HBM."""
+                h2 = h_in.reshape(B * T, -1)
+                if use_stacked and name in big_names:
+                    y = qdot_stacked(
+                        h2, params["blocks"][name], idx,
+                        compute_dtype=cfg.dtype, kernel_ok=quant_kernel,
+                    ).reshape(B, T, -1)
+                else:
+                    y = qdot(
+                        h2, w, compute_dtype=cfg.dtype,
+                        kernel_ok=quant_kernel,
+                    ).reshape(B, T, -1)
                 if name in lblocks:
                     y = y + lora_delta(h_in, lblocks[name])
                 return y if out_fp32 else y.astype(cfg.dtype)
 
             h = _rmsnorm(x, layer["ln1"]["scale"])
-            q = proj(h, "wq", layer["wq"], out_fp32=True)
-            k = proj(h, "wk", layer["wk"], out_fp32=True)
-            v = proj(h, "wv", layer["wv"], out_fp32=True)
+            q = proj(h, "wq", layer.get("wq"), out_fp32=True)
+            k = proj(h, "wk", layer.get("wk"), out_fp32=True)
+            v = proj(h, "wv", layer.get("wv"), out_fp32=True)
             q = q.astype(cfg.dtype).reshape(B, T, cfg.n_heads,
                                             cfg.head_dim)
             k, v = (
@@ -845,7 +884,7 @@ class TpuLM:
             probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
             attn = jnp.einsum("bkgts,bskd->btkgd", probs, v_read)
             attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
-            x = x + proj(attn, "wo", layer["wo"])
+            x = x + proj(attn, "wo", layer.get("wo"))
             h = _rmsnorm(x, layer["ln2"]["scale"])
             if cfg.n_experts:
                 y, _ = _moe_mlp(     # aux is a training-only signal
@@ -854,12 +893,18 @@ class TpuLM:
                     capacity_factor=cfg.expert_capacity_factor,
                 )
             else:
-                y = proj(h, "w_in", layer["w_in"], out_fp32=True)
+                y = proj(h, "w_in", layer.get("w_in"), out_fp32=True)
                 y = jax.nn.gelu(y).astype(cfg.dtype)
-                y = proj(y, "w_out", layer["w_out"])
+                y = proj(y, "w_out", layer.get("w_out"))
             return x + y, (kc, vc, ks, vs) if quant else (kc, vc)
 
-        xs_in = (params["blocks"], cache["k"], cache["v"])
+        if use_stacked:
+            small = {k: v for k, v in params["blocks"].items()
+                     if k not in big_names}
+            xs_in = (small, jnp.arange(cfg.n_layers, dtype=jnp.int32))
+        else:
+            xs_in = (params["blocks"],)
+        xs_in += (cache["k"], cache["v"])
         if quant:
             xs_in += (cache["k_s"], cache["v_s"])
         if use_lora:

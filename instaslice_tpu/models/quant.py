@@ -8,9 +8,12 @@ activations stay bf16 and the scale is per-channel symmetric). On TPU
 XLA does NOT fuse the dequantize into the dot — dot operands are
 materialized, so the naive quantized path streams int8 + 2× bf16 bytes
 (measured: the 2026-07-31 7B capture's 36 ms decode step). :func:`qdot`
-therefore routes decode-sized contractions through the pallas w8a16
-kernel (``ops/quant_matmul.py``), where the int8 bytes are the only
-weight HBM traffic.
+can route decode-sized contractions through the pallas w8a16 kernel
+(``ops/quant_matmul.py``), where the int8 bytes are the only weight
+HBM traffic — OPT-IN via ``TPUSLICE_QUANT_KERNEL=1`` (default off: the
+2026-07-31 end-to-end measurements showed XLA hides the non-matmul
+decode work under its weight stream, which custom-call boundaries
+forfeit — see :func:`kernel_enabled`).
 
 Usage::
 
@@ -152,32 +155,75 @@ def shard_params(params: Params, mesh, specs: Params) -> Params:
 _QDOT_MAX_M = 256
 
 
-def _kernel_enabled() -> bool:
-    return os.environ.get("TPUSLICE_QUANT_KERNEL", "1") != "0"
+def kernel_enabled() -> bool:
+    """Opt-IN (default off) after the 2026-07-31 in-situ measurements:
+    per-op the pallas w8a16 kernel beats the XLA path 1.8-2.0×
+    (tools/microbench_qdot.py), but inside the full decode step XLA
+    streams the hoisted bf16 weights at ~820 GB/s while hiding ALL
+    attention/cache/softmax work under the weight stream — pallas
+    custom-call boundaries serialize that work (~7 ms/step at batch 8),
+    so end-to-end the kernel only reaches parity (b8/b16) or loses
+    (-15% at b32; BENCH_TPU_RESULTS history in git). The kernel pays
+    off once the whole decode layer fuses into one kernel; until then
+    the einsum path wins and the kernel stays an explicit experiment:
+    ``TPUSLICE_QUANT_KERNEL=1``."""
+    return os.environ.get("TPUSLICE_QUANT_KERNEL", "0") == "1"
 
 
 def qdot(x2: jax.Array, leaf, *, compute_dtype=None,
          transpose_w: bool = False, kernel_ok: bool = True) -> jax.Array:
     """(M, K) contraction against a params leaf → fp32 (M, N).
 
-    A :class:`QuantizedTensor` at decode-sized M routes through the
+    Default: dequantize-then-einsum (XLA's choice of hoisting/fusion —
+    the measured-fastest end-to-end decode path). With the OPT-IN
+    ``TPUSLICE_QUANT_KERNEL=1`` (trace-time, see :func:`kernel_enabled`),
+    a :class:`QuantizedTensor` at decode-sized M routes through the
     pallas w8a16 kernel (``ops/quant_matmul.py``) so only int8 bytes
-    cross HBM — XLA materializes dequantized dot operands, which costs
-    ~5 bytes/param/step and was the measured 7B decode bottleneck
-    (2026-07-31 capture: 36 ms/step ≈ the materialized-path bytes at
-    v5e bandwidth). Everything else takes dequantize-then-einsum.
-    ``TPUSLICE_QUANT_KERNEL=0`` is the kill switch (trace-time);
-    ``kernel_ok=False`` is the caller's static opt-out — pallas_call
-    does not auto-partition, so tensor-parallel programs (engine with a
-    multi-device mesh) must take the einsum path XLA can shard.
+    cross HBM. ``kernel_ok=False`` is the caller's static opt-out —
+    pallas_call does not auto-partition, so tensor-parallel programs
+    (engine with a multi-device mesh) must take the einsum path XLA
+    can shard.
     """
     if (kernel_ok and isinstance(leaf, QuantizedTensor)
-            and _kernel_enabled() and x2.shape[0] <= _QDOT_MAX_M):
+            and kernel_enabled() and x2.shape[0] <= _QDOT_MAX_M):
         from instaslice_tpu.ops.quant_matmul import quant_matmul
         return quant_matmul(x2, leaf.q, leaf.s, transpose_w=transpose_w)
     w = weight(leaf, compute_dtype)
     sub = "mk,nk->mn" if transpose_w else "mk,kn->mn"
     return jnp.einsum(sub, x2, w, preferred_element_type=jnp.float32)
+
+
+def qdot_stacked(x2: jax.Array, leaf, layer, *, compute_dtype=None,
+                 kernel_ok: bool = True) -> jax.Array:
+    """Layer-indexed (M, K) contraction against a STACKED (L, K, N)
+    params leaf → fp32 (M, N), for layer loops over quantized weights.
+
+    Inside ``lax.scan`` a pallas operand sliced from the stack must
+    materialize (einsum operands fuse the slice; custom calls cannot),
+    which costs an extra write+read of the full int8 bytes per layer —
+    measured +16.6 ms/step on the 7B stack, erasing the kernel's win.
+    The stacked kernel instead DMAs tiles straight from the (L, K, N)
+    buffer at a scalar-prefetched layer index, so the caller never
+    slices. Falls back to slice-dequantize-einsum (XLA fuses the slice)
+    when the kernel is off, the shape does not tile, or M is
+    prefill-sized.
+    """
+    if (kernel_ok and isinstance(leaf, QuantizedTensor)
+            and kernel_enabled() and x2.shape[0] <= _QDOT_MAX_M
+            and leaf.q.ndim == 3):
+        from instaslice_tpu.ops.quant_matmul import quant_matmul_stacked
+        return quant_matmul_stacked(x2, leaf.q, leaf.s, layer)
+    if isinstance(leaf, QuantizedTensor):
+        N = leaf.q.shape[-1]
+        w = (leaf.q[layer].astype(jnp.float32)
+             * leaf.s[layer].astype(jnp.float32).reshape(1, N))
+        w = w.astype(compute_dtype or leaf.s.dtype)
+    else:
+        w = leaf[layer]
+        if compute_dtype is not None:
+            w = w.astype(compute_dtype)
+    return jnp.einsum("mk,kn->mn", x2, w,
+                      preferred_element_type=jnp.float32)
 
 
 def weight(leaf, dtype=None) -> jax.Array:

@@ -1,27 +1,35 @@
 """int8-weight × float-activation matmul as a pallas TPU kernel (w8a16).
 
 Why a kernel: weight-only int8 halves the bytes a decode step streams
-only if the int8 bytes are what actually cross HBM. XLA cannot fuse an
-elementwise producer into a ``dot`` operand — the dequantized bf16
-weight is materialized in HBM first, so the quantized path costs
-int8-read + bf16-write + bf16-read ≈ 5 bytes/param/step instead of 1.
-The 2026-07-31 on-chip capture showed exactly that: the 7B int8 decode
-step took ~36 ms at batch 32 ≈ the 34 GB the materialized path streams
-at v5e's ~819 GB/s, not the ~8.4 ms the int8 bytes alone would take.
+only if the int8 bytes are what actually cross HBM. XLA materializes
+dequantized dot operands — and, in a block-decode scan, hoists the
+dequantize out of the step loop entirely, so the XLA path streams the
+FULL bf16 weight bytes every step (measured 2026-07-31 on v5e: the 7B
+int8 decode step ran at the bf16 roofline, ~16.7 ms/step at batch 8 —
+the int8 storage saved HBM *capacity* but zero per-step *bandwidth*).
+This kernel streams the int8 bytes and nothing else: weight tiles DMA
+HBM→VMEM as int8, convert in-register (exact: int8 values are integers
+≤ 127), hit the MXU with fp32 accumulation, and the per-output-channel
+fp32 scale lands once on the accumulated output — mathematically
+identical to dequantize-then-dot because the scale is constant along
+the contraction: Σ_k x_k (q_kn s_n) = s_n Σ_k x_k q_kn.
 
-This kernel streams int8 weight tiles HBM→VMEM, converts to the
-activation dtype inside VMEM (exact: int8 values are integers ≤ 127),
-feeds the MXU with fp32 accumulation, and applies the per-output-channel
-fp32 scale once to the accumulated output block — mathematically
-identical to dequantize-then-dot because the scale is constant along the
-contraction:  Σ_k x_k (q_kn s_n) = s_n Σ_k x_k q_kn.  Only the int8
-bytes ever cross HBM. (Slightly *more* accurate than the XLA fallback,
-which rounds q·s to bf16 before the dot; here the scale stays fp32.)
+Tiling (v2 — the v1 lesson): tiles must be FULL ROW WIDTH. A
+(block_k, block_n) tile of a row-major (K, N) int8 array DMAs as
+block_k short strided segments and gated the v1 kernel to ~240 GB/s
+effective (slower than the XLA bf16 path). v2 tiles are
 
-Decode is the target: M = batch (8–64) rows against (K, N) weights of
-4k–20k, purely bandwidth-bound, so the win is the 5×→1× byte ratio.
-Prefill (M in the thousands) is compute-bound and stays on the XLA path
-— the materialized dequant amortizes over thousands of rows there.
+- ``(block_k, N)`` for the (K, N) projection layout: whole rows,
+  contiguous DMA; a 1-D grid over k-stripes accumulates into a
+  VMEM-resident (M, N) fp32 block (constant out index map);
+- ``(block_n, K)`` for the (N, K) embedding layout: whole rows again;
+  each grid step computes a finished (M, block_n) output slab, no
+  accumulation (x rides whole in VMEM).
+
+Decode is the target: M = batch (8–64) rows against (4k, 4k–20k)
+weights, purely bandwidth-bound. Prefill (M in the thousands) is
+compute-bound and stays on the XLA path, which also keeps it
+shardable under tensor parallelism.
 
 Reference analog: the reference operator has no compute kernels at all
 (SURVEY.md §1 — no ops layer); this belongs to the TPU-first serving
@@ -35,6 +43,13 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: VMEM spending ceiling for one kernel instance: resident operands
+#: (fp32 accumulator / whole-x) + 2× the streamed tile (double
+#: buffering) must fit under it, leaving ~4 MB of the ~16 MB VMEM for
+#: the compiler's own scratch
+_VMEM_BUDGET = 12 * 1024 * 1024
 
 
 def quant_matmul_ref(x: jax.Array, q: jax.Array, s: jax.Array,
@@ -48,65 +63,183 @@ def quant_matmul_ref(x: jax.Array, q: jax.Array, s: jax.Array,
     return jnp.einsum(sub, x, w, preferred_element_type=jnp.float32)
 
 
-def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, *, transpose_w: bool):
-    """One (M, block_n) output block accumulated over the k grid axis.
-
-    The output block is revisited across k steps (its index map ignores
-    the k program id); step 0 zeroes it, the last step applies the
-    per-column scale to the finished fp32 accumulator.
-    """
-    kj = pl.program_id(1)
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref):
+    """k-stripe accumulation for the (K, N) layout: one (block_k, N)
+    whole-row weight tile per grid step, output (M, N) resident in VMEM
+    across the 1-D grid (constant out index map); step 0 zeroes it, the
+    last step applies the per-column scale."""
+    kj = pl.program_id(0)
 
     @pl.when(kj == 0)
     def _zero():
         o_ref[...] = jnp.zeros_like(o_ref)
 
-    x = x_ref[...]                               # (M, bk) activations
-    w = w_ref[...].astype(x.dtype)               # int8 → exact in bf16
-    contract = ((1,), (1,)) if transpose_w else ((1,), (0,))
+    x = x_ref[...]                               # (M, bk)
+    w = w_ref[...].astype(x.dtype)               # int8 → exact
     o_ref[...] += jax.lax.dot_general(
-        x, w, (contract, ((), ())),
+        x, w, ((((1,), (0,))), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
-    @pl.when(kj == pl.num_programs(1) - 1)
+    @pl.when(kj == pl.num_programs(0) - 1)
     def _scale():
-        o_ref[...] = o_ref[...] * s_ref[...]     # (1, bn) fp32
+        o_ref[...] = o_ref[...] * s_ref[...]     # (1, N) fp32
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("transpose_w", "block_k", "block_n", "interpret"),
-)
-def _qmm_call(x, q, s, transpose_w, block_k, block_n, interpret):
+def _qmm_t_kernel(x_ref, w_ref, s_ref, o_ref):
+    """n-slab kernel for the (N, K) layout: x rides whole in VMEM, each
+    grid step streams a (block_n, K) whole-row weight tile and emits a
+    finished (M, block_n) output slab — no accumulation, no revisit."""
+    x = x_ref[...]                               # (M, K)
+    w = w_ref[...].astype(x.dtype)               # (bn, K) int8 → exact
+    acc = jax.lax.dot_general(
+        x, w, ((((1,), (1,))), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[...] = acc * s_ref[...]                # (1, bn) fp32
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def _qmm_call(x, q, s, block_k, interpret):
     M, K = x.shape
-    N = q.shape[0] if transpose_w else q.shape[1]
-    if transpose_w:
-        w_spec = pl.BlockSpec((block_n, block_k), lambda n, k: (n, k))
-    else:
-        w_spec = pl.BlockSpec((block_k, block_n), lambda n, k: (k, n))
+    N = q.shape[1]
     return pl.pallas_call(
-        functools.partial(_qmm_kernel, transpose_w=transpose_w),
+        _qmm_kernel,
         out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
-        # n outer (parallel output tiles), k inner (accumulation)
-        grid=(N // block_n, K // block_k),
+        grid=(K // block_k,),
         in_specs=[
-            pl.BlockSpec((M, block_k), lambda n, k: (0, k)),
-            w_spec,
-            pl.BlockSpec((1, block_n), lambda n, k: (0, n)),
+            pl.BlockSpec((M, block_k), lambda k: (0, k)),
+            pl.BlockSpec((block_k, N), lambda k: (k, 0)),
+            pl.BlockSpec((1, N), lambda k: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((M, block_n), lambda n, k: (0, n)),
+        out_specs=pl.BlockSpec((M, N), lambda k: (0, 0)),
         interpret=interpret,
     )(x, q, s)
 
 
-def _fit_block(pref: int, size: int) -> int:
-    """Largest block ≤ ``pref`` dividing ``size`` (halving), floor 128 =
-    the TPU lane tile; 0 when none fits (caller falls back to XLA)."""
-    b = min(pref, size)
-    while b >= 128 and size % b:
-        b //= 2
-    return b if b >= 128 and size % b == 0 else 0
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def _qmm_t_call(x, q, s, block_n, interpret):
+    M, K = x.shape
+    N = q.shape[0]
+    return pl.pallas_call(
+        _qmm_t_kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+        grid=(N // block_n,),
+        in_specs=[
+            pl.BlockSpec((M, K), lambda n: (0, 0)),
+            pl.BlockSpec((block_n, K), lambda n: (n, 0)),
+            pl.BlockSpec((1, block_n), lambda n: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((M, block_n), lambda n: (0, n)),
+        interpret=interpret,
+    )(x, q, s)
+
+
+def _qmm_stacked_kernel(li_ref, x_ref, w_ref, s_ref, o_ref):
+    """Layer-indexed k-stripe accumulation: identical math to
+    :func:`_qmm_kernel`, but the weight tile DMAs straight out of the
+    STACKED (L, K, N) buffer at the prefetched layer index — the index
+    map does the layer selection, so the caller never slices the stack.
+
+    Why this exists: a ``lax.scan`` over layers hands each iteration a
+    dynamic-slice of the stacked weights. An einsum fuses that slice
+    into its operand read; a ``pallas_call`` operand must materialize,
+    so the sliced int8 weight is written to a temp buffer and re-read
+    EVERY layer — +2 bytes/param/step of pure copy traffic, which
+    erased the kernel's whole 2026-07-31 microbench win in-situ
+    (measured: +16.6 ms/step on the 6.8 GB 7B stack ≈ exactly
+    write+read at HBM speed). Scalar-prefetch indexing reads the tile
+    from the original buffer instead.
+    """
+    del li_ref  # consumed by the index maps, not the body
+    kj = pl.program_id(0)
+
+    @pl.when(kj == 0)
+    def _zero():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    x = x_ref[...]                               # (M, bk)
+    w = w_ref[0].astype(x.dtype)                 # (1, bk, N) int8 tile
+    o_ref[...] += jax.lax.dot_general(
+        x, w, ((((1,), (0,))), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kj == pl.num_programs(0) - 1)
+    def _scale():
+        o_ref[...] = o_ref[...] * s_ref[0]       # (1, 1, N) fp32
+
+
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
+def _qmm_stacked_call(x, q3, s3, layer, block_k, interpret):
+    M, K = x.shape
+    N = q3.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(K // block_k,),
+        in_specs=[
+            pl.BlockSpec((M, block_k), lambda k, li: (0, k)),
+            pl.BlockSpec((1, block_k, N), lambda k, li: (li[0], k, 0)),
+            pl.BlockSpec((1, 1, N), lambda k, li: (li[0], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((M, N), lambda k, li: (0, 0)),
+    )
+    return pl.pallas_call(
+        _qmm_stacked_kernel,
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(layer, jnp.int32).reshape(1), x, q3, s3)
+
+
+def quant_matmul_stacked(
+    x: jax.Array,
+    q3: jax.Array,
+    s3: jax.Array,
+    layer: jax.Array,
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x @ dequant(q3[layer], s3[layer])`` without ever slicing the
+    stack: the kernel's index maps select the layer (scalar prefetch),
+    so inside a layer loop the int8 bytes of THIS layer are the only
+    weight HBM traffic. ``q3``: (L, K, N) int8; ``s3``: (L, 1, N)
+    scales; ``layer``: traced int32 index. Falls back to
+    slice-dequantize-einsum (which XLA fuses) for untileable shapes.
+    """
+    M, K = x.shape
+    L, Kw, N = q3.shape
+    if Kw != K:
+        raise ValueError(f"contraction mismatch: x K={K}, w K={Kw}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tile_budget = (_VMEM_BUDGET - M * N * 4) // 2
+    bk = _stripe_block(K, N + M * x.dtype.itemsize, tile_budget)
+    if bk and N % 128 == 0:
+        s2 = s3.astype(jnp.float32).reshape(L, 1, N)
+        return _qmm_stacked_call(x, q3, s2, layer, bk, interpret)
+    w = (q3[layer].astype(jnp.float32)
+         * s3[layer].astype(jnp.float32).reshape(1, N)).astype(x.dtype)
+    return jnp.einsum("mk,kn->mn", x, w,
+                      preferred_element_type=jnp.float32)
+
+
+def _stripe_block(dim: int, row_bytes: int,
+                  budget: int = 4 * 1024 * 1024) -> int:
+    """Largest 128-multiple divisor of ``dim`` whose (block × row_bytes)
+    tile fits ``budget``; 0 when none does (or the budget is already
+    spent). Full downward scan in 128 steps (trace-time only, ≤ dim/128
+    iterations): halving alone would miss e.g. 640 | 32000 for the
+    vocab axis."""
+    if budget <= 0:
+        return 0
+    cap = min(dim, budget // max(row_bytes, 1))
+    b = cap - cap % 128
+    while b >= 128:
+        if dim % b == 0:
+            return b
+        b -= 128
+    return 0
 
 
 def quant_matmul(
@@ -115,8 +248,6 @@ def quant_matmul(
     s: jax.Array,
     *,
     transpose_w: bool = False,
-    block_k: int = 1024,
-    block_n: int = 512,
     interpret: bool | None = None,
 ) -> jax.Array:
     """``x @ dequant(q, s)`` with int8 bytes as the only weight HBM
@@ -126,8 +257,9 @@ def quant_matmul(
     ``x``: (M, K) activations (bf16/f32). ``q``: int8 weight, (K, N) —
     or (N, K) with ``transpose_w=True`` (the embedding-table layout).
     ``s``: per-output-channel scale, any shape with N total elements.
-    Shapes whose K/N no 128-multiple block divides fall back to the XLA
-    reference path rather than failing.
+    Shapes the whole-row tiling cannot cover (a dim with no 128-multiple
+    divisor, or resident operands that would blow VMEM) fall back to the
+    XLA reference path rather than failing.
     """
     M, K = x.shape
     if transpose_w:
@@ -136,11 +268,25 @@ def quant_matmul(
         Kw, N = q.shape
     if Kw != K:
         raise ValueError(f"contraction mismatch: x K={K}, w K={Kw}")
-    bk = _fit_block(block_k, K)
-    bn = _fit_block(block_n, N)
-    if not bk or not bn:
-        return quant_matmul_ref(x, q, s, transpose_w)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     s2 = s.astype(jnp.float32).reshape(1, N)
-    return _qmm_call(x, q, s2, transpose_w, bk, bn, interpret)
+    xsz = x.dtype.itemsize
+    if transpose_w:
+        # x rides whole in VMEM (resident); each grid step streams a
+        # (bn, K) weight tile and writes an (M, bn) fp32 slab — both
+        # double-buffered, so a bn costs bn·(K + M·4) against what the
+        # resident x leaves of the budget
+        tile_budget = (_VMEM_BUDGET - M * K * xsz) // 2
+        bn = _stripe_block(N, K + M * 4, tile_budget)
+        if bn and K % 128 == 0:
+            return _qmm_t_call(x, q, s2, bn, interpret)
+    else:
+        # fp32 (M, N) accumulator rides resident across the k grid;
+        # each step streams a (bk, N) weight tile + an (M, bk) x tile,
+        # double-buffered: a bk costs bk·(N + M·xsz)
+        tile_budget = (_VMEM_BUDGET - M * N * 4) // 2
+        bk = _stripe_block(K, N + M * xsz, tile_budget)
+        if bk and N % 128 == 0:
+            return _qmm_call(x, q, s2, bk, interpret)
+    return quant_matmul_ref(x, q, s, transpose_w)

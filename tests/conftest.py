@@ -50,6 +50,7 @@ SLOW_MODULES = {
     "test_data",          # mmap dataset + training-input pipelines
     "test_tpulock",       # cross-process holder spawn/kill round-trips
     "test_lora",          # adapter train-step compiles
+    "test_quant_matmul",  # pallas w8a16 kernel (interpret mode) sweeps
 }
 
 

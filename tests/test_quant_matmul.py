@@ -20,7 +20,7 @@ from instaslice_tpu.models.quant import (
     quantize_tensor,
 )
 from instaslice_tpu.ops.quant_matmul import (
-    _fit_block,
+    _stripe_block,
     quant_matmul,
     quant_matmul_ref,
 )
@@ -39,9 +39,9 @@ class TestKernel:
     @pytest.mark.parametrize("m", [1, 8, 32, 33])
     def test_matches_oracle(self, m):
         x, qt = _mk(m, 256, 384)
-        got = quant_matmul(x, qt.q, qt.s, block_k=128, block_n=128)
+        got = quant_matmul(x, qt.q, qt.s)
         want = quant_matmul_ref(x, qt.q, qt.s)
-        # blocked k-accumulation reorders the fp32 sums vs one einsum
+        # k-stripe accumulation reorders the fp32 sums vs one einsum
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
     def test_transposed_weight_layout(self):
@@ -49,8 +49,7 @@ class TestKernel:
         x = jax.random.normal(jax.random.key(1), (16, 256))
         w = jax.random.normal(jax.random.key(2), (384, 256), jnp.float32)
         qt = quantize_tensor(w, reduce_axis=-1)     # scale (384, 1)
-        got = quant_matmul(x, qt.q, qt.s, transpose_w=True,
-                           block_k=128, block_n=128)
+        got = quant_matmul(x, qt.q, qt.s, transpose_w=True)
         want = quant_matmul_ref(x, qt.q, qt.s, transpose_w=True)
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
@@ -61,14 +60,14 @@ class TestKernel:
         fallback. Verify against an fp64-free fp32 einsum on the raw
         int8 values."""
         x, qt = _mk(8, 128, 128, seed=3)
-        got = quant_matmul(x, qt.q, qt.s, block_k=128, block_n=128)
+        got = quant_matmul(x, qt.q, qt.s)
         raw = jnp.einsum("mk,kn->mn", x, qt.q.astype(jnp.float32))
         want = raw * qt.s.astype(jnp.float32)
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
     def test_bf16_activations(self):
         x, qt = _mk(32, 512, 256, seed=4, dtype=jnp.bfloat16)
-        got = quant_matmul(x, qt.q, qt.s, block_k=256, block_n=128)
+        got = quant_matmul(x, qt.q, qt.s)
         want = quant_matmul_ref(x, qt.q, qt.s)
         # the oracle rounds q·s to bf16 pre-dot; the kernel keeps the
         # scale fp32 — the gap is ~sqrt(K)·bf16-eps ABSOLUTE (not
@@ -90,22 +89,102 @@ class TestKernel:
         with pytest.raises(ValueError, match="contraction mismatch"):
             quant_matmul(x[:, :64], qt.q, qt.s)
 
-    def test_fit_block(self):
-        assert _fit_block(1024, 4096) == 1024
-        assert _fit_block(512, 256) == 256      # clamps to the dim
-        assert _fit_block(512, 384) == 384      # whole axis is legal
-        assert _fit_block(512, 96) == 0         # lane floor
-        # the 7B shapes all tile: d=4096, ff=20480, vocab=32000
-        assert _fit_block(1024, 20480) == 1024
-        assert _fit_block(512, 32000) == 256    # 512 ∤ 32000, halve once
+    def test_stripe_block(self):
+        MB = 1024 * 1024
+        # wq (K=4096, N=4096): 1024-row stripes hit the 4 MB tile cap
+        assert _stripe_block(4096, 4096) == 1024
+        # w_in (K=4096, N=20480): 20 KB rows -> 128-row stripes
+        assert _stripe_block(4096, 20480) == 128
+        # wk/wv (K=4096, N=1024): whole K in one 4 MB tile
+        assert _stripe_block(4096, 1024) == 4096
+        # embed vocab axis: 640 | 32000 (halving alone would miss it)
+        assert _stripe_block(32000, 4096) == 640
+        # no 128-multiple divisor -> 0 (caller falls back)
+        assert _stripe_block(96, 4096) == 0
+        assert _stripe_block(200, 4096) == 0
+        # every candidate fits the transfer ceiling
+        for dim, row in ((4096, 4096), (4096, 20480), (32000, 4096)):
+            b = _stripe_block(dim, row)
+            assert b * row <= 4 * MB
+
+
+class TestStackedKernel:
+    def test_every_layer_matches_sliced_oracle(self):
+        """The scalar-prefetch index maps must pick exactly layer li's
+        weight tile for every li — an off-by-one here silently serves
+        the wrong layer's weights."""
+        from instaslice_tpu.ops.quant_matmul import quant_matmul_stacked
+
+        L, K, N = 3, 256, 384
+        x = jax.random.normal(jax.random.key(9), (8, K))
+        q3 = jax.random.randint(
+            jax.random.key(10), (L, K, N), -127, 128, jnp.int8
+        )
+        s3 = jax.random.uniform(
+            jax.random.key(11), (L, 1, N), jnp.float32, 0.01, 0.1
+        )
+        for li in range(L):
+            got = quant_matmul_stacked(x, q3, s3, jnp.int32(li))
+            want = quant_matmul_ref(x, q3[li], s3[li])
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_traced_index_inside_scan(self):
+        """The in-situ pattern: the layer index is a traced scan value,
+        one compiled program serves every layer."""
+        from jax import lax
+
+        from instaslice_tpu.ops.quant_matmul import quant_matmul_stacked
+
+        L, K, N = 4, 128, 256
+        x = jax.random.normal(jax.random.key(12), (4, K))
+        q3 = jax.random.randint(
+            jax.random.key(13), (L, K, N), -127, 128, jnp.int8
+        )
+        s3 = jnp.full((L, 1, N), 0.02, jnp.float32)
+
+        @jax.jit
+        def run(x):
+            def body(carry, li):
+                # no carry feedback: an iterated tanh∘matmul map is
+                # chaotic (benign 1e-5 kernel-vs-oracle differences
+                # grow ~50× per layer), which would swamp the thing
+                # under test — that ys[li] used layer li's weights
+                return carry, quant_matmul_stacked(carry, q3, s3, li)
+
+            _, ys = lax.scan(
+                body, x, jnp.arange(L, dtype=jnp.int32)
+            )
+            return ys
+
+        ys = run(x)
+        for li in range(L):
+            want = quant_matmul_ref(x, q3[li], s3[li])
+            np.testing.assert_allclose(
+                ys[li], want, rtol=1e-4, atol=1e-4
+            )
+
+    def test_untileable_falls_back_to_sliced_einsum(self):
+        from instaslice_tpu.ops.quant_matmul import quant_matmul_stacked
+
+        L, K, N = 2, 96, 80          # no 128-multiple divisor
+        x = jax.random.normal(jax.random.key(14), (4, K))
+        q3 = jax.random.randint(
+            jax.random.key(15), (L, K, N), -127, 128, jnp.int8
+        )
+        s3 = jnp.full((L, 1, N), 0.05, jnp.float32)
+        got = quant_matmul_stacked(x, q3, s3, jnp.int32(1))
+        want = quant_matmul_ref(x, q3[1], s3[1])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 
 class TestQdotRouting:
     def test_qdot_kernel_vs_fallback_identical_decisions(self, monkeypatch):
-        """qdot(kernel) ≈ qdot(kill-switch) on tileable shapes."""
+        """qdot(kernel, opt-in) ≈ qdot(default einsum) on tileable
+        shapes."""
         x, qt = _mk(8, 128, 256, seed=5)
+        monkeypatch.setenv("TPUSLICE_QUANT_KERNEL", "1")
         with_kernel = qdot(x, qt)
-        monkeypatch.setenv("TPUSLICE_QUANT_KERNEL", "0")
+        monkeypatch.delenv("TPUSLICE_QUANT_KERNEL")
         without = qdot(x, qt)
         np.testing.assert_allclose(
             with_kernel, without, rtol=1e-2, atol=1e-2
@@ -156,8 +235,9 @@ class TestModelDecodeThroughKernel:
             rid = eng.add_request([5, 9, 2, 7])
             return eng.decode_block(8)[rid]
 
+        monkeypatch.setenv("TPUSLICE_QUANT_KERNEL", "1")
         with_kernel = chain()
-        monkeypatch.setenv("TPUSLICE_QUANT_KERNEL", "0")
+        monkeypatch.delenv("TPUSLICE_QUANT_KERNEL")
         jax.clear_caches()           # drop the traced kernel programs
         without = chain()
         assert with_kernel == without

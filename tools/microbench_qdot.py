@@ -1,0 +1,162 @@
+"""On-chip microbench for the w8a16 decode matmul paths.
+
+Times three formulations of the decode-critical contraction at serving
+shapes (M = batch rows) through :func:`bench_tpu._chained_per_call` —
+the RTT-guarded harness (auto-scaled chain of truly data-dependent
+steps, one dispatch, one readback, in-phase RTT subtraction):
+
+- ``bf16``: dot against pre-dequantized bf16 weights — what XLA's
+  hoisted-dequant decode path streams per step (the bandwidth floor to
+  beat: 2 bytes/param/step);
+- ``dequant``: int8 weights dequantized inside the step body — the
+  dequant is loop-invariant, so this lane measures WHATEVER XLA
+  chooses: hoist it (then it equals the bf16 lane — observed for the
+  16 MB attn_proj) or keep it fused in-loop (then it approaches the
+  int8 roofline — observed for the 84 MB ffn mats). A window into
+  XLA's policy, not a fixed formulation;
+- ``kernel``: the pallas w8a16 kernel (``ops/quant_matmul.py``) — int8
+  bytes only, 1 byte/param/step, target ≈ 2× the bf16 path.
+
+Each step maps x → x via ``tanh`` of (a tile of) the output, so the
+chain is a real data dependence — a ``0·Σy`` pseudo-dependence gets
+constant-folded and the matmul dead-code-eliminated (the first draft of
+this tool "measured" 1.5 TB/s on an 819 GB/s chip that way).
+
+Effective GB/s counts the WEIGHT bytes the formulation is supposed to
+stream (bf16: 2·K·N; int8 paths: K·N) — above-HBM-peak output flags a
+measurement artifact, kernel GB/s ≈ the dequant path flags DMA-
+inefficient tiling (the v1 lesson: partial-row tiles DMA as short
+strided segments).
+
+Usage (claims the host TPU flock; refuses while a bench/watchdog
+capture holds it): ``python tools/microbench_qdot.py [--m 8 32]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+SHAPES = [
+    # (K, N, transpose_w, label)
+    (4096, 4096, False, "attn_proj"),      # wq / wo
+    (4096, 20480, False, "ffn_in"),        # w_in
+    (20480, 4096, False, "ffn_out"),       # w_out
+    (4096, 32000, True, "logits_embed"),   # (vocab, d) table: contract
+                                           # d, emit vocab logits
+]
+
+
+def bench_shape(K: int, N: int, transpose_w: bool, label: str, M: int,
+                budget_s: float = 90.0) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.bench_tpu import _chained_per_call
+    from instaslice_tpu.models.quant import quantize_tensor
+    from instaslice_tpu.ops.quant_matmul import quant_matmul
+
+    kx, kw = jax.random.split(jax.random.key(0))
+    x0 = jax.random.normal(kx, (M, K), jnp.bfloat16)
+    wshape = (N, K) if transpose_w else (K, N)
+    w32 = jax.random.normal(kw, wshape, jnp.float32) * K ** -0.5
+    qt = quantize_tensor(w32.astype(jnp.bfloat16),
+                         reduce_axis=-1 if transpose_w else -2)
+    q, s = qt.q, qt.s
+    w_bf16 = qt.dequantize(jnp.bfloat16)
+    sub = "mk,nk->mn" if transpose_w else "mk,kn->mn"
+
+    def dep(y):
+        """(M, N) output → (M, K) next input, REAL data dependence on
+        EVERY output column (tanh: bounded forever, not foldable; the
+        row-sum term consumes all N columns — a bare y[:, :K] slice
+        lets XLA dead-code-eliminate the other N-K output columns and
+        stream 1/5 of the ffn_in weight, which first "measured"
+        3.2 TB/s on an 819 GB/s chip)."""
+        total = jnp.sum(y, axis=1, keepdims=True)    # consumes all N
+        if N >= K:
+            t = y[:, :K] + total
+        else:
+            t = jnp.concatenate(
+                [y] * (K // N + 1), axis=1)[:, :K] + total
+        return jnp.tanh(t).astype(jnp.bfloat16)
+
+    def step_bf16(x):
+        return dep(jnp.einsum(sub, x, w_bf16,
+                              preferred_element_type=jnp.float32))
+
+    def step_dequant(x):
+        w = (q.astype(jnp.float32) * s.astype(jnp.float32)
+             ).astype(jnp.bfloat16)
+        return dep(jnp.einsum(sub, x, w,
+                              preferred_element_type=jnp.float32))
+
+    def step_kernel(x):
+        return dep(quant_matmul(x, q, s, transpose_w=transpose_w))
+
+    bytes_bf16 = 2 * K * N
+    bytes_int8 = K * N
+    out = {"label": label, "M": M, "K": K, "N": N}
+    for name, fn, nbytes in (
+        ("bf16", step_bf16, bytes_bf16),
+        ("dequant", step_dequant, bytes_int8),
+        ("kernel", step_kernel, bytes_int8),
+    ):
+        stats: dict = {}
+        dt = _chained_per_call(fn, x0, n=8, stats=stats,
+                               budget_s=budget_s)
+        out[f"{name}_us"] = round(dt * 1e6, 1)
+        out[f"{name}_eff_gbps"] = round(nbytes / dt / 1e9, 1)
+        out[f"{name}_chain_n"] = stats.get("chain_n")
+        out[f"{name}_spread_pct"] = stats.get("spread_pct")
+    out["rtt_ms"] = stats.get("rtt_ms")
+    if out["kernel_us"]:
+        out["kernel_speedup_vs_bf16"] = round(
+            out["bf16_us"] / out["kernel_us"], 2)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--m", type=int, nargs="+", default=[8, 32])
+    ap.add_argument("--budget-s", type=float, default=90.0)
+    ap.add_argument("--shapes", default="",
+                    help="comma-separated label filter")
+    args = ap.parse_args(argv)
+
+    from instaslice_tpu.utils.tpulock import TpuBusyError, TpuClaim
+
+    try:
+        claim = TpuClaim().acquire(timeout=10)
+    except TpuBusyError as e:
+        print(f"TPU busy (capture in progress?): {e}", file=sys.stderr)
+        return 1
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            print(f"not on TPU (backend={jax.default_backend()}); "
+                  "refusing to microbench the CPU emulator",
+                  file=sys.stderr)
+            return 1
+        labels = {l for l in args.shapes.split(",") if l}
+        for M in args.m:
+            for K, N, t, label in SHAPES:
+                if labels and label not in labels:
+                    continue
+                r = bench_shape(K, N, t, label, M,
+                                budget_s=args.budget_s)
+                print(json.dumps(r), flush=True)
+        return 0
+    finally:
+        claim.release()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
