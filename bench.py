@@ -406,8 +406,11 @@ def _fold_store(out: dict, store: dict) -> None:
 #: headline, the training headline, then the rest.
 WATCHDOG_PRIORITY = [
     "probe", "flash_fwd", "serving_7b", "mfu", "flash_bwd", "serving",
-    "serving_quant", "moe", "serving_lora", "serving_spec",
-    "serving_small", "serving_tp",
+    "serving_quant", "serving_lora", "serving_spec",
+    "serving_small", "serving_tp", "moe",
+    # moe last: its two fresh model compiles make it the slowest phase
+    # by far (three 480s timeouts on 2026-07-31), and a slow phase
+    # early in the order delays everything behind it
 ]
 _PHASE_CAPS = dict(TPU_PHASES)
 
@@ -488,7 +491,33 @@ def watchdog(interval: float, max_hours: float, once: bool) -> int:
                         print(f"[watchdog] {phase}: ERROR {err}",
                               file=sys.stderr)
                         if frag.get("timed_out"):
-                            break     # mid-burst wedge: back to probing
+                            # a chronically slow phase and a wedged
+                            # tunnel look identical from out here —
+                            # distinguish with a cheap re-probe, or a
+                            # slow phase early in the priority order
+                            # starves every phase behind it (moe did
+                            # exactly this on 2026-07-31: three bursts
+                            # died at moe with the tail never tried)
+                            p2 = _run_tpu_phase(
+                                "probe", _PHASE_CAPS["probe"], env,
+                                pass_fds=(claim.fd,),
+                            )
+                            p2err = p2.get("error")
+                            # every probe is journaled — the health
+                            # timeline must cover exactly the moments
+                            # around timeouts one diagnoses with it
+                            _journal({
+                                "alive": p2err is None,
+                                "rtt_ms": p2.get("readback_rtt_ms"),
+                                **({"error": p2err[:200]}
+                                   if p2err else {}),
+                                "source": "watchdog",
+                            })
+                            if p2err is not None:
+                                break  # probe dead too: real wedge
+                            print(f"[watchdog] chip still alive after "
+                                  f"{phase} timeout; continuing burst",
+                                  file=sys.stderr)
                         continue      # phase-specific failure: next one
                     _record_phase(phase, frag)
                     _journal({"phase": phase, "captured": True,

@@ -131,8 +131,17 @@ def _chained_per_call(step_fn, x0, n: int,
         t0 = time.perf_counter()
         float(run(x0, n))
         ts.append(time.perf_counter() - t0)
+        # the reps honor the budget too: with a genuinely slow step
+        # (the 2026-07-31 moe phase ran 16+ min against a 480 s cap)
+        # each rep is chain_n × step — keep at least 2 for a spread,
+        # then stop burning the phase cap
+        if len(ts) >= 2 and time.monotonic() > deadline:
+            break
     ts.sort()
-    med = ts[len(ts) // 2]
+    # true median: the budget break can leave an even count, where a
+    # bare ts[len//2] would return the upper sample (max at count 2)
+    k = (len(ts) - 1) // 2
+    med = (ts[k] + ts[len(ts) // 2]) / 2
     if stats is not None:
         stats["chain_n"] = int(n)
         stats["rtt_ms"] = round(rtt * 1000, 1)
@@ -414,9 +423,9 @@ def bench_serving_quant(out: dict) -> None:
     out["decode_tokens_per_sec_per_chip_int8"] = round(tput, 1)
     # provenance: whether decode streamed int8 weight bytes through the
     # pallas w8a16 kernel or the XLA dequant path (ops/quant_matmul.py)
-    from instaslice_tpu.models.quant import _kernel_enabled
+    from instaslice_tpu.models.quant import kernel_enabled
     out["serving_quant_w8a16_kernel"] = bool(
-        _kernel_enabled() and eng._quant_kernel
+        kernel_enabled() and eng._quant_kernel
     )
 
 
@@ -556,9 +565,9 @@ def bench_serving_7b(out: dict) -> None:
     # decode was actually measured; ANDed with the engine's own routing
     # decision, not just the env kill-switch.
     if kernel_routed is not None:
-        from instaslice_tpu.models.quant import _kernel_enabled
+        from instaslice_tpu.models.quant import kernel_enabled
         out["serving_7b_w8a16_kernel"] = bool(
-            _kernel_enabled() and kernel_routed
+            kernel_enabled() and kernel_routed
         )
 
 
@@ -774,9 +783,9 @@ def bench_train_mfu(out: dict, generation: str) -> None:
 
 
 def bench_moe(out: dict, *, d_model: int = 2048, n_heads: int = 16,
-              n_layers: int = 8, dense_ff: int = 8192, n_experts: int = 8,
-              top_k: int = 2, batch: int = 8, seq: int = 1024,
-              vocab: int = 8192, chain_budget_s: float = 60.0) -> None:
+              n_layers: int = 4, dense_ff: int = 8192, n_experts: int = 8,
+              top_k: int = 2, batch: int = 4, seq: int = 512,
+              vocab: int = 8192, chain_budget_s: float = 45.0) -> None:
     """GShard dispatch/combine overhead vs the dense MLP at MATCHED
     active FLOPs (``models/lm.py:_moe_mlp`` — the one model feature
     with no perf evidence until this phase).
