@@ -82,14 +82,16 @@ TPU_PHASES = [
     ("serving_quant", 300.0),
     ("mfu", 300.0),
     ("serving_7b", 420.0),
-    # two fresh model compiles (dense + MoE with the one-hot dispatch
-    # einsums) over a tunnel: 300s was hit twice on 2026-07-31 when a
-    # code edit invalidated the compile cache mid-round
-    ("moe", 480.0),
     ("serving_lora", 300.0),
     ("serving_spec", 300.0),
     ("serving_small", 180.0),
     ("serving_tp", 120.0),
+    # moe LAST in both orderings (here and WATCHDOG_PRIORITY): it is
+    # the slowest phase (two fresh model compiles), and a slow phase
+    # early in a shared-budget sequence starves everything behind it —
+    # the 2026-07-31 lesson, where three watchdog bursts died at moe
+    # with four phases never attempted
+    ("moe", 480.0),
 ]
 
 
@@ -257,6 +259,19 @@ def _store_lock():
         yield
     finally:
         os.close(fd)
+
+
+def _journal_probe(frag: dict, source: str):
+    """Journal one probe result in the canonical shape; returns the
+    probe's error (None = alive) so callers can branch on it."""
+    err = frag.get("error")
+    _journal({
+        "alive": err is None,
+        "rtt_ms": frag.get("readback_rtt_ms"),
+        **({"error": err[:200]} if err else {}),
+        "source": source,
+    })
+    return err
 
 
 def _journal(event: dict) -> None:
@@ -461,12 +476,7 @@ def watchdog(interval: float, max_hours: float, once: bool) -> int:
             frag = _run_tpu_phase("probe", _PHASE_CAPS["probe"], env,
                                   pass_fds=(claim.fd,))
             err = frag.get("error")
-            _journal({
-                "alive": err is None,
-                "rtt_ms": frag.get("readback_rtt_ms"),
-                **({"error": err[:200]} if err else {}),
-                "source": "watchdog",
-            })
+            _journal_probe(frag, "watchdog")
             if err is None:
                 _record_phase("probe", {
                     k: v for k, v in frag.items()
@@ -502,17 +512,10 @@ def watchdog(interval: float, max_hours: float, once: bool) -> int:
                                 "probe", _PHASE_CAPS["probe"], env,
                                 pass_fds=(claim.fd,),
                             )
-                            p2err = p2.get("error")
                             # every probe is journaled — the health
                             # timeline must cover exactly the moments
                             # around timeouts one diagnoses with it
-                            _journal({
-                                "alive": p2err is None,
-                                "rtt_ms": p2.get("readback_rtt_ms"),
-                                **({"error": p2err[:200]}
-                                   if p2err else {}),
-                                "source": "watchdog",
-                            })
+                            p2err = _journal_probe(p2, "watchdog")
                             if p2err is not None:
                                 break  # probe dead too: real wedge
                             print(f"[watchdog] chip still alive after "

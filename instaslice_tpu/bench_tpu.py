@@ -75,7 +75,7 @@ MIN_RTT_MULT = 10.0
 
 def _chained_per_call(step_fn, x0, n: int,
                       reps: int = 5, stats: dict = None,
-                      budget_s: float = 60.0) -> float:
+                      budget_s: float = 60.0, const_args=()) -> float:
     """Seconds per ``step_fn`` call, measured as one compiled loop of n
     chained calls ending in a scalar readback (real sync), minus the
     tunnel round-trip measured HERE, inside the same phase (RTT drifts
@@ -97,20 +97,26 @@ def _chained_per_call(step_fn, x0, n: int,
     import jax
     import jax.numpy as jnp
 
+    # ``const_args`` (e.g. a params tree) ride as REAL jit arguments:
+    # a step_fn that merely closes over big device arrays embeds them
+    # as program constants, and the axon tunnel's remote_compile POSTs
+    # the serialized program — a closed-over 400M-param tree blew its
+    # request-size limit (HTTP 413) and killed every moe capture until
+    # 2026-07-31
     @jax.jit
-    def run(x, steps):
+    def run(x, steps, *cargs):
         out = jax.lax.fori_loop(
-            0, steps, lambda i, v: step_fn(v), x,
+            0, steps, lambda i, v: step_fn(v, *cargs), x,
         )
         return out.astype(jnp.float32).sum()
 
     deadline = time.monotonic() + budget_s
-    float(run(x0, n))                                 # compile + warm
+    float(run(x0, n, *const_args))                    # compile + warm
     rtt = _readback_rtt()
     floor = MIN_RTT_MULT * rtt
     while time.monotonic() < deadline:
         t0 = time.perf_counter()
-        float(run(x0, n))
+        float(run(x0, n, *const_args))
         wall = time.perf_counter() - t0
         compute = wall - rtt
         if compute >= floor:
@@ -129,7 +135,7 @@ def _chained_per_call(step_fn, x0, n: int,
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        float(run(x0, n))
+        float(run(x0, n, *const_args))
         ts.append(time.perf_counter() - t0)
         # the reps honor the budget too: with a genuinely slow step
         # (the 2026-07-31 moe phase ran 16+ min against a 480 s cap)
@@ -147,6 +153,9 @@ def _chained_per_call(step_fn, x0, n: int,
         stats["rtt_ms"] = round(rtt * 1000, 1)
         stats["wall_median_s"] = round(med, 3)
         stats["spread_pct"] = round(100 * (ts[-1] - ts[0]) / med, 1)
+        # the budget break can cut reps below the default: record how
+        # many samples the spread actually rests on
+        stats["reps"] = len(ts)
     return max(med - rtt, 1e-9) / n
 
 
@@ -828,15 +837,19 @@ def bench_moe(out: dict, *, d_model: int = 2048, n_heads: int = 16,
         model = TpuLM(cfg)
         params = model.init(jax.random.key(12))
 
-        def step(toks, _model=model, _params=params):
-            # default-arg binding: each kind's step closes over ITS
-            # model/params, not the loop's last iteration
-            logits = _model.apply(_params, toks)
+        def step(toks, p, _model=model):
+            # params arrive as a jit ARGUMENT (const_args), never a
+            # closure: closed-over weights serialize into the program
+            # body, which the tunnel's remote_compile rejects with
+            # HTTP 413 at these model sizes. Model binds by default-arg
+            # so each kind's step uses ITS model, not the loop's last.
+            logits = _model.apply(p, toks)
             return jnp.argmax(logits, -1).astype(toks.dtype)
 
         stats: dict = {}
         t = _chained_per_call(step, tokens0, n=2, stats=stats,
-                              budget_s=chain_budget_s)
+                              budget_s=chain_budget_s,
+                              const_args=(params,))
         times[kind] = t
         out[f"moe_bench_{kind}_fwd_seconds"] = round(t, 5)
         out[f"moe_bench_{kind}_fwd_seconds_timing"] = dict(stats)

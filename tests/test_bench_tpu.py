@@ -41,7 +41,8 @@ class TestChainedPerCall:
         assert t > 0
         # evidence keys the artifact carries
         assert set(stats) == {"chain_n", "rtt_ms", "wall_median_s",
-                              "spread_pct"}
+                              "spread_pct", "reps"}
+        assert stats["reps"] >= 2
         # the chain must have grown until compute >= MIN_RTT_MULT x RTT
         # (on CPU the RTT is microseconds, so even n=1 may pass — but
         # the invariant must hold for whatever n it settled on)
@@ -291,7 +292,7 @@ class TestMoeBenchPhase:
         for kind in ("dense", "moe"):
             ev = out[f"moe_bench_{kind}_fwd_seconds_timing"]
             assert set(ev) == {"chain_n", "rtt_ms", "wall_median_s",
-                               "spread_pct"}
+                               "spread_pct", "reps"}
         assert "moe_bench_overhead_pct" in out
         assert "matched active FLOPs" in out["moe_bench_config"]
 
