@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for the LM.
+"""Weight-only quantization for the LM: per-channel int8 and group-wise packed int4.
 
 Decode serving at LM scale is HBM-bandwidth-bound: every step re-reads
 all weights, so storing them as int8 (+ a per-output-channel fp32 scale)
@@ -100,13 +100,123 @@ def quantize_tensor(w: jax.Array, reduce_axis: int = -2) -> QuantizedTensor:
     return QuantizedTensor(q, scale.astype(w.dtype))
 
 
-def quantize_params(params: Params) -> Params:
-    """Quantize every matmul weight in an :func:`init_params` tree to
-    int8; norms/router stay full precision. Idempotent on already
-    quantized leaves."""
+@jax.tree_util.register_pytree_node_class
+class Int4Tensor:
+    """Group-wise int4 weights: two values packed per uint8 byte along
+    the contraction axis, one fp32 scale per (group, output channel).
+
+    The CAPACITY tier below int8: a 13B-class model's ~26 GB of bf16
+    weights become ~6.5 GB — the difference between needing a 2x2 slice
+    and fitting ONE 16 GB v5e chip next to its KV cache. Per-step
+    decode bandwidth is NOT the pitch: the decode path dequantizes to
+    the compute dtype and XLA streams that (docs/PERF.md, "The w8a16
+    kernel investigation") — int4 buys model size, not tok/s.
+
+    ``p``: packed uint8; along ``pack_axis`` each byte holds values
+    (2i | 2i+1 << 4). ``s``: fp32 scales, the packed axis reduced to
+    n_groups — SAME RANK as the original weight, so the weight's
+    PartitionSpec applies to both leaves; a spec sharding the packed
+    axis itself (wo/w_out shard their contraction axis under TP) is
+    honored when every shard keeps whole byte-pairs and whole groups
+    (see :func:`shard_params`), else that axis is replicated.
+    ``pack_axis`` is -2 for (…, in, out) projections, -1 for the
+    (vocab, d) embedding.
+    """
+
+    def __init__(self, p: jax.Array, s: jax.Array, group: int,
+                 pack_axis: int):
+        self.p = p
+        self.s = s
+        self.group = group
+        self.pack_axis = pack_axis
+
+    @property
+    def shape(self):
+        shp = list(self.p.shape)
+        shp[self.pack_axis] *= 2
+        return tuple(shp)
+
+    @property
+    def dtype(self):
+        return self.s.dtype
+
+    def _unpack(self) -> jax.Array:
+        """int values in [-7, 7], original shape, int32."""
+        ax = self.pack_axis % self.p.ndim
+        p = self.p.astype(jnp.int32)
+        lo = ((p & 0xF) ^ 8) - 8          # sign-extend low nibble
+        hi = ((p >> 4) ^ 8) - 8
+        u = jnp.stack([lo, hi], axis=ax + 1)   # (..., K/2, 2, ...)
+        shp = list(self.p.shape)
+        shp[ax] *= 2
+        return u.reshape(shp)
+
+    def dequantize(self, dtype=None) -> jax.Array:
+        ax = self.pack_axis % self.p.ndim
+        u = self._unpack().astype(jnp.float32)
+        K = u.shape[ax]
+        g = self.group
+        grouped = list(u.shape)
+        grouped[ax:ax + 1] = [K // g, g]
+        u = u.reshape(grouped)
+        s = jnp.expand_dims(self.s.astype(jnp.float32), axis=ax + 1)
+        out = (u * s).reshape([d for d in self.shape])
+        return out.astype(dtype or self.s.dtype)
+
+    def tree_flatten(self):
+        return (self.p, self.s), (self.group, self.pack_axis)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def __repr__(self):
+        return (f"Int4Tensor(shape={self.shape}, group={self.group}, "
+                f"pack_axis={self.pack_axis})")
+
+
+def quantize_tensor_int4(w: jax.Array, reduce_axis: int = -2,
+                         group: int = 128) -> Int4Tensor:
+    """Symmetric group-wise int4: the contraction axis splits into
+    ``group``-sized runs, each with one fp32 scale per output channel
+    (per-group scaling recovers most of the accuracy a single
+    per-channel int4 scale loses — the standard 4-bit weight-only
+    recipe). Values live in [-7, 7] (the -8 code is unused: symmetric),
+    packed two per byte along the same axis."""
+    ax = reduce_axis % w.ndim
+    K = w.shape[ax]
+    g = min(group, K)
+    if K % g or K % 2:
+        raise ValueError(f"contraction dim {K} must be even and "
+                         f"divisible by group={g}")
+    w32 = w.astype(jnp.float32)
+    grouped = list(w.shape)
+    grouped[ax:ax + 1] = [K // g, g]
+    wg = w32.reshape(grouped)
+    amax = jnp.max(jnp.abs(wg), axis=ax + 1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int32)
+    q = q.reshape(w.shape)
+    lo = jax.lax.slice_in_dim(q, 0, K, stride=2, axis=ax)
+    hi = jax.lax.slice_in_dim(q, 1, K, stride=2, axis=ax)
+    packed = ((lo & 0xF) | ((hi & 0xF) << 4)).astype(jnp.uint8)
+    return Int4Tensor(packed, jnp.squeeze(scale, axis=ax + 1),
+                      g, reduce_axis)
+
+
+def quantize_params(params: Params, bits: int = 8,
+                    group: int = 128) -> Params:
+    """Quantize every matmul weight in an :func:`init_params` tree —
+    ``bits=8``: per-channel int8 (:class:`QuantizedTensor`, the
+    throughput/capacity default); ``bits=4``: group-wise packed int4
+    (:class:`Int4Tensor`, the capacity tier — 4× smaller than bf16).
+    Norms/router stay full precision. Idempotent on already quantized
+    leaves."""
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
 
     def walk(tree, key=""):
-        if isinstance(tree, QuantizedTensor):
+        if isinstance(tree, (QuantizedTensor, Int4Tensor)):
             return tree
         if isinstance(tree, dict):
             # skipped subtrees (norms, router) pass through wholesale
@@ -114,8 +224,11 @@ def quantize_params(params: Params) -> Params:
                 k: (tree[k] if k in _SKIP_KEYS else walk(tree[k], k))
                 for k in tree
             }
-        return quantize_tensor(tree, reduce_axis=-1 if key == "embed"
-                               else -2)
+        axis = -1 if key == "embed" else -2
+        if bits == 4:
+            return quantize_tensor_int4(tree, reduce_axis=axis,
+                                        group=group)
+        return quantize_tensor(tree, reduce_axis=axis)
 
     return walk(params)
 
@@ -140,11 +253,38 @@ def shard_params(params: Params, mesh, specs: Params) -> Params:
             ))
             s = jax.device_put(leaf.s, NamedSharding(mesh, sspec))
             return QuantizedTensor(q, s)
+        if isinstance(leaf, Int4Tensor):
+            # packed values and group scales keep the weight's rank, so
+            # the spec applies to both. A spec that shards the PACKED
+            # axis (wo/w_out shard their contraction axis under TP) is
+            # honored when each shard keeps whole byte-pairs AND whole
+            # groups — true whenever K/D is a multiple of the group
+            # size, e.g. K=4096, D≤8, g=128. Only when that fails is
+            # the axis masked to None (replicated: correct, wasteful).
+            ax = leaf.pack_axis % leaf.p.ndim
+            K = leaf.p.shape[ax] * 2
+            names = spec[ax] if ax < len(spec) else None
+            if names is not None:
+                D = 1
+                for nm in ([names] if isinstance(names, str) else names):
+                    D *= mesh.shape[nm]
+                ok = (leaf.p.shape[ax] % D == 0
+                      and (K // D) % leaf.group == 0)
+            else:
+                ok = True
+            pspec = P(*(
+                (spec[d] if d < len(spec) else None)
+                if (d != ax or ok) else None
+                for d in range(leaf.p.ndim)
+            ))
+            pq = jax.device_put(leaf.p, NamedSharding(mesh, pspec))
+            ps = jax.device_put(leaf.s, NamedSharding(mesh, pspec))
+            return Int4Tensor(pq, ps, leaf.group, leaf.pack_axis)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree.map(
         place, params, specs,
-        is_leaf=lambda x: isinstance(x, QuantizedTensor),
+        is_leaf=lambda x: isinstance(x, (QuantizedTensor, Int4Tensor)),
     )
 
 
@@ -228,9 +368,10 @@ def qdot_stacked(x2: jax.Array, leaf, layer, *, compute_dtype=None,
 
 def weight(leaf, dtype=None) -> jax.Array:
     """A usable weight from a params leaf: dequantize
-    :class:`QuantizedTensor`, pass arrays through. The model calls this
-    at every weight use so one code path serves both precisions."""
-    if isinstance(leaf, QuantizedTensor):
+    :class:`QuantizedTensor` / :class:`Int4Tensor`, pass arrays
+    through. The model calls this at every weight use so one code path
+    serves every precision."""
+    if isinstance(leaf, (QuantizedTensor, Int4Tensor)):
         return leaf.dequantize(dtype)
     return leaf if dtype is None else leaf.astype(dtype)
 
@@ -243,4 +384,10 @@ def embed_lookup(leaf, tokens: jax.Array) -> jax.Array:
         rows = leaf.q[tokens].astype(jnp.float32)
         scales = leaf.s[tokens].astype(jnp.float32)   # (..., 1) per-row
         return (rows * scales).astype(leaf.s.dtype)
+    if isinstance(leaf, Int4Tensor):
+        # gather packed rows + their group scales, dequantize only the
+        # gathered (…, D/2) bytes — the table itself stays packed
+        sub = Int4Tensor(leaf.p[tokens], leaf.s[tokens],
+                         leaf.group, leaf.pack_axis)
+        return sub.dequantize()
     return leaf[tokens]

@@ -797,7 +797,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "suffix (alpha is a training-time choice, not "
                          "recoverable from the tree)")
     ap.add_argument("--quantize", action="store_true",
-                    help="serve int8 weights + int8 KV cache")
+                    help="serve quantized weights + int8 KV cache")
+    ap.add_argument("--quantize-bits", type=int, default=8,
+                    choices=[8, 4],
+                    help="weight quantization width with --quantize: "
+                    "8 = per-channel int8 (throughput default), 4 = "
+                    "group-wise packed int4 (capacity tier: ~4x "
+                    "smaller than bf16 — 13B-class on one 16 GB chip)")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="0 = greedy; sampling config is engine-level "
                     "(one compiled program per setting)")
@@ -929,10 +935,12 @@ def build_engine(args) -> ServingEngine:
         merged_name = names[0]
         adapters, alphas, names = [], [], []
     kv_quant = False
-    if args.quantize:
+    # an explicit non-default width implies --quantize: silently
+    # serving bf16 would OOM the capacity recipes at load instead
+    if args.quantize or args.quantize_bits != 8:
         from instaslice_tpu.models.quant import quantize_params
 
-        params = quantize_params(params)
+        params = quantize_params(params, bits=args.quantize_bits)
         kv_quant = True
     eng = ServingEngine(
         model, params, max_batch=args.max_batch, max_len=args.max_len,

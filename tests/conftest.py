@@ -51,6 +51,7 @@ SLOW_MODULES = {
     "test_tpulock",       # cross-process holder spawn/kill round-trips
     "test_lora",          # adapter train-step compiles
     "test_quant_matmul",  # pallas w8a16 kernel (interpret mode) sweeps
+    "test_int4",          # packed int4 quantization + engine compiles
 }
 
 

@@ -645,6 +645,52 @@ class TestBuildEngineCli:
         rid = eng.add_request([3, 1, 4])
         assert len(eng.decode_block(4)[rid]) == 4
 
+    def test_quantize_bits_4(self):
+        """--quantize-bits 4 builds a packed-int4 engine; bad widths
+        are an argparse error, not a runtime crash."""
+        import pytest
+
+        from instaslice_tpu.models.quant import Int4Tensor
+        from instaslice_tpu.serving.api_server import (
+            build_engine,
+            build_parser,
+        )
+
+        args = build_parser().parse_args([
+            "--d-model", "32", "--n-heads", "4", "--n-layers", "2",
+            "--d-ff", "64", "--vocab-size", "64", "--max-len", "64",
+            "--prefill-len", "8", "--max-batch", "2",
+            "--quantize", "--quantize-bits", "4",
+        ])
+        eng = build_engine(args)
+        assert isinstance(eng.params["blocks"]["wq"], Int4Tensor)
+        assert eng.cache["k"].dtype == jnp.int8
+        rid = eng.add_request([3, 1, 4])
+        assert len(eng.decode_block(4)[rid]) == 4
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["--quantize-bits", "5"])
+
+    def test_quantize_bits_implies_quantize(self):
+        """An explicit non-default --quantize-bits without --quantize
+        means the operator wants quantization — honor it rather than
+        silently serving bf16 (which OOMs the 13B-on-one-chip recipe
+        at load instead of at the flag)."""
+        from instaslice_tpu.models.quant import Int4Tensor
+        from instaslice_tpu.serving.api_server import (
+            build_engine,
+            build_parser,
+        )
+
+        args = build_parser().parse_args([
+            "--d-model", "32", "--n-heads", "4", "--n-layers", "2",
+            "--d-ff", "64", "--vocab-size", "64", "--max-len", "64",
+            "--prefill-len", "8", "--max-batch", "2",
+            "--quantize-bits", "4",
+        ])
+        eng = build_engine(args)
+        assert isinstance(eng.params["blocks"]["wq"], Int4Tensor)
+        assert eng.cache["k"].dtype == jnp.int8
+
     def test_checkpoint_restore(self, tmp_path):
         import numpy as np
 

@@ -1,0 +1,170 @@
+"""Group-wise packed int4 weights (models/quant.py: Int4Tensor).
+
+The capacity tier below int8: correctness bars are (1) pack/unpack is
+a lossless round-trip of the int values, (2) dequantization error is
+group-bounded, (3) the quantized model's full and cache forwards agree
+(the serving invariant), (4) the engine serves an int4 model end to
+end including TP-sharded, and (5) the int4 tree really is ~4× smaller
+than bf16.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.models.quant import (
+    Int4Tensor,
+    quantize_params,
+    quantize_tensor_int4,
+)
+from instaslice_tpu.serving import ServingEngine
+
+
+class TestInt4Tensor:
+    def test_pack_unpack_roundtrip_exact(self):
+        """Every int in [-7, 7] survives pack→unpack bit-exactly, at
+        every position parity."""
+        w = jax.random.normal(jax.random.key(0), (64, 32), jnp.float32)
+        qt = quantize_tensor_int4(w, group=32)
+        u = qt._unpack()
+        # reconstruct the reference quantized ints the same way the
+        # quantizer did
+        wg = w.astype(jnp.float32).reshape(2, 32, 32)
+        amax = jnp.max(jnp.abs(wg), axis=1, keepdims=True)
+        scale = jnp.maximum(amax, 1e-8) / 7.0
+        ref = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int32)
+        np.testing.assert_array_equal(u, ref.reshape(64, 32))
+        assert qt.p.dtype == jnp.uint8
+        assert qt.p.shape == (32, 32)            # packed axis halved
+        assert qt.s.shape == (2, 32)             # one scale per group
+
+    def test_dequantize_error_group_bounded(self):
+        w = jax.random.normal(jax.random.key(1), (256, 64), jnp.float32)
+        qt = quantize_tensor_int4(w, group=128)
+        err = jnp.abs(qt.dequantize(jnp.float32) - w)
+        # per-group scale: error <= scale/2 per element
+        wg = jnp.abs(w).reshape(2, 128, 64)
+        bound = jnp.max(wg, axis=1, keepdims=True) / 7.0 / 2.0
+        assert bool(jnp.all(err.reshape(2, 128, 64) <= bound + 1e-6))
+
+    def test_embed_layout_last_axis(self):
+        """The (vocab, d) table packs along d (reduce -1)."""
+        from instaslice_tpu.models.quant import embed_lookup
+
+        w = jax.random.normal(jax.random.key(2), (64, 32), jnp.float32)
+        qt = quantize_tensor_int4(w, reduce_axis=-1, group=16)
+        assert qt.p.shape == (64, 16)
+        assert qt.s.shape == (64, 2)
+        toks = jnp.array([[3, 9], [61, 0]])
+        got = embed_lookup(qt, toks)
+        want = qt.dequantize(jnp.float32)[toks]
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_pytree_roundtrip(self):
+        qt = quantize_tensor_int4(jnp.ones((32, 8)), group=16)
+        leaves, treedef = jax.tree.flatten(qt)
+        assert len(leaves) == 2
+        back = jax.tree.unflatten(treedef, leaves)
+        assert isinstance(back, Int4Tensor)
+        assert back.group == 16 and back.pack_axis == -2
+
+    def test_odd_contraction_rejected(self):
+        with pytest.raises(ValueError, match="even"):
+            quantize_tensor_int4(jnp.ones((33, 8)), group=33)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+class TestInt4Model:
+    def test_quantize_params_bits4(self, model):
+        _, params = model
+        qp = quantize_params(params, bits=4, group=16)
+        assert isinstance(qp["blocks"]["wq"], Int4Tensor)
+        assert isinstance(qp["embed"], Int4Tensor)
+        # norms stay full precision; idempotent
+        assert not isinstance(qp["blocks"]["ln1"]["scale"], Int4Tensor)
+        qp2 = quantize_params(qp, bits=4)
+        assert qp2["blocks"]["wq"] is qp["blocks"]["wq"]
+
+    def test_tree_is_4x_smaller_than_fp32_over_8x(self, model):
+        """The capacity claim: packed int4 ≈ 1/8 the fp32 bytes (1/4
+        of bf16), scales amortized away at group 16+."""
+        _, params = model
+        qp = quantize_params(params, bits=4, group=16)
+
+        def nbytes(t):
+            return sum(x.size * x.dtype.itemsize
+                       for x in jax.tree.leaves(t))
+
+        ratio = nbytes(qp) / nbytes(params)        # params are fp32
+        assert ratio < 0.22, ratio                  # 1/8 + scale slack
+
+    def test_logits_close_to_full_precision(self, model):
+        m, params = model
+        toks = jax.random.randint(jax.random.key(3), (2, 16), 0, 64)
+        full = m.apply(params, toks)
+        q4 = m.apply(quantize_params(params, bits=4, group=16), toks)
+        rel = float(jnp.linalg.norm(q4 - full) / jnp.linalg.norm(full))
+        # int4 is lossy and a tiny random d=32 model is its worst case
+        # (no outlier structure for the group scales to exploit, logit
+        # norm near zero); measured ~0.17 here vs int8's ~0.012 — the
+        # bound catches packing/scale bugs (which blow past 1.0), not
+        # quantization noise
+        assert rel < 0.3, rel
+
+    def test_cache_path_matches_full_forward(self, model):
+        """The serving invariant under int4: same weights, two code
+        paths, same logits."""
+        m, params = model
+        qp = quantize_params(params, bits=4, group=16)
+        toks = jax.random.randint(jax.random.key(4), (2, 12), 0, 64)
+        full = m.apply(qp, toks)
+        cache = m.init_cache(2, 32)
+        lengths = jnp.zeros(2, jnp.int32)
+        lg, cache = m.apply_with_cache(qp, toks[:, :5], cache, lengths)
+        assert float(jnp.abs(lg - full[:, :5]).max()) < 1e-4
+        lengths = lengths + 5
+        for t in range(5, 12):
+            lg, cache = m.apply_with_cache(
+                qp, toks[:, t:t + 1], cache, lengths
+            )
+            assert float(jnp.abs(lg[:, 0] - full[:, t]).max()) < 1e-4
+            lengths = lengths + 1
+
+
+class TestInt4Serving:
+    def test_engine_serves_int4(self, model):
+        m, params = model
+        qp = quantize_params(params, bits=4, group=16)
+        eng = ServingEngine(m, qp, max_batch=2, max_len=64,
+                            prefill_len=8, kv_quant=True)
+        rid = eng.add_request([5, 9, 2, 7])
+        out = eng.decode_block(6)[rid]
+        assert len(out) == 6 and all(0 <= t < 64 for t in out)
+
+    def test_engine_tp_int4(self, model):
+        """TP-sharded int4: the packed/group axis is masked from the
+        spec, the output-channel shards still split."""
+        from jax.sharding import Mesh
+
+        m, params = model
+        qp = quantize_params(params, bits=4, group=16)
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("model",))
+        eng = ServingEngine(m, qp, max_batch=2, max_len=64,
+                            prefill_len=8, mesh=mesh)
+        wq = eng.params["blocks"]["wq"]
+        shard = next(iter(wq.p.addressable_shards))
+        assert shard.data.shape[-1] == wq.p.shape[-1] // 2
+        rid = eng.add_request([5, 9, 2, 7])
+        out = eng.decode_block(6)[rid]
+        assert len(out) == 6 and all(0 <= t < 64 for t in out)
