@@ -798,12 +798,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "recoverable from the tree)")
     ap.add_argument("--quantize", action="store_true",
                     help="serve quantized weights + int8 KV cache")
-    ap.add_argument("--quantize-bits", type=int, default=8,
+    ap.add_argument("--quantize-bits", type=int, default=None,
                     choices=[8, 4],
-                    help="weight quantization width with --quantize: "
-                    "8 = per-channel int8 (throughput default), 4 = "
+                    help="weight quantization width: 8 = per-channel "
+                    "int8 (the default with --quantize), 4 = "
                     "group-wise packed int4 (capacity tier: ~4x "
-                    "smaller than bf16 — 13B-class on one 16 GB chip)")
+                    "smaller than bf16 — 13B-class on one 16 GB "
+                    "chip). Giving this EXPLICITLY implies --quantize")
     ap.add_argument("--temperature", type=float, default=0.0,
                     help="0 = greedy; sampling config is engine-level "
                     "(one compiled program per setting)")
@@ -935,12 +936,12 @@ def build_engine(args) -> ServingEngine:
         merged_name = names[0]
         adapters, alphas, names = [], [], []
     kv_quant = False
-    # an explicit non-default width implies --quantize: silently
+    # ANY explicit width implies --quantize (8 included): silently
     # serving bf16 would OOM the capacity recipes at load instead
-    if args.quantize or args.quantize_bits != 8:
+    if args.quantize or args.quantize_bits is not None:
         from instaslice_tpu.models.quant import quantize_params
 
-        params = quantize_params(params, bits=args.quantize_bits)
+        params = quantize_params(params, bits=args.quantize_bits or 8)
         kv_quant = True
     eng = ServingEngine(
         model, params, max_batch=args.max_batch, max_len=args.max_len,

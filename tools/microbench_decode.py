@@ -1,0 +1,116 @@
+"""On-chip decomposition of the 7B decode step (the b32 gap).
+
+The 2026-07-31 capture left a question PERF.md could only hypothesize
+about: serving_7b steps cost 16.9 / 23.1 / 35.7 ms at batch 8/16/32 —
+~0.78 ms per row beyond the weight floor. Candidate binders: the int8
+KV cache's dequantize (XLA materializes dot operands, so reading int8
+KV costs int8-read + compute-dtype write + re-read), the per-row
+vmapped cache writes (scatters), or plain VPU attention work.
+
+This tool separates them by measuring the REAL engine's block-decode
+throughput across {kv_quant on/off} × {attend_len 256/1024} × batch:
+
+- kv_quant OFF removes the dequant (bf16 KV feeds the dot directly) at
+  2× the cache bytes: if int8-KV's dequant materialization dominates,
+  bf16 KV WINS despite more bytes (5 effective byte-passes vs 2);
+- attend_len scaling isolates the KV-read term from per-row costs that
+  do not touch the cache depth (writes, rope, sampling).
+
+OOM is a RESULT (bf16 KV at batch 32 × 1024 may not fit next to 6.8 GB
+of weights): reported, not raised. Claims the host TPU flock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def measure(model, params, batch: int, kv_quant: bool,
+            attend_len: int, n_steps: int = 64) -> dict:
+    from instaslice_tpu.bench_tpu import _is_oom, _readback_rtt
+    from instaslice_tpu.serving import ServingEngine
+
+    out = {"batch": batch, "kv_quant": kv_quant,
+           "attend_len": attend_len}
+    eng = None
+    try:
+        eng = ServingEngine(model, params, max_batch=batch,
+                            max_len=1024, prefill_len=128,
+                            kv_quant=kv_quant)
+        for _ in range(batch):
+            eng.add_request([1, 2, 3])
+        # warm to a depth such that BOTH the compile block and the
+        # timed block sit inside the target attend bucket (block
+        # length and bucket are compile keys — timing a first-call
+        # block would bill its compile as step time)
+        warm = max(1, attend_len - 3 - 2 * n_steps - 8)
+        eng.decode_block(warm)
+        eng.decode_block(n_steps)          # compile + warm this program
+        rtt = _readback_rtt()
+        t0 = time.perf_counter()
+        got = eng.decode_block(n_steps)
+        dt = time.perf_counter() - t0 - rtt
+        toks = sum(len(v) for v in got.values())
+        out["step_ms"] = round(dt / n_steps * 1000, 2)
+        out["tokens_per_sec"] = round(toks / dt, 1)
+        out["rtt_ms"] = round(rtt * 1000, 1)
+    except Exception as e:  # noqa: BLE001 - OOM is a result here
+        if not _is_oom(e):
+            raise
+        out["result"] = "OOM"
+    finally:
+        del eng                       # free the KV cache before the next
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, nargs="+", default=[8, 32])
+    ap.add_argument("--steps", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    from instaslice_tpu.utils.tpulock import TpuBusyError, TpuClaim
+
+    try:
+        claim = TpuClaim().acquire(timeout=10)
+    except TpuBusyError as e:
+        print(f"TPU busy: {e}", file=sys.stderr)
+        return 1
+    try:
+        import jax
+
+        if jax.default_backend() != "tpu":
+            print("not on TPU; refusing", file=sys.stderr)
+            return 1
+        import jax.numpy as jnp
+
+        from instaslice_tpu.bench_tpu import _init_quantized_params
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+
+        cfg = ModelConfig(
+            vocab_size=32000, d_model=4096, n_heads=32, n_kv_heads=8,
+            n_layers=32, d_ff=20480, max_seq_len=2048,
+            dtype=jnp.bfloat16, remat=False,
+        )
+        params = _init_quantized_params(cfg)
+        model = TpuLM(cfg)
+        for batch in args.batches:
+            for kv_quant in (True, False):
+                for attend in (256, 1024):
+                    r = measure(model, params, batch, kv_quant,
+                                attend, n_steps=args.steps)
+                    print(json.dumps(r), flush=True)
+        return 0
+    finally:
+        claim.release()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
