@@ -622,17 +622,23 @@ class TpuLM:
     def init_cache(self, batch: int, max_len: int,
                    quant: bool = False) -> Params:
         """Zeroed KV cache for incremental decoding: per-layer stacked
-        (L, B, max_len, H, hd) key/value tensors (the serving engine's
-        slot-batched layout).
+        (L, B, H, max_len, hd) key/value tensors (the serving engine's
+        slot-batched layout). HEAD-MAJOR on purpose: the decode
+        attention dots batch over (B, H) and contract positions, so a
+        position-major cache forces XLA to materialize a transposed
+        copy of every attended slice per layer — measured 6.4 GB/step
+        of pure copy traffic at batch 32 (and a transient-OOM at deep
+        attends) before the layout flip.
 
         ``quant=True`` stores K/V as int8 with one fp32 scale per
-        (layer, slot, position, head) — decode streams the whole cache
+        (layer, slot, head, position) — decode streams the whole cache
         every step, so int8 halves its HBM traffic and doubles how many
         tokens fit; the per-vector scale keeps the error sub-percent.
         Under grouped-query attention only ``cfg.kv_heads`` heads are
         stored — the cache shrinks by n_heads/kv_heads on top."""
         cfg = self.cfg
-        shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+        shape = (cfg.n_layers, batch, cfg.kv_heads, max_len,
+                 cfg.head_dim)
         if quant:
             return {
                 "k": jnp.zeros(shape, jnp.int8),
@@ -691,7 +697,7 @@ class TpuLM:
         cfg = self.cfg
         quant = "k_s" in cache                        # int8 KV storage
         B, T = tokens.shape
-        S_max = attend_len or cache["k"].shape[2]
+        S_max = attend_len or cache["k"].shape[3]
         x = embed_lookup(params["embed"], tokens).astype(cfg.dtype)
         positions = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)
 
@@ -744,7 +750,7 @@ class TpuLM:
         # Cached positions are therefore valid iff s < lengths[b]
         # (position-independent of t: the current T entries are local,
         # not yet in the cache).
-        S_cache = cache["k"].shape[2]
+        S_cache = cache["k"].shape[3]
         # band width: the fresh T entries attend LOCALLY now, so the
         # union of admissible cached positions over all T queries is
         # [lengths-window+1, lengths-1] — window-1 slots regardless of T
@@ -763,10 +769,12 @@ class TpuLM:
             )
 
             def read_band(c):
-                """(B, S, ...) → (B, win_band, ...) at per-row starts."""
+                """(B, H, S, …) → (B, H, win_band, …) at per-row
+                starts (position is axis 1 of the per-row leaf for
+                both K/V and their scales)."""
                 return jax.vmap(
                     lambda cb, st: lax.dynamic_slice_in_dim(
-                        cb, st, win_band, axis=0
+                        cb, st, win_band, axis=1
                     )
                 )(c, start)
         else:
@@ -821,7 +829,7 @@ class TpuLM:
             if quant:
                 kc, vc, ks, vs = rest                 # kc int8, ks f32
             else:
-                kc, vc = rest                         # kc: (B,S,H,hd)
+                kc, vc = rest                         # kc: (B,H,S,hd)
 
             def proj(h_in, name, w, out_fp32=False):
                 """Base contraction + this row's adapter delta (if
@@ -870,8 +878,8 @@ class TpuLM:
                     k8r, v8r = read_band(kc), read_band(vc)
                     ksr, vsr = read_band(ks), read_band(vs)
                 else:
-                    k8r, v8r = kc[:, :S_max], vc[:, :S_max]
-                    ksr, vsr = ks[:, :S_max], vs[:, :S_max]
+                    k8r, v8r = kc[:, :, :S_max], vc[:, :, :S_max]
+                    ksr, vsr = ks[:, :, :S_max], vs[:, :, :S_max]
                 k_read = (k8r.astype(jnp.float32)
                           * ksr[..., None]).astype(cfg.dtype)
                 v_read = (v8r.astype(jnp.float32)
@@ -881,7 +889,7 @@ class TpuLM:
                 if use_window:
                     k_read, v_read = read_band(kc), read_band(vc)
                 else:
-                    k_read, v_read = kc[:, :S_max], vc[:, :S_max]
+                    k_read, v_read = kc[:, :, :S_max], vc[:, :, :S_max]
             # grouped-query decode: contract the stored KV heads against
             # their query-head groups directly — the repeated-KV tensor
             # the cache shrank away is never materialized, so the HBM
@@ -892,7 +900,7 @@ class TpuLM:
             sm = cfg.head_dim ** -0.5
             q5 = q.reshape(B, T, cfg.kv_heads, G, cfg.head_dim)
             lg_c = jnp.einsum(
-                "btkgd,bskd->bkgts", q5, k_read,
+                "btkgd,bksd->bkgts", q5, k_read,
                 preferred_element_type=jnp.float32,
             ) * sm
             lg_c = jnp.where(mask[:, None, None], lg_c, -1e9)
@@ -906,7 +914,7 @@ class TpuLM:
                 jnp.concatenate([lg_c, lg_l], axis=-1), axis=-1
             ).astype(cfg.dtype)
             attn = jnp.einsum(
-                "bkgts,bskd->btkgd", probs[..., :S_attn], v_read
+                "bkgts,bksd->btkgd", probs[..., :S_attn], v_read
             ) + jnp.einsum(
                 "bkgtu,bukd->btkgd", probs[..., S_attn:], v
             )
@@ -948,17 +956,24 @@ class TpuLM:
 
         def write_all(c, n):
             """ONE per-row-offset write covering every layer:
-            (L, B, S, …) ← (L, B, T, …) at each row's own offset."""
+            (L, B, H, S, …) ← (L, B, H, T, …) at each row's own
+            offset (position is axis 2 of the per-row leaf)."""
             return jax.vmap(
                 lambda cb, nb, p: lax.dynamic_update_slice(
-                    cb, nb, (0, p) + (0,) * (cb.ndim - 2)
+                    cb, nb, (0, 0, p) + (0,) * (cb.ndim - 3)
                 ),
                 in_axes=(1, 1, 0), out_axes=1,
             )(c, n, lengths)
 
-        out_cache = {"k": write_all(cache["k"], new[0]),
-                     "v": write_all(cache["v"], new[1])}
+        # fresh entries come off the scan as (L, B, T, H[, hd]) —
+        # reorder to the cache's head-major layout (tiny tensors)
+        out_cache = {
+            "k": write_all(cache["k"], jnp.swapaxes(new[0], 2, 3)),
+            "v": write_all(cache["v"], jnp.swapaxes(new[1], 2, 3)),
+        }
         if quant:
-            out_cache["k_s"] = write_all(cache["k_s"], new[2])
-            out_cache["v_s"] = write_all(cache["v_s"], new[3])
+            out_cache["k_s"] = write_all(cache["k_s"],
+                                         jnp.swapaxes(new[2], 2, 3))
+            out_cache["v_s"] = write_all(cache["v_s"],
+                                         jnp.swapaxes(new[3], 2, 3))
         return logits, out_cache

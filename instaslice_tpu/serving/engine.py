@@ -102,7 +102,7 @@ class _Prefix:
     """A registered shared prompt prefix: its prefilled KV stripe(s),
     ready to be copied into any slot instead of re-running prefill."""
     tokens: tuple                      # the prefix token ids
-    stripe: Params                     # cache leaves (L, 1, T, H, …)
+    stripe: Params                     # cache leaves (L, 1, H, T[, hd])
     draft_stripe: Optional[Params]     # ditto for the speculative draft
 
 
@@ -303,7 +303,7 @@ class ServingEngine:
 
         # every cache-transforming jit DONATES its cache argument: the
         # callers all rebind (self.cache = ...), so XLA may alias the
-        # update in place instead of copying the full (L, B, S, H, hd)
+        # update in place instead of copying the full (L, B, H, S, hd)
         # buffer per call — without this, admission paths (prefix-cache
         # hits, parallel-sample forks) pay O(full cache) HBM per written
         # slot where a stripe write suffices. _read_stripe stays
@@ -387,7 +387,8 @@ class ServingEngine:
         from instaslice_tpu.models.quant import shard_params
 
         params = shard_params(params, mesh, param_specs(model.cfg))
-        cache_sharding = NamedSharding(mesh, P(None, None, None, "model"))
+        # head-major cache: heads (the TP-sharded axis) sit at axis 2
+        cache_sharding = NamedSharding(mesh, P(None, None, "model"))
         cache = jax.tree.map(
             lambda c: jax.device_put(c, cache_sharding), cache
         )
@@ -454,11 +455,12 @@ class ServingEngine:
 
     def _read_stripe_impl(self, cache, slot, *, length: int):
         """Copy out one slot's cache positions [0, length) — every leaf
-        is (L, B, S, H, …) with slot on axis 1 and position on axis 2."""
+        is (L, B, H, S[, hd]) with slot on axis 1 and position on
+        axis 3 (head-major — see ``TpuLM.init_cache``)."""
 
         def rd(c):
             one = jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
-            return jax.lax.slice_in_dim(one, 0, length, axis=2)
+            return jax.lax.slice_in_dim(one, 0, length, axis=3)
 
         return jax.tree.map(rd, cache)
 

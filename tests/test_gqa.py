@@ -71,10 +71,10 @@ class TestGqaModel:
     def test_cache_stores_only_kv_heads(self, model):
         m, _ = model
         cache = m.init_cache(2, 16)
-        assert cache["k"].shape == (2, 2, 16, 2, 8)          # Hkv=2
+        assert cache["k"].shape == (2, 2, 2, 16, 8)          # Hkv=2, head-major
         qc = m.init_cache(2, 16, quant=True)
-        assert qc["k"].shape == (2, 2, 16, 2, 8)
-        assert qc["k_s"].shape == (2, 2, 16, 2)
+        assert qc["k"].shape == (2, 2, 2, 16, 8)
+        assert qc["k_s"].shape == (2, 2, 2, 16)
 
     def test_incremental_matches_full_forward(self, model):
         m, params = model

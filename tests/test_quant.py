@@ -150,7 +150,7 @@ class TestKvCacheQuant:
         full = m.apply(params, toks)
         cache = m.init_cache(2, 32, quant=True)
         assert cache["k"].dtype == jnp.int8
-        assert cache["k_s"].shape == (2, 2, 32, 2)    # (L, B, S, H)
+        assert cache["k_s"].shape == (2, 2, 2, 32)    # (L, B, H, S)
         lg, cache = m.apply_with_cache(
             params, toks, cache, jnp.zeros(2, jnp.int32)
         )

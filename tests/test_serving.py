@@ -241,7 +241,8 @@ class TestTensorParallelServing:
         assert shard.data.shape[-1] == wq.shape[-1] // 2  # heads split
         kc = eng.cache["k"]
         kshard = next(iter(kc.addressable_shards))
-        assert kshard.data.shape[3] == kc.shape[3] // 2   # cache H split
+        # head-major cache: heads at axis 2
+        assert kshard.data.shape[2] == kc.shape[2] // 2   # cache H split
 
     def test_tp_rejects_mesh_without_model_axis(self, model):
         import numpy as np
