@@ -406,7 +406,7 @@ def bench_serving(out: dict) -> None:
         )
         tput = eng.throughput(n_steps=256, overhead_seconds=rtt)
         out[key] = round(tput, 1)
-        del eng  # free the 2·(L,B,S,H,hd) cache before the next size
+        del eng  # free the 2·(L,B,H,S,hd) cache before the next size
     out["serving_batch"] = 32
     out["serving_bench_seconds"] = round(time.perf_counter() - t0, 1)
     out["serving_model_params_m"] = round(_param_count(cfg) / 1e6)

@@ -1,0 +1,89 @@
+"""Cross-product regression net for the 2026-07-31 decode rewrite.
+
+The attention path changed twice in one day (read-only cache with a
+joint prefix‖local softmax; head-major cache layout), each change
+validated piecewise by the serving/window/gqa suites. This module pins
+the combined semantics directly at the model level, across the full
+feature cross-product, against the full-forward oracle — including the
+mixed-depth + rollback case the engine only exercises implicitly:
+
+rows sit at DIFFERENT depths (the rectangular-batch invariant), reached
+here by prefilling uniformly and then rolling rows back to staggered
+lengths — exactly speculative decoding's rejection semantics: a cache
+position beyond ``lengths`` must be invisible AND overwritable.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+@pytest.mark.parametrize("window", [0, 5])
+@pytest.mark.parametrize("n_kv", [0, 2])
+def test_mixed_depth_decode_matches_full_forward(kv_quant, window, n_kv):
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_kv_heads=n_kv,
+        n_layers=2, d_ff=64, window=window, max_seq_len=32,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    params = m.init(jax.random.key(0))
+    B, S = 3, 10
+    seqs = jax.random.randint(jax.random.key(1), (B, S), 0, 64)
+    full = m.apply(params, seqs)                     # (B, S, V)
+
+    cache = m.init_cache(B, 24, quant=kv_quant)
+    lg, cache = m.apply_with_cache(
+        params, seqs[:, :6], cache, jnp.zeros(B, jnp.int32)
+    )
+    # the prefill chunk itself must match the oracle at every position
+    tol = 0.05 if kv_quant else 1e-4
+    rel = np.linalg.norm(np.asarray(lg - full[:, :6])) / np.linalg.norm(
+        np.asarray(full[:, :6])
+    )
+    assert rel < tol, rel
+
+    # roll rows back to staggered depths (spec-decode rejection): the
+    # discarded positions still hold stale K/V — they must be invisible
+    depths = jnp.array([4, 2, 6], jnp.int32)
+    for step in range(3):
+        lens = depths + step
+        tok = jnp.take_along_axis(seqs, lens[:, None], axis=1)
+        lg, cache = m.apply_with_cache(params, tok, cache, lens)
+        for r in range(B):
+            pos = int(lens[r])
+            got = np.asarray(lg[r, 0])
+            want = np.asarray(full[r, pos])
+            rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+            assert rel < tol, (kv_quant, window, n_kv, step, r, rel)
+
+
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_attend_len_bucket_is_bit_identical(kv_quant):
+    """The engine's attend_len bucketing claim: bounding the attended
+    prefix must not change a single logit (rows' lengths all fit the
+    bucket)."""
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    params = m.init(jax.random.key(2))
+    B = 2
+    seqs = jax.random.randint(jax.random.key(3), (B, 8), 0, 64)
+    caches = []
+    for attend in (0, 16):           # 0 = whole buffer
+        cache = m.init_cache(B, 48, quant=kv_quant)
+        _, cache = m.apply_with_cache(
+            params, seqs, cache, jnp.zeros(B, jnp.int32)
+        )
+        lg, cache = m.apply_with_cache(
+            params, seqs[:, :1], cache,
+            jnp.full((B,), 8, jnp.int32), attend_len=attend,
+        )
+        caches.append(np.asarray(lg))
+    np.testing.assert_array_equal(caches[0], caches[1])
