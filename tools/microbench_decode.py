@@ -33,7 +33,8 @@ sys.path.insert(0, _REPO)
 
 
 def measure(model, params, batch: int, kv_quant: bool,
-            attend_len: int, n_steps: int = 64) -> dict:
+            attend_len: int, n_steps: int = 64,
+            max_len: int = 1024) -> dict:
     from instaslice_tpu.bench_tpu import _is_oom, _readback_rtt
     from instaslice_tpu.serving import ServingEngine
 
@@ -42,7 +43,7 @@ def measure(model, params, batch: int, kv_quant: bool,
     eng = None
     try:
         eng = ServingEngine(model, params, max_batch=batch,
-                            max_len=1024, prefill_len=128,
+                            max_len=max_len, prefill_len=128,
                             kv_quant=kv_quant)
         for _ in range(batch):
             eng.add_request([1, 2, 3])
@@ -74,6 +75,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, nargs="+", default=[8, 32])
     ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--max-len", type=int, default=1024)
+    ap.add_argument("--attends", type=int, nargs="+",
+                    default=[256, 1024])
+    ap.add_argument("--kv-quant-only", action="store_true")
     args = ap.parse_args(argv)
 
     from instaslice_tpu.utils.tpulock import TpuBusyError, TpuClaim
@@ -102,10 +107,12 @@ def main(argv=None) -> int:
         params = _init_quantized_params(cfg)
         model = TpuLM(cfg)
         for batch in args.batches:
-            for kv_quant in (True, False):
-                for attend in (256, 1024):
+            for kv_quant in ((True,) if args.kv_quant_only
+                             else (True, False)):
+                for attend in args.attends:
                     r = measure(model, params, batch, kv_quant,
-                                attend, n_steps=args.steps)
+                                attend, n_steps=args.steps,
+                                max_len=args.max_len)
                     print(json.dumps(r), flush=True)
         return 0
     finally:
