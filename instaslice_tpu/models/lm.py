@@ -804,6 +804,24 @@ class TpuLM:
         # extra write+read of the full int8 bytes every layer (measured
         # +16.6 ms/step on the 7B stack; see quant.qdot_stacked).
         # MoE layers keep the xs formulation (4-D expert stacks).
+        # fused decode-attention kernel (opt-in, T = 1, quant cache,
+        # full-causal): the cache leaves the scan's xs entirely — the
+        # kernel reads the whole head-major stack at a scalar-prefetched
+        # layer index, so no slice of it ever materializes
+        from instaslice_tpu.ops.flash_decode import (
+            decode_kernel_enabled,
+            merge_local,
+            quant_decode_attention,
+        )
+        blk_ok = S_max <= 256 or S_max % 256 == 0
+        use_fdk = (
+            quant and T == 1 and not use_window and quant_kernel
+            and not cfg.n_experts
+            and decode_kernel_enabled() and blk_ok
+            and (cfg.head_dim % 128 == 0
+                 or jax.default_backend() != "tpu")
+        )
+
         big_names = ("wq", "wk", "wv", "wo", "w_in", "w_out")
         # gated on the kernel opt-in too (trace-time): with the kernel
         # off, qdot_stacked would only ever hit its gather-dequant
@@ -820,13 +838,15 @@ class TpuLM:
                 xs, lblocks = xs[:-1], xs[-1]
             else:
                 lblocks = {}
-            if use_stacked:
-                layer, idx = xs[0], xs[1]     # small per-layer tree, index
+            if use_stacked or use_fdk:
+                layer, idx = xs[0], xs[1]     # per-layer tree, index
                 rest = xs[2:]
             else:
                 layer, idx = xs[0], None
                 rest = xs[1:]
-            if quant:
+            if use_fdk:
+                kc = vc = ks = vs = None      # cache closed over (kernel)
+            elif quant:
                 kc, vc, ks, vs = rest                 # kc int8, ks f32
             else:
                 kc, vc = rest                         # kc: (B,H,S,hd)
@@ -865,7 +885,29 @@ class TpuLM:
             )
             q = _rope(q, positions)
             k = _rope(k, positions)
-            if quant:
+            if use_fdk:
+                k_new, k_sc = _kv_quantize(k)
+                v_new, v_sc = _kv_quantize(v)
+                new_out = (k_new, v_new, k_sc, v_sc)
+                G = cfg.n_heads // cfg.kv_heads
+                sm = cfg.head_dim ** -0.5
+                q4 = q.reshape(B, cfg.kv_heads, G, cfg.head_dim)
+                o, m_, l_ = quant_decode_attention(
+                    q4, cache["k"], cache["k_s"],
+                    cache["v"], cache["v_s"], lengths, idx, S_max,
+                )
+                k_loc = k[:, 0].astype(jnp.float32)    # (B, Hkv, hd)
+                v_loc = v[:, 0]
+                lg_l = jnp.einsum(
+                    "bkgd,bkd->bkg",
+                    q4.astype(jnp.float32) * sm, k_loc,
+                )
+                attn4 = merge_local(o, m_, l_, lg_l, v_loc)
+                attn = attn4.astype(cfg.dtype).reshape(
+                    B, 1, cfg.n_heads * cfg.head_dim
+                )
+                # falls through to the SHARED wo/MLP tail below
+            if not use_fdk and quant:
                 # quantize the fresh entries ONLY for storage (emitted
                 # as scan outputs, written post-scan); the local
                 # attendance below uses the exact values. The cached
@@ -884,7 +926,7 @@ class TpuLM:
                           * ksr[..., None]).astype(cfg.dtype)
                 v_read = (v8r.astype(jnp.float32)
                           * vsr[..., None]).astype(cfg.dtype)
-            else:
+            elif not use_fdk:
                 new_out = (k, v)
                 if use_window:
                     k_read, v_read = read_band(kc), read_band(vc)
@@ -939,9 +981,12 @@ class TpuLM:
             xs_in = (small, jnp.arange(cfg.n_layers, dtype=jnp.int32))
         else:
             xs_in = (params["blocks"],)
-        xs_in += (cache["k"], cache["v"])
-        if quant:
-            xs_in += (cache["k_s"], cache["v_s"])
+            if use_fdk:
+                xs_in += (jnp.arange(cfg.n_layers, dtype=jnp.int32),)
+        if not use_fdk:
+            xs_in += (cache["k"], cache["v"])
+            if quant:
+                xs_in += (cache["k_s"], cache["v_s"])
         if use_lora:
             xs_in += (lora["blocks"],)
         x, new = lax.scan(block, x, xs_in)

@@ -1,0 +1,190 @@
+"""Fused decode attention over the int8 KV cache (pallas, opt-in).
+
+The last measured decode binder (docs/PERF.md): reading the int8 cache
+through XLA dequantizes into a materialized compute-dtype copy feeding
+the attention dots — the depth term ran 2.7–3.7× the raw int8 bytes.
+This kernel streams the int8 bytes, dequantizes tile-by-tile in VMEM,
+and runs the online-softmax attention for ONE query step (T = 1)
+against each row's written prefix. Unlike the w8a16 weight kernel
+(which lost end-to-end because XLA hides non-matmul work under its
+weight stream), attention is serial with nothing to hide it under —
+the overlap objection does not apply.
+
+Design (everything learned on 2026-07-31 baked in):
+
+- operands are the WHOLE stacked head-major cache (L, B, Hkv, S, hd)
+  with the layer picked by scalar-prefetch index maps — a scan-sliced
+  pallas operand materializes (the +16.6 ms/step lesson);
+- grid is (B,) only: per program the full (Hkv, S_attn, hd) int8 K and
+  V blocks ride VMEM (≤ 2 MB at S 2048) and an inner ``fori_loop``
+  dequantizes 256-position tiles into registers — small grids keep the
+  per-program overhead (~1-2 µs each) off the step time;
+- per-row validity (`s < lengths[b]`) comes from a scalar-prefetched
+  lengths vector; the output is the UNNORMALIZED accumulator plus
+  per-(head, group) running (m, l) so the caller merges the current
+  step's local entry with the standard online-softmax identity —
+  bit-for-bit the joint softmax the XLA path computes.
+
+Opt-in via ``TPUSLICE_DECODE_KERNEL=1`` (trace-time), decode path
+only (T = 1, quantized cache, full-causal); everything else keeps the
+measured XLA formulation.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: trailing lane width for the (m, l) row statistics (same trick as
+#: the flash kernel: a bare (G,) vector block does not lower)
+_LANES = 8
+
+#: inner dequant tile along the position axis; the engine buckets
+#: attends to 256-position steps, so this always divides S_attn
+_BLK = 256
+
+
+def decode_kernel_enabled() -> bool:
+    """Opt-in (default off) until the in-situ measurement says
+    otherwise — the w8a16 kernel taught us per-op wins can lose
+    end-to-end; see docs/PERF.md."""
+    return os.environ.get("TPUSLICE_DECODE_KERNEL", "0") == "1"
+
+
+def _fd_kernel(li_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref,
+               o_ref, m_ref, l_ref, *, sm_scale: float, blk: int):
+    b = pl.program_id(0)
+    q = q_ref[0].astype(jnp.float32) * sm_scale      # (Hkv, G, hd)
+    Hkv, G, hd = q.shape
+    S = k_ref.shape[3]
+    len_b = len_ref[b]
+    n_blk = S // blk
+
+    def body(j, carry):
+        m, l, acc = carry
+        k8 = k_ref[0, 0, :, pl.ds(j * blk, blk), :]
+        ks = ks_ref[0, 0, :, pl.ds(j * blk, blk)]
+        k = k8.astype(jnp.float32) * ks[..., None]   # (Hkv, blk, hd)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                            # (Hkv, G, blk)
+        pos = j * blk + jax.lax.broadcasted_iota(
+            jnp.int32, (Hkv, G, blk), 2
+        )
+        s = jnp.where(pos < len_b, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v8 = v_ref[0, 0, :, pl.ds(j * blk, blk), :]
+        vs = vs_ref[0, 0, :, pl.ds(j * blk, blk)]
+        v = v8.astype(jnp.float32) * vs[..., None]
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                            # (Hkv, G, hd)
+        return m_new, l, acc
+
+    # rows at depth 0 (empty prefix) still run one tile: everything
+    # masks to -1e30, l stays ~0, and the caller's merge with the
+    # local entry recovers exactly the local-only softmax
+    m0 = jnp.full((Hkv, G, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((Hkv, G, 1), jnp.float32)
+    a0 = jnp.zeros((Hkv, G, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_blk, body, (m0, l0, a0))
+    o_ref[0] = acc
+    m_ref[0] = jnp.broadcast_to(m, (Hkv, G, _LANES))
+    l_ref[0] = jnp.broadcast_to(l, (Hkv, G, _LANES))
+
+
+@functools.partial(jax.jit, static_argnames=("s_attn", "interpret"))
+def _fd_call(q4, k3, ks3, v3, vs3, lengths, li, s_attn, interpret):
+    B, Hkv, G, hd = q4.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # layer index, lengths
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, G, hd), lambda b, li, ln: (b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, s_attn, hd),
+                         lambda b, li, ln: (li[0], b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, s_attn),
+                         lambda b, li, ln: (li[0], b, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, s_attn, hd),
+                         lambda b, li, ln: (li[0], b, 0, 0, 0)),
+            pl.BlockSpec((1, 1, Hkv, s_attn),
+                         lambda b, li, ln: (li[0], b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, Hkv, G, hd), lambda b, li, ln: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, G, _LANES),
+                         lambda b, li, ln: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, G, _LANES),
+                         lambda b, li, ln: (b, 0, 0, 0)),
+        ),
+    )
+    sm = hd ** -0.5
+    return pl.pallas_call(
+        functools.partial(_fd_kernel, sm_scale=sm,
+                          blk=min(_BLK, s_attn)),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, Hkv, G, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, G, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, G, _LANES), jnp.float32),
+        ),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(li, jnp.int32).reshape(1),
+      jnp.asarray(lengths, jnp.int32), q4, k3, ks3, v3, vs3)
+
+
+def quant_decode_attention(
+    q4: jax.Array,
+    k3: jax.Array,
+    ks3: jax.Array,
+    v3: jax.Array,
+    vs3: jax.Array,
+    lengths: jax.Array,
+    layer: jax.Array,
+    s_attn: int,
+    *,
+    interpret: bool | None = None,
+):
+    """Prefix attention for one decode step over the stacked int8
+    cache; returns (acc, m, l) — the unnormalized weighted values and
+    per-(head, group) running max / sum for the caller's online-softmax
+    merge with the step's local entry.
+
+    ``q4``: (B, Hkv, G, hd). ``k3``/``v3``: (L, B, Hkv, S, hd) int8
+    (head-major). ``ks3``/``vs3``: (L, B, Hkv, S) fp32 scales.
+    ``lengths``: (B,) valid-prefix lengths. ``s_attn``: static attend
+    bound, a multiple of 256 (the engine's bucket step).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # the FULL cache goes in; the BlockSpecs read only the s_attn
+    # prefix — slicing here would materialize a copy of exactly the
+    # bytes this kernel exists not to copy
+    o, m, l = _fd_call(q4, k3, ks3, v3, vs3,
+                       lengths, layer, s_attn, interpret)
+    return o, m[..., 0], l[..., 0]
+
+
+def merge_local(o, m, l, lg_l, v_local):
+    """Online-softmax merge of the kernel's prefix partials with the
+    current step's single local entry (its logit ``lg_l`` (B, Hkv, G)
+    and value ``v_local`` (B, Hkv, hd)) → normalized (B, Hkv, G, hd).
+    Bit-for-bit the joint softmax over (prefix ‖ local)."""
+    m_tot = jnp.maximum(m, lg_l)
+    alpha = jnp.exp(m - m_tot)
+    beta = jnp.exp(lg_l - m_tot)
+    l_tot = l * alpha + beta
+    num = o * alpha[..., None] + (
+        v_local[:, :, None, :].astype(jnp.float32) * beta[..., None]
+    )
+    return num / l_tot[..., None]

@@ -53,6 +53,7 @@ SLOW_MODULES = {
     "test_quant_matmul",  # pallas w8a16 kernel (interpret mode) sweeps
     "test_int4",          # packed int4 quantization + engine compiles
     "test_decode_equivalence",  # decode-vs-oracle cross-product compiles
+    "test_flash_decode",  # fused decode-attention kernel (interpret)
 }
 
 

@@ -1,0 +1,90 @@
+"""Fused decode-attention kernel (ops/flash_decode.py) — opt-in.
+
+Correctness bars: (1) kernel partials + local merge reproduce the
+joint-softmax oracle over (prefix ‖ local) at per-row lengths,
+including empty prefixes; (2) the layer index picks the right layer's
+cache; (3) the opt-in gate default-off keeps the measured XLA path.
+The in-situ perf verdict (kernel LOSES once the head-major layout let
+XLA fuse the dequant reads) is recorded in docs/PERF.md.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from instaslice_tpu.ops.flash_decode import (
+    decode_kernel_enabled,
+    merge_local,
+    quant_decode_attention,
+)
+
+
+def _mk_cache(L, B, Hkv, S, hd, seed=0):
+    kk, kv = jax.random.split(jax.random.key(seed))
+    k3 = jax.random.randint(kk, (L, B, Hkv, S, hd), -127, 128, jnp.int8)
+    v3 = jax.random.randint(kv, (L, B, Hkv, S, hd), -127, 128, jnp.int8)
+    ks3 = jax.random.uniform(kk, (L, B, Hkv, S), jnp.float32, 0.01, 0.1)
+    vs3 = jax.random.uniform(kv, (L, B, Hkv, S), jnp.float32, 0.01, 0.1)
+    return k3, ks3, v3, vs3
+
+
+def _oracle(q4, k3, ks3, v3, vs3, lengths, li, lg_l, v_local):
+    """Joint softmax over (dequantized prefix ‖ local entry), fp32."""
+    sm = q4.shape[-1] ** -0.5
+    k = k3[li].astype(jnp.float32) * ks3[li][..., None]
+    v = v3[li].astype(jnp.float32) * vs3[li][..., None]
+    s = jnp.einsum("bkgd,bksd->bkgs", q4.astype(jnp.float32) * sm, k)
+    S = s.shape[-1]
+    mask = jnp.arange(S)[None, None, None] < lengths[:, None, None, None]
+    s = jnp.where(mask, s, -1e30)
+    joint = jnp.concatenate([s, lg_l[..., None]], axis=-1)
+    p = jax.nn.softmax(joint, axis=-1)
+    return (jnp.einsum("bkgs,bksd->bkgd", p[..., :S], v)
+            + p[..., S:] * v_local[:, :, None, :].astype(jnp.float32))
+
+
+@pytest.mark.parametrize("li", [0, 2])
+def test_matches_joint_softmax_oracle(li):
+    L, B, Hkv, S, hd, G = 3, 4, 2, 256, 16, 2
+    q4 = jax.random.normal(jax.random.key(1), (B, Hkv, G, hd))
+    k3, ks3, v3, vs3 = _mk_cache(L, B, Hkv, S, hd)
+    # staggered lengths, including an EMPTY prefix (row 0)
+    lengths = jnp.array([0, 5, 100, 256], jnp.int32)
+    k_loc = jax.random.normal(jax.random.key(2), (B, Hkv, hd))
+    v_loc = jax.random.normal(jax.random.key(3), (B, Hkv, hd))
+    sm = hd ** -0.5
+    lg_l = jnp.einsum("bkgd,bkd->bkg", q4 * sm, k_loc)
+
+    o, m, l = quant_decode_attention(
+        q4, k3, ks3, v3, vs3, lengths, jnp.int32(li), S
+    )
+    got = merge_local(o, m, l, lg_l, v_loc)
+    want = _oracle(q4, k3, ks3, v3, vs3, lengths, li, lg_l, v_loc)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_prefix_bound_reads_only_s_attn():
+    """s_attn bounds the attended prefix: entries beyond it must not
+    influence the result even when lengths would admit them."""
+    L, B, Hkv, S, hd = 1, 2, 2, 512, 16
+    q4 = jax.random.normal(jax.random.key(4), (B, Hkv, 2, hd))
+    k3, ks3, v3, vs3 = _mk_cache(L, B, Hkv, S, hd, seed=5)
+    lengths = jnp.array([200, 256], jnp.int32)
+    out_full = quant_decode_attention(
+        q4, k3, ks3, v3, vs3, lengths, jnp.int32(0), 512)
+    out_bound = quant_decode_attention(
+        q4, k3, ks3, v3, vs3, lengths, jnp.int32(0), 256)
+    # lengths <= 256, so bounding to 256 changes nothing
+    for a, b in zip(out_full, out_bound):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_opt_in_gate_defaults_off(monkeypatch):
+    monkeypatch.delenv("TPUSLICE_DECODE_KERNEL", raising=False)
+    assert decode_kernel_enabled() is False
+    monkeypatch.setenv("TPUSLICE_DECODE_KERNEL", "1")
+    assert decode_kernel_enabled() is True
