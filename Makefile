@@ -51,6 +51,17 @@ test-e2e:  ## Full in-process cluster lifecycle tier
 test-e2e-kind:  ## Real-cluster e2e on KinD (skips cleanly without docker/kind)
 	./deploy/e2e_kind.sh
 
+.PHONY: chaos
+chaos:  ## Control-plane + serving chaos tiers across 3 seeds (hung tests dump all thread stacks via faulthandler before the outer timeout kills them)
+	@set -e; for seed in 1 2 3; do \
+	  echo "=== chaos seed $$seed ==="; \
+	  CHAOS_SEED=$$seed CHAOS_DURATION=$${CHAOS_DURATION:-8} \
+	  PYTEST_FAULTHANDLER_SESSION_TIMEOUT=330 \
+	  JAX_PLATFORMS=cpu \
+	  timeout -k 10 360 $(PY) -m pytest \
+	    tests/test_chaos.py tests/test_serving_chaos.py -q; \
+	done
+
 .PHONY: bench
 bench:  ## Headline benchmark: slice-grant p50 latency (one JSON line)
 	$(PY) bench.py

@@ -97,6 +97,11 @@ class AgentRunner:
         try:
             self._stop.wait()
         finally:
+            # readiness drops FIRST (readyz -> 503 "draining") so the
+            # Service routes around this replica while the agent's
+            # reconcile/health loops wind down; liveness stays green
+            if self.probes:
+                self.probes.set_draining(True)
             self._ready = False
             self.agent.stop()
             if self.probes:

@@ -132,6 +132,11 @@ class ControllerRunner:
         try:
             self._stop.wait()
         finally:
+            # readiness drops FIRST (readyz → 503 "draining") so the
+            # Service routes around this replica while the reconcile
+            # loops finish their in-flight keys; liveness stays green
+            if self.probes:
+                self.probes.set_draining(True)
             self._ready = False
             self.controller.stop()
             if self.elector:

@@ -1,0 +1,391 @@
+"""Deterministic, seedable fault injection across the whole stack.
+
+The chaos tiers already hammer the control plane (``tests/test_chaos.py``
+drives the fake backend's ``inject_failures``/``fail_chip``), but those
+hooks are backend-local and hand-rolled per test. This module is the one
+fault surface for everything else: a :class:`FaultPlan` holds a seeded
+RNG plus per-**site** specs (probability, exact call schedules, fire
+caps), and adapters graft it onto each layer —
+
+- :class:`FaultyKubeClient` wraps any :class:`KubeClient` and injects
+  transient API failures (503/429/connection reset) into the verbs and
+  mid-stream disconnects into watches — what a flaky API server or an
+  overloaded kube-apiserver does to the control plane.
+- :class:`FaultyBackend` wraps a :class:`DeviceBackend` and injects
+  :class:`DeviceError`, slow dispatch, and chip failures.
+- :func:`engine_fault_hook` returns the callable a
+  :class:`~instaslice_tpu.serving.engine.ServingEngine` consults before
+  every dispatch (``engine.fault_hook``): it can delay (slow dispatch),
+  raise (transient backend error), or **poison** the donated KV cache
+  exactly the way a failed jitted call does — driving the engine's
+  recovery path for real.
+- The API scheduler consults a plan-provided hook once per loop round
+  (site ``scheduler.round``) for delays/errors in the serving loop.
+
+Everything is deterministic given the seed: the same plan replays the
+same fault sequence (per-site call counters, one shared RNG). Plans are
+built in tests or parsed from the ``TPUSLICE_FAULT_PLAN`` env var, which
+:class:`~instaslice_tpu.sim.SimCluster` honors so any sim-driven tier
+can run under faults without code changes::
+
+    TPUSLICE_FAULT_PLAN="seed=7;kube.request:p=0.05,kinds=http-503|conn-reset;device.reserve:p=0.1"
+
+Grammar: ``seed=N`` then ``;``-separated ``site:key=val,key=val`` specs
+with keys ``p`` (probability), ``kinds`` (``|``-separated), ``at``
+(``|``-separated exact call numbers, 1-based), ``max`` (fire cap),
+``delay`` (seconds, for kind ``delay``).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from instaslice_tpu.kube.client import ApiError, KubeClient, WatchEvent
+
+
+class FaultError(Exception):
+    """An injected failure (distinguishable from organic ones in logs)."""
+
+
+class InjectedApiError(ApiError):
+    """An injected kube API failure; ``code`` carries the HTTP status."""
+
+
+@dataclass
+class SiteSpec:
+    """How one site misbehaves. ``kinds`` is sampled uniformly when the
+    site fires; ``at_calls`` (1-based call numbers) always fire
+    regardless of probability — exact schedules for regression tests."""
+
+    probability: float = 0.0
+    kinds: Tuple[str, ...] = ("error",)
+    at_calls: frozenset = field(default_factory=frozenset)
+    max_fires: int = -1          # -1 = unlimited
+    delay_s: float = 0.01
+
+
+class FaultPlan:
+    """Seeded fault schedule over named sites. Thread-safe: the serving
+    data plane consults it from the scheduler thread while HTTP threads
+    and the control plane consult it concurrently."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.sites: Dict[str, SiteSpec] = {}
+        self.calls: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def site(self, name: str, probability: float = 0.0,
+             kinds: Tuple[str, ...] = ("error",), at_calls=(),
+             max_fires: int = -1, delay_s: float = 0.01) -> "FaultPlan":
+        """Register/replace a site spec; returns self for chaining."""
+        self.sites[name] = SiteSpec(
+            probability=probability, kinds=tuple(kinds),
+            at_calls=frozenset(at_calls), max_fires=max_fires,
+            delay_s=delay_s,
+        )
+        return self
+
+    def fire(self, name: str) -> Optional[str]:
+        """One call at ``name``: returns the fault kind to inject, or
+        None. Counts every call (fired or not) so ``at_calls`` schedules
+        are exact."""
+        with self._lock:
+            spec = self.sites.get(name)
+            self.calls[name] = n = self.calls.get(name, 0) + 1
+            if spec is None:
+                return None
+            if 0 <= spec.max_fires <= self.fired.get(name, 0):
+                return None
+            hit = n in spec.at_calls or (
+                spec.probability > 0
+                and self.rng.random() < spec.probability
+            )
+            if not hit:
+                return None
+            self.fired[name] = self.fired.get(name, 0) + 1
+            return (spec.kinds[self.rng.randrange(len(spec.kinds))]
+                    if len(spec.kinds) > 1 else spec.kinds[0])
+
+    def randrange(self, n: int) -> int:
+        """A draw from the plan's RNG under its lock — wrappers that
+        need extra randomness (e.g. which chip to fail) must come
+        through here, or concurrent fire() calls would interleave with
+        the draw and break seeded replayability."""
+        with self._lock:
+            return self.rng.randrange(n)
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-site {calls, fired} — chaos tests log this on failure so
+        a regression names the fault sequence that broke it."""
+        with self._lock:
+            return {
+                name: {"calls": self.calls.get(name, 0),
+                       "fired": self.fired.get(name, 0)}
+                for name in set(self.calls) | set(self.sites)
+            }
+
+    # ------------------------------------------------------------- env
+
+    @classmethod
+    def from_env(cls, text: Optional[str] = None) -> Optional["FaultPlan"]:
+        """Parse the ``TPUSLICE_FAULT_PLAN`` grammar (module docstring).
+        Returns None for empty/missing text so callers can write
+        ``plan = FaultPlan.from_env()`` unconditionally."""
+        if text is None:
+            import os
+
+            text = os.environ.get("TPUSLICE_FAULT_PLAN", "")
+        text = (text or "").strip()
+        if not text:
+            return None
+        seed = 0
+        specs: List[Tuple[str, dict]] = []
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if part.startswith("seed="):
+                seed = int(part[len("seed="):])
+                continue
+            site, _, body = part.partition(":")
+            kw: dict = {}
+            for item in body.split(","):
+                if not item.strip():
+                    continue
+                key, _, val = item.partition("=")
+                key = key.strip()
+                if key == "p":
+                    kw["probability"] = float(val)
+                elif key == "kinds":
+                    kw["kinds"] = tuple(val.split("|"))
+                elif key == "at":
+                    kw["at_calls"] = frozenset(
+                        int(x) for x in val.split("|") if x
+                    )
+                elif key == "max":
+                    kw["max_fires"] = int(val)
+                elif key == "delay":
+                    kw["delay_s"] = float(val)
+                else:
+                    raise ValueError(
+                        f"TPUSLICE_FAULT_PLAN: unknown key {key!r} "
+                        f"in {part!r}"
+                    )
+            specs.append((site.strip(), kw))
+        plan = cls(seed)
+        for site, kw in specs:
+            plan.site(site, **kw)
+        return plan
+
+
+# --------------------------------------------------------------- kube
+
+class FaultyKubeClient(KubeClient):
+    """Injects API flakiness between a consumer and any
+    :class:`KubeClient`. Sites:
+
+    - ``kube.request`` — every verb. Kinds: ``http-503``/``http-500``
+      (InjectedApiError with that code), ``http-429`` (too many
+      requests), ``conn-reset`` (ConnectionResetError — what a dropped
+      TCP session surfaces after the real client's retries give up),
+      ``delay`` (slow API server).
+    - ``kube.watch`` — consulted per watch **event**. Kind
+      ``disconnect`` truncates the stream mid-flight (the consumer must
+      re-establish and resume); ``delay`` stalls delivery.
+
+    The wrapper injects at the KubeClient interface, so it composes
+    with both the in-process fake and :class:`RealKubeClient` (where it
+    models failures that survive the client's own retry layer)."""
+
+    def __init__(self, inner: KubeClient, plan: FaultPlan) -> None:
+        self.inner = inner
+        self.plan = plan
+        # forward the watch-pacing hint so wrapped Managers behave
+        pref = getattr(inner, "preferred_watch_timeout", None)
+        if pref is not None:
+            self.preferred_watch_timeout = pref
+
+    def _maybe_fail(self) -> None:
+        kind = self.plan.fire("kube.request")
+        if kind is None:
+            return
+        if kind == "delay":
+            time.sleep(self.plan.sites["kube.request"].delay_s)
+            return
+        if kind == "conn-reset":
+            raise ConnectionResetError("injected: connection reset")
+        code = {"http-429": 429, "http-500": 500}.get(kind, 503)
+        err = InjectedApiError(f"injected: HTTP {code}")
+        err.code = code
+        raise err
+
+    def create(self, kind: str, obj: dict) -> dict:
+        self._maybe_fail()
+        return self.inner.create(kind, obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        self._maybe_fail()
+        return self.inner.get(kind, namespace, name)
+
+    def list(self, kind, namespace=None, label_selector=None):
+        self._maybe_fail()
+        return self.inner.list(kind, namespace=namespace,
+                               label_selector=label_selector)
+
+    def update(self, kind: str, obj: dict) -> dict:
+        self._maybe_fail()
+        return self.inner.update(kind, obj)
+
+    def patch(self, kind, namespace, name, patch):
+        self._maybe_fail()
+        return self.inner.patch(kind, namespace, name, patch)
+
+    def patch_status(self, kind, namespace, name, patch):
+        self._maybe_fail()
+        return self.inner.patch_status(kind, namespace, name, patch)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._maybe_fail()
+        self.inner.delete(kind, namespace, name)
+
+    def watch(self, kind, namespace=None, replay=True, timeout=None,
+              resource_version=None) -> Iterator[WatchEvent]:
+        stream = self.inner.watch(
+            kind, namespace=namespace, replay=replay, timeout=timeout,
+            resource_version=resource_version,
+        )
+
+        def _faulty() -> Iterator[WatchEvent]:
+            for ev in stream:
+                fault = self.plan.fire("kube.watch")
+                if fault == "disconnect":
+                    return  # stream cut mid-flight; consumer resumes
+                if fault == "delay":
+                    time.sleep(self.plan.sites["kube.watch"].delay_s)
+                yield ev
+
+        return _faulty()
+
+
+# ------------------------------------------------------------- device
+
+class FaultyBackend:
+    """Injects device flakiness in front of a
+    :class:`~instaslice_tpu.device.backend.DeviceBackend`. Sites
+    ``device.<op>`` for op in reserve/release/list/discover/health;
+    kinds: ``error`` (DeviceError), ``delay`` (slow ioctl), and
+    ``chip-fail`` (marks a random chip unhealthy through the inner
+    backend's ``fail_chip`` — the health sweep then sees it, exactly
+    like an ICI link drop)."""
+
+    def __init__(self, inner, plan: FaultPlan) -> None:
+        self._inner = inner
+        self._plan = plan
+
+    def __getattr__(self, name):  # passthrough (test helpers included)
+        return getattr(self._inner, name)
+
+    def _maybe_fail(self, op: str) -> None:
+        from instaslice_tpu.device.backend import DeviceError
+
+        kind = self._plan.fire(f"device.{op}")
+        if kind is None:
+            return
+        if kind == "delay":
+            time.sleep(self._plan.sites[f"device.{op}"].delay_s)
+            return
+        if kind == "chip-fail":
+            fail = getattr(self._inner, "fail_chip", None)
+            if fail is not None:
+                inv = self._inner.discover()
+                chips = sorted(inv.chip_paths)
+                fail(chips[self._plan.randrange(len(chips))])
+            return
+        raise DeviceError(f"injected device.{op} failure")
+
+    def discover(self):
+        self._maybe_fail("discover")
+        return self._inner.discover()
+
+    def reserve(self, slice_uuid, chip_ids):
+        self._maybe_fail("reserve")
+        return self._inner.reserve(slice_uuid, chip_ids)
+
+    def release(self, slice_uuid):
+        self._maybe_fail("release")
+        return self._inner.release(slice_uuid)
+
+    def list_reservations(self):
+        self._maybe_fail("list")
+        return self._inner.list_reservations()
+
+    def chip_health(self):
+        self._maybe_fail("health")
+        return self._inner.chip_health()
+
+
+# ------------------------------------------------------------- engine
+
+def poison_cache(engine) -> None:
+    """Consume the engine's donated KV-cache buffers — byte-for-byte
+    the state a failed jitted call leaves behind (``cache_poisoned()``
+    turns True; only ``recover()`` makes the engine decode again)."""
+    import jax
+
+    trees = [engine.cache]
+    if getattr(engine, "draft_model", None) is not None:
+        trees.append(engine.draft_cache)
+    for tree in trees:
+        for leaf in jax.tree.leaves(tree):
+            delete = getattr(leaf, "delete", None)
+            if delete is not None and not leaf.is_deleted():
+                delete()
+
+
+def engine_fault_hook(plan: FaultPlan, engine) -> Callable[[str], None]:
+    """The callable for ``engine.fault_hook``: consulted with the op
+    name (``"prefill"``/``"decode"``/``"spec"``) before each dispatch.
+    Sites ``engine.<op>``; kinds: ``delay`` (slow dispatch), ``poison``
+    (chip failure mid-dispatch: the donated cache is consumed AND the
+    call raises — the full recovery path), ``error`` (host-side raise,
+    cache intact)."""
+
+    def hook(op: str) -> None:
+        site = f"engine.{op}"
+        kind = plan.fire(site)
+        if kind is None:
+            return
+        if kind == "delay":
+            time.sleep(plan.sites[site].delay_s)
+            return
+        if kind == "poison":
+            poison_cache(engine)
+            raise FaultError(f"injected chip failure during {op} "
+                             "(cache consumed)")
+        raise FaultError(f"injected {op} failure")
+
+    return hook
+
+
+def scheduler_fault_hook(plan: FaultPlan) -> Callable[[], None]:
+    """Hook for the API scheduler's loop (site ``scheduler.round``):
+    ``delay`` stalls a round, ``error`` raises into the loop's guard —
+    proving one bad round never kills the serving thread."""
+
+    def hook() -> None:
+        kind = plan.fire("scheduler.round")
+        if kind is None:
+            return
+        if kind == "delay":
+            time.sleep(plan.sites["scheduler.round"].delay_s)
+            return
+        raise FaultError("injected scheduler-round failure")
+
+    return hook

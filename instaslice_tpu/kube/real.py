@@ -19,12 +19,16 @@ from __future__ import annotations
 
 import atexit
 import base64
+import http.client
 import json
+import logging
 import os
+import random
 import socket
 import ssl
 import subprocess
 import tempfile
+import threading
 import time
 import urllib.parse
 import urllib.request
@@ -43,6 +47,27 @@ from instaslice_tpu.kube.client import (
 )
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+log = logging.getLogger("instaslice_tpu.kube")
+
+#: transport-level failures worth a retry: connection reset/refused,
+#: DNS blips, read timeouts, truncated responses from a dying apiserver
+_TRANSIENT_EXC = (
+    urllib.error.URLError,
+    ConnectionError,
+    socket.timeout,
+    TimeoutError,
+    http.client.HTTPException,
+)
+
+
+class CircuitOpen(ApiError):
+    """The client's circuit breaker is open: recent requests failed
+    consecutively past the threshold, so callers fail fast instead of
+    stacking timeouts against a dead API server. Clears after the
+    cooldown (next request is the half-open probe)."""
+
+    code = 503
 
 
 def build_client(kubeconfig: str = "") -> "RealKubeClient":
@@ -113,6 +138,26 @@ class RealKubeClient(KubeClient):
     #: this to avoid 4-reconnects-per-second against a live API server
     preferred_watch_timeout = 15.0
 
+    # --- retry/backoff policy (instance-overridable; client-go's
+    # rest.Config QPS/backoff analog). A verb retries TRANSIENT failures
+    # (connection reset/refused/timeout, truncated response, HTTP 429,
+    # HTTP 5xx) up to max_attempts with capped exponential backoff +
+    # decorrelated jitter; 429/503 Retry-After headers are honored
+    # (capped). Non-transient API errors (404/409/400/410) surface
+    # immediately — retrying a semantic error cannot help.
+    max_attempts = 4
+    backoff_base = 0.1
+    backoff_cap = 5.0
+    retry_after_cap = 30.0
+    #: consecutive transient failures (across requests) that open the
+    #: circuit breaker; while open every call fails fast with
+    #: :class:`CircuitOpen` until the cooldown elapses, then ONE
+    #: half-open probe is let through (a probe failure re-opens).
+    breaker_threshold = 5
+    breaker_cooldown = 10.0
+    #: transparent in-stream watch re-establishments before giving up
+    watch_reconnects = 5
+
     def __init__(
         self,
         base_url: str,
@@ -142,6 +187,10 @@ class RealKubeClient(KubeClient):
         #: private-key material; deleted on close() (atexit-registered by
         #: from_kubeconfig)
         self._temp_files: List[str] = []
+        # circuit breaker: shared across this client's threads
+        self._breaker_lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._breaker_open_until = 0.0
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
             if insecure_skip_verify:
@@ -340,6 +389,64 @@ class RealKubeClient(KubeClient):
             parts.append(subresource)
         return "/".join(parts)
 
+    # ----------------------------------------------------------- breaker
+
+    def _breaker_check(self) -> None:
+        """Fail fast while the breaker is open (threshold consecutive
+        transient failures); past the cooldown the caller becomes the
+        half-open probe."""
+        with self._breaker_lock:
+            remaining = self._breaker_open_until - time.monotonic()
+            if remaining > 0:
+                raise CircuitOpen(
+                    f"circuit open for another {remaining:.1f}s "
+                    f"({self.breaker_threshold} consecutive failures "
+                    f"against {self.base_url})"
+                )
+
+    def _breaker_fail(self) -> None:
+        with self._breaker_lock:
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.breaker_threshold:
+                self._breaker_open_until = (
+                    time.monotonic() + self.breaker_cooldown
+                )
+                # leave the count one short of the threshold: a failed
+                # half-open probe re-opens immediately, a success resets
+                self._consecutive_failures = self.breaker_threshold - 1
+                log.warning(
+                    "kube circuit breaker OPEN for %.1fs (%s)",
+                    self.breaker_cooldown, self.base_url,
+                )
+
+    def _breaker_ok(self) -> None:
+        with self._breaker_lock:
+            self._consecutive_failures = 0
+            self._breaker_open_until = 0.0
+
+    @staticmethod
+    def _retry_after_seconds(headers) -> Optional[float]:
+        """Parse a Retry-After header (delta-seconds form; the HTTP-date
+        form is ignored — kube API servers send seconds)."""
+        raw = headers.get("Retry-After") if headers is not None else None
+        if not raw:
+            return None
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            return None
+
+    def _backoff_sleep(self, prev: float,
+                       retry_after: Optional[float]) -> float:
+        """Sleep with capped decorrelated jitter, stretched to honor a
+        server-provided Retry-After; returns the new backoff state."""
+        delay = min(self.backoff_cap,
+                    random.uniform(self.backoff_base, prev * 3))
+        if retry_after is not None:
+            delay = max(delay, min(retry_after, self.retry_after_cap))
+        time.sleep(delay)
+        return delay
+
     def _request(
         self,
         method: str,
@@ -349,7 +456,12 @@ class RealKubeClient(KubeClient):
         timeout: float = 30.0,
     ) -> dict:
         data = None if body is None else json.dumps(body).encode()
-        for attempt in (0, 1):
+        auth_retried = False
+        attempt = 0
+        delay = self.backoff_base
+        last_exc: Optional[BaseException] = None
+        while attempt < self.max_attempts:
+            self._breaker_check()
             req = urllib.request.Request(url, data=data, method=method)
             req.add_header("Accept", "application/json")
             if data is not None:
@@ -361,15 +473,47 @@ class RealKubeClient(KubeClient):
                 with urllib.request.urlopen(
                     req, context=self._ctx, timeout=timeout
                 ) as resp:
+                    self._breaker_ok()
                     return json.loads(resp.read().decode() or "{}")
             except urllib.error.HTTPError as e:
-                # rotated-out credential: refresh and retry once
-                if e.code == 401 and attempt == 0 and self._refreshable():
+                # rotated-out credential: refresh and retry once (not a
+                # transient failure — doesn't count against attempts or
+                # the breaker)
+                if e.code == 401 and not auth_retried and self._refreshable():
+                    auth_retried = True
                     self._invalidate_token()
                     continue
-                _raise_for(e.code, e.read())
+                payload = e.read()
+                if e.code == 429 or e.code >= 500:
+                    self._breaker_fail()
+                    attempt += 1
+                    if attempt >= self.max_attempts:
+                        _raise_for(e.code, payload)
+                    delay = self._backoff_sleep(
+                        delay, self._retry_after_seconds(e.headers)
+                    )
+                    continue
+                # semantic errors (404/409/400/410) are HEALTHY server
+                # round-trips: they prove connectivity, so they reset
+                # the consecutive-failure count like a 2xx — otherwise
+                # a 404-heavy poll loop would let isolated transients
+                # accumulate across hours and trip the breaker
+                self._breaker_ok()
+                _raise_for(e.code, payload)
                 raise  # unreachable; _raise_for always raises
-        raise AssertionError("unreachable")
+            except _TRANSIENT_EXC as e:
+                self._breaker_fail()
+                last_exc = e
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    break
+                delay = self._backoff_sleep(delay, None)
+        err = ApiError(
+            f"{method} {url} failed after {attempt} attempts: "
+            f"{type(last_exc).__name__}: {last_exc}"
+        )
+        err.code = 503
+        raise err from last_exc
 
     # ------------------------------------------------------------- verbs
 
@@ -445,8 +589,34 @@ class RealKubeClient(KubeClient):
         bounded event window; the fake's log-tail replay has no such
         horizon). The stream ends after ``timeout`` seconds of quiet
         (socket read timeout) — the Manager re-establishes with the
-        bookmark it last saw."""
+        bookmark it last saw.
+
+        A watch DROPPED mid-stream (connection reset, truncated chunk,
+        5xx/429 at establishment) re-establishes transparently from the
+        last seen resourceVersion with jittered backoff — up to
+        ``watch_reconnects`` consecutive failures — so a flaky network
+        path costs a short stall, not a cold relist; seen events are
+        never replayed because the server resumes strictly after rv."""
         timeout = timeout if timeout is not None else 30.0
+
+        def _connect(rv: Optional[str]):
+            params = {
+                "watch": "1",
+                "allowWatchBookmarks": "true",
+                "timeoutSeconds": str(max(1, int(timeout * 4))),
+            }
+            if rv:
+                params["resourceVersion"] = rv
+            url = (self._path(kind, namespace) + "?"
+                   + urllib.parse.urlencode(params))
+            req = urllib.request.Request(url, method="GET")
+            req.add_header("Accept", "application/json")
+            tok = self._bearer_token()
+            if tok:
+                req.add_header("Authorization", f"Bearer {tok}")
+            return urllib.request.urlopen(
+                req, context=self._ctx, timeout=timeout
+            )
 
         def _stream() -> Iterator[WatchEvent]:
             rv = resource_version
@@ -466,55 +636,96 @@ class RealKubeClient(KubeClient):
                 "BOOKMARK",
                 {"metadata": {"resourceVersion": rv or "0"}},
             )
-            params = {
-                "watch": "1",
-                "allowWatchBookmarks": "true",
-                "timeoutSeconds": str(max(1, int(timeout * 4))),
-            }
-            if rv:
-                params["resourceVersion"] = rv
-            url = self._path(kind, namespace) + "?" + urllib.parse.urlencode(
-                params
-            )
-            req = urllib.request.Request(url, method="GET")
-            req.add_header("Accept", "application/json")
-            tok = self._bearer_token()
-            if tok:
-                req.add_header("Authorization", f"Bearer {tok}")
-            try:
-                resp = urllib.request.urlopen(
-                    req, context=self._ctx, timeout=timeout
-                )
-            except urllib.error.HTTPError as e:
-                if e.code == 401 and self._refreshable():
-                    self._invalidate_token()  # next establishment refreshes
-                _raise_for(e.code, e.read())  # 410 → ResourceVersionExpired
-                return
-            try:
-                buf = b""
-                while True:
-                    try:
-                        chunk = resp.read1(65536)
-                    except (socket.timeout, TimeoutError):
-                        return  # quiet period over; caller resumes by rv
-                    if not chunk:
-                        return
-                    buf += chunk
-                    while b"\n" in buf:
-                        line, buf = buf.split(b"\n", 1)
-                        if not line.strip():
-                            continue
-                        rec = json.loads(line)
-                        etype = rec.get("type", "")
-                        obj = rec.get("object", {})
-                        if etype == "ERROR":
-                            if obj.get("code") == 410:
-                                raise ResourceVersionExpired(
-                                    f"watch {kind} rv={rv} expired mid-stream"
-                                )
-                            continue
-                        yield (etype, obj)
-            finally:
-                resp.close()
+            # A dropped watch re-establishes HERE, resuming from the
+            # last seen resourceVersion with jittered backoff — seen
+            # events are never replayed (the server resumes after rv)
+            # and the consumer never restarts its burst cold. Clean
+            # stream ends (server timeout / quiet period) still return:
+            # the caller owns the long-term re-establishment cadence.
+            reconnects = 0
+            while True:
+                try:
+                    resp = _connect(rv)
+                except urllib.error.HTTPError as e:
+                    if e.code == 401 and self._refreshable():
+                        self._invalidate_token()  # next attempt refreshes
+                    payload = e.read()
+                    if e.code == 429 or e.code >= 500:
+                        reconnects += 1
+                        if reconnects > self.watch_reconnects:
+                            _raise_for(e.code, payload)
+                        self._backoff_sleep(
+                            self.backoff_base,
+                            self._retry_after_seconds(e.headers),
+                        )
+                        continue
+                    _raise_for(e.code, payload)  # 410 → RVExpired
+                    return
+                except _TRANSIENT_EXC as e:
+                    reconnects += 1
+                    if reconnects > self.watch_reconnects:
+                        err = ApiError(
+                            f"watch {kind} failed after {reconnects} "
+                            f"attempts: {type(e).__name__}: {e}"
+                        )
+                        err.code = 503
+                        raise err from e
+                    self._backoff_sleep(self.backoff_base, None)
+                    continue
+                try:
+                    buf = b""
+                    while True:
+                        try:
+                            chunk = resp.read1(65536)
+                        except (socket.timeout, TimeoutError):
+                            return  # quiet period over; caller resumes
+                        if not chunk:
+                            return  # clean end; caller resumes by rv
+                        buf += chunk
+                        while b"\n" in buf:
+                            line, buf = buf.split(b"\n", 1)
+                            if not line.strip():
+                                continue
+                            rec = json.loads(line)
+                            etype = rec.get("type", "")
+                            obj = rec.get("object", {})
+                            if etype == "ERROR":
+                                if obj.get("code") == 410:
+                                    raise ResourceVersionExpired(
+                                        f"watch {kind} rv={rv} expired "
+                                        "mid-stream"
+                                    )
+                                continue
+                            seen = obj.get("metadata", {}).get(
+                                "resourceVersion"
+                            )
+                            if seen:
+                                rv = seen
+                            # delivery proves the server is healthy:
+                            # a fresh drop gets the full budget again
+                            reconnects = 0
+                            yield (etype, obj)
+                except ResourceVersionExpired:
+                    raise
+                except (ConnectionResetError, http.client.IncompleteRead,
+                        ssl.SSLError, OSError) as e:
+                    # mid-stream transport drop (RST, truncated chunk):
+                    # resume from the last seen rv instead of failing
+                    # the whole stream back to a cold relist
+                    reconnects += 1
+                    if reconnects > self.watch_reconnects:
+                        err = ApiError(
+                            f"watch {kind} dropped {reconnects} times: "
+                            f"{type(e).__name__}: {e}"
+                        )
+                        err.code = 503
+                        raise err from e
+                    log.info(
+                        "watch %s dropped (%s); resuming from rv=%s",
+                        kind, type(e).__name__, rv,
+                    )
+                    self._backoff_sleep(self.backoff_base, None)
+                finally:
+                    resp.close()
 
         return _stream()

@@ -117,9 +117,14 @@ class ServingMetrics:
             self.queue_depth = _NoopMetric()
             self.live_slots = _NoopMetric()
             self.request_seconds = _NoopMetric()
+            self.draining = _NoopMetric()
             self.registry = None
             return
         self.registry = registry or CollectorRegistry()
+        # outcome ∈ ok | error | timeout | rejected | shed (queue-full
+        # 429) | drained (drain-time 503). Every HTTP request lands in
+        # EXACTLY one outcome — tests/test_serving_chaos.py reconciles
+        # the sum against delivered responses under fault injection.
         self.requests = Counter(
             "tpuslice_serve_requests_total",
             "Completion requests by outcome",
@@ -145,6 +150,11 @@ class ServingMetrics:
             "tpuslice_serve_request_seconds",
             "Wall time from admission-queue entry to completion",
             buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
+            registry=self.registry,
+        )
+        self.draining = Gauge(
+            "tpuslice_serve_draining",
+            "1 while the server is draining (readyz 503, no admission)",
             registry=self.registry,
         )
 

@@ -505,7 +505,14 @@ class TpuLM:
         comes from XLA's sharding propagation).
         """
         cfg = self.cfg
-        ring = cfg.ring_attention and mesh is not None
+        from instaslice_tpu.parallel.compat import supports_partial_manual
+
+        # ring composes manual seq-collectives with GSPMD-auto
+        # data/model axes; where partial-manual shard_map is
+        # unavailable (jax 0.4.x) degrade to plain attention — GSPMD
+        # still shards it, only the O(S/n)-memory win is lost
+        ring = (cfg.ring_attention and mesh is not None
+                and supports_partial_manual())
         B, S = tokens.shape
         x = embed_lookup(params["embed"], tokens).astype(cfg.dtype)
         if ring:
@@ -527,7 +534,11 @@ class TpuLM:
                     g = q.shape[2] // k.shape[2]
                     k = jnp.repeat(k, g, axis=2)
                     v = jnp.repeat(v, g, axis=2)
-                return jax.shard_map(
+                from instaslice_tpu.parallel.compat import (
+                    shard_map,
+                )
+
+                return shard_map(
                     functools.partial(ring_attention, axis_name="seq"),
                     mesh=mesh,
                     in_specs=(P(None, "seq", None, None),) * 3,

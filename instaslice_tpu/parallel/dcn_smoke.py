@@ -47,7 +47,12 @@ def main() -> int:
     import jax
 
     if n_local:
-        jax.config.update("jax_num_cpu_devices", n_local)
+        try:
+            jax.config.update("jax_num_cpu_devices", n_local)
+        except AttributeError:
+            # jax < 0.5: the XLA_FLAGS device-count path set by the
+            # caller is the only knob
+            pass
 
     import numpy as np
     from jax.sharding import Mesh, PartitionSpec as P
@@ -78,8 +83,10 @@ def main() -> int:
     arr = jax.make_array_from_process_local_data(
         jax.NamedSharding(mesh, P("d")), contrib, (len(devs),)
     )
+    from instaslice_tpu.parallel.compat import shard_map
+
     total = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda v: jax.lax.psum(v, "d"),
             mesh=mesh, in_specs=P("d"), out_specs=P(),
         )

@@ -170,10 +170,13 @@ def pipeline_blocks(
                 _vary(t, (axis_name,), to="varying")
                 for t in (zero, acc0, aux0)
             )
-        else:  # pragma: no cover - older jax
+        elif hasattr(lax, "pvary"):  # pragma: no cover - older jax
             zero, acc0, aux0 = (
                 lax.pvary(t, (axis_name,)) for t in (zero, acc0, aux0)
             )
+        # jax 0.4.x has neither pcast nor pvary: no varying-type
+        # tracking exists, so the carries need no annotation (compat
+        # shard_map runs with check_rep=False there)
         (_, acc, aux_acc), _ = lax.scan(
             tick,
             (zero, acc0, aux0),
@@ -190,7 +193,9 @@ def pipeline_blocks(
         aux_total = lax.psum(aux_acc, axis_name)
         return acc, aux_total
 
-    out, aux_total = jax.shard_map(
+    from instaslice_tpu.parallel.compat import shard_map
+
+    out, aux_total = shard_map(
         stage,
         mesh=mesh,
         in_specs=(

@@ -56,8 +56,10 @@ def ring_attention(
         o0, m0, l0 = (
             _vary(t, axis_name, to="varying") for t in (o0, m0, l0)
         )
-    else:  # pragma: no cover - older jax
+    elif hasattr(lax, "pvary"):
         o0, m0, l0 = (lax.pvary(t, (axis_name,)) for t in (o0, m0, l0))
+    # jax 0.4.x has neither: no varying-type tracking exists there, so
+    # the accumulators need no annotation at all
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def step(carry, i):

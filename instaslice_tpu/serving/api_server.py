@@ -47,6 +47,7 @@ import json
 import logging
 import os
 import queue
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -55,6 +56,30 @@ from typing import Dict, List, Optional
 from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
 
 log = logging.getLogger("instaslice_tpu.serving.api")
+
+
+def _env_float(name: str, default: float) -> float:
+    """One definition of each env-tunable default, shared by the
+    library constructor and the CLI parser so they cannot drift."""
+    return float(os.environ.get(name, str(default)))
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity: the request was shed (HTTP 429 with
+    Retry-After) instead of joining a line it would only time out in."""
+
+    def __init__(self, retry_after: float = 1.0):
+        super().__init__("admission queue full")
+        self.retry_after = retry_after
+
+
+class Draining(Exception):
+    """The server is draining (SIGTERM / POST /v1/drain): no new
+    admissions; clients get a clean 503 and should hit another replica."""
 
 
 class _Pending:
@@ -76,6 +101,11 @@ class _Pending:
         self.rid_index: Dict[int, int] = {}    # engine rid → choice idx
         self.results: Dict[int, GenerationResult] = {}  # choice idx → r
         self.error: str = ""
+        # load-shedding/drain disposition ("" = normal): "drain" — was
+        # queued when the drain started; "evicted" — in flight past the
+        # drain budget. Either way the client gets a clean 503 and the
+        # metrics outcome is "drained", never "error"/"ok".
+        self.shed: str = ""
         self.timed_out = False        # set by the HTTP layer on 503,
         #                               or on a broken streaming socket
         # serializes the timeout decision against completion: the HTTP
@@ -113,8 +143,13 @@ class _Pending:
 class _Scheduler(threading.Thread):
     """Owns the engine: admission, block decode, budgets, delivery."""
 
+    #: Retry-After hint on a 429 shed: one block decode is the natural
+    #: re-try grain — by then the queue has moved
+    shed_retry_after = 1.0
+
     def __init__(self, engine: ServingEngine, block_size: int = 16,
-                 metrics=None):
+                 metrics=None, max_queue: int = 0,
+                 drain_budget: float = 30.0, fault_hook=None):
         super().__init__(name="serve-scheduler", daemon=True)
         self.engine = engine
         self.block_size = block_size
@@ -125,6 +160,25 @@ class _Scheduler(threading.Thread):
         # popped but unadmittable head-of-line request (needs more free
         # slots than currently available); retried next round, FIFO kept
         self._head: Optional[_Pending] = None
+        #: admission bound (0 = unbounded): past it, submit() sheds with
+        #: 429 instead of queueing a request that would 503 at timeout.
+        #: The lock makes bound-check + enqueue atomic across the HTTP
+        #: threads (one per request): without it, C concurrent
+        #: submitters could all pass the check and overshoot by C-1.
+        self.max_queue = max_queue
+        self._submit_lock = threading.Lock()
+        self.drain_budget = drain_budget
+        #: flipped by drain()/undrain(); while set, /readyz is 503, no
+        #: admissions, queued requests shed, in-flight finish until the
+        #: deadline then evict
+        self.draining = threading.Event()
+        self.drain_deadline = 0.0
+        #: set once a drain has fully quiesced (no queue, no in-flight)
+        self.drained = threading.Event()
+        #: faults.scheduler_fault_hook seam: consulted once per loop
+        #: round inside the round guard — an injected raise must never
+        #: kill the serving thread
+        self.fault_hook = fault_hook
         if metrics is None:
             from instaslice_tpu.metrics.metrics import ServingMetrics
 
@@ -132,14 +186,173 @@ class _Scheduler(threading.Thread):
         self.metrics = metrics
 
     def submit(self, pending: _Pending) -> None:
-        self.queue.put(pending)
+        """Admit into the scheduler queue, or shed: :class:`Draining`
+        while a drain is on (503), :class:`QueueFull` past the
+        admission bound (429 + Retry-After). Shed requests are counted
+        here — exactly one metrics outcome per request, always."""
+        # prefix-cache mutations are not completions: they never enter
+        # the outcome ledger (here or in _maybe_complete), so the
+        # requests_total counters reconcile against completion traffic
+        is_completion = not pending.prefix_op
+        if self.draining.is_set():
+            if is_completion:
+                self.metrics.requests.labels(outcome="drained").inc()
+            raise Draining("server draining")
+        with self._submit_lock:
+            if self.max_queue > 0 and (
+                self.queue.qsize() + (self._head is not None)
+                >= self.max_queue
+            ):
+                if is_completion:
+                    self.metrics.requests.labels(outcome="shed").inc()
+                raise QueueFull(self.shed_retry_after)
+            self.queue.put(pending)
+
+    # ------------------------------------------------------------ drain
+
+    def drain(self, budget: Optional[float] = None) -> None:
+        """Stop admission, flip readiness, let in-flight requests
+        finish for ``budget`` seconds (default ``drain_budget``), then
+        evict the rest with a clean 503. Idempotent; ``drained`` is set
+        once fully quiesced."""
+        self.drain_deadline = time.monotonic() + (
+            self.drain_budget if budget is None else budget
+        )
+        self.drained.clear()
+        self.draining.set()
+        self.metrics.draining.set(1)
+
+    def undrain(self) -> None:
+        """Resume admission after a drain (rolling-restart aborted,
+        readiness restored)."""
+        self.draining.clear()
+        self.drained.clear()
+        self.metrics.draining.set(0)
+
+    def _fail_shed(self, p: _Pending, shed: str, msg: str) -> None:
+        p.shed = shed
+        p.error = p.error or msg
+        if p.stream_q is not None:
+            p.stream_q.put(p.error)
+        self._maybe_complete(p)
+
+    def _shed_queued(self) -> None:
+        """Draining: everything still queued gets its terminal 503 NOW
+        — a queued request can only get worse by waiting out the drain."""
+        while True:
+            if self._head is not None:
+                p, self._head = self._head, None
+            else:
+                try:
+                    p = self.queue.get_nowait()
+                except queue.Empty:
+                    return
+            self._fail_shed(p, "drain",
+                            "server draining: request not admitted")
+
+    def _evict_for_drain(self) -> None:
+        """Drain budget exhausted: in-flight requests are evicted with
+        a clean 503 (their tokens were never delivered)."""
+        eng = self.engine
+        for slot, req in list(eng.slots.items()):
+            p = self._by_rid.pop(req.request_id, None)
+            self._budget.pop(req.request_id, None)
+            if p is None:
+                continue
+            eng.evict_slot(slot)
+            self._fail_shed(p, "evicted",
+                            "evicted: drain budget exceeded")
+
+    # ------------------------------------------------------------- loop
 
     def run(self) -> None:
-        eng = self.engine
         while not self.stop_flag.is_set():
-            # admit while there is room (FIFO; a head-of-line request
-            # needing more slots than free waits for the next round)
-            while True:
+            try:
+                self._round()
+            except Exception as e:  # noqa: BLE001 - keep serving
+                # one bad round (injected fault, transient device error
+                # outside the decode guard) must never kill the
+                # scheduler thread — recover poisoned state, carry on
+                log.exception("scheduler round failed: %s", e)
+                if self.engine.cache_poisoned():
+                    self._recover_engine(e)
+
+    def _round(self) -> None:
+        eng = self.engine
+        if self.fault_hook is not None:
+            self.fault_hook()   # may raise (injected); run() recovers
+        if self.draining.is_set():
+            # no admission; shed the queue, enforce the drain budget
+            self._shed_queued()
+            if time.monotonic() >= self.drain_deadline:
+                self._evict_for_drain()
+            if not self._by_rid:
+                self.drained.set()
+        else:
+            self._admit()
+        # evict abandoned requests: the HTTP layer already 503'd the
+        # client, so decoding the slot to its budget would burn
+        # batch capacity producing tokens nobody reads
+        for slot, req in list(eng.slots.items()):
+            p = self._by_rid.get(req.request_id)
+            if p is not None and p.timed_out:
+                eng.evict_slot(slot)
+                self._by_rid.pop(req.request_id, None)
+                self._budget.pop(req.request_id, None)
+                self._maybe_complete(p)
+        # budget enforcement BEFORE decoding (add_request already
+        # produced one token, so a max_tokens=1 arrival is done on
+        # admission — decoding first would waste a batch-wide step
+        # whose tokens get truncated away; same ordering rationale
+        # as ServingEngine.generate())
+        for slot, req in list(eng.slots.items()):
+            b = self._budget.get(req.request_id)
+            if b is not None and len(req.generated) >= b:
+                eng.finish_slot(slot, n_keep=b)
+        self._deliver()
+        if not eng.slots:
+            self.stop_flag.wait(0.005)
+            return
+        # block bounded by the smallest remaining budget among OUR
+        # requests and the cache headroom (same shape as generate())
+        owned = [
+            r for r in eng.slots.values()
+            if r.request_id in self._budget
+        ]
+        n = self.block_size
+        if owned:
+            # at-budget slots were just removed: remaining >= 1
+            n = min(n, min(
+                self._budget[r.request_id] - len(r.generated)
+                for r in owned
+            ))
+        worst = max(
+            len(r.prompt) + len(r.generated)
+            for r in eng.slots.values()
+        )
+        n = min(n, eng.max_len - 2 - worst)
+        try:
+            if eng.draft_model is not None:
+                eng.spec_step()
+            elif n >= 1:
+                eng.decode_block(n)
+            else:
+                eng.step()
+        except Exception as e:  # noqa: BLE001 - recover, keep serving
+            log.exception("decode failed: %s", e)
+            if eng.cache_poisoned():
+                # the failed call consumed its donated cache buffer:
+                # carrying on would raise "Array has been deleted"
+                # on every later decode — reset the device state,
+                # fail the in-flight requests, keep serving
+                self._recover_engine(e)
+        self._deliver()
+
+    def _admit(self) -> None:
+        eng = self.engine
+        # admit while there is room (FIFO; a head-of-line request
+        # needing more slots than free waits for the next round)
+        while True:
                 if self._head is not None:
                     p, self._head = self._head, None
                 else:
@@ -176,15 +389,25 @@ class _Scheduler(threading.Thread):
                 try:
                     rids = eng.add_request_n(p.prompt, p.n, stop=p.stop,
                                              adapter=p.adapter)
-                except Exception as e:  # bad prompt (too long, empty…)
+                except Exception as e:
                     p.error = f"{type(e).__name__}: {e}"
-                    self.metrics.requests.labels(outcome="rejected").inc()
+                    # ValueError/TypeError = the client's prompt was
+                    # bad (too long, empty, unknown adapter) → 400 +
+                    # outcome "rejected". ANYTHING else (device error,
+                    # injected fault, transient host failure) is the
+                    # server's problem → 500 + outcome "error" — a
+                    # transient engine failure must never be pinned on
+                    # the client
+                    client_mistake = isinstance(e, (ValueError, TypeError))
+                    p.server_fault = not client_mistake
+                    self.metrics.requests.labels(
+                        outcome="rejected" if client_mistake else "error"
+                    ).inc()
                     # admission prefills through DONATING jits: a
                     # device-side failure mid-prefill consumed the
                     # cache, and without recovery every later call
                     # would raise "Array has been deleted" forever
                     if eng.cache_poisoned():
-                        p.server_fault = True
                         self._recover_engine(e)
                     if p.stream_q is not None:
                         p.stream_q.put(p.error)
@@ -194,63 +417,6 @@ class _Scheduler(threading.Thread):
                     p.rid_index[rid] = i
                     self._by_rid[rid] = p
                     self._budget[rid] = p.max_tokens
-            # evict abandoned requests: the HTTP layer already 503'd the
-            # client, so decoding the slot to its budget would burn
-            # batch capacity producing tokens nobody reads
-            for slot, req in list(eng.slots.items()):
-                p = self._by_rid.get(req.request_id)
-                if p is not None and p.timed_out:
-                    eng.evict_slot(slot)
-                    self._by_rid.pop(req.request_id, None)
-                    self._budget.pop(req.request_id, None)
-                    self._maybe_complete(p)
-            # budget enforcement BEFORE decoding (add_request already
-            # produced one token, so a max_tokens=1 arrival is done on
-            # admission — decoding first would waste a batch-wide step
-            # whose tokens get truncated away; same ordering rationale
-            # as ServingEngine.generate())
-            for slot, req in list(eng.slots.items()):
-                b = self._budget.get(req.request_id)
-                if b is not None and len(req.generated) >= b:
-                    eng.finish_slot(slot, n_keep=b)
-            self._deliver()
-            if not eng.slots:
-                self.stop_flag.wait(0.005)
-                continue
-            # block bounded by the smallest remaining budget among OUR
-            # requests and the cache headroom (same shape as generate())
-            owned = [
-                r for r in eng.slots.values()
-                if r.request_id in self._budget
-            ]
-            n = self.block_size
-            if owned:
-                # at-budget slots were just removed: remaining >= 1
-                n = min(n, min(
-                    self._budget[r.request_id] - len(r.generated)
-                    for r in owned
-                ))
-            worst = max(
-                len(r.prompt) + len(r.generated)
-                for r in eng.slots.values()
-            )
-            n = min(n, eng.max_len - 2 - worst)
-            try:
-                if eng.draft_model is not None:
-                    eng.spec_step()
-                elif n >= 1:
-                    eng.decode_block(n)
-                else:
-                    eng.step()
-            except Exception as e:  # noqa: BLE001 - recover, keep serving
-                log.exception("decode failed: %s", e)
-                if eng.cache_poisoned():
-                    # the failed call consumed its donated cache buffer:
-                    # carrying on would raise "Array has been deleted"
-                    # on every later decode — reset the device state,
-                    # fail the in-flight requests, keep serving
-                    self._recover_engine(e)
-            self._deliver()
 
     def _recover_engine(self, e: Exception) -> None:
         """Reset poisoned device state and fail every in-flight request
@@ -277,12 +443,20 @@ class _Scheduler(threading.Thread):
             return
         if any(rid in self._by_rid for rid in p.rid_index):
             return
+        if p.prefix_op:
+            # prefix-cache mutations stay out of the completion ledger
+            # (their normal path completes inline in _admit, uncounted
+            # — counting only the shed ones would skew reconciliation)
+            with p.lock:
+                p.done.set()
+            return
         # a request the HTTP layer already 503'd must not read as a
         # success on the dashboard — the client never got the tokens.
         # Outcome read + done.set() are atomic under p.lock so the HTTP
         # thread's expiring wait cannot interleave (503 counted as ok).
         with p.lock:
             outcome = ("timeout" if p.timed_out
+                       else "drained" if p.shed
                        else "error" if p.error else "ok")
             self.metrics.requests.labels(outcome=outcome).inc()
             self.metrics.request_seconds.observe(time.monotonic() - p.t0)
@@ -362,6 +536,8 @@ class _Scheduler(threading.Thread):
         return {
             "live_slots": len(eng.slots),
             "free_slots": eng.free_slots(),
+            "draining": self.draining.is_set(),
+            "max_queue": self.max_queue,
             "queued": self.queue.qsize() + (self._head is not None),
             "tokens_generated": eng.tokens_generated,
             "max_batch": eng.max_batch,
@@ -381,17 +557,29 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
 
-    def _send(self, code: int, payload: dict) -> None:
+    def _send(self, code: int, payload: dict,
+              retry_after: Optional[float] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            # ceil to whole seconds: Retry-After is delta-seconds
+            self.send_header("Retry-After", str(max(1, int(retry_after))))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
         if self.path.startswith("/healthz"):
             self._send(200, {"status": "ok"})
+        elif self.path.startswith("/readyz"):
+            # readiness flips with the drain state: a draining replica
+            # must leave the Service endpoints BEFORE its requests stop
+            # (the kube rolling-restart contract)
+            if type(self).scheduler.draining.is_set():
+                self._send(503, {"status": "draining"})
+            else:
+                self._send(200, {"status": "ok"})
         elif self.path.startswith("/v1/stats"):
             self._send(200, type(self).scheduler.stats())
         elif self.path.rstrip("/").startswith("/v1/models"):
@@ -450,6 +638,22 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         if self.path.startswith("/v1/prefixes"):
             self._prefix_request("register")
+            return
+        if self.path.startswith("/v1/drain"):
+            try:
+                body = self._read_body()
+                budget = body.get("budget")
+                budget = None if budget is None else float(budget)
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+                return
+            sched = type(self).scheduler
+            sched.drain(budget)
+            self._send(200, {
+                "draining": True,
+                "budget": (sched.drain_budget if budget is None
+                           else budget),
+            })
             return
         if not self.path.startswith("/v1/completions"):
             self._send(404, {"error": f"no route {self.path}"})
@@ -519,7 +723,8 @@ class _Handler(BaseHTTPRequestHandler):
                            stop=stop,
                            want_logprobs=bool(req.get("logprobs", False)),
                            n=n, adapter=adapter)
-        type(self).scheduler.submit(pending)
+        if not self._submit_or_shed(pending):
+            return
         if pending.stream_q is not None:
             self._stream_response(pending)
             return
@@ -527,10 +732,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(503, {"error": "request timed out in queue"})
             return
         if pending.error:
+            # shed/drained requests get a clean 503 (retry elsewhere);
             # client mistakes are 400s; an engine-side failure that
             # killed the request is the server's fault
-            self._send(500 if pending.server_fault else 400,
-                       {"error": pending.error})
+            if pending.shed:
+                self._send(503, {"error": pending.error},
+                           retry_after=type(self).scheduler.drain_budget)
+            else:
+                self._send(500 if pending.server_fault else 400,
+                           {"error": pending.error})
             return
         choices = []
         for idx in sorted(pending.results):
@@ -554,6 +764,23 @@ class _Handler(BaseHTTPRequestHandler):
             },
         })
 
+
+    def _submit_or_shed(self, pending: _Pending) -> bool:
+        """Submit to the scheduler; on shed, send the terminal response
+        (429 queue-full with Retry-After / 503 draining) and return
+        False — the backpressure contract: a client NEVER waits on a
+        request the server already knows it cannot serve."""
+        try:
+            type(self).scheduler.submit(pending)
+            return True
+        except QueueFull as e:
+            self._send(429, {"error": "admission queue full; retry"},
+                       retry_after=e.retry_after)
+            return False
+        except Draining:
+            self._send(503, {"error": "server draining"},
+                       retry_after=type(self).scheduler.drain_budget)
+            return False
 
     def _await_or_timeout(self, pending: _Pending) -> bool:
         """Wait for completion; on expiry flag the timeout UNDER the
@@ -671,6 +898,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         if self.path.startswith("/v1/prefixes"):
             self._prefix_request("drop")
+        elif self.path.startswith("/v1/drain"):
+            type(self).scheduler.undrain()
+            self._send(200, {"draining": False})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
@@ -702,12 +932,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": str(e)})
             return
         pending = _Pending(tokens, 0, prefix_op=op)
-        type(self).scheduler.submit(pending)
+        if not self._submit_or_shed(pending):
+            return
         if not self._await_or_timeout(pending):
             self._send(503, {"error": "request timed out in queue"})
             return
         if pending.error:
-            code = 404 if "no such prefix" in pending.error else 400
+            code = (503 if pending.shed
+                    else 404 if "no such prefix" in pending.error
+                    else 400)
             self._send(code, {"error": pending.error})
             return
         key = "registered" if op == "register" else "dropped"
@@ -715,13 +948,40 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ApiServer:
-    """HTTP server + scheduler around an engine."""
+    """HTTP server + scheduler around an engine.
+
+    ``request_timeout`` defaults from ``TPUSLICE_REQUEST_TIMEOUT`` (then
+    300 s); ``max_queue`` from ``TPUSLICE_MAX_QUEUE`` (then 0 =
+    unbounded); ``drain_budget`` from ``TPUSLICE_DRAIN_BUDGET`` (then
+    30 s). ``fault_plan`` (a :class:`instaslice_tpu.faults.FaultPlan`)
+    wires the engine's dispatch hook and the scheduler's round hook —
+    the whole serving data plane runs under the one seeded plan."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
                  port: int = 0, block_size: int = 16, metrics=None,
-                 request_timeout: float = 300.0):
+                 request_timeout: Optional[float] = None,
+                 max_queue: Optional[int] = None,
+                 drain_budget: Optional[float] = None,
+                 fault_plan=None):
+        if request_timeout is None:
+            request_timeout = _env_float("TPUSLICE_REQUEST_TIMEOUT", 300)
+        if max_queue is None:
+            max_queue = _env_int("TPUSLICE_MAX_QUEUE", 0)
+        if drain_budget is None:
+            drain_budget = _env_float("TPUSLICE_DRAIN_BUDGET", 30)
+        sched_hook = None
+        if fault_plan is not None:
+            from instaslice_tpu.faults import (
+                engine_fault_hook,
+                scheduler_fault_hook,
+            )
+
+            engine.fault_hook = engine_fault_hook(fault_plan, engine)
+            sched_hook = scheduler_fault_hook(fault_plan)
         self.scheduler = _Scheduler(engine, block_size=block_size,
-                                    metrics=metrics)
+                                    metrics=metrics, max_queue=max_queue,
+                                    drain_budget=drain_budget,
+                                    fault_hook=sched_hook)
         handler = type("BoundHandler", (_Handler,),
                        {"scheduler": self.scheduler,
                         "request_timeout": request_timeout})
@@ -740,6 +1000,18 @@ class ApiServer:
         self._thread.start()
         return self
 
+    def drain(self, budget: Optional[float] = None) -> None:
+        """Graceful-degradation entry point (SIGTERM, POST /v1/drain):
+        readiness flips to 503, admission stops, in-flight requests get
+        ``budget`` seconds, the rest are evicted with a clean 503."""
+        self.scheduler.drain(budget)
+
+    def undrain(self) -> None:
+        self.scheduler.undrain()
+
+    def wait_drained(self, timeout: float) -> bool:
+        return self.scheduler.drained.wait(timeout)
+
     def stop(self) -> None:
         self.scheduler.stop_flag.set()
         self._srv.shutdown()
@@ -757,9 +1029,23 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="tpuslice-serve")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
-    ap.add_argument("--request-timeout", type=float, default=300.0,
+    ap.add_argument("--request-timeout", type=float,
+                    default=_env_float("TPUSLICE_REQUEST_TIMEOUT", 300),
                     help="seconds before a queued/decoding request 503s "
-                         "and its slot is evicted back to the batch")
+                         "and its slot is evicted back to the batch "
+                         "(env: TPUSLICE_REQUEST_TIMEOUT)")
+    ap.add_argument("--max-queue", type=int,
+                    default=_env_int("TPUSLICE_MAX_QUEUE", 0),
+                    help="admission queue bound: past it new requests "
+                         "are shed with 429 + Retry-After instead of "
+                         "queueing into a timeout (0 = unbounded; env: "
+                         "TPUSLICE_MAX_QUEUE)")
+    ap.add_argument("--drain-budget", type=float,
+                    default=_env_float("TPUSLICE_DRAIN_BUDGET", 30),
+                    help="seconds in-flight requests get to finish "
+                         "after SIGTERM / POST /v1/drain before "
+                         "eviction with a clean 503 (env: "
+                         "TPUSLICE_DRAIN_BUDGET)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="Prometheus /metrics port (0 = off)")
     ap.add_argument("--max-batch", type=int, default=8)
@@ -1001,8 +1287,13 @@ def main(argv=None) -> int:
                 engine, n_followers=topo.num_workers - 1,
                 port=args.oplog_port,
             )
+    from instaslice_tpu.faults import FaultPlan
+
     srv = ApiServer(engine, host=args.host, port=args.port,
-                    request_timeout=args.request_timeout).start()
+                    request_timeout=args.request_timeout,
+                    max_queue=args.max_queue,
+                    drain_budget=args.drain_budget,
+                    fault_plan=FaultPlan.from_env()).start()
     if args.metrics_port:
         from instaslice_tpu.metrics.metrics import start_metrics_server
 
@@ -1011,8 +1302,22 @@ def main(argv=None) -> int:
         )
     log.info("serving on %s (mesh=%s, quantized=%s)", srv.url,
              mesh and dict(mesh.shape), quantized)
+    # SIGTERM (the kubelet's pod-stop signal) starts a drain instead of
+    # killing in-flight decodes: readiness flips so the Service routes
+    # around this replica, in-flight requests finish inside the budget,
+    # stragglers get a clean 503, then the process exits — the
+    # terminationGracePeriodSeconds contract
+    term = threading.Event()
     try:
-        threading.Event().wait()
+        signal.signal(signal.SIGTERM, lambda *_: term.set())
+    except ValueError:  # not the main thread (embedded use)
+        pass
+    try:
+        term.wait()
+        log.info("SIGTERM: draining (budget %.1fs)", args.drain_budget)
+        srv.drain()
+        srv.wait_drained(args.drain_budget + 5.0)
+        srv.stop()
     except KeyboardInterrupt:
         srv.stop()
     finally:

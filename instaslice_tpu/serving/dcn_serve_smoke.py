@@ -46,7 +46,12 @@ def main() -> int:
     import jax
 
     if n_local:
-        jax.config.update("jax_num_cpu_devices", n_local)
+        try:
+            jax.config.update("jax_num_cpu_devices", n_local)
+        except AttributeError:
+            # jax < 0.5: the XLA_FLAGS device-count path set by the
+            # caller is the only knob
+            pass
 
     import numpy as np
     from jax.sharding import Mesh

@@ -261,6 +261,12 @@ class ServingEngine:
         self.max_prefixes = max_prefixes
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
+        #: fault-injection seam (instaslice_tpu.faults.engine_fault_hook):
+        #: called with the op name ("prefill"/"decode"/"spec") before
+        #: every device dispatch; the hook may delay, raise, or consume
+        #: the donated cache exactly like a real failed jitted call —
+        #: None (the default) costs one attribute read per dispatch
+        self.fault_hook = None
 
         self.draft_model = draft_model
         self.spec_k = spec_k
@@ -797,6 +803,8 @@ class ServingEngine:
         if key in self.prefixes:
             return
         self._validate_prefix(prefix)
+        if self.fault_hook is not None:
+            self.fault_hook("prefill")
         slot = self._first_free_slot("no free slots to prefill the prefix")
         self._prefill_chunks(slot, list(prefix))
         stripe = self._read_stripe(self.cache, slot, length=len(prefix))
@@ -897,6 +905,8 @@ class ServingEngine:
             )
         self._check_prompt_fits(prompt)
         self._check_capacity(n)
+        if self.fault_hook is not None:
+            self.fault_hook("prefill")
         slots = self._free_slot_indices()[:n]
         first = slots[0]
         if self.lora is not None:
@@ -977,6 +987,8 @@ class ServingEngine:
         token. Slots hitting eos/max_len move to ``finished``."""
         if not self.slots:
             return {}
+        if self.fault_hook is not None:
+            self.fault_hook("decode")
         if self.draft_model is not None:
             # keep the draft cache position-complete: it must consume
             # every token the target consumes or later spec_steps attend
@@ -1028,6 +1040,8 @@ class ServingEngine:
         instead of silently clamping writes."""
         if not self.slots:
             return {}
+        if self.fault_hook is not None:
+            self.fault_hook("decode")
         worst = max(
             len(r.prompt) + len(r.generated) for r in self.slots.values()
         )
@@ -1110,6 +1124,8 @@ class ServingEngine:
             )
         if not self.slots:
             return {}
+        if self.fault_hook is not None:
+            self.fault_hook("spec")
         worst = max(
             len(r.prompt) + len(r.generated) for r in self.slots.values()
         )

@@ -21,13 +21,14 @@ from __future__ import annotations
 import argparse
 import json
 import random
+import socket
 import statistics
 import sys
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import List
+from typing import List, Optional
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -38,9 +39,38 @@ def _percentile(xs: List[float], q: float) -> float:
     return ys[i]
 
 
+#: terminal-outcome classes a request can land in. The one that must
+#: stay ZERO for a healthy server is "hung": the client's own timeout
+#: expired, i.e. the server never produced a terminal response — the
+#: exact failure mode the drain/shed machinery exists to eliminate.
+OUTCOMES = ("ok", "shed-429", "timeout-503", "transport-error", "hung")
+
+
+def _classify(err: Optional[str], code: Optional[int]) -> str:
+    """Outcome class for one finished request. 429 = the server shed
+    load (backpressure working as designed); 503 = a terminal timeout/
+    drain response; a client-side timeout means the request HUNG —
+    no terminal response ever arrived. Other HTTP errors (a clean 500
+    from engine recovery, a 400) also land in "transport-error" — the
+    report's ``status_counts`` breakdown separates those terminal
+    server responses from genuine transport failures (code None)."""
+    if err is None:
+        return "ok"
+    if code == 429:
+        return "shed-429"
+    if code == 503:
+        return "timeout-503"
+    if code is None and (
+        "timed out" in err or "TimeoutError" in err
+    ):
+        return "hung"
+    return "transport-error"
+
+
 def _one_request(url: str, prompt: List[int], max_tokens: int,
                  stream: bool, timeout: float, adapter: str = ""):
-    """Returns (latency_s, ttft_s or None, tokens, error or None)."""
+    """Returns (latency_s, ttft_s or None, tokens, error or None,
+    http_code or None)."""
     body = {"prompt": prompt, "max_tokens": max_tokens}
     if adapter:
         body["adapter"] = adapter
@@ -59,7 +89,7 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
                 out = json.loads(r.read())
                 dt = time.monotonic() - t0
                 toks = sum(len(c["token_ids"]) for c in out["choices"])
-                return dt, None, toks, None
+                return dt, None, toks, None, r.status
             ttft = None
             toks = 0
             buf = b""
@@ -67,7 +97,7 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
                 chunk = r.read1(65536)
                 if not chunk:
                     return (time.monotonic() - t0, ttft, toks,
-                            "stream ended without [DONE]")
+                            "stream ended without [DONE]", r.status)
                 buf += chunk
                 while b"\n\n" in buf:
                     event, buf = buf.split(b"\n\n", 1)
@@ -76,11 +106,12 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
                         continue
                     data = line[len("data: "):]
                     if data == "[DONE]":
-                        return time.monotonic() - t0, ttft, toks, None
+                        return (time.monotonic() - t0, ttft, toks, None,
+                                r.status)
                     payload = json.loads(data)
                     if "error" in payload:
                         return (time.monotonic() - t0, ttft, toks,
-                                payload["error"])
+                                payload["error"], r.status)
                     got = payload["choices"][0]["token_ids"]
                     if got and ttft is None:
                         ttft = time.monotonic() - t0
@@ -93,13 +124,20 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
         except Exception:  # noqa: BLE001 - body unreadable/not ours
             detail = ""
         msg = f"HTTPError {e.code}: {detail or e.reason}"
-        return time.monotonic() - t0, None, 0, msg
+        return time.monotonic() - t0, None, 0, msg, e.code
+    except (socket.timeout, TimeoutError) as e:
+        # the client deadline expired with NO terminal response: the
+        # request is HUNG — the one outcome a robust server must never
+        # produce (classified separately so runs can assert on it)
+        return (time.monotonic() - t0, None, 0,
+                f"TimeoutError: {e or 'timed out'}", None)
     except Exception as e:  # noqa: BLE001 - a benchmark client must
         # ACCOUNT for every failure (IncompleteRead from a dropped
         # body, JSONDecodeError from a proxy's HTML error page, …);
         # an uncaught exception would kill the worker thread silently
         # and the run would report fewer requests with zero errors
-        return time.monotonic() - t0, None, 0, f"{type(e).__name__}: {e}"
+        return (time.monotonic() - t0, None, 0,
+                f"{type(e).__name__}: {e}", None)
 
 
 def run(url: str, requests: int, concurrency: int, prompt_len: int,
@@ -116,6 +154,8 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     lat: List[float] = []
     ttfts: List[float] = []
     errors: List[str] = []
+    outcomes = {k: 0 for k in OUTCOMES}
+    status_counts: dict = {}
     tokens = [0]
     lock = threading.Lock()
     it = iter(range(requests))
@@ -126,11 +166,14 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
                 i = next(it, None)
             if i is None:
                 return
-            dt, ttft, toks, err = _one_request(
+            dt, ttft, toks, err, code = _one_request(
                 url, prompts[i], max_tokens, stream, timeout,
                 adapter=adapters[i % len(adapters)] if adapters else "",
             )
             with lock:
+                outcomes[_classify(err, code)] += 1
+                key = str(code) if code is not None else "none"
+                status_counts[key] = status_counts.get(key, 0) + 1
                 if err is None:
                     lat.append(dt)
                     tokens[0] += toks
@@ -155,6 +198,8 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
         "concurrency": concurrency,
         "ok": len(lat),
         "errors": len(errors),
+        "outcomes": outcomes,
+        "status_counts": status_counts,
         "p95_latency": round(_percentile(lat, 0.95), 4),
         "mean_latency": round(statistics.mean(lat), 4) if lat else 0.0,
         "client_tokens_per_sec": round(tokens[0] / wall, 1),
@@ -218,6 +263,7 @@ def main(argv=None) -> int:
                     args.timeout, seed=args.seed, adapters=adapters)
             curve.append(r)
         errors = sum(r["errors"] for r in curve)
+        hung = sum(r["outcomes"]["hung"] for r in curve)
         # headline = the level with the best aggregate throughput; the
         # knee of the curve is visible in the per-level entries
         best = max(curve, key=lambda r: r["client_tokens_per_sec"])
@@ -228,14 +274,18 @@ def main(argv=None) -> int:
             "best_concurrency": best["concurrency"],
             "levels": curve,
             "errors": errors,
+            "hung": hung,
         }))
-        return 0 if not errors else 1
+        # exit 2 is reserved for the unforgivable outcome: a request
+        # that never got a terminal response (server robustness bug, as
+        # opposed to explicit shed/timeout errors, which are exit 1)
+        return 2 if hung else (1 if errors else 0)
     out = run(args.url, args.requests, args.concurrency,
               args.prompt_len, args.max_tokens, args.vocab,
               args.stream, args.timeout, seed=args.seed,
               adapters=adapters)
     print(json.dumps(out))
-    return 0 if not out["errors"] else 1
+    return 2 if out["outcomes"]["hung"] else (1 if out["errors"] else 0)
 
 
 if __name__ == "__main__":

@@ -48,6 +48,7 @@ class SimCluster:
         device_plugins: bool = False,
         transport: str = "inproc",
         backend: str = "fake",
+        fault_plan=None,
     ) -> None:
         """``transport="inproc"`` wires every component straight to the
         in-process FakeKube. ``transport="http"`` puts the store behind
@@ -69,7 +70,23 @@ class SimCluster:
         servers ride in ``self.mock_servers[node]`` for failure
         injection (``fail_next_create`` → FAILED queued resource →
         allocation ``failed`` → controller retry, the
-        ``instaslice_daemonset.go:95-231`` error contract)."""
+        ``instaslice_daemonset.go:95-231`` error contract).
+
+        ``fault_plan`` (a :class:`instaslice_tpu.faults.FaultPlan`, or
+        by default whatever ``TPUSLICE_FAULT_PLAN`` describes) wraps
+        every component's kube client in a
+        :class:`~instaslice_tpu.faults.FaultyKubeClient` and every node
+        backend in a :class:`~instaslice_tpu.faults.FaultyBackend`, so
+        any sim-driven tier runs under seeded fault injection with no
+        code changes. The submit/observe client (``self.kube``) stays
+        clean — tests assert through it."""
+        from instaslice_tpu.faults import (
+            FaultPlan,
+            FaultyBackend,
+            FaultyKubeClient,
+        )
+
+        self.fault_plan = fault_plan or FaultPlan.from_env()
         self.backing = FakeKube()
         self.server = None
         if transport == "http":
@@ -78,13 +95,25 @@ class SimCluster:
 
             self.server = FakeApiServer(self.backing).start()
             url = self.server.url
-            self._client_for = lambda: RealKubeClient(url)
-            self.kube: "FakeKube" = self._client_for()  # type: ignore
+            self._component_client = lambda: RealKubeClient(url)
+            self.kube: "FakeKube" = self._component_client()  # type: ignore
         elif transport == "inproc":
-            self._client_for = lambda: self.backing
+            self._component_client = lambda: self.backing
             self.kube = self.backing
         else:
             raise ValueError(f"unknown transport {transport!r}")
+        if self.fault_plan is not None:
+            # components get the faulty view; the observer stays clean
+            base = self._component_client
+            self._client_for = lambda: FaultyKubeClient(
+                base(), self.fault_plan
+            )
+            self._wrap_backend = lambda b: FaultyBackend(
+                b, self.fault_plan
+            )
+        else:
+            self._client_for = self._component_client
+            self._wrap_backend = lambda b: b
         self.namespace = namespace
         self.generation = generation
         gen = get_generation(generation)
@@ -129,9 +158,12 @@ class SimCluster:
                     host_offset=host_offset,
                     torus_group=group,
                 )
+            # observers (tests, invariant checks) read the clean
+            # backend; the agent drives through the faulty wrapper
             self.backends[node] = node_backend
             self.agents[node] = NodeAgent(
-                self._client_for(), node_backend, node, namespace,
+                self._client_for(), self._wrap_backend(node_backend),
+                node, namespace,
                 metrics=metrics, health_interval=health_interval,
             )
         self.controller = Controller(

@@ -9,7 +9,13 @@ from typing import Callable, Optional
 
 
 class ProbeServer:
-    """Serves ``/healthz`` (process alive) and ``/readyz`` (callback)."""
+    """Serves ``/healthz`` (process alive) and ``/readyz`` (callback).
+
+    :meth:`set_draining` forces ``/readyz`` to 503 regardless of the
+    callback — the graceful-shutdown hook: a component that got SIGTERM
+    flips readiness FIRST so the Service stops routing to it, finishes
+    in-flight work, then exits (liveness stays green throughout; a
+    draining process is degrading gracefully, not dead)."""
 
     def __init__(
         self,
@@ -18,6 +24,7 @@ class ProbeServer:
     ) -> None:
         host, _, port = bind_address.rpartition(":")
         self._ready = ready_check or (lambda: True)
+        self._draining = False
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -28,11 +35,13 @@ class ProbeServer:
                 if self.path.startswith("/healthz"):
                     ok = True
                 elif self.path.startswith("/readyz"):
-                    ok = outer._ready()
+                    ok = not outer._draining and outer._ready()
                 else:
                     self.send_error(404)
                     return
-                body = b"ok" if ok else b"not ready"
+                body = (b"ok" if ok
+                        else b"draining" if outer._draining
+                        else b"not ready")
                 self.send_response(200 if ok else 503)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -48,6 +57,10 @@ class ProbeServer:
     @property
     def port(self) -> int:
         return self._srv.server_address[1]
+
+    def set_draining(self, draining: bool = True) -> None:
+        """Force ``/readyz`` to 503 (back to the callback with False)."""
+        self._draining = draining
 
     def start(self) -> "ProbeServer":
         self._thread.start()

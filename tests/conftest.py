@@ -23,7 +23,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the XLA_FLAGS env var
+    # set above (before any jax import) is the only path there
+    pass
 
 
 # ------------------------------------------------------------ fast/slow
@@ -54,6 +59,7 @@ SLOW_MODULES = {
     "test_int4",          # packed int4 quantization + engine compiles
     "test_decode_equivalence",  # decode-vs-oracle cross-product compiles
     "test_flash_decode",  # fused decode-attention kernel (interpret)
+    "test_serving_chaos",  # fault-injected serving + drain under load
 }
 
 
@@ -63,6 +69,36 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if item.module.__name__ in SLOW_MODULES:
             item.add_marker(pytest.mark.slow)
+
+
+# ------------------------------------------------------------ watchdog
+# The chaos/serving tiers run under `timeout -k`: a hung test used to
+# die SILENTLY when the outer kill landed (no stacks, no culprit). Arm
+# faulthandler for the whole session so a session still alive at the
+# deadline dumps every thread's stack to stderr — the outer `timeout
+# -k` stays the killer (exit=False: a healthy-but-long run, e.g. `make
+# test-all` at ~19 min, must never be shot by its own diagnostics;
+# repeat=True keeps dumping so the LAST stacks before the outer kill
+# show the actual hang). PYTEST_FAULTHANDLER_SESSION_TIMEOUT tunes the
+# deadline (0 disables); the Makefile chaos target sets it just below
+# its own `timeout -k` budget.
+
+
+def pytest_configure(config):
+    import faulthandler
+
+    timeout = float(os.environ.get(
+        "PYTEST_FAULTHANDLER_SESSION_TIMEOUT", "840"
+    ))
+    if timeout > 0:
+        faulthandler.dump_traceback_later(timeout, repeat=True,
+                                          exit=False)
+
+
+def pytest_unconfigure(config):
+    import faulthandler
+
+    faulthandler.cancel_dump_traceback_later()
 
 
 # --------------------------------------------------------------- helpers

@@ -768,7 +768,9 @@ class TestGrantToServe:
         code = (
             "import jax;"
             "jax.config.update('jax_platforms','cpu');"
-            "jax.config.update('jax_num_cpu_devices',8);"
+            # jax < 0.5 has no jax_num_cpu_devices; XLA_FLAGS covers it
+            "\ntry: jax.config.update('jax_num_cpu_devices',8)\n"
+            "except AttributeError: pass\n"
             "from instaslice_tpu.serving.api_server import main;"
             f"main(['--host','127.0.0.1','--port','{port}',"
             "'--d-model','32','--n-heads','4','--n-layers','2',"

@@ -10,6 +10,7 @@ no leaked reservations, every surviving pod either Running or Pending,
 and a clean sweep after deleting everything.
 """
 
+import os
 import random
 import time
 
@@ -19,8 +20,18 @@ from instaslice_tpu.controller.gates import RESTART_ON_FAILURE_ANNOTATION
 from instaslice_tpu.sim import SimCluster
 
 PROFILES = ["v5e-1x1", "v5e-2x1", "v5e-2x2"]
-SEED = 1234
-DURATION_S = 8.0
+# Parametrized via env so `make chaos` sweeps seeds and a red run is
+# reproducible with CHAOS_SEED=<printed seed>.
+SEED = int(os.environ.get("CHAOS_SEED", "1234"))
+DURATION_S = float(os.environ.get("CHAOS_DURATION", "8.0"))
+
+
+@pytest.fixture(autouse=True)
+def _print_chaos_params():
+    # pytest surfaces captured stdout only for FAILING tests, so this
+    # line is exactly the repro recipe a red chaos run needs
+    print(f"chaos params: CHAOS_SEED={SEED} CHAOS_DURATION={DURATION_S}")
+    yield
 
 
 def _no_double_grant(cluster):

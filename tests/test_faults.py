@@ -1,0 +1,129 @@
+"""FaultPlan unit tier: determinism, schedules, env parsing, and the
+kube/device wrappers — the machinery the chaos tiers trust."""
+
+import pytest
+
+from instaslice_tpu.device import FakeTpuBackend
+from instaslice_tpu.device.backend import DeviceError
+from instaslice_tpu.faults import (
+    FaultPlan,
+    FaultyBackend,
+    FaultyKubeClient,
+    InjectedApiError,
+)
+from instaslice_tpu.kube import FakeKube
+
+
+def pod(name, ns="default"):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {}, "status": {},
+    }
+
+
+class TestFaultPlan:
+    def test_deterministic_given_seed(self):
+        def sequence(seed):
+            plan = FaultPlan(seed).site("s", probability=0.3)
+            return [plan.fire("s") for _ in range(50)]
+
+        assert sequence(7) == sequence(7)
+        assert sequence(7) != sequence(8)
+
+    def test_at_calls_schedule_is_exact(self):
+        plan = FaultPlan(0).site("s", at_calls={2, 4})
+        fired = [plan.fire("s") is not None for _ in range(5)]
+        assert fired == [False, True, False, True, False]
+
+    def test_max_fires_caps(self):
+        plan = FaultPlan(0).site("s", probability=1.0, max_fires=2)
+        fired = [plan.fire("s") is not None for _ in range(5)]
+        assert fired == [True, True, False, False, False]
+
+    def test_unregistered_site_never_fires(self):
+        plan = FaultPlan(0)
+        assert all(plan.fire("nope") is None for _ in range(10))
+        assert plan.stats()["nope"]["calls"] == 10
+
+    def test_from_env_grammar(self):
+        plan = FaultPlan.from_env(
+            "seed=42;kube.request:p=0.5,kinds=http-503|conn-reset;"
+            "engine.decode:at=1|3,kinds=poison;device.reserve:p=0.1,max=2"
+        )
+        assert plan.seed == 42
+        assert plan.sites["kube.request"].probability == 0.5
+        assert plan.sites["kube.request"].kinds == (
+            "http-503", "conn-reset",
+        )
+        assert plan.sites["engine.decode"].at_calls == frozenset({1, 3})
+        assert plan.sites["device.reserve"].max_fires == 2
+
+    def test_from_env_empty_is_none(self):
+        assert FaultPlan.from_env("") is None
+        assert FaultPlan.from_env("   ") is None
+
+    def test_from_env_rejects_unknown_key(self):
+        with pytest.raises(ValueError):
+            FaultPlan.from_env("s:bogus=1")
+
+
+class TestFaultyKubeClient:
+    def test_injects_api_errors(self):
+        plan = FaultPlan(0).site(
+            "kube.request", at_calls={1}, kinds=("http-503",),
+        )
+        c = FaultyKubeClient(FakeKube(), plan)
+        with pytest.raises(InjectedApiError) as ei:
+            c.create("Pod", pod("a"))
+        assert ei.value.code == 503
+        # next call goes through; the store never saw the failed one
+        c.create("Pod", pod("a"))
+        assert c.get("Pod", "default", "a")["metadata"]["name"] == "a"
+
+    def test_injects_connection_reset(self):
+        plan = FaultPlan(0).site(
+            "kube.request", at_calls={1}, kinds=("conn-reset",),
+        )
+        c = FaultyKubeClient(FakeKube(), plan)
+        with pytest.raises(ConnectionResetError):
+            c.list("Pod")
+
+    def test_watch_disconnect_truncates_stream(self):
+        store = FakeKube()
+        for i in range(6):
+            store.create("Pod", pod(f"p{i}"))
+        plan = FaultPlan(0).site(
+            "kube.watch", at_calls={3}, kinds=("disconnect",),
+        )
+        c = FaultyKubeClient(store, plan)
+        events = list(c.watch("Pod", timeout=0.05))
+        # the replay burst alone is 6 ADDED + a BOOKMARK: the injected
+        # disconnect cut it at 2 delivered events
+        assert len(events) == 2
+
+
+class TestFaultyBackend:
+    def test_injects_device_errors_and_passthrough(self):
+        plan = FaultPlan(0).site(
+            "device.reserve", at_calls={1}, kinds=("error",),
+        )
+        b = FaultyBackend(FakeTpuBackend(), plan)
+        with pytest.raises(DeviceError):
+            b.reserve("s1", [0, 1])
+        r = b.reserve("s1", [0, 1])          # second attempt lands
+        assert r.chip_ids == (0, 1)
+        assert [x.slice_uuid for x in b.list_reservations()] == ["s1"]
+        b.release("s1")
+        # test helpers pass through the wrapper
+        b.inject_failures("reserve", 1)
+        with pytest.raises(DeviceError):
+            b.reserve("s2", [2])
+
+    def test_chip_fail_kind_marks_chip_unhealthy(self):
+        plan = FaultPlan(3).site(
+            "device.health", at_calls={1}, kinds=("chip-fail",),
+        )
+        b = FaultyBackend(FakeTpuBackend(), plan)
+        health = b.chip_health()
+        assert not all(health.values())       # one chip went down

@@ -1,0 +1,361 @@
+"""Serving-plane chaos tier: loadgen traffic through a live ApiServer
+while a seeded FaultPlan injects chip failures (cache poison), dispatch
+errors/delays, scheduler-round faults, and kube flakes into a
+concurrently-churning control plane — plus a mid-run drain/undrain.
+
+The contracts under test are the robustness story end to end:
+
+- every HTTP request reaches a TERMINAL response (200/4xx/5xx) — zero
+  hung requests (the loadgen "hung" outcome class stays 0);
+- the metrics ledger reconciles: each request lands in EXACTLY one
+  outcome counter, so the sum equals the requests sent;
+- the engine recovers: once faults stop, the same server serves 200s;
+- the fault-wrapped control plane converges (no wedged pods, no chip
+  double-grants) despite injected API failures.
+
+Seeded via CHAOS_SEED (printed on failure) like tests/test_chaos.py.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.faults import FaultPlan
+from instaslice_tpu.metrics.metrics import ServingMetrics
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.serving import ServingEngine
+from instaslice_tpu.serving import loadgen
+from instaslice_tpu.serving.api_server import ApiServer
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "1234"))
+
+VOCAB = 64
+OUTCOME_LABELS = ("ok", "error", "timeout", "rejected", "shed", "drained")
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+def post(url, payload, path="/v1/completions", method="POST", timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method=method,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def get(url, path, timeout=10):
+    try:
+        with urllib.request.urlopen(url + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def metrics_outcome_counts(metrics: ServingMetrics) -> dict:
+    out = {}
+    for label in OUTCOME_LABELS:
+        v = metrics.registry.get_sample_value(
+            "tpuslice_serve_requests_total", {"outcome": label}
+        )
+        if v:
+            out[label] = int(v)
+    return out
+
+
+class TestServingChaos:
+    def test_faults_everywhere_plus_midrun_drain(self, model):
+        print(f"chaos params: CHAOS_SEED={CHAOS_SEED}")
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        # every serving site misbehaves, on a bounded budget (max_fires)
+        # so the post-chaos recovery check is deterministic; the
+        # at_calls entries guarantee the poison/recovery path runs at
+        # EVERY seed (probability alone could whiff on a short run)
+        plan = (
+            FaultPlan(CHAOS_SEED)
+            .site("engine.decode", probability=0.05,
+                  kinds=("poison", "error", "delay"), max_fires=12,
+                  at_calls={3, 9}, delay_s=0.02)
+            .site("engine.prefill", probability=0.04,
+                  kinds=("poison", "error"), max_fires=8)
+            .site("scheduler.round", probability=0.005,
+                  kinds=("error", "delay"), max_fires=10, delay_s=0.02)
+        )
+        # ... and so does the control plane's kube path, concurrently.
+        # The plan starts with no sites and is ARMED after the cluster
+        # is up: faults during __init__ hit the main thread (a real
+        # process would crash-loop and restart), while faults against a
+        # RUNNING cluster hit the reconcile loops — the case under test.
+        cp_plan = FaultPlan(CHAOS_SEED + 1)
+        from instaslice_tpu.sim import SimCluster
+
+        metrics = ServingMetrics()
+        sim = SimCluster(n_nodes=1, generation="v5e",
+                         deletion_grace_seconds=0.1,
+                         health_interval=0.1,
+                         fault_plan=cp_plan).start()
+        cp_plan.site("kube.request", probability=0.04,
+                     kinds=("http-503", "conn-reset", "http-429"),
+                     max_fires=60)
+        cp_plan.site("kube.watch", probability=0.01,
+                     kinds=("disconnect",), max_fires=20)
+        cp_plan.site("device.reserve", probability=0.05,
+                     kinds=("error",), max_fires=10)
+        churn_stop = threading.Event()
+        churned = []
+
+        def churn():
+            i = 0
+            while not churn_stop.is_set():
+                name = f"chaos-{i}"
+                try:
+                    sim.submit(name, "v5e-1x1")
+                    churned.append(name)
+                except Exception:
+                    pass  # injected kube flake on the submit path
+                if len(churned) >= 3 and i % 2:
+                    victim = churned.pop(0)
+                    try:
+                        sim.delete_pod(victim)
+                    except Exception:
+                        pass
+                i += 1
+                churn_stop.wait(0.4)
+
+        N_REQUESTS = 60
+        try:
+            with ApiServer(eng, block_size=4, metrics=metrics,
+                           request_timeout=20, max_queue=10,
+                           drain_budget=5.0, fault_plan=plan) as srv:
+                # warm the compiled prefill/decode programs BEFORE the
+                # clock starts: on a cold engine the first decode is a
+                # multi-second jit compile, and a drain landing inside
+                # it would evict the only admitted requests — testing
+                # compile latency, not fault robustness. The warm-up
+                # rides before the metrics snapshot below.
+                for _ in range(3):  # a fault may fire mid-warm-up
+                    code, out, _ = post(srv.url, {"prompt": [1, 2, 3],
+                                                  "max_tokens": 2})
+                    if code == 200:
+                        break
+                assert code == 200, out
+                warm = metrics_outcome_counts(metrics)
+
+                churner = threading.Thread(target=churn, daemon=True)
+                churner.start()
+
+                def mid_run_drain():
+                    time.sleep(1.5)
+                    code, body, _ = post(srv.url, {"budget": 0.5},
+                                         path="/v1/drain")
+                    assert code == 200 and body["draining"], body
+                    code, _ = get(srv.url, "/readyz")
+                    assert code == 503
+                    time.sleep(1.5)
+                    code, body, _ = post(srv.url, {}, path="/v1/drain",
+                                         method="DELETE")
+                    assert code == 200 and not body["draining"], body
+                    code, _ = get(srv.url, "/readyz")
+                    assert code == 200
+
+                drainer = threading.Thread(target=mid_run_drain,
+                                           daemon=True)
+                drainer.start()
+                report = loadgen.run(
+                    srv.url, requests=N_REQUESTS, concurrency=8,
+                    prompt_len=8, max_tokens=8, vocab=VOCAB,
+                    stream=False, timeout=60, seed=CHAOS_SEED,
+                )
+                drainer.join(timeout=30)
+                assert not drainer.is_alive(), "drain thread stuck"
+                churn_stop.set()
+                churner.join(timeout=10)
+
+                print("loadgen:", json.dumps(report))
+                print("faults:", json.dumps(plan.stats()))
+                print("cp faults:", json.dumps(cp_plan.stats()))
+
+                # 1. every request reached a terminal response
+                assert report["outcomes"]["hung"] == 0, report
+                assert sum(report["outcomes"].values()) == N_REQUESTS
+
+                # 2. the metrics ledger reconciles: one outcome per
+                # request, none double-counted, none lost (diffed
+                # against the pre-run snapshot so the warm-up request
+                # doesn't skew the ledger)
+                counted = metrics_outcome_counts(metrics)
+                print("metrics:", json.dumps(counted))
+                delta = (sum(counted.values())
+                         - sum(warm.values()))
+                assert delta == N_REQUESTS, (warm, counted)
+
+                # 3. faults actually fired (the tier tested something)
+                assert sum(
+                    s["fired"] for s in plan.stats().values()
+                ) > 0, plan.stats()
+
+                # 4. recovery: faults off, the SAME server serves 200s
+                eng.fault_hook = None
+                srv.scheduler.fault_hook = None
+                for _ in range(3):
+                    code, out, _ = post(srv.url, {
+                        "prompt": [5, 9, 2, 7], "max_tokens": 4,
+                    })
+                    assert code == 200, out
+                    assert len(out["choices"][0]["token_ids"]) == 4
+
+                # 5. the fault-injected control plane didn't wedge:
+                # chips never double-granted, pods settle
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    claimed = [
+                        c for r in sim.backends["node-0"]
+                        .list_reservations() for c in r.chip_ids
+                    ]
+                    assert len(claimed) == len(set(claimed)), claimed
+                    phases = {p: sim.pod_phase(p) for p in churned}
+                    if all(ph in ("Running", "Pending", "Gone")
+                           for ph in phases.values()):
+                        break
+                    time.sleep(0.2)
+                bad = {p: ph for p, ph in phases.items()
+                       if ph not in ("Running", "Pending", "Gone")}
+                assert not bad, f"pods wedged under kube faults: {bad}"
+        finally:
+            churn_stop.set()
+            sim.stop()
+
+    def test_drain_lifecycle_deterministic(self, model):
+        """No faults: SIGTERM-equivalent drain semantics alone.
+        readyz flips, in-flight finishes inside the budget, a queued
+        request sheds 503, past-budget slots evict with 503, undrain
+        restores service."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4, request_timeout=30) as srv:
+            code, _ = get(srv.url, "/readyz")
+            assert code == 200
+
+            # occupy the slot with a long request
+            results = {}
+
+            def long_request():
+                results["long"] = post(srv.url, {
+                    "prompt": [1, 2, 3], "max_tokens": 48,
+                })
+
+            t = threading.Thread(target=long_request, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not eng.slots:
+                time.sleep(0.01)
+            assert eng.slots, "long request never admitted"
+
+            # drain with a zero budget: the in-flight slot is evicted
+            # with a clean 503, new admissions 503 immediately
+            code, body, headers = post(srv.url, {"budget": 0.0},
+                                       path="/v1/drain")
+            assert code == 200, body
+            code, _ = get(srv.url, "/readyz")
+            assert code == 503
+            code, out, headers = post(srv.url, {
+                "prompt": [4, 5], "max_tokens": 4,
+            })
+            assert code == 503, out
+            assert "Retry-After" in headers
+            t.join(timeout=20)
+            assert not t.is_alive(), "evicted request hung"
+            code, out, _ = results["long"]
+            assert code == 503, out
+            assert srv.scheduler.drained.wait(10), "drain never quiesced"
+
+            # undrain: service restored, same engine
+            code, body, _ = post(srv.url, {}, path="/v1/drain",
+                                 method="DELETE")
+            assert code == 200 and not body["draining"]
+            code, _ = get(srv.url, "/readyz")
+            assert code == 200
+            code, out, _ = post(srv.url, {
+                "prompt": [5, 9, 2, 7], "max_tokens": 4,
+            })
+            assert code == 200, out
+
+    def test_bounded_queue_sheds_with_429(self, model):
+        """Past the admission bound, requests get an immediate 429 +
+        Retry-After instead of queueing into a timeout."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4, request_timeout=30,
+                       max_queue=1) as srv:
+            results = []
+            lock = threading.Lock()
+
+            def fire(max_tokens):
+                r = post(srv.url, {"prompt": [1, 2, 3],
+                                   "max_tokens": max_tokens})
+                with lock:
+                    results.append(r)
+
+            # one decoding (occupies the slot), one parked head-of-line
+            t1 = threading.Thread(target=fire, args=(48,), daemon=True)
+            t1.start()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not eng.slots:
+                time.sleep(0.01)
+            t2 = threading.Thread(target=fire, args=(4,), daemon=True)
+            t2.start()
+            deadline = time.monotonic() + 10
+            while (time.monotonic() < deadline
+                   and srv.scheduler.queue.qsize() == 0
+                   and srv.scheduler._head is None):
+                time.sleep(0.01)
+            # the bound is hit: this one must shed NOW
+            code, out, headers = post(srv.url, {"prompt": [7, 8],
+                                                "max_tokens": 4})
+            assert code == 429, out
+            assert "Retry-After" in headers
+            t1.join(timeout=30)
+            t2.join(timeout=30)
+            codes = sorted(r[0] for r in results)
+            assert codes == [200, 200], results
+
+    def test_scheduler_survives_injected_round_faults(self, model):
+        """Errors raised INSIDE the scheduler loop (not decode) never
+        kill the serving thread."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        plan = FaultPlan(CHAOS_SEED).site(
+            "scheduler.round", at_calls={1, 2, 3, 5, 8},
+            kinds=("error",),
+        )
+        with ApiServer(eng, block_size=4, request_timeout=30,
+                       fault_plan=plan) as srv:
+            for _ in range(3):
+                code, out, _ = post(srv.url, {
+                    "prompt": [5, 9, 2, 7], "max_tokens": 4,
+                })
+                assert code == 200, out
+            assert srv.scheduler.is_alive()
+            assert plan.stats()["scheduler.round"]["fired"] >= 3
