@@ -36,8 +36,16 @@ native-test: native
 # ------------------------------------------------------------------ tests
 
 .PHONY: test
-test:  ## Fast tier (~2 min): control plane, device, kube, topology
+test:  ## Fast tier (~2 min): control plane, device, kube, topology — then the trace-check observability gate
 	$(PY) -m pytest tests/ -x -q -m "not slow"
+	$(MAKE) trace-check
+
+.PHONY: trace-check
+trace-check:  ## Observability gate: drive the sim + a short loadgen with TPUSLICE_TRACE_FILE set, then validate the JSONL (unparseable lines, negative durations, orphan spans, broken trace propagation)
+	@f=$$(mktemp -u /tmp/tpuslice-trace-check.XXXXXX.jsonl); \
+	  echo "trace-check: $$f"; \
+	  JAX_PLATFORMS=cpu $(PY) tools/validate_trace.py --drive $$f \
+	    && rm -f $$f
 
 .PHONY: test-all
 test-all:  ## Everything, incl. jax-workload + multi-process tiers (~19 min)

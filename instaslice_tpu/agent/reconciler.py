@@ -35,6 +35,7 @@ from instaslice_tpu.device.backend import (
     DeviceError,
     SliceExists,
     SliceNotFound,
+    TracedBackend,
 )
 from instaslice_tpu.kube.client import (
     AlreadyExists,
@@ -70,12 +71,17 @@ class NodeAgent:
         health_interval: float = 10.0,
     ) -> None:
         self.client = client
-        self.backend = backend
+        # every device op this agent issues becomes a ``device.<op>``
+        # span, joining whatever trace the agent has bound (the
+        # allocation's trace id during realize/teardown)
+        self.backend = (
+            backend if isinstance(backend, TracedBackend)
+            else TracedBackend(backend)
+        )
         self.node_name = node_name
         self.namespace = namespace
         self.metrics = metrics
         self.health_interval = health_interval
-        self.tracer = get_tracer()
         self.manager = Manager(
             name=f"agent-{node_name}",
             client=client,
@@ -98,6 +104,13 @@ class NodeAgent:
         return discover_node(
             self.client, self.backend, self.node_name, self.namespace
         )
+
+    @property
+    def tracer(self):
+        # resolved per use, never cached at construction: after
+        # reset_tracer() the agent's grant spans must land in the NEW
+        # default tracer, not an orphaned closed ring
+        return get_tracer()
 
     def start(self) -> None:
         self.boot()
@@ -141,15 +154,21 @@ class NodeAgent:
         return alloc.local_chip_ids(self.node_name, gen.host_bounds)
 
     def _realize(self, ts: TpuSlice, alloc: AllocationDetails) -> None:
+        with self.tracer.span(
+            "agent.realize", trace_id=alloc.trace_id or None,
+            node=self.node_name, alloc=alloc.alloc_id,
+        ):
+            self._realize_inner(ts, alloc)
+
+    def _realize_inner(self, ts: TpuSlice, alloc: AllocationDetails) -> None:
         suid = slice_uuid_for(alloc.alloc_id, multihost=len(alloc.parts) > 1)
         chip_ids = self._chip_ids_for(ts, alloc)
         t0 = time.monotonic()
         try:
-            with self.tracer.span(
-                "device.reserve", node=self.node_name, slice=suid,
-                chips=len(chip_ids),
-            ):
-                self.backend.reserve(suid, chip_ids)
+            # the backend is span-instrumented (TracedBackend): this
+            # reserve shows up as a device.reserve child span of
+            # agent.realize, in the allocation's trace
+            self.backend.reserve(suid, chip_ids)
         except SliceExists:
             log.info("%s: reservation %s already live (idempotent)",
                      self.node_name, suid)
@@ -243,16 +262,20 @@ class NodeAgent:
     # ------------------------------------------------------------ teardown
 
     def _teardown(self, ts: TpuSlice, alloc: AllocationDetails) -> None:
+        with self.tracer.span(
+            "agent.teardown", trace_id=alloc.trace_id or None,
+            node=self.node_name, alloc=alloc.alloc_id,
+        ):
+            self._teardown_inner(ts, alloc)
+
+    def _teardown_inner(self, ts: TpuSlice, alloc: AllocationDetails) -> None:
         suid = slice_uuid_for(alloc.alloc_id, multihost=len(alloc.parts) > 1)
         # Always attempt release, even when this node never made it into
         # realized_on: a reserve that succeeded right as the allocation
         # was deleted (raced mut returning None) would otherwise leak the
         # device reservation forever.
         try:
-            with self.tracer.span(
-                "device.release", node=self.node_name, slice=suid
-            ):
-                self.backend.release(suid)
+            self.backend.release(suid)
         except SliceNotFound:
             pass
         except DeviceError as e:

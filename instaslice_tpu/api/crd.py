@@ -49,6 +49,11 @@ _ALLOCATION_PROPS = {
     "message": {"type": "string"},
     "createdAt": {"type": "number"},
     "deletionRequestedAt": {"type": "number"},
+    # observability: the grant's trace id (minted at pod admission);
+    # without this property a structural-schema API server would PRUNE
+    # the field on write and silently break end-to-end trace
+    # propagation (docs/OBSERVABILITY.md)
+    "traceId": {"type": "string"},
 }
 
 _PREPARED_PART_PROPS = {

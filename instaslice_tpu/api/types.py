@@ -142,6 +142,11 @@ class AllocationDetails:
     message: str = ""                # last error for FAILED
     created_at: float = 0.0          # unix secs; grant-latency metric input
     deletion_requested_at: float = 0.0
+    # observability: the trace id minted when the controller admitted the
+    # gated pod — every span the controller, agents, and device layer
+    # emit for this allocation carries it, so one grant is queryable
+    # end-to-end (utils/trace.py; docs/OBSERVABILITY.md)
+    trace_id: str = ""
 
     def to_dict(self) -> dict:
         return {
@@ -159,6 +164,7 @@ class AllocationDetails:
             "message": self.message,
             "createdAt": self.created_at,
             "deletionRequestedAt": self.deletion_requested_at,
+            **({"traceId": self.trace_id} if self.trace_id else {}),
         }
 
     @staticmethod
@@ -178,6 +184,7 @@ class AllocationDetails:
             message=d.get("message", ""),
             created_at=float(d.get("createdAt", 0.0)),
             deletion_requested_at=float(d.get("deletionRequestedAt", 0.0)),
+            trace_id=d.get("traceId", ""),
         )
 
     def global_box(self) -> Box:
@@ -225,6 +232,7 @@ class AllocationDetails:
         pods: List[PodRef],
         alloc_id: str = "",
         now: Optional[float] = None,
+        trace_id: str = "",
     ) -> "AllocationDetails":
         if not pods:
             raise ValueError("allocation needs at least one pod")
@@ -240,6 +248,7 @@ class AllocationDetails:
             },
             status=AllocationStatus.CREATING,
             created_at=time.time() if now is None else now,
+            trace_id=trace_id,
         )
 
 

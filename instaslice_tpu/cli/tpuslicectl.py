@@ -64,6 +64,70 @@ def _serve_bench(args) -> int:
     return 0
 
 
+def _trace_summary(p, args) -> int:
+    """``trace-summary``: one span pipeline, two sources — an offline
+    ``TPUSLICE_TRACE_FILE`` JSONL dump, or a live server's in-memory
+    ring over ``GET /v1/debug/trace``. Default output is per-span-name
+    p50/p95/max rows; ``--slowest N`` adds the N slowest trace roots;
+    ``--trace ID`` dumps one trace's spans in start order."""
+    from instaslice_tpu.utils.trace import summarize_durations
+
+    if bool(args.file) == bool(args.url):
+        p.error("trace-summary needs a JSONL file OR --url (not both)")
+
+    if args.url:
+        import urllib.parse
+        import urllib.request
+
+        base = args.url.rstrip("/") + "/v1/debug/trace"
+        query = {}
+        if args.trace:
+            query["trace_id"] = args.trace
+        if args.slowest:
+            query["n"] = str(args.slowest)
+        if query:
+            base += "?" + urllib.parse.urlencode(query)
+        try:
+            with urllib.request.urlopen(base, timeout=10) as r:
+                out = json.loads(r.read().decode())
+        except Exception as e:  # noqa: BLE001 - CLI: message, not trace
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 1
+        if args.trace:
+            for span in out.get("spans", []):
+                print(json.dumps(span))
+            return 0
+        for name, row in out.get("summary", {}).items():
+            print(json.dumps({"name": name, **row}))
+        if args.slowest:
+            for span in out.get("slowest", [])[: args.slowest]:
+                print(json.dumps(span))
+        return 0
+
+    spans = []
+    with open(args.file) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                spans.append(json.loads(line))
+    if args.trace:
+        mine = [s for s in spans if s.get("traceId") == args.trace]
+        for span in sorted(mine, key=lambda s: s.get("start", 0.0)):
+            print(json.dumps(span))
+        return 0 if mine else 1
+    by: dict = {}
+    for rec in spans:
+        by.setdefault(rec["name"], []).append(rec["durationMs"])
+    for name, row in summarize_durations(by).items():
+        print(json.dumps({"name": name, **row}))
+    if args.slowest:
+        roots = [s for s in spans if not s.get("parentId")]
+        roots.sort(key=lambda s: -s["durationMs"])
+        for span in roots[: args.slowest]:
+            print(json.dumps(span))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tpuslice", description="instaslice_tpu operator CLI"
@@ -82,9 +146,24 @@ def main(argv=None) -> int:
 
     tr = sub.add_parser(
         "trace-summary",
-        help="summarize a TPUSLICE_TRACE_FILE JSONL (per-span p50/max)",
+        help="summarize spans from a TPUSLICE_TRACE_FILE JSONL or a "
+        "live server's GET /v1/debug/trace (per-span p50/p95/max, "
+        "slowest traces, single-trace drill-down)",
     )
-    tr.add_argument("file", help="trace JSONL path")
+    tr.add_argument("file", nargs="?", default="",
+                    help="trace JSONL path (or use --url)")
+    tr.add_argument("--url", default="",
+                    help="live tpuslice-serve base url (e.g. "
+                         "http://127.0.0.1:8000): read the in-memory "
+                         "ring over GET /v1/debug/trace instead of a "
+                         "file")
+    tr.add_argument("--trace", default="", metavar="TRACE_ID",
+                    help="dump every span of ONE trace (start order) "
+                         "— the id an X-Trace-Id response header or a "
+                         "slowest-traces row points at")
+    tr.add_argument("--slowest", type=int, default=0, metavar="N",
+                    help="also print the N slowest trace roots "
+                         "(name, traceId, durationMs)")
 
     st = sub.add_parser(
         "status",
@@ -222,19 +301,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "trace-summary":
-        from instaslice_tpu.utils.trace import summarize_durations
-
-        by = {}
-        with open(args.file) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                rec = json.loads(line)
-                by.setdefault(rec["name"], []).append(rec["durationMs"])
-        for name, row in summarize_durations(by).items():
-            print(json.dumps({"name": name, **row}))
-        return 0
+        return _trace_summary(p, args)
 
     if args.cmd == "catalog":
         from instaslice_tpu.topology import profile_catalog

@@ -55,6 +55,7 @@ from instaslice_tpu.topology.placement import Box, Occupancy, Placement
 from instaslice_tpu.topology.policy import AllocationPolicy, get_policy
 from instaslice_tpu.topology.profiles import TopologyProfile
 from instaslice_tpu.utils.reconcile import Manager
+from instaslice_tpu.utils.trace import get_tracer, new_trace_id
 
 log = logging.getLogger("instaslice_tpu.controller")
 
@@ -88,6 +89,12 @@ class Controller:
         self.metrics = metrics
         self._pending_lock = threading.Lock()
         self._pending: set = set()
+        #: pod key → trace id minted on the pod's FIRST no-capacity
+        #: attempt: every ~2s requeue re-probes under the SAME trace id
+        #: (and only the first attempt records a span), so a pod waiting
+        #: an hour is one pending trace, not ~1800 single-span traces
+        #: evicting real grants from the ring and the trace file
+        self._pending_trace: Dict[str, str] = {}
         #: pod_uid → {node: monotonic deadline}: nodes whose device
         #: layer just failed this pod's allocation. The retry placement
         #: avoids them (falling back to ANY capacity when nothing else
@@ -121,6 +128,14 @@ class Controller:
             for p in alloc.get("pods", []):
                 keys.append(f"{p.get('namespace', '')}/{p.get('podName', '')}")
         return keys
+
+    @property
+    def tracer(self):
+        # resolved per use, never cached at construction: after
+        # reset_tracer() (test isolation, trace-file rebinding) the
+        # controller's spans must land in the NEW default tracer, not
+        # an orphaned closed ring
+        return get_tracer()
 
     def start(self) -> None:
         self.manager.start()
@@ -443,45 +458,67 @@ class Controller:
             return None
 
         avoid = self._avoid_nodes_for(pod_uid)
-        placement = self._place(profile, slices, avoid=avoid)
-        if placement is None and avoid:
-            # nothing fits elsewhere — the failed node may be the only
-            # capacity (single-node cluster): retry in place rather
-            # than starving the pod
-            placement = self._place(profile, slices)
-        if placement is None:
-            self._set_pending(self._pod_key(pod), True)
-            return self.no_capacity_requeue
-        self._set_pending(self._pod_key(pod), False)
-        pod_refs = [
-            PodRef(
-                pod_uuid=p["metadata"].get("uid", ""),
-                pod_name=p["metadata"]["name"],
-                namespace=p["metadata"].get("namespace", ""),
-                worker_id=i,
-                handoff_name=(p["metadata"].get("annotations") or {}).get(
-                    HANDOFF_ANNOTATION, ""
-                ),
+        # Admission into the allocation pipeline: mint THE trace id for
+        # this grant. It is persisted on the allocation record, so the
+        # agent's realize/teardown spans, the device-layer spans, and
+        # the ungate all join the same trace (docs/OBSERVABILITY.md).
+        # A capacity-starved pod keeps the id minted on its first
+        # attempt, so the whole wait and the eventual grant are ONE
+        # trace — and the ~2s requeues in between don't each record a
+        # root span (the first pending attempt and the grant do).
+        pod_key = self._pod_key(pod)
+        with self._pending_lock:
+            pending_tid = self._pending_trace.get(pod_key)
+        trace_id = pending_tid or new_trace_id()
+        with self.tracer.span(
+            "controller.allocate", trace_id=trace_id,
+            pod=pod_key, profile=profile.name,
+        ) as sp:
+            placement = self._place(profile, slices, avoid=avoid)
+            if placement is None and avoid:
+                # nothing fits elsewhere — the failed node may be the only
+                # capacity (single-node cluster): retry in place rather
+                # than starving the pod
+                placement = self._place(profile, slices)
+            if placement is None:
+                sp.attrs["placed"] = "false"
+                sp.drop = pending_tid is not None
+                with self._pending_lock:
+                    self._pending_trace[pod_key] = trace_id
+                self._set_pending(pod_key, True)
+                return self.no_capacity_requeue
+            self._set_pending(pod_key, False)
+            sp.attrs["box"] = placement.box.key()
+            pod_refs = [
+                PodRef(
+                    pod_uuid=p["metadata"].get("uid", ""),
+                    pod_name=p["metadata"]["name"],
+                    namespace=p["metadata"].get("namespace", ""),
+                    worker_id=i,
+                    handoff_name=(
+                        p["metadata"].get("annotations") or {}
+                    ).get(HANDOFF_ANNOTATION, ""),
+                )
+                for i, p in enumerate(
+                    sorted(pods, key=lambda p: p["metadata"]["name"])
+                )
+            ]
+            if gid:
+                aid = self._group_alloc_id(pod_refs[0].namespace, gid)
+            else:
+                aid = pod_refs[0].pod_uuid
+            alloc = AllocationDetails.from_placement(
+                placement, pod_refs, alloc_id=aid, trace_id=trace_id
             )
-            for i, p in enumerate(
-                sorted(pods, key=lambda p: p["metadata"]["name"])
-            )
-        ]
-        if gid:
-            aid = self._group_alloc_id(pod_refs[0].namespace, gid)
-        else:
-            aid = pod_refs[0].pod_uuid
-        alloc = AllocationDetails.from_placement(
-            placement, pod_refs, alloc_id=aid
-        )
-        for p in pods:
-            self._ensure_finalizer(p)
-        self._write_allocation(alloc)
+            for p in pods:
+                self._ensure_finalizer(p)
+            self._write_allocation(alloc)
         if self.metrics:
             self.metrics.allocations.labels(status="creating").inc()
         log.info(
-            "allocated %s: %s at %s across %s",
+            "allocated %s: %s at %s across %s (trace %s)",
             alloc.alloc_id, alloc.profile, alloc.box, list(alloc.parts),
+            trace_id,
         )
         return self.no_capacity_requeue  # check progress even if events drop
 
@@ -631,7 +668,11 @@ class Controller:
             a.deletion_requested_at = time.time()
             return True
 
-        self._for_each_holder(alloc, mutate)
+        with self.tracer.span(
+            "controller.teardown", trace_id=alloc.trace_id or None,
+            alloc=alloc.alloc_id,
+        ):
+            self._for_each_holder(alloc, mutate)
         if self.metrics:
             self.metrics.allocations.labels(status="deleted").inc()
 
@@ -641,6 +682,13 @@ class Controller:
         """Remove the scheduling gate from every pod of the allocation,
         then mark it ungated (reference: ``unGatePod`` + status write,
         instaslice_controller.go:157-184)."""
+        with self.tracer.span(
+            "controller.ungate", trace_id=alloc.trace_id or None,
+            alloc=alloc.alloc_id,
+        ):
+            self._ungate_all_inner(alloc)
+
+    def _ungate_all_inner(self, alloc: AllocationDetails) -> None:
         for p in alloc.pods:
             def mut(pod: dict) -> Optional[dict]:
                 gates = pod.get("spec", {}).get("schedulingGates", []) or []
@@ -675,8 +723,16 @@ class Controller:
         # double-count the north-star grant-latency metric
         if self.metrics and transitioned:
             if alloc.created_at:
-                self.metrics.slice_grant_seconds.observe(
-                    granted_at - alloc.created_at
+                # exemplar: a bad histogram bucket links straight to the
+                # trace that landed in it (docs/OBSERVABILITY.md)
+                from instaslice_tpu.metrics.metrics import (
+                    observe_with_exemplar,
+                )
+
+                observe_with_exemplar(
+                    self.metrics.slice_grant_seconds,
+                    granted_at - alloc.created_at,
+                    trace_id=alloc.trace_id,
                 )
             self.metrics.allocations.labels(status="ungated").inc()
 
@@ -847,6 +903,7 @@ class Controller:
                 self._pending.add(key)
             else:
                 self._pending.discard(key)
+                self._pending_trace.pop(key, None)
             if self.metrics:
                 self.metrics.pending_pods.set(len(self._pending))
 

@@ -100,6 +100,52 @@ class DeviceBackend(abc.ABC):
         return {}
 
 
+class TracedBackend:
+    """Span-emitting decorator for any :class:`DeviceBackend`: the
+    state-changing device operations (discover/reserve/release) become
+    ``device.<op>`` spans in the process tracer, inheriting the
+    caller's ambient trace context — so a reserve issued inside the
+    agent's ``agent.realize`` span (which is bound to the allocation's
+    trace id) shows up as a child span of that grant's trace. The
+    periodic read-only polls (``healthy``/``chip_health``/
+    ``list_reservations``) are deliberately NOT spanned: they run every
+    few seconds forever, and each would root a fresh single-span trace
+    — flooding the span ring and any ``TPUSLICE_TRACE_FILE`` with
+    noise unrelated to any grant. Exceptions pass through untouched
+    (the span records them); unknown attributes (the untraced polls,
+    backend-specific test helpers, ``name``) proxy to the inner
+    backend, mirroring ``faults.FaultyBackend`` so the two wrappers
+    stack in either order."""
+
+    def __init__(self, inner: DeviceBackend) -> None:
+        self._inner = inner
+
+    def __getattr__(self, name):  # passthrough (test helpers included)
+        return getattr(self._inner, name)
+
+    def _traced(self, op: str, fn, **attrs):
+        from instaslice_tpu.utils.trace import get_tracer
+
+        with get_tracer().span(f"device.{op}", **attrs):
+            return fn()
+
+    def discover(self) -> NodeInventory:
+        return self._traced("discover", self._inner.discover)
+
+    def reserve(self, slice_uuid: str, chip_ids: List[int]) -> Reservation:
+        return self._traced(
+            "reserve",
+            lambda: self._inner.reserve(slice_uuid, chip_ids),
+            slice=slice_uuid, chips=len(chip_ids),
+        )
+
+    def release(self, slice_uuid: str) -> None:
+        return self._traced(
+            "release", lambda: self._inner.release(slice_uuid),
+            slice=slice_uuid,
+        )
+
+
 def env_overrides() -> dict:
     """Topology hints the platform provides via env (GKE TPU node pools
     set these; tests set them explicitly):

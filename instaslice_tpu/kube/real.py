@@ -45,6 +45,7 @@ from instaslice_tpu.kube.client import (
     ResourceVersionExpired,
     WatchEvent,
 )
+from instaslice_tpu.utils.trace import get_tracer
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -418,6 +419,10 @@ class RealKubeClient(KubeClient):
                     "kube circuit breaker OPEN for %.1fs (%s)",
                     self.breaker_cooldown, self.base_url,
                 )
+                get_tracer().record(
+                    "kube.breaker_open", 0.0,
+                    cooldown=self.breaker_cooldown, server=self.base_url,
+                )
 
     def _breaker_ok(self) -> None:
         with self._breaker_lock:
@@ -444,7 +449,14 @@ class RealKubeClient(KubeClient):
                     random.uniform(self.backoff_base, prev * 3))
         if retry_after is not None:
             delay = max(delay, min(retry_after, self.retry_after_cap))
-        time.sleep(delay)
+        # a span, not a log line: backoff stalls inside a reconcile show
+        # up as children of that reconcile's kube.request span, so a
+        # slow grant is attributable to API-server pushback
+        with get_tracer().span(
+            "kube.backoff", delay=round(delay, 3),
+            retry_after=retry_after if retry_after is not None else "",
+        ):
+            time.sleep(delay)
         return delay
 
     def _request(
@@ -454,6 +466,27 @@ class RealKubeClient(KubeClient):
         body: Optional[dict] = None,
         content_type: str = "application/json",
         timeout: float = 30.0,
+    ) -> dict:
+        # one span per API round-trip (retries included — the span's
+        # duration is what the CALLER waited); errors and the attempt
+        # count land in it, so trace-summary shows API-server pain
+        path = (url[len(self.base_url):]
+                if url.startswith(self.base_url) else url)
+        with get_tracer().span(
+            "kube.request", method=method, path=path.partition("?")[0],
+        ) as sp:
+            return self._request_attempts(
+                method, url, body, content_type, timeout, sp
+            )
+
+    def _request_attempts(
+        self,
+        method: str,
+        url: str,
+        body: Optional[dict],
+        content_type: str,
+        timeout: float,
+        sp,
     ) -> dict:
         data = None if body is None else json.dumps(body).encode()
         auth_retried = False
@@ -474,6 +507,8 @@ class RealKubeClient(KubeClient):
                     req, context=self._ctx, timeout=timeout
                 ) as resp:
                     self._breaker_ok()
+                    if attempt:
+                        sp.attrs["retries"] = str(attempt)
                     return json.loads(resp.read().decode() or "{}")
             except urllib.error.HTTPError as e:
                 # rotated-out credential: refresh and retry once (not a
@@ -712,6 +747,10 @@ class RealKubeClient(KubeClient):
                     # mid-stream transport drop (RST, truncated chunk):
                     # resume from the last seen rv instead of failing
                     # the whole stream back to a cold relist
+                    get_tracer().record(
+                        "kube.watch_reconnect", 0.0, kind=kind,
+                        cause=type(e).__name__, rv=rv or "",
+                    )
                     reconnects += 1
                     if reconnects > self.watch_reconnects:
                         err = ApiError(

@@ -8,8 +8,11 @@ Served from the addresses the reference reserves for the same purpose
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
+
+log = logging.getLogger("instaslice_tpu.metrics")
 
 try:
     from prometheus_client import (
@@ -23,6 +26,22 @@ try:
     _PROM = True
 except ImportError:  # pragma: no cover - prometheus_client is in the image
     _PROM = False
+
+_warned_no_prom = False
+
+
+def _warn_no_prom() -> None:
+    """One loud warning instead of silently dropping every metric: an
+    image built without prometheus_client used to serve an operator
+    whose dashboards were empty with no hint why."""
+    global _warned_no_prom
+    if not _warned_no_prom:
+        _warned_no_prom = True
+        log.warning(
+            "prometheus_client is not installed: ALL metrics are no-ops "
+            "(grant latency, serve outcomes, TTFT/TPOT histograms). "
+            "Install prometheus_client to restore the /metrics surface."
+        )
 
 
 class _NoopMetric:
@@ -42,11 +61,48 @@ class _NoopMetric:
         pass
 
 
+def observe_with_exemplar(hist, value: float, trace_id: str = "") -> None:
+    """Observe ``value`` on ``hist``, attaching the trace id as an
+    OpenMetrics exemplar when the client library supports it — a slow
+    bucket of ``tpuslice_grant_seconds`` / ``tpuslice_serve_request_
+    seconds`` then links straight to the trace that caused it. Falls
+    back to a plain observe on noop metrics or older client libs
+    (TypeError fires at the call boundary, before any increment).
+
+    The id is validated against the shared ``TRACE_ID_SAFE`` shape
+    HERE rather than relying on the client library's ValueError:
+    prometheus_client increments the histogram BEFORE validating the
+    exemplar, so a catch-and-reobserve fallback would double-count
+    the observation."""
+    from instaslice_tpu.utils.trace import TRACE_ID_SAFE
+
+    if trace_id and TRACE_ID_SAFE.match(trace_id):
+        try:
+            hist.observe(value, exemplar={"trace_id": trace_id})
+            return
+        except TypeError:
+            pass  # old prometheus_client: no exemplar kwarg
+    hist.observe(value)
+
+
+def render(metrics) -> str:
+    """Exposition-format dump of ``metrics.registry`` (any holder with a
+    ``registry`` attribute) — lets tests and debug handlers assert on
+    metric output without binding a port. "" when prometheus_client is
+    missing or the holder is noop-backed."""
+    if not _PROM or getattr(metrics, "registry", None) is None:
+        return ""
+    from prometheus_client import generate_latest
+
+    return generate_latest(metrics.registry).decode()
+
+
 class OperatorMetrics:
     """One instance per process; inject into Controller / NodeAgent."""
 
     def __init__(self, registry: Optional["CollectorRegistry"] = None):
         if not _PROM:
+            _warn_no_prom()
             self.slice_grant_seconds = _NoopMetric()
             self.reserve_seconds = _NoopMetric()
             self.device_errors = _NoopMetric()
@@ -112,12 +168,19 @@ class ServingMetrics:
 
     def __init__(self, registry: Optional["CollectorRegistry"] = None):
         if not _PROM:
+            _warn_no_prom()
             self.requests = _NoopMetric()
             self.tokens = _NoopMetric()
             self.queue_depth = _NoopMetric()
             self.live_slots = _NoopMetric()
             self.request_seconds = _NoopMetric()
             self.draining = _NoopMetric()
+            self.ttft_seconds = _NoopMetric()
+            self.tpot_seconds = _NoopMetric()
+            self.step_seconds = _NoopMetric()
+            self.phase_seconds = _NoopMetric()
+            self.batch_occupancy = _NoopMetric()
+            self.kv_cache_utilization = _NoopMetric()
             self.registry = None
             return
         self.registry = registry or CollectorRegistry()
@@ -155,6 +218,50 @@ class ServingMetrics:
         self.draining = Gauge(
             "tpuslice_serve_draining",
             "1 while the server is draining (readyz 503, no admission)",
+            registry=self.registry,
+        )
+        # --- engine latency profiler (docs/OBSERVABILITY.md) ---
+        # TTFT: admission-queue entry → first sampled token. The
+        # user-facing responsiveness number the MIG-serving papers
+        # (arXiv:2109.11067, ParvaGPU) drive reconfiguration from.
+        self.ttft_seconds = Histogram(
+            "tpuslice_serve_ttft_seconds",
+            "Time to first token (queue entry to first sampled token)",
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
+                     5, 10, 30, 60),
+            registry=self.registry,
+        )
+        # TPOT: mean inter-token gap over a request's decode phase
+        self.tpot_seconds = Histogram(
+            "tpuslice_serve_tpot_seconds",
+            "Per-request mean time per output token after the first",
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1, 2.5),
+            registry=self.registry,
+        )
+        # phase ∈ prefill | decode | spec — one scheduler dispatch each
+        self.step_seconds = Histogram(
+            "tpuslice_serve_step_seconds",
+            "Engine dispatch wall time per scheduler round, by phase",
+            ["phase"],
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1, 2.5, 5),
+            registry=self.registry,
+        )
+        self.phase_seconds = Counter(
+            "tpuslice_serve_phase_seconds_total",
+            "Cumulative engine wall time split prefill vs decode",
+            ["phase"],
+            registry=self.registry,
+        )
+        self.batch_occupancy = Gauge(
+            "tpuslice_serve_batch_occupancy",
+            "Live slots / max_batch (decode batch utilization)",
+            registry=self.registry,
+        )
+        self.kv_cache_utilization = Gauge(
+            "tpuslice_serve_kv_cache_utilization",
+            "Occupied KV-cache positions / total cache positions",
             registry=self.registry,
         )
 

@@ -50,12 +50,29 @@ import queue
 import signal
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+from instaslice_tpu.utils.trace import (
+    TRACE_ID_SAFE,
+    get_tracer,
+    new_span_id,
+    new_trace_id,
+)
 
 log = logging.getLogger("instaslice_tpu.serving.api")
+
+
+def _mint_trace_id(header: Optional[str]) -> str:
+    """The serving plane's trace admission point: honor a well-formed
+    client ``X-Trace-Id`` (cross-service propagation; the shared
+    ``TRACE_ID_SAFE`` shape — header content must not leak into JSONL
+    trace files or exemplar labels unsanitized), mint otherwise."""
+    if header and TRACE_ID_SAFE.match(header):
+        return header
+    return new_trace_id()
 
 
 def _env_float(name: str, default: float) -> float:
@@ -87,9 +104,18 @@ class _Pending:
                  prefix_op: str = "", stream: bool = False,
                  stop: Optional[List[List[int]]] = None,
                  want_logprobs: bool = False, n: int = 1,
-                 adapter: int = 0):
+                 adapter: int = 0, trace_id: str = ""):
         self.prompt = prompt
         self.max_tokens = max_tokens
+        #: the request's trace id (minted/accepted at HTTP admission);
+        #: every span of this request's lifecycle carries it, and the
+        #: root ``serve.request`` span uses ``span_id`` so children
+        #: recorded earlier parent correctly
+        self.trace_id = trace_id
+        self.span_id = new_span_id() if trace_id else ""
+        #: set when the engine samples this request's first token
+        #: (admission prefill) — TTFT = first_token_at - t0
+        self.first_token_at: Optional[float] = None
         self.stop = stop or []         # normalized token-id sequences
         self.want_logprobs = want_logprobs
         self.n = n                     # parallel samples (OpenAI "n")
@@ -117,6 +143,7 @@ class _Pending:
         self.server_fault = False     # engine-side failure (HTTP 500),
         #                               vs a client mistake (HTTP 400)
         self.t0 = time.monotonic()
+        self.t0_wall = time.time()    # span start timestamps
         # streaming: the scheduler pushes dict events after every decode
         # block ({"kind": "delta"/"final", "index": choice, ...}); a str
         # is a pre-admission error. ``sent`` tracks per-rid delivery.
@@ -141,7 +168,13 @@ class _Pending:
 
 
 class _Scheduler(threading.Thread):
-    """Owns the engine: admission, block decode, budgets, delivery."""
+    """Owns the engine: admission, block decode, budgets, delivery.
+
+    Also the serving plane's profiler: it owns every timestamp a
+    request's latency decomposes into (queue wait, prefill, decode
+    rounds, delivery), so TTFT/TPOT histograms, the per-round step-time
+    and occupancy gauges, and the per-request trace spans are all
+    emitted from here."""
 
     #: Retry-After hint on a 429 shed: one block decode is the natural
     #: re-try grain — by then the queue has moved
@@ -331,6 +364,9 @@ class _Scheduler(threading.Thread):
             for r in eng.slots.values()
         )
         n = min(n, eng.max_len - 2 - worst)
+        phase = "spec" if eng.draft_model is not None else "decode"
+        round_rids = [r.request_id for r in eng.slots.values()]
+        t_step = time.monotonic()
         try:
             if eng.draft_model is not None:
                 eng.spec_step()
@@ -346,7 +382,48 @@ class _Scheduler(threading.Thread):
                 # on every later decode — reset the device state,
                 # fail the in-flight requests, keep serving
                 self._recover_engine(e)
+        finally:
+            self._observe_round(
+                phase, time.monotonic() - t_step, n, round_rids
+            )
         self._deliver()
+
+    def _observe_round(self, phase: str, dt: float, n_steps: int,
+                       rids: List[int]) -> None:
+        """Profiler output for one engine dispatch: step-time histogram,
+        prefill-vs-decode time split, and one ``serve.decode_round``
+        span per participating request — every trace shows which rounds
+        its tokens came from and what each cost."""
+        self.metrics.step_seconds.labels(phase=phase).observe(dt)
+        self.metrics.phase_seconds.labels(phase=phase).inc(dt)
+        tracer = get_tracer()
+        start = time.time() - dt
+        seen = set()
+        for rid in rids:
+            p = self._by_rid.get(rid)
+            if p is None or not p.trace_id or id(p) in seen:
+                continue  # untraced (prefix op) or n>1 fork already done
+            seen.add(id(p))
+            tracer.record(
+                "serve.decode_round", dt * 1e3, trace_id=p.trace_id,
+                parent_id=p.span_id, start=start, phase=phase,
+                n_steps=n_steps, batch=len(rids),
+            )
+
+    def _record_request_span(self, p: _Pending, outcome: str) -> None:
+        """The request's ROOT span, recorded at its terminal moment
+        (assembled here rather than held open: the lifecycle crosses
+        the HTTP and scheduler threads). Shed/timeout/drain requests
+        get one too — a 429 must be traceable, not just counted."""
+        if not p.trace_id:
+            return
+        get_tracer().record(
+            "serve.request", (time.monotonic() - p.t0) * 1e3,
+            trace_id=p.trace_id, span_id=p.span_id, start=p.t0_wall,
+            error=p.error if outcome == "error" else "",
+            outcome=outcome,
+            tokens=sum(len(r.tokens) for r in p.results.values()),
+        )
 
     def _admit(self) -> None:
         eng = self.engine
@@ -361,8 +438,26 @@ class _Scheduler(threading.Thread):
                     except queue.Empty:
                         break
                 if p.timed_out:
-                    # queued past its HTTP deadline: the client is gone
-                    self.metrics.requests.labels(outcome="timeout").inc()
+                    # queued past its HTTP deadline: the client is gone.
+                    # Completions get the full ledger treatment —
+                    # outcome counter AND latency observation (the
+                    # slowest requests must not vanish from the
+                    # histogram) AND root span; prefix ops stay out of
+                    # the completion ledger like everywhere else
+                    if not p.prefix_op:
+                        self.metrics.requests.labels(
+                            outcome="timeout"
+                        ).inc()
+                        from instaslice_tpu.metrics.metrics import (
+                            observe_with_exemplar,
+                        )
+
+                        observe_with_exemplar(
+                            self.metrics.request_seconds,
+                            time.monotonic() - p.t0,
+                            trace_id=p.trace_id,
+                        )
+                        self._record_request_span(p, "timeout")
                     p.done.set()
                     continue
                 if p.prefix_op:
@@ -386,9 +481,32 @@ class _Scheduler(threading.Thread):
                 if eng.free_slots() < p.n:
                     self._head = p
                     break
+                tracer = get_tracer()
+                t_admit = time.monotonic()
+                if p.trace_id:
+                    # queue-wait span: submit → the moment a slot freed
+                    tracer.record(
+                        "serve.queue", (t_admit - p.t0) * 1e3,
+                        trace_id=p.trace_id, parent_id=p.span_id,
+                        start=p.t0_wall,
+                    )
                 try:
-                    rids = eng.add_request_n(p.prompt, p.n, stop=p.stop,
-                                             adapter=p.adapter)
+                    with tracer.span(
+                        "serve.prefill", trace_id=p.trace_id or None,
+                        parent_id=p.span_id or None,
+                        tokens=len(p.prompt), n=p.n,
+                    ):
+                        rids = eng.add_request_n(p.prompt, p.n,
+                                                 stop=p.stop,
+                                                 adapter=p.adapter)
+                    dt_admit = time.monotonic() - t_admit
+                    p.first_token_at = time.monotonic()
+                    self.metrics.step_seconds.labels(
+                        phase="prefill"
+                    ).observe(dt_admit)
+                    self.metrics.phase_seconds.labels(
+                        phase="prefill"
+                    ).inc(dt_admit)
                 except Exception as e:
                     p.error = f"{type(e).__name__}: {e}"
                     # ValueError/TypeError = the client's prompt was
@@ -411,6 +529,9 @@ class _Scheduler(threading.Thread):
                         self._recover_engine(e)
                     if p.stream_q is not None:
                         p.stream_q.put(p.error)
+                    self._record_request_span(
+                        p, "rejected" if client_mistake else "error"
+                    )
                     p.done.set()
                     continue
                 for i, rid in enumerate(rids):
@@ -459,7 +580,28 @@ class _Scheduler(threading.Thread):
                        else "drained" if p.shed
                        else "error" if p.error else "ok")
             self.metrics.requests.labels(outcome=outcome).inc()
-            self.metrics.request_seconds.observe(time.monotonic() - p.t0)
+            from instaslice_tpu.metrics.metrics import (
+                observe_with_exemplar,
+            )
+
+            now = time.monotonic()
+            observe_with_exemplar(
+                self.metrics.request_seconds, now - p.t0,
+                trace_id=p.trace_id,
+            )
+            if p.first_token_at is not None:
+                observe_with_exemplar(
+                    self.metrics.ttft_seconds, p.first_token_at - p.t0,
+                    trace_id=p.trace_id,
+                )
+                tokens = sum(len(r.tokens) for r in p.results.values())
+                if outcome == "ok" and tokens > 1:
+                    # mean inter-token gap over the decode phase: the
+                    # per-request TPOT the client experienced
+                    self.metrics.tpot_seconds.observe(
+                        (now - p.first_token_at) / (tokens - 1)
+                    )
+            self._record_request_span(p, outcome)
             p.done.set()
 
     def _deliver(self) -> None:
@@ -469,6 +611,10 @@ class _Scheduler(threading.Thread):
             self.queue.qsize() + (self._head is not None)
         )
         self.metrics.live_slots.set(len(eng.slots))
+        self.metrics.batch_occupancy.set(
+            len(eng.slots) / max(1, eng.max_batch)
+        )
+        self.metrics.kv_cache_utilization.set(eng.kv_utilization())
         # stream incremental tokens for live slots (capped at the
         # request budget so a truncated tail is never streamed)
         for req in eng.slots.values():
@@ -558,7 +704,8 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, code: int, payload: dict,
-              retry_after: Optional[float] = None) -> None:
+              retry_after: Optional[float] = None,
+              trace_id: str = "") -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -566,6 +713,11 @@ class _Handler(BaseHTTPRequestHandler):
         if retry_after is not None:
             # ceil to whole seconds: Retry-After is delta-seconds
             self.send_header("Retry-After", str(max(1, int(retry_after))))
+        if trace_id:
+            # echo the request's trace id (minted or client-supplied):
+            # the client can pull the full trace from /v1/debug/trace —
+            # on EVERY terminal response, 429s and 500s included
+            self.send_header("X-Trace-Id", trace_id)
         self.end_headers()
         self.wfile.write(body)
 
@@ -582,6 +734,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, {"status": "ok"})
         elif self.path.startswith("/v1/stats"):
             self._send(200, type(self).scheduler.stats())
+        elif self.path.startswith("/v1/debug/trace"):
+            self._debug_trace()
         elif self.path.rstrip("/").startswith("/v1/models"):
             # OpenAI-client compatibility probe: one entry describing
             # the engine's model and serving limits ("created"/
@@ -635,6 +789,45 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {"error": f"no route {self.path}"})
 
+    def _debug_trace(self) -> None:
+        """``GET /v1/debug/trace``: the process tracer's live view —
+        per-span-name summaries, the slowest traces (root spans by
+        duration), and the most recent spans. ``?trace_id=X`` returns
+        every ring span of one trace in start order (the drill-down a
+        response's ``X-Trace-Id`` header points at); ``?n=`` bounds the
+        recent/slowest lists (default 20)."""
+        tracer = get_tracer()
+        qs = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query
+        )
+        try:
+            n = int((qs.get("n") or ["20"])[0])
+            if n < 1:
+                raise ValueError
+        except ValueError:
+            self._send(400, {"error": "n must be a positive integer"})
+            return
+        tid = (qs.get("trace_id") or [""])[0]
+        if tid:
+            spans = tracer.trace(tid)
+            if not spans:
+                self._send(404, {"error": f"no spans for trace {tid!r} "
+                                          "in the ring"})
+                return
+            self._send(200, {
+                "traceId": tid,
+                "spans": [s.to_dict() for s in spans],
+            })
+            return
+        self._send(200, {
+            "summary": tracer.summary(),
+            "slowest": [
+                s.to_dict()
+                for s in tracer.slowest(n, roots_only=True)
+            ],
+            "recent": [s.to_dict() for s in tracer.spans()[-n:]],
+        })
+
     def do_POST(self):
         if self.path.startswith("/v1/prefixes"):
             self._prefix_request("register")
@@ -658,6 +851,10 @@ class _Handler(BaseHTTPRequestHandler):
         if not self.path.startswith("/v1/completions"):
             self._send(404, {"error": f"no route {self.path}"})
             return
+        # HTTP admission is the serving plane's trace admission point:
+        # the id is minted (or accepted from X-Trace-Id) BEFORE parsing,
+        # so even a 400 is traceable and echoes the id back
+        tid = _mint_trace_id(self.headers.get("X-Trace-Id"))
         try:
             req = self._read_body()
             try:
@@ -716,20 +913,21 @@ class _Handler(BaseHTTPRequestHandler):
                         f"tpuslice-serve with --{key.replace('_', '-')}"
                     )
         except (ValueError, TypeError, json.JSONDecodeError) as e:
-            self._send(400, {"error": str(e)})
+            self._send(400, {"error": str(e)}, trace_id=tid)
             return
         pending = _Pending(prompt, max_tokens,
                            stream=bool(req.get("stream", False)),
                            stop=stop,
                            want_logprobs=bool(req.get("logprobs", False)),
-                           n=n, adapter=adapter)
+                           n=n, adapter=adapter, trace_id=tid)
         if not self._submit_or_shed(pending):
             return
         if pending.stream_q is not None:
             self._stream_response(pending)
             return
         if not self._await_or_timeout(pending):
-            self._send(503, {"error": "request timed out in queue"})
+            self._send(503, {"error": "request timed out in queue"},
+                       trace_id=tid)
             return
         if pending.error:
             # shed/drained requests get a clean 503 (retry elsewhere);
@@ -737,10 +935,11 @@ class _Handler(BaseHTTPRequestHandler):
             # killed the request is the server's fault
             if pending.shed:
                 self._send(503, {"error": pending.error},
-                           retry_after=type(self).scheduler.drain_budget)
+                           retry_after=type(self).scheduler.drain_budget,
+                           trace_id=tid)
             else:
                 self._send(500 if pending.server_fault else 400,
-                           {"error": pending.error})
+                           {"error": pending.error}, trace_id=tid)
             return
         choices = []
         for idx in sorted(pending.results):
@@ -762,7 +961,7 @@ class _Handler(BaseHTTPRequestHandler):
                     len(r.tokens) for r in pending.results.values()
                 ),
             },
-        })
+        }, trace_id=tid)
 
 
     def _submit_or_shed(self, pending: _Pending) -> bool:
@@ -770,16 +969,23 @@ class _Handler(BaseHTTPRequestHandler):
         (429 queue-full with Retry-After / 503 draining) and return
         False — the backpressure contract: a client NEVER waits on a
         request the server already knows it cannot serve."""
+        sched = type(self).scheduler
         try:
-            type(self).scheduler.submit(pending)
+            sched.submit(pending)
             return True
         except QueueFull as e:
+            # shed at admission still gets its root span: a 429 must be
+            # traceable from /v1/debug/trace, not just counted
+            sched._record_request_span(pending, "shed")
             self._send(429, {"error": "admission queue full; retry"},
-                       retry_after=e.retry_after)
+                       retry_after=e.retry_after,
+                       trace_id=pending.trace_id)
             return False
         except Draining:
+            sched._record_request_span(pending, "drained")
             self._send(503, {"error": "server draining"},
-                       retry_after=type(self).scheduler.drain_budget)
+                       retry_after=sched.drain_budget,
+                       trace_id=pending.trace_id)
             return False
 
     def _await_or_timeout(self, pending: _Pending) -> bool:
@@ -827,6 +1033,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
+            if pending.trace_id:
+                self.send_header("X-Trace-Id", pending.trace_id)
             self.end_headers()
             finals = 0
             while True:

@@ -69,6 +69,7 @@ from instaslice_tpu.serving.sampling import (
     filter_logits,
     token_logprob,
 )
+from instaslice_tpu.utils.trace import get_tracer
 
 
 @dataclasses.dataclass
@@ -626,6 +627,19 @@ class ServingEngine:
     def free_slots(self) -> int:
         return self.max_batch - len(self.slots)
 
+    def kv_utilization(self) -> float:
+        """Fraction of the KV cache's (max_batch × max_len) positions
+        holding live-slot context — host-side bookkeeping only, no
+        device sync. Feeds ``tpuslice_serve_kv_cache_utilization``;
+        MIG-serving reconfiguration papers key decisions off exactly
+        this occupancy signal."""
+        if not self.slots:
+            return 0.0
+        used = sum(
+            len(r.prompt) + len(r.generated) for r in self.slots.values()
+        )
+        return min(1.0, used / float(self.max_batch * self.max_len))
+
     def finish_slot(self, slot: int, n_keep: Optional[int] = None,
                     reason: str = "max_new_tokens") -> None:
         """Externally finish a live slot (budget cut, client eviction):
@@ -805,6 +819,12 @@ class ServingEngine:
         self._validate_prefix(prefix)
         if self.fault_hook is not None:
             self.fault_hook("prefill")
+        with get_tracer().span(
+            "engine.prefix_register", tokens=len(prefix),
+        ):
+            self._register_prefix_inner(prefix, key)
+
+    def _register_prefix_inner(self, prefix: List[int], key) -> None:
         slot = self._first_free_slot("no free slots to prefill the prefix")
         self._prefill_chunks(slot, list(prefix))
         stripe = self._read_stripe(self.cache, slot, length=len(prefix))
@@ -897,6 +917,17 @@ class ServingEngine:
         chain (allowed, like OpenAI, but pointless); at temperature > 0
         forks diverge from the first sampled token on (independent
         Gumbel noise per batch row)."""
+        # the span joins the caller's ambient trace (the API scheduler
+        # binds the request's trace id around admission), so prefill
+        # cost is attributable to the request that paid it
+        with get_tracer().span(
+            "engine.prefill", tokens=len(prompt), n=n,
+        ) as sp:
+            rids = self._add_request_n_inner(prompt, n, stop, adapter, sp)
+        return rids
+
+    def _add_request_n_inner(self, prompt: List[int], n: int,
+                             stop, adapter: int, sp) -> List[int]:
         stop = self._normalize_stop(stop)
         if not 0 <= adapter <= self.n_adapters:
             raise ValueError(
@@ -919,6 +950,7 @@ class ServingEngine:
         # (reusing base KV would serve a silent base/adapter hybrid)
         pref = self._match_prefix(prompt) if adapter == 0 else None
         if pref is not None:
+            sp.attrs["prefix_hit"] = str(len(pref.tokens))
             self.cache = self._write_stripe(self.cache, pref.stripe,
                                             first)
             if self.draft_model is not None:
@@ -987,6 +1019,12 @@ class ServingEngine:
         token. Slots hitting eos/max_len move to ``finished``."""
         if not self.slots:
             return {}
+        with get_tracer().span(
+            "engine.decode_step", batch=len(self.slots),
+        ):
+            return self._step_inner()
+
+    def _step_inner(self) -> Dict[int, int]:
         if self.fault_hook is not None:
             self.fault_hook("decode")
         if self.draft_model is not None:
@@ -1040,6 +1078,13 @@ class ServingEngine:
         instead of silently clamping writes."""
         if not self.slots:
             return {}
+        with get_tracer().span(
+            "engine.decode_block", n_steps=n_steps,
+            batch=len(self.slots),
+        ):
+            return self._decode_block_inner(n_steps)
+
+    def _decode_block_inner(self, n_steps: int) -> Dict[int, List[int]]:
         if self.fault_hook is not None:
             self.fault_hook("decode")
         worst = max(
@@ -1124,6 +1169,12 @@ class ServingEngine:
             )
         if not self.slots:
             return {}
+        with get_tracer().span(
+            "engine.spec_round", batch=len(self.slots), k=self.spec_k,
+        ):
+            return self._spec_step_inner()
+
+    def _spec_step_inner(self) -> Dict[int, List[int]]:
         if self.fault_hook is not None:
             self.fault_hook("spec")
         worst = max(

@@ -28,6 +28,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from typing import List, Optional
 
 
@@ -68,18 +69,24 @@ def _classify(err: Optional[str], code: Optional[int]) -> str:
 
 
 def _one_request(url: str, prompt: List[int], max_tokens: int,
-                 stream: bool, timeout: float, adapter: str = ""):
+                 stream: bool, timeout: float, adapter: str = "",
+                 trace_id: str = ""):
     """Returns (latency_s, ttft_s or None, tokens, error or None,
-    http_code or None)."""
+    http_code or None). ``trace_id`` rides the ``X-Trace-Id`` header,
+    so every loadgen request is findable in the server's
+    ``/v1/debug/trace`` ring / ``TPUSLICE_TRACE_FILE`` dump."""
     body = {"prompt": prompt, "max_tokens": max_tokens}
     if adapter:
         body["adapter"] = adapter
     if stream:
         body["stream"] = True
+    headers = {"Content-Type": "application/json"}
+    if trace_id:
+        headers["X-Trace-Id"] = trace_id
     req = urllib.request.Request(
         url + "/v1/completions",
         data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"},
+        headers=headers,
         method="POST",
     )
     t0 = time.monotonic()
@@ -147,12 +154,18 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     requests ("" rides the base model) — load-tests the batched
     per-request adapter path."""
     rng = random.Random(seed)
+    # per-run nonce in every trace id: two runs with the same seed
+    # against one long-lived server must not reuse ids, or the
+    # documented `--trace` drill-down would merge unrelated requests'
+    # spans from the server's ring (stays within TRACE_ID_SAFE)
+    run_id = uuid.uuid4().hex[:6]
     prompts = [
         [rng.randrange(1, vocab) for _ in range(prompt_len)]
         for _ in range(requests)
     ]
     lat: List[float] = []
     ttfts: List[float] = []
+    tpots: List[float] = []
     errors: List[str] = []
     outcomes = {k: 0 for k in OUTCOMES}
     status_counts: dict = {}
@@ -169,6 +182,7 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
             dt, ttft, toks, err, code = _one_request(
                 url, prompts[i], max_tokens, stream, timeout,
                 adapter=adapters[i % len(adapters)] if adapters else "",
+                trace_id=f"lg-{seed}-{run_id}-{i}",
             )
             with lock:
                 outcomes[_classify(err, code)] += 1
@@ -179,6 +193,12 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
                     tokens[0] += toks
                     if ttft is not None:
                         ttfts.append(ttft)
+                        if toks > 1:
+                            # the client-observed mean inter-token gap
+                            # over the decode phase — the number the
+                            # server-side TPOT histogram must reconcile
+                            # with (chaos tier cross-check)
+                            tpots.append((dt - ttft) / (toks - 1))
                 else:
                     errors.append(err)
 
@@ -201,15 +221,28 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
         "outcomes": outcomes,
         "status_counts": status_counts,
         "p95_latency": round(_percentile(lat, 0.95), 4),
+        "p99_latency": round(_percentile(lat, 0.99), 4),
         "mean_latency": round(statistics.mean(lat), 4) if lat else 0.0,
         "client_tokens_per_sec": round(tokens[0] / wall, 1),
         "stream": stream,
+        # every request carried X-Trace-Id "<prefix><i>": paste one
+        # into `tpuslice trace-summary --url ... --trace <prefix><i>`
+        # to see where its time went server-side
+        "trace_id_prefix": f"lg-{seed}-{run_id}-",
     }
     if adapters:
         out["adapters"] = list(adapters)
     if stream:
         out["ttft_p50"] = round(_percentile(ttfts, 0.5), 4)
         out["ttft_p95"] = round(_percentile(ttfts, 0.95), 4)
+        out["ttft_p99"] = round(_percentile(ttfts, 0.99), 4)
+        out["ttft_mean"] = (round(statistics.mean(ttfts), 4)
+                            if ttfts else 0.0)
+        # client-side per-output-token latency (decode-phase mean gap
+        # per request, percentiles across requests)
+        out["tpot_p50"] = round(_percentile(tpots, 0.5), 5)
+        out["tpot_p95"] = round(_percentile(tpots, 0.95), 5)
+        out["tpot_p99"] = round(_percentile(tpots, 0.99), 5)
     if errors:
         out["first_error"] = errors[0][:200]
     return out

@@ -110,20 +110,29 @@ class Manager:
         error_backoff: float = 0.5,
         tracer=None,
     ) -> None:
-        from instaslice_tpu.utils.trace import get_tracer
-
         self.name = name
         self.client = client
         self.reconcile = reconcile
         self.watches = watches
         self.resync_period = resync_period
         self.error_backoff = error_backoff
-        self.tracer = tracer if tracer is not None else get_tracer()
+        # resolved per use, never cached: after reset_tracer() swaps the
+        # process default, reconcile spans must land in the NEW tracer,
+        # not an orphaned closed ring
+        self._tracer = tracer
         self.queue = WorkQueue()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self.reconcile_count = 0
         self.error_count = 0
+
+    @property
+    def tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from instaslice_tpu.utils.trace import get_tracer
+
+        return get_tracer()
 
     # ------------------------------------------------------------------
 

@@ -340,6 +340,109 @@ class TestServingChaos:
             codes = sorted(r[0] for r in results)
             assert codes == [200, 200], results
 
+    def test_client_percentiles_reconcile_with_server_histograms(
+        self, model
+    ):
+        """Satellite contract (docs/OBSERVABILITY.md): loadgen's
+        client-side TTFT / per-token percentiles must reconcile with
+        the server-side profiler histograms — same request population
+        (counts match exactly) and consistent magnitudes (client-
+        observed times sit at or above the server-measured ones, by no
+        more than scheduling/delivery slack)."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        metrics = ServingMetrics()
+        with ApiServer(eng, block_size=4, metrics=metrics,
+                       request_timeout=60) as srv:
+            # warm the compiled programs, then snapshot the histograms
+            # so the diff below covers exactly the loadgen population
+            code, out, _ = post(srv.url, {"prompt": [1, 2, 3],
+                                          "max_tokens": 2})
+            assert code == 200, out
+
+            def sample(name, labels=None):
+                return metrics.registry.get_sample_value(
+                    name, labels or {}
+                ) or 0.0
+
+            warm = {
+                n: sample(n) for n in (
+                    "tpuslice_serve_ttft_seconds_count",
+                    "tpuslice_serve_ttft_seconds_sum",
+                    "tpuslice_serve_tpot_seconds_count",
+                    "tpuslice_serve_tpot_seconds_sum",
+                    "tpuslice_serve_request_seconds_count",
+                    "tpuslice_serve_request_seconds_sum",
+                )
+            }
+            N = 24
+            report = loadgen.run(
+                srv.url, requests=N, concurrency=4, prompt_len=8,
+                max_tokens=8, vocab=VOCAB, stream=True, timeout=60,
+                seed=CHAOS_SEED,
+            )
+            print("loadgen:", json.dumps(report))
+            assert report["outcomes"]["hung"] == 0, report
+            assert report["ok"] == N, report
+
+            # counts reconcile exactly: one TTFT / TPOT / latency
+            # observation per successful request, none double-counted
+            ttft_n = sample("tpuslice_serve_ttft_seconds_count") - \
+                warm["tpuslice_serve_ttft_seconds_count"]
+            tpot_n = sample("tpuslice_serve_tpot_seconds_count") - \
+                warm["tpuslice_serve_tpot_seconds_count"]
+            req_n = sample("tpuslice_serve_request_seconds_count") - \
+                warm["tpuslice_serve_request_seconds_count"]
+            assert ttft_n == N, (ttft_n, N)
+            assert tpot_n == N, (tpot_n, N)
+            assert req_n == N, (req_n, N)
+
+            # magnitudes reconcile: the server measures queue-entry →
+            # first sampled token; the client measures send → first
+            # chunk RECEIVED — strictly later on the wall clock, by
+            # delivery latency only (generous slack: one decode round
+            # + HTTP overhead)
+            ttft_mean = (
+                sample("tpuslice_serve_ttft_seconds_sum")
+                - warm["tpuslice_serve_ttft_seconds_sum"]
+            ) / ttft_n
+            assert ttft_mean <= report["ttft_mean"] + 0.25, (
+                ttft_mean, report["ttft_mean"])
+            assert report["ttft_mean"] <= ttft_mean + 2.0, (
+                ttft_mean, report["ttft_mean"])
+
+            tpot_mean = (
+                sample("tpuslice_serve_tpot_seconds_sum")
+                - warm["tpuslice_serve_tpot_seconds_sum"]
+            ) / tpot_n
+            assert tpot_mean >= 0.0
+            # client TPOT includes delivery; same order of magnitude
+            assert tpot_mean <= report["tpot_p99"] + 0.25, (
+                tpot_mean, report)
+
+            req_mean = (
+                sample("tpuslice_serve_request_seconds_sum")
+                - warm["tpuslice_serve_request_seconds_sum"]
+            ) / req_n
+            assert abs(req_mean - report["mean_latency"]) <= \
+                0.5 + 0.5 * report["mean_latency"], (
+                    req_mean, report["mean_latency"])
+
+            # the per-round profiler populated alongside: step times
+            # in both phases, occupancy/KV gauges exported
+            assert sample("tpuslice_serve_step_seconds_count",
+                          {"phase": "prefill"}) >= N
+            assert sample("tpuslice_serve_step_seconds_count",
+                          {"phase": "decode"}) >= 1
+            assert sample("tpuslice_serve_phase_seconds_total",
+                          {"phase": "decode"}) > 0
+            from instaslice_tpu.metrics.metrics import render
+
+            text = render(metrics)
+            assert "tpuslice_serve_batch_occupancy" in text
+            assert "tpuslice_serve_kv_cache_utilization" in text
+
     def test_scheduler_survives_injected_round_faults(self, model):
         """Errors raised INSIDE the scheduler loop (not decode) never
         kill the serving thread."""

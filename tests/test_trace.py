@@ -7,7 +7,11 @@ import threading
 import pytest
 
 from instaslice_tpu.sim import SimCluster
-from instaslice_tpu.utils.trace import Tracer, get_tracer
+from instaslice_tpu.utils.trace import (
+    Tracer,
+    get_tracer,
+    reset_tracer,
+)
 
 
 class TestTracer:
@@ -67,6 +71,122 @@ class TestTracer:
                 pass
         s = t.summary()
         assert s["a"]["count"] == 3 and s["a"]["maxMs"] >= s["a"]["p50Ms"]
+        assert s["a"]["p50Ms"] <= s["a"]["p95Ms"] <= s["a"]["maxMs"]
+
+
+class TestTraceStructure:
+    """Parent/child spans, trace ids, and the cross-thread record path."""
+
+    def test_nested_span_inherits_trace_and_parents(self):
+        t = Tracer()
+        with t.span("parent") as p:
+            with t.span("child") as c:
+                pass
+        assert p.trace_id and p.span_id and not p.parent_id
+        assert c.trace_id == p.trace_id
+        assert c.parent_id == p.span_id
+
+    def test_explicit_trace_id_reroots_out_of_ambient(self):
+        t = Tracer()
+        with t.span("ambient") as a:
+            with t.span("other", trace_id="tid-x") as s:
+                pass
+        assert s.trace_id == "tid-x"
+        # a cross-trace parent link would orphan the span in its own
+        # trace: the ambient span must NOT become the parent
+        assert s.parent_id == ""
+        assert a.trace_id != "tid-x"
+
+    def test_explicit_same_trace_parents_to_ambient(self):
+        t = Tracer()
+        with t.span("a", trace_id="T") as a:
+            with t.span("b", trace_id="T") as b:
+                pass
+        assert b.parent_id == a.span_id and b.trace_id == "T"
+
+    def test_context_does_not_leak_across_threads(self):
+        t = Tracer()
+        seen = {}
+
+        def worker():
+            with t.span("bg") as s:
+                seen["span"] = s
+
+        with t.span("fg") as fg:
+            th = threading.Thread(target=worker)
+            th.start()
+            th.join()
+        assert seen["span"].trace_id != fg.trace_id
+        assert not seen["span"].parent_id
+
+    def test_record_cross_thread_root_assembly(self):
+        t = Tracer()
+        rec = t.record("serve.request", 12.5, trace_id="T",
+                       span_id="root1", outcome="ok")
+        kid = t.record("serve.queue", 2.0, trace_id="T",
+                       parent_id="root1")
+        assert rec.trace_id == kid.trace_id == "T"
+        assert kid.parent_id == "root1"
+        got = t.trace("T")
+        assert {s.name for s in got} == {"serve.request", "serve.queue"}
+        # trace() orders by wall start: the root's backdated start
+        # (now - duration) puts it before the child recorded after it
+        assert got[0].name == "serve.request"
+
+    def test_trace_query_and_slowest(self):
+        t = Tracer()
+        t.record("a", 5.0, trace_id="T1", span_id="s1")
+        t.record("b", 50.0, trace_id="T2", span_id="s2")
+        t.record("c", 1.0, trace_id="T2", span_id="s3",
+                 parent_id="s2")
+        assert [s.name for s in t.trace("T2")] == ["b", "c"] or \
+            {s.name for s in t.trace("T2")} == {"b", "c"}
+        slow = t.slowest(2, roots_only=True)
+        assert [s.name for s in slow] == ["b", "a"]  # c is a child
+
+    def test_file_output_carries_trace_fields(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        t = Tracer(trace_file=path)
+        with t.span("parent", trace_id="T") as p:
+            with t.span("child"):
+                pass
+        t.close()
+        recs = [json.loads(line) for line in open(path)]
+        child = next(r for r in recs if r["name"] == "child")
+        assert child["traceId"] == "T"
+        assert child["parentId"] == p.span_id
+
+
+class TestLifecycle:
+    def test_close_idempotent_and_span_after_close_safe(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        t = Tracer(trace_file=path)
+        with t.span("before"):
+            pass
+        t.close()
+        t.close()  # idempotent
+        with t.span("after"):  # must not raise on the closed handle
+            pass
+        assert {s.name for s in t.spans()} == {"before", "after"}
+        recs = [json.loads(line) for line in open(path)]
+        assert [r["name"] for r in recs] == ["before"]
+
+    def test_reset_tracer_swaps_default_and_rereads_env(
+        self, tmp_path, monkeypatch
+    ):
+        path = str(tmp_path / "trace.jsonl")
+        first = get_tracer()
+        monkeypatch.setenv("TPUSLICE_TRACE_FILE", path)
+        reset_tracer()
+        second = get_tracer()
+        assert second is not first  # env re-read on the fresh default
+        with second.span("op"):
+            pass
+        monkeypatch.delenv("TPUSLICE_TRACE_FILE")
+        reset_tracer()  # closes second's handle
+        assert get_tracer() is not second
+        [rec] = [json.loads(line) for line in open(path)]
+        assert rec["name"] == "op"
 
 
 class TestEndToEndSpans:

@@ -1,0 +1,320 @@
+"""End-to-end trace propagation tier (docs/OBSERVABILITY.md).
+
+Two contracts, one per plane:
+
+- **Control plane**: the trace id the controller mints when it admits a
+  gated pod is persisted on the allocation record and carried by every
+  span of the grant — ``controller.allocate`` → ``agent.realize`` →
+  ``device.reserve`` (a child of the realize span) →
+  ``controller.ungate`` — and the teardown spans of the same
+  allocation, so one grant is queryable end to end.
+
+- **Serving plane**: the trace id minted (or accepted from
+  ``X-Trace-Id``) at HTTP admission is echoed on the response and
+  shared by every span of the request's lifecycle — root
+  ``serve.request`` plus ``serve.queue`` / ``serve.prefill`` /
+  ``engine.prefill`` / ``serve.decode_round`` children — INCLUDING
+  requests that terminate in shed (429), timeout (503), and drain
+  (503) outcomes: a shed request must be traceable, not just counted.
+
+Also covers ``GET /v1/debug/trace`` (the live drill-down surface the
+``X-Trace-Id`` header points at) and the profiler metrics appearing in
+exposition output via ``metrics.render()``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.faults import FaultPlan
+from instaslice_tpu.metrics.metrics import ServingMetrics, render
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.serving import ServingEngine
+from instaslice_tpu.serving.api_server import ApiServer
+from instaslice_tpu.sim import SimCluster
+from instaslice_tpu.utils.trace import get_tracer, reset_tracer
+
+VOCAB = 64
+
+
+@pytest.fixture(autouse=True)
+def fresh_tracer():
+    """Each test gets a fresh process-default tracer (and components
+    constructed inside the test bind to it): span assertions must not
+    see another test's ring."""
+    reset_tracer()
+    yield
+    reset_tracer()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=VOCAB, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+def post(url, payload, path="/v1/completions", headers=None,
+         timeout=60):
+    """Returns (status, body dict, response headers dict)."""
+    h = {"Content-Type": "application/json"}
+    if headers:
+        h.update(headers)
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(), headers=h,
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode()), dict(e.headers)
+
+
+def get(url, path, timeout=10):
+    try:
+        with urllib.request.urlopen(url + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def wait_span(tracer, name, trace_id, timeout=10.0):
+    """Spans land asynchronously (scheduler thread): poll the ring."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        mine = [s for s in tracer.trace(trace_id) if s.name == name]
+        if mine:
+            return mine[0]
+        time.sleep(0.02)
+    raise AssertionError(
+        f"span {name!r} never appeared in trace {trace_id!r}; have "
+        f"{[s.name for s in tracer.trace(trace_id)]}"
+    )
+
+
+class TestGrantTrace:
+    def test_one_trace_id_pod_gate_to_ungate_and_teardown(self):
+        tracer = get_tracer()
+        with SimCluster(n_nodes=1, deletion_grace_seconds=0.2) as c:
+            c.submit("t1", "v5e-1x1")
+            assert c.wait_phase("t1", "Running", timeout=10)
+            allocs = c.allocations()
+            assert len(allocs) == 1
+            tid = next(iter(allocs.values())).get("traceId", "")
+            assert tid, "allocation record carries no trace id"
+            c.delete_pod("t1")
+            assert c.wait_gone("t1", timeout=10)
+        spans = tracer.trace(tid)
+        names = {s.name for s in spans}
+        # the grant: admission → placement → realize → device → ungate
+        assert {"controller.allocate", "agent.realize",
+                "device.reserve", "controller.ungate"} <= names, names
+        # ... and the teardown of the SAME allocation joins the trace
+        assert {"controller.teardown", "agent.teardown",
+                "device.release"} <= names, names
+        # parentage: the device call is a child of the agent's realize
+        realize = next(s for s in spans if s.name == "agent.realize")
+        reserve = next(s for s in spans if s.name == "device.reserve")
+        assert reserve.parent_id == realize.span_id
+        assert realize.trace_id == reserve.trace_id == tid
+        # exactly one grant trace: no other allocation trace bled in
+        allocate = [s for s in spans if s.name == "controller.allocate"]
+        assert len(allocate) == 1 and not allocate[0].parent_id
+
+
+class TestServingTrace:
+    def test_request_spans_share_client_trace_id(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        metrics = ServingMetrics()
+        tracer = get_tracer()
+        with ApiServer(eng, block_size=4, metrics=metrics) as srv:
+            code, out, hdrs = post(
+                srv.url, {"prompt": [1, 2, 3], "max_tokens": 4},
+                headers={"X-Trace-Id": "req-abc"},
+            )
+            assert code == 200, out
+            assert hdrs.get("X-Trace-Id") == "req-abc"
+            root = wait_span(tracer, "serve.request", "req-abc")
+            assert not root.parent_id and root.attrs["outcome"] == "ok"
+            spans = tracer.trace("req-abc")
+            names = {s.name for s in spans}
+            assert {"serve.request", "serve.queue", "serve.prefill",
+                    "engine.prefill", "serve.decode_round"} <= names, \
+                names
+            # every lifecycle span shares the request's trace id, and
+            # the direct children parent to the root's span id
+            assert all(s.trace_id == "req-abc" for s in spans)
+            for name in ("serve.queue", "serve.prefill",
+                         "serve.decode_round"):
+                s = next(x for x in spans if x.name == name)
+                assert s.parent_id == root.span_id, (name, s.parent_id)
+            # engine.prefill nests under serve.prefill (ambient ctx)
+            ep = next(s for s in spans if s.name == "engine.prefill")
+            sp = next(s for s in spans if s.name == "serve.prefill")
+            assert ep.parent_id == sp.span_id
+
+            # profiler metrics made it to exposition output
+            text = render(metrics)
+            for metric in ("tpuslice_serve_ttft_seconds",
+                           "tpuslice_serve_tpot_seconds",
+                           "tpuslice_serve_step_seconds",
+                           "tpuslice_serve_phase_seconds_total",
+                           "tpuslice_serve_batch_occupancy",
+                           "tpuslice_serve_kv_cache_utilization"):
+                assert metric in text, metric
+            assert metrics.registry.get_sample_value(
+                "tpuslice_serve_ttft_seconds_count"
+            ) == 1
+            assert metrics.registry.get_sample_value(
+                "tpuslice_serve_step_seconds_count",
+                {"phase": "prefill"},
+            ) >= 1
+
+    def test_trace_id_minted_when_header_absent_or_malformed(
+        self, model
+    ):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        tracer = get_tracer()
+        with ApiServer(eng, block_size=4) as srv:
+            code, _, hdrs = post(srv.url, {"prompt": [1],
+                                           "max_tokens": 2})
+            assert code == 200
+            minted = hdrs.get("X-Trace-Id", "")
+            assert minted  # server minted one
+            wait_span(tracer, "serve.request", minted)
+
+            bad = "not a valid id!!"
+            code, _, hdrs = post(srv.url,
+                                 {"prompt": [1], "max_tokens": 2},
+                                 headers={"X-Trace-Id": bad})
+            assert code == 200
+            assert hdrs.get("X-Trace-Id") not in ("", bad)
+
+    def test_shed_timeout_drain_outcomes_are_traced(self, model):
+        """The failure outcomes each get a root span carrying the
+        client's trace id: 429 queue-full shed, 503 queue timeout, and
+        503 drain refusal."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8)
+        tracer = get_tracer()
+        plan = FaultPlan(7)
+        with ApiServer(eng, block_size=4, request_timeout=60,
+                       max_queue=1, fault_plan=plan) as srv:
+            # warm the compiled programs so the stall below is the
+            # injected delay, not a jit compile (the warm-up runs with
+            # a generous timeout; the timeout contract under test is
+            # tightened only once the programs are hot)
+            code, out, _ = post(srv.url, {"prompt": [1, 2],
+                                          "max_tokens": 2})
+            assert code == 200, out
+            srv._srv.RequestHandlerClass.request_timeout = 0.75
+            # arm AFTER warm-up: the next prefill stalls the scheduler
+            # thread for 3 s — a deterministic busy window
+            plan.site("engine.prefill", probability=1.0,
+                      kinds=("delay",), delay_s=3.0, max_fires=1)
+
+            # A: admitted into the stalled prefill → its client wait
+            # expires → outcome "timeout"
+            ta = threading.Thread(
+                target=post,
+                args=(srv.url, {"prompt": [3, 4], "max_tokens": 2}),
+                kwargs={"headers": {"X-Trace-Id": "t-timeout"}},
+                daemon=True,
+            )
+            ta.start()
+            time.sleep(0.3)  # let A reach the scheduler
+            # B: queued behind the stall (fills the 1-deep queue),
+            # also times out
+            tb = threading.Thread(
+                target=post,
+                args=(srv.url, {"prompt": [5, 6], "max_tokens": 2}),
+                kwargs={"headers": {"X-Trace-Id": "t-timeout2"}},
+                daemon=True,
+            )
+            tb.start()
+            time.sleep(0.3)
+            # C: queue full → 429 shed, traced synchronously
+            code, _, hdrs = post(srv.url,
+                                 {"prompt": [7], "max_tokens": 2},
+                                 headers={"X-Trace-Id": "t-shed"})
+            assert code == 429
+            assert hdrs.get("X-Trace-Id") == "t-shed"
+            shed = wait_span(tracer, "serve.request", "t-shed",
+                             timeout=2)
+            assert shed.attrs["outcome"] == "shed"
+
+            ta.join(timeout=10)
+            tb.join(timeout=10)
+            to = wait_span(tracer, "serve.request", "t-timeout")
+            assert to.attrs["outcome"] == "timeout"
+            to2 = wait_span(tracer, "serve.request", "t-timeout2")
+            assert to2.attrs["outcome"] == "timeout"
+
+            # drain: admission refused with a traced 503
+            code, body, _ = post(srv.url, {"budget": 5.0},
+                                 path="/v1/drain")
+            assert code == 200 and body["draining"], body
+            code, _, hdrs = post(srv.url,
+                                 {"prompt": [8], "max_tokens": 2},
+                                 headers={"X-Trace-Id": "t-drain"})
+            assert code == 503
+            assert hdrs.get("X-Trace-Id") == "t-drain"
+            dr = wait_span(tracer, "serve.request", "t-drain",
+                           timeout=2)
+            assert dr.attrs["outcome"] == "drained"
+
+
+class TestDebugTraceEndpoint:
+    def test_summary_slowest_recent_and_drilldown(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        tracer = get_tracer()
+        with ApiServer(eng, block_size=4) as srv:
+            code, out, _ = post(
+                srv.url, {"prompt": [1, 2, 3], "max_tokens": 4},
+                headers={"X-Trace-Id": "dbg-1"},
+            )
+            assert code == 200, out
+            wait_span(tracer, "serve.request", "dbg-1")
+
+            code, body = get(srv.url, "/v1/debug/trace")
+            assert code == 200
+            assert "serve.request" in body["summary"]
+            assert body["summary"]["serve.request"]["count"] >= 1
+            assert {"p50Ms", "p95Ms", "maxMs"} <= set(
+                body["summary"]["serve.request"]
+            )
+            assert body["recent"], "recent spans missing"
+            roots = body["slowest"]
+            assert roots and all(not s.get("parentId") for s in roots)
+
+            # drill-down by the id the X-Trace-Id header advertised
+            code, body = get(srv.url, "/v1/debug/trace?trace_id=dbg-1")
+            assert code == 200 and body["traceId"] == "dbg-1"
+            names = {s["name"] for s in body["spans"]}
+            assert {"serve.request", "serve.prefill"} <= names
+            # spans come back in start order
+            starts = [s["start"] for s in body["spans"]]
+            assert starts == sorted(starts)
+
+            code, _ = get(srv.url,
+                          "/v1/debug/trace?trace_id=nope-missing")
+            assert code == 404
+            code, _ = get(srv.url, "/v1/debug/trace?n=bogus")
+            assert code == 400
