@@ -35,8 +35,12 @@ native-test: native
 
 # ------------------------------------------------------------------ tests
 
+.PHONY: lint
+lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tolerance — any finding fails the build
+	$(PY) tools/slicelint.py
+
 .PHONY: test
-test:  ## Fast tier (~2 min): control plane, device, kube, topology — then the trace-check observability gate
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check observability gate
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 
@@ -60,7 +64,7 @@ test-e2e-kind:  ## Real-cluster e2e on KinD (skips cleanly without docker/kind)
 	./deploy/e2e_kind.sh
 
 .PHONY: chaos
-chaos:  ## Control-plane + serving chaos tiers across 3 seeds (hung tests dump all thread stacks via faulthandler before the outer timeout kills them)
+chaos:  ## Control-plane + serving chaos tiers across 3 seeds (hung tests dump all thread stacks via faulthandler before the outer timeout kills them). TPUSLICE_LOCKCHECK=1 arms the lock-order race detector: any ABBA cycle observed during the run fails the session (docs/STATIC_ANALYSIS.md)
 	@set -e; for seed in 1 2 3; do \
 	  echo "=== chaos seed $$seed ==="; \
 	  CHAOS_SEED=$$seed CHAOS_DURATION=$${CHAOS_DURATION:-8} \

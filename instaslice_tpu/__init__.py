@@ -29,20 +29,19 @@ Layer map (mirrors SURVEY.md §1, re-designed TPU-first):
 
 __version__ = "0.1.0"
 
-GROUP = "tpu.instaslice.dev"
-VERSION = "v1alpha1"
-API_VERSION = f"{GROUP}/{VERSION}"
-KIND = "TpuSlice"
-PLURAL = "tpuslices"
-
-# Scheduling gate + finalizer (reference: "org.instaslice/accelarator",
-# samples/test-pod.yaml:1-19 — typo deliberately not replicated).
-GATE_NAME = f"{GROUP}/accelerator"
-FINALIZER = f"{GROUP}/accelerator"
-
-# Per-pod extended resource prefix (reference: "org.instaslice/<podname>").
-POD_RESOURCE_PREFIX = f"{GROUP}/"
-
-# Extended resource advertised by the device plugin (reference:
-# "nvidia.com/mig-*" via the NVIDIA GPU operator).
-TPU_RESOURCE = "google.com/tpu"
+# The names themselves live in instaslice_tpu.api.constants — the one
+# module allowed to spell them as literals (enforced by tools/slicelint
+# rule ``name-literal``). Re-exported here for the established import
+# path (`from instaslice_tpu import GATE_NAME`).
+from instaslice_tpu.api.constants import (  # noqa: F401,E402
+    API_VERSION,
+    FINALIZER,
+    GATE_NAME,
+    GROUP,
+    KIND,
+    LEGACY_GATE_NAME,
+    PLURAL,
+    POD_RESOURCE_PREFIX,
+    TPU_RESOURCE,
+    VERSION,
+)

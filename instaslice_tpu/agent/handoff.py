@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Dict, List
 
+from instaslice_tpu.api.constants import POD_UID_LABEL
 from instaslice_tpu.api.types import AllocationDetails, PodRef
 from instaslice_tpu.topology.grid import Shape, get_generation
 from instaslice_tpu.topology.placement import Box
@@ -98,7 +99,7 @@ def configmap_manifest(
             "namespace": namespace,
             "labels": {
                 "app.kubernetes.io/managed-by": "instaslice-tpu",
-                "tpu.instaslice.dev/pod-uid": owner_pod_uid,
+                POD_UID_LABEL: owner_pod_uid,
             },
         },
         "data": dict(env),

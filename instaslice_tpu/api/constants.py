@@ -1,0 +1,77 @@
+"""Canonical home of every shared gate / finalizer / resource /
+annotation name — the ONLY module allowed to spell them as string
+literals.
+
+``tools/slicelint.py``'s ``name-literal`` rule enforces that: any other
+module writing ``"tpu.instaslice.dev/..."`` / ``"google.com/tpu..."`` /
+``"org.instaslice/..."`` inline fails ``make lint``. A name that exists
+in two places drifts in two places — the reference shipped its
+scheduling gate with a typo (``org.instaslice/accelarator``,
+``/root/reference/samples/test-pod.yaml``) and could never fix it
+because the literal was replicated across the controller, daemonset,
+webhook, and samples. Here the misspelling survives only as
+:data:`LEGACY_GATE_NAME`, honored for interop, and the spelling is
+corrected exactly once.
+
+This module is import-time pure (no package ``__init__`` dependencies):
+``instaslice_tpu/__init__.py`` re-exports from here, so everything below
+must stay standalone literals/f-strings.
+"""
+
+GROUP = "tpu.instaslice.dev"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "TpuSlice"
+PLURAL = "tpuslices"
+
+# --------------------------------------------------------------- gating
+
+#: Scheduling gate + finalizer (reference: ``org.instaslice/accelarator``
+#: — typo deliberately not replicated; see LEGACY_GATE_NAME).
+GATE_NAME = f"{GROUP}/accelerator"
+FINALIZER = f"{GROUP}/accelerator"
+
+#: The reference operator's gate, canonical misspelling included
+#: (``accelarator``). Pods gated by a reference-era webhook/mutator carry
+#: THIS name; the controller recognizes it on ungate so a migration
+#: doesn't strand them Pending forever.
+LEGACY_GATE_NAME = "org.instaslice/accelarator"
+
+# ------------------------------------------------------------ resources
+
+#: Per-pod extended resource prefix (reference: ``org.instaslice/<pod>``).
+POD_RESOURCE_PREFIX = f"{GROUP}/"
+
+#: Extended resource advertised by the whole-chip device plugin
+#: (reference: ``nvidia.com/mig-*`` via the NVIDIA GPU operator).
+TPU_RESOURCE = "google.com/tpu"
+
+#: Per-profile slice resources (``google.com/tpu-v5e-2x2``) advertised by
+#: the slice device-plugin manager and requested in pod limits.
+TPU_PROFILE_RESOURCE_PREFIX = f"{TPU_RESOURCE}-"
+
+# ---------------------------------------------------- pod annotations
+
+PROFILE_ANNOTATION = f"{GROUP}/profile"
+GROUP_ANNOTATION = f"{GROUP}/group"
+GROUP_SIZE_ANNOTATION = f"{GROUP}/group-size"
+HANDOFF_ANNOTATION = f"{GROUP}/handoff-name"
+UNHEALTHY_ANNOTATION = f"{GROUP}/slice-unhealthy"
+RESTART_ON_FAILURE_ANNOTATION = f"{GROUP}/restart-on-failure"
+ERROR_ANNOTATION = f"{GROUP}/error"
+
+#: Device-plugin allocate-response annotations (surfaced on the pod by
+#: the kubelet / the sim's kubelet emulator).
+CHIPS_ANNOTATION = f"{GROUP}/chips"
+SLICE_DEVICE_ANNOTATION = f"{GROUP}/slice-device"
+DEVICE_PATHS_ANNOTATION = f"{GROUP}/device-paths"
+KUBELET_ENV_CHIPS_ANNOTATION = f"{GROUP}/kubelet-env-chips"
+
+# ------------------------------------------------------- labels / leases
+
+#: Handoff ConfigMap owner label (garbage collection + discovery).
+POD_UID_LABEL = f"{GROUP}/pod-uid"
+
+#: Sub-second lease durations for the leader election (the integer
+#: ``spec.leaseDurationSeconds`` field truncates; see utils/election.py).
+LEASE_DURATION_MS_ANNOTATION = f"{GROUP}/lease-duration-ms"

@@ -9,7 +9,7 @@ from :mod:`instaslice_tpu.api.types`.
 
 from __future__ import annotations
 
-from instaslice_tpu import GROUP, KIND, PLURAL, VERSION
+from instaslice_tpu.api.constants import GROUP, KIND, PLURAL, VERSION
 
 _ALLOCATION_PROPS = {
     "allocId": {"type": "string"},

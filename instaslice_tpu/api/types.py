@@ -26,7 +26,7 @@ import enum
 import time
 from typing import Dict, List, Optional, Tuple
 
-from instaslice_tpu import API_VERSION, KIND
+from instaslice_tpu.api.constants import API_VERSION, KIND
 from instaslice_tpu.topology.grid import Coord, NodeGrid, Shape, get_generation
 from instaslice_tpu.topology.placement import Box, HostPart, Placement
 from instaslice_tpu.topology.profiles import TopologyProfile, parse_profile_name

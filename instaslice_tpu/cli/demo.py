@@ -77,7 +77,8 @@ def main(argv=None) -> int:
             return 1
         deadline = time.monotonic() + 30
         while c.allocations() and time.monotonic() < deadline:
-            time.sleep(0.05)
+            # CLI observer poll, deadline-bounded; ^C interrupts sleep
+            time.sleep(0.05)  # slicelint: disable=sleep-in-loop
         if c.allocations():
             say(f"FAILED: allocation not erased: {c.allocations()}")
             return 1

@@ -10,15 +10,17 @@ from __future__ import annotations
 import argparse
 import sys
 
+from instaslice_tpu.api.constants import TPU_RESOURCE
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpuslice-deviceplugin",
-        description="kubelet device plugin advertising google.com/tpu",
+        description=f"kubelet device plugin advertising {TPU_RESOURCE}",
     )
     p.add_argument("--plugin-dir", default="/var/lib/kubelet/device-plugins")
     p.add_argument("--backend", default="auto")
-    p.add_argument("--resource", default="google.com/tpu")
+    p.add_argument("--resource", default=TPU_RESOURCE)
     return p
 
 

@@ -15,24 +15,28 @@ from __future__ import annotations
 import re
 from typing import Optional, Tuple
 
-from instaslice_tpu import GATE_NAME, GROUP
+from instaslice_tpu import GATE_NAME, LEGACY_GATE_NAME
+# Annotation names live in api/constants.py (the one literal-bearing
+# module — slicelint's name-literal rule); re-exported here because this
+# module is their established import path for the control plane.
+# HANDOFF_ANNOTATION: stable handoff name for template-managed pods
+# (Deployment/Job pods get generated names; their template's envFrom +
+# per-pod resource limit need a fixed name — see samples/vllm-tpu.yaml).
+# UNHEALTHY/RESTART_ON_FAILURE: slice health (no reference analog —
+# SURVEY.md §5 gap). The agent stamps UNHEALTHY_ANNOTATION on a running
+# pod whose granted chips fail; pods opting in with
+# RESTART_ON_FAILURE_ANNOTATION="true" are deleted instead so their
+# managing controller respawns them onto a fresh slice.
+from instaslice_tpu.api.constants import (  # noqa: F401 (re-exports)
+    ERROR_ANNOTATION,
+    GROUP_ANNOTATION,
+    GROUP_SIZE_ANNOTATION,
+    HANDOFF_ANNOTATION,
+    PROFILE_ANNOTATION,
+    RESTART_ON_FAILURE_ANNOTATION,
+    UNHEALTHY_ANNOTATION,
+)
 from instaslice_tpu.topology.profiles import TopologyProfile, parse_profile_name
-
-PROFILE_ANNOTATION = f"{GROUP}/profile"
-GROUP_ANNOTATION = f"{GROUP}/group"
-GROUP_SIZE_ANNOTATION = f"{GROUP}/group-size"
-# Stable handoff name for template-managed pods (Deployment/Job pods get
-# generated names; their template's envFrom + per-pod resource limit need
-# a fixed name to reference — see samples/vllm-tpu.yaml).
-HANDOFF_ANNOTATION = f"{GROUP}/handoff-name"
-# Slice health (no reference analog — SURVEY.md §5 gap). The agent stamps
-# UNHEALTHY_ANNOTATION on a running pod whose granted chips fail; pods
-# opting in with RESTART_ON_FAILURE_ANNOTATION="true" are deleted instead
-# so their managing controller (Deployment/Job) respawns them onto a fresh
-# slice carved from healthy chips.
-UNHEALTHY_ANNOTATION = f"{GROUP}/slice-unhealthy"
-RESTART_ON_FAILURE_ANNOTATION = f"{GROUP}/restart-on-failure"
-ERROR_ANNOTATION = f"{GROUP}/error"
 
 _RESOURCE_RE = re.compile(r"tpu-(v\d+[a-z]*-\d+x\d+(?:x\d+)?)$")
 
@@ -45,7 +49,11 @@ def is_pod_gated(pod: dict) -> bool:
     if pod.get("metadata", {}).get("deletionTimestamp"):
         return False
     gates = pod.get("spec", {}).get("schedulingGates", []) or []
-    if not any(g.get("name") == GATE_NAME for g in gates):
+    # LEGACY_GATE_NAME: pods gated by a reference-era webhook carry the
+    # original (misspelled) org.instaslice gate; honoring it keeps a
+    # migration from stranding them Pending forever
+    if not any(g.get("name") in (GATE_NAME, LEGACY_GATE_NAME)
+               for g in gates):
         return False
     phase = pod.get("status", {}).get("phase", "Pending")
     return phase in ("", "Pending")

@@ -22,7 +22,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from instaslice_tpu import FINALIZER, GATE_NAME, KIND
+from instaslice_tpu import FINALIZER, GATE_NAME, KIND, LEGACY_GATE_NAME
 from instaslice_tpu.api import (
     AllocationDetails,
     AllocationStatus,
@@ -61,6 +61,7 @@ log = logging.getLogger("instaslice_tpu.controller")
 
 
 from instaslice_tpu.utils.timeutil import parse_timestamp as _parse_timestamp
+from instaslice_tpu.utils.lockcheck import named_lock
 
 
 class Controller:
@@ -87,7 +88,7 @@ class Controller:
         self.grace = deletion_grace_seconds
         self.no_capacity_requeue = no_capacity_requeue
         self.metrics = metrics
-        self._pending_lock = threading.Lock()
+        self._pending_lock = named_lock("controller.pending")
         self._pending: set = set()
         #: pod key → trace id minted on the pod's FIRST no-capacity
         #: attempt: every ~2s requeue re-probes under the SAME trace id
@@ -453,7 +454,7 @@ class Controller:
                 pod,
                 f"profile {profile.name} spans {want_hosts} host(s) but pod "
                 f"group has {len(pods)} pod(s); set "
-                f"tpu.instaslice.dev/group-size={want_hosts}",
+                f"{GROUP_SIZE_ANNOTATION}={want_hosts}",
             )
             return None
 
@@ -692,7 +693,12 @@ class Controller:
         for p in alloc.pods:
             def mut(pod: dict) -> Optional[dict]:
                 gates = pod.get("spec", {}).get("schedulingGates", []) or []
-                kept = [g for g in gates if g.get("name") != GATE_NAME]
+                # drop the legacy (reference-spelled) gate too: a pod
+                # admitted through is_pod_gated's interop path must not
+                # stay gated after its grant
+                kept = [g for g in gates
+                        if g.get("name") not in (GATE_NAME,
+                                                 LEGACY_GATE_NAME)]
                 if len(kept) == len(gates):
                     return None
                 pod["spec"]["schedulingGates"] = kept

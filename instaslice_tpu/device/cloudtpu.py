@@ -243,7 +243,9 @@ class CloudTpuBackend(DeviceBackend):
                     f"queued resource {slice_uuid} not ACTIVE within "
                     f"{self.provision_timeout}s (state={state or '?'})"
                 )
-            time.sleep(self.poll_interval)
+            # queued-resource provisioning poll: bounded by
+            # provision_timeout above; the cloud API offers no event
+            time.sleep(self.poll_interval)  # slicelint: disable=sleep-in-loop
 
     def release(self, slice_uuid: str) -> None:
         try:

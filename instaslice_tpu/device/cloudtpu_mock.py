@@ -23,6 +23,7 @@ from typing import Dict, Optional
 from urllib.parse import parse_qs, urlsplit
 
 from instaslice_tpu.device.cloudtpu import CHIPS_LABEL
+from instaslice_tpu.utils.lockcheck import named_lock
 
 _PATH = re.compile(
     r"^/projects/(?P<proj>[^/]+)/locations/(?P<zone>[^/]+)"
@@ -198,7 +199,7 @@ class CloudTpuMockServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  provision_polls: int = 1,
                  required_token: Optional[str] = None) -> None:
-        self.lock = threading.Lock()
+        self.lock = named_lock("device.cloudtpu_mock")
         self.resources: Dict[str, _QueuedResource] = {}
         self.provision_polls = provision_polls
         self.fail_next_creates = 0

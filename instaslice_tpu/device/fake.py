@@ -20,6 +20,7 @@ from instaslice_tpu.device.backend import (
     SliceNotFound,
 )
 from instaslice_tpu.topology.grid import Coord, get_generation
+from instaslice_tpu.utils.lockcheck import named_lock
 
 
 class FakeTpuBackend(DeviceBackend):
@@ -41,7 +42,7 @@ class FakeTpuBackend(DeviceBackend):
             torus_group=torus_group,
             source="fake",
         )
-        self._lock = threading.Lock()
+        self._lock = named_lock("device.fake")
         self._reservations: Dict[str, Tuple[int, ...]] = {}
         # failure injection: op name → remaining failures to inject
         self._fail: Dict[str, int] = {}

@@ -35,7 +35,12 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 import grpc
 
-from instaslice_tpu import GROUP
+from instaslice_tpu.api.constants import (
+    CHIPS_ANNOTATION,
+    SLICE_DEVICE_ANNOTATION,
+    TPU_PROFILE_RESOURCE_PREFIX,
+    TPU_RESOURCE,
+)
 from instaslice_tpu.device.backend import DeviceBackend, DeviceError
 from instaslice_tpu.deviceplugin import deviceplugin_pb2 as pb
 from instaslice_tpu.deviceplugin.wire import (
@@ -47,16 +52,15 @@ from instaslice_tpu.deviceplugin.wire import (
     device_plugin_handler,
 )
 from instaslice_tpu.topology.grid import Shape, get_generation, id_to_coord
+from instaslice_tpu.utils.lockcheck import named_condition, named_lock
 
 log = logging.getLogger("tpuslice.deviceplugin")
 
-DEFAULT_RESOURCE = "google.com/tpu"
+DEFAULT_RESOURCE = TPU_RESOURCE
 DEFAULT_PLUGIN_DIR = "/var/lib/kubelet/device-plugins"
 SOCKET_NAME = "tpuslice.sock"
 DEVICE_ID_PREFIX = "tpu-"
 SLICE_ID_PREFIX = "slice-"
-CHIPS_ANNOTATION = f"{GROUP}/chips"
-SLICE_DEVICE_ANNOTATION = f"{GROUP}/slice-device"
 
 
 def device_id(chip_id: int) -> str:
@@ -339,11 +343,15 @@ class TpuDevicePlugin:
         self.socket_name = socket_name
         self.health_poll_seconds = health_poll_seconds
         self.register_with_kubelet = register_with_kubelet
-        self.running = False
+        #: set on stop(): every retry/poll loop paces on .wait(timeout)
+        #: instead of time.sleep so shutdown interrupts the nap; also
+        #: the single source of truth behind the ``running`` property
+        self._stop_evt = threading.Event()
+        self._stop_evt.set()  # not running until start()
         self.registered_count = 0
         self.metrics_allocations = 0
         self._unhealthy: Set[int] = set()
-        self._health_cv = threading.Condition()
+        self._health_cv = named_condition("deviceplugin.health")
         self._server: Optional[grpc.Server] = None
         self._watch_thread: Optional[threading.Thread] = None
 
@@ -432,7 +440,7 @@ class TpuDevicePlugin:
             (device_plugin_handler(TpuDevicePluginServicer(self)),)
         )
         server.add_insecure_port(f"unix://{self.socket_path}")
-        self.running = True
+        self._stop_evt.clear()  # running = True
         server.start()
         self._server = server
         log.info(
@@ -473,7 +481,10 @@ class TpuDevicePlugin:
                 raise DeviceError(
                     f"kubelet not reachable at {self.kubelet_socket_path}"
                 )
-            time.sleep(0.2)
+            if self._stop_evt.wait(0.2):
+                raise DeviceError(
+                    "plugin stopped during kubelet registration"
+                )
 
     def _watch_kubelet(self) -> None:
         """Kubelet restart wipes the plugin dir: when our socket vanishes,
@@ -491,13 +502,26 @@ class TpuDevicePlugin:
                     return  # start() spawned a fresh watcher
                 except (DeviceError, OSError) as e:
                     log.error("re-registration failed (will retry): %s", e)
-                    time.sleep(self.health_poll_seconds)
+                    if self._stop_evt.wait(self.health_poll_seconds):
+                        return
                     continue
-            time.sleep(self.health_poll_seconds)
+            if self._stop_evt.wait(self.health_poll_seconds):
+                return
+
+    def wait_stopped(self, timeout: float) -> bool:
+        """Block until stop() (or ``timeout``); True once stopping."""
+        return self._stop_evt.wait(timeout)
+
+    @property
+    def running(self) -> bool:
+        """Derived from the stop event — one source of truth, so a
+        loop's pacing (.wait on the event) and its continue-condition
+        can never disagree."""
+        return not self._stop_evt.is_set()
 
     def stop(self, keep_running_flag: bool = False) -> None:
         if not keep_running_flag:
-            self.running = False
+            self._stop_evt.set()
         self.notify_health()  # unblock ListAndWatch streams
         if self._server is not None:
             self._server.stop(grace=1.0).wait()
@@ -528,7 +552,7 @@ class SlicePluginManager:
         self,
         backend: DeviceBackend,
         plugin_dir: str = DEFAULT_PLUGIN_DIR,
-        resource_prefix: str = "google.com/tpu-",
+        resource_prefix: str = TPU_PROFILE_RESOURCE_PREFIX,
         poll_seconds: float = 0.5,
         register_with_kubelet: bool = True,
     ) -> None:
@@ -541,7 +565,7 @@ class SlicePluginManager:
         self.generation = inv.generation
         self.host_bounds: Shape = get_generation(inv.generation).host_bounds
         self.plugins: Dict[str, TpuDevicePlugin] = {}   # profile → plugin
-        self._lock = threading.Lock()
+        self._lock = named_lock("deviceplugin.manager")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -627,7 +651,7 @@ def serve(args) -> int:
     plugin.start()
     try:
         while plugin.running:
-            time.sleep(1.0)
+            plugin.wait_stopped(1.0)
     except KeyboardInterrupt:
         pass
     finally:

@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from instaslice_tpu.kube.client import ApiError, KubeClient, WatchEvent
+from instaslice_tpu.utils.lockcheck import named_lock
 
 
 class FaultError(Exception):
@@ -79,7 +80,7 @@ class FaultPlan:
         self.sites: Dict[str, SiteSpec] = {}
         self.calls: Dict[str, int] = {}
         self.fired: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.plan")
 
     def site(self, name: str, probability: float = 0.0,
              kinds: Tuple[str, ...] = ("error",), at_calls=(),
@@ -268,7 +269,9 @@ class FaultyKubeClient(KubeClient):
                 if fault == "disconnect":
                     return  # stream cut mid-flight; consumer resumes
                 if fault == "delay":
-                    time.sleep(self.plan.sites["kube.watch"].delay_s)
+                    # the injected stall is the fault being modeled
+                    time.sleep(  # slicelint: disable=sleep-in-loop
+                        self.plan.sites["kube.watch"].delay_s)
                 yield ev
 
         return _faulty()

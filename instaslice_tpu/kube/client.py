@@ -175,5 +175,9 @@ def update_with_retry(
         except Conflict as e:
             last = e
             # Full jitter keeps N agents hammering one CR from lockstep.
-            time.sleep(random.uniform(0, 0.01 * (2**attempt)))
+            # full-jitter conflict backoff, <= ~80 ms total; a free
+            # function has no stop event and the nap is too short to
+            # stretch any shutdown
+            time.sleep(  # slicelint: disable=sleep-in-loop
+                random.uniform(0, 0.01 * (2**attempt)))
     raise last if last is not None else Conflict("update_with_retry exhausted")

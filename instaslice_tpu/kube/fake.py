@@ -25,6 +25,7 @@ from instaslice_tpu.kube.client import (
     NotFound,
     WatchEvent,
 )
+from instaslice_tpu.utils.lockcheck import named_rlock
 
 _Key = Tuple[str, str, str]  # (kind, namespace, name)
 
@@ -60,7 +61,7 @@ class FakeKube(KubeClient):
     HISTORY_MAX = 50_000
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = named_rlock("kube.fake_store")
         self._objects: Dict[_Key, dict] = {}
         self._rv = 0
         self._watchers: List[_Watcher] = []

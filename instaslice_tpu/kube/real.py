@@ -46,6 +46,7 @@ from instaslice_tpu.kube.client import (
     WatchEvent,
 )
 from instaslice_tpu.utils.trace import get_tracer
+from instaslice_tpu.utils.lockcheck import named_lock
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -189,7 +190,7 @@ class RealKubeClient(KubeClient):
         #: from_kubeconfig)
         self._temp_files: List[str] = []
         # circuit breaker: shared across this client's threads
-        self._breaker_lock = threading.Lock()
+        self._breaker_lock = named_lock("kube.breaker")
         self._consecutive_failures = 0
         self._breaker_open_until = 0.0
         if self.base_url.startswith("https"):

@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 import threading
 from typing import Optional
+from instaslice_tpu.utils.lockcheck import named_lock
 
 log = logging.getLogger("instaslice_tpu.metrics")
 
@@ -266,7 +267,7 @@ class ServingMetrics:
         )
 
 
-_server_started = threading.Lock()
+_server_started = named_lock("metrics.server_start")
 
 
 def start_metrics_server(metrics, port: int, host: str = "") -> bool:

@@ -55,6 +55,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.trace import (
     TRACE_ID_SAFE,
     get_tracer,
@@ -139,7 +140,7 @@ class _Pending:
         # flag_timeout), and the scheduler decides the metrics outcome +
         # sets done under the same lock — so a request can never be
         # 503'd AND counted ok
-        self.lock = threading.Lock()
+        self.lock = named_lock("serve.pending")
         self.server_fault = False     # engine-side failure (HTTP 500),
         #                               vs a client mistake (HTTP 400)
         self.t0 = time.monotonic()
@@ -199,7 +200,7 @@ class _Scheduler(threading.Thread):
         #: threads (one per request): without it, C concurrent
         #: submitters could all pass the check and overshoot by C-1.
         self.max_queue = max_queue
-        self._submit_lock = threading.Lock()
+        self._submit_lock = named_lock("serve.submit")
         self.drain_budget = drain_budget
         #: flipped by drain()/undrain(); while set, /readyz is 503, no
         #: admissions, queued requests shed, in-flight finish until the
@@ -472,6 +473,10 @@ class _Scheduler(threading.Thread):
                             p.error = "ValueError: no such prefix"
                     except Exception as e:
                         p.error = f"{type(e).__name__}: {e}"
+                        # surfaced to the client via p.error, but the
+                        # server log must show engine-side failures too
+                        log.warning("prefix %s failed: %s",
+                                    p.prefix_op, p.error)
                         # register_prefix prefills through donating jits
                         if eng.cache_poisoned():
                             p.server_fault = True
@@ -509,6 +514,11 @@ class _Scheduler(threading.Thread):
                     ).inc(dt_admit)
                 except Exception as e:
                     p.error = f"{type(e).__name__}: {e}"
+                    # client mistakes are the client's problem (400,
+                    # below); an engine-side admission failure must
+                    # also land in the server log, not just the 500
+                    if not isinstance(e, (ValueError, TypeError)):
+                        log.warning("admission failed: %s", p.error)
                     # ValueError/TypeError = the client's prompt was
                     # bad (too long, empty, unknown adapter) → 400 +
                     # outcome "rejected". ANYTHING else (device error,

@@ -242,7 +242,9 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
         except OSError:
             if time.monotonic() > deadline:
                 raise
-            time.sleep(0.2)
+            # driver not listening yet: deadline-bounded startup poll
+            # (no stop event exists before the stream is established)
+            time.sleep(0.2)  # slicelint: disable=sleep-in-loop
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.sendall((json.dumps({"hello": HELLO_MAGIC}) + "\n").encode())
     applied = 0

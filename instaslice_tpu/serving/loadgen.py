@@ -26,10 +26,12 @@ import statistics
 import sys
 import threading
 import time
+import http.client
 import urllib.error
 import urllib.request
 import uuid
 from typing import List, Optional
+from instaslice_tpu.utils.lockcheck import named_lock
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -127,8 +129,12 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
         # carry the server's error BODY, not just the status line —
         # "unknown adapter 'x' (serving: ...)" beats "400 Bad Request"
         try:
-            detail = json.loads(e.read().decode()).get("error", "")
-        except Exception:  # noqa: BLE001 - body unreadable/not ours
+            body = json.loads(e.read().decode())
+            # a proxy's error body can be valid JSON that is not an
+            # object — .get() on it would kill the worker thread
+            detail = body.get("error", "") if isinstance(body, dict) else ""
+        except (ValueError, OSError, http.client.HTTPException):
+            # body unreadable / truncated / not JSON
             detail = ""
         msg = f"HTTPError {e.code}: {detail or e.reason}"
         return time.monotonic() - t0, None, 0, msg, e.code
@@ -138,7 +144,7 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
         # produce (classified separately so runs can assert on it)
         return (time.monotonic() - t0, None, 0,
                 f"TimeoutError: {e or 'timed out'}", None)
-    except Exception as e:  # noqa: BLE001 - a benchmark client must
+    except Exception as e:  # slicelint: disable=broad-except
         # ACCOUNT for every failure (IncompleteRead from a dropped
         # body, JSONDecodeError from a proxy's HTML error page, …);
         # an uncaught exception would kill the worker thread silently
@@ -170,7 +176,7 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     outcomes = {k: 0 for k in OUTCOMES}
     status_counts: dict = {}
     tokens = [0]
-    lock = threading.Lock()
+    lock = named_lock("loadgen.results")
     it = iter(range(requests))
 
     def worker():

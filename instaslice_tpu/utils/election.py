@@ -16,6 +16,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from instaslice_tpu.api.constants import LEASE_DURATION_MS_ANNOTATION
 from instaslice_tpu.kube.client import (
     AlreadyExists,
     ApiError,
@@ -56,7 +57,7 @@ class LeaderElector:
     # instantly-expired to every elector (ownership ping-pong). The integer
     # field stays schema-valid (>= 1) for real API servers; electors prefer
     # the annotation when present.
-    DURATION_MS_ANNOTATION = "tpu.instaslice.dev/lease-duration-ms"
+    DURATION_MS_ANNOTATION = LEASE_DURATION_MS_ANNOTATION
 
     def _manifest(self, transitions: int) -> dict:
         return {

@@ -17,6 +17,7 @@ import threading
 import time
 import traceback
 from typing import Callable, Dict, List, Optional, Tuple
+from instaslice_tpu.utils.lockcheck import named_condition
 
 log = logging.getLogger("instaslice_tpu")
 
@@ -31,7 +32,7 @@ class WorkQueue:
     the earliest due time."""
 
     def __init__(self) -> None:
-        self._cond = threading.Condition()
+        self._cond = named_condition("reconcile.workqueue")
         self._due: Dict[str, float] = {}
         self._heap: List[Tuple[float, str]] = []
         self._closed = False
@@ -218,19 +219,19 @@ class Manager:
                 )
                 last_rv = None
                 force_replay = True
-                time.sleep(self.error_backoff)
+                self._stop.wait(self.error_backoff)
             except Exception:
                 log.warning(
                     "%s: watch %s failed:\n%s",
                     self.name, kind, traceback.format_exc(),
                 )
-                time.sleep(self.error_backoff)
+                self._stop.wait(self.error_backoff)
             else:
                 # a healthy stream lives for ~watch_timeout; one that dies
                 # instantly with nothing to say is a broken server or a
                 # stale-rv loop — pace it like an error, don't hammer
                 if events == 0 and time.monotonic() - started < 0.05:
-                    time.sleep(self.error_backoff)
+                    self._stop.wait(self.error_backoff)
             # watch ended (timeout/quiet) → re-establish; brief pause keeps
             # the fake-kube polling cheap
             self._stop.wait(0.02)
@@ -293,5 +294,7 @@ class Manager:
                     return True
             else:
                 quiet_since = None
-            time.sleep(0.02)
+            # observer poll (test helper): a stopped manager's queue is
+            # already empty, so settle expires promptly either way
+            time.sleep(0.02)  # slicelint: disable=sleep-in-loop
         return False

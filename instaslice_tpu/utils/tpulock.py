@@ -191,7 +191,9 @@ class TpuClaim:
                         "exit, or set JAX_PLATFORMS=cpu for off-chip "
                         "work"
                     ) from None
-                time.sleep(poll_interval)
+                # cross-process flock contention: no in-process event
+                # can signal another process's release; deadline-bounded
+                time.sleep(poll_interval)  # slicelint: disable=sleep-in-loop
         # holder note: best-effort, error messages only
         try:
             note = f"pid={os.getpid()} argv={' '.join(sys.argv[:4])}\n"

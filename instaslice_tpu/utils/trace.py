@@ -35,6 +35,7 @@ import time
 import uuid
 from collections import deque
 from typing import Dict, Iterator, List, Optional
+from instaslice_tpu.utils.lockcheck import named_lock
 
 #: the ONE accepted shape of an externally-supplied trace id — shared
 #: by the serving plane's X-Trace-Id sanitizer and the metrics layer's
@@ -101,7 +102,7 @@ class Tracer:
 
     def __init__(self, capacity: int = 4096,
                  trace_file: Optional[str] = None) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("trace.ring")
         self._spans: deque = deque(maxlen=capacity)
         self._counts: Dict[str, int] = {}
         self._file = None
@@ -110,7 +111,7 @@ class Tracer:
         # handle check AND the write both happen under it, so close()
         # can never yank the handle between them (and a write landing
         # after close is silently dropped, never an exception)
-        self._file_lock = threading.Lock()
+        self._file_lock = named_lock("trace.file")
         path = trace_file or os.environ.get("TPUSLICE_TRACE_FILE")
         if path:
             self._file = open(path, "a", buffering=1)
@@ -282,7 +283,7 @@ def summarize_durations(
 
 
 _default: Optional[Tracer] = None
-_default_lock = threading.Lock()
+_default_lock = named_lock("trace.default")
 
 
 def get_tracer() -> Tracer:

@@ -101,6 +101,38 @@ def pytest_unconfigure(config):
     faulthandler.cancel_dump_traceback_later()
 
 
+# ------------------------------------------------------------ lockcheck
+# With TPUSLICE_LOCKCHECK=1 every named lock records its per-thread
+# acquisition order (instaslice_tpu/utils/lockcheck.py); any ABBA cycle
+# observed anywhere in the session — even on a benign interleaving —
+# fails the run here. `make chaos` armed this way IS the race detector
+# (docs/STATIC_ANALYSIS.md). test_lockcheck.py's deliberate cycles are
+# reset by its own fixtures, so only cycles from REAL project locks
+# survive to this hook.
+
+
+def pytest_sessionfinish(session, exitstatus):
+    from instaslice_tpu.utils import lockcheck
+
+    if not lockcheck.armed():
+        return
+    rep = lockcheck.report()
+    print(
+        f"\nlockcheck: {len(rep['edges'])} order edge(s), "
+        f"{len(rep['cycles'])} cycle(s), "
+        f"{len(rep['longHolds'])} long hold(s)"
+    )
+    if rep["cycles"] or rep["longHolds"]:
+        import json
+
+        print(json.dumps(
+            {"cycles": rep["cycles"], "longHolds": rep["longHolds"]},
+            indent=2,
+        ))
+    if rep["cycles"]:
+        session.exitstatus = 3
+
+
 # --------------------------------------------------------------- helpers
 # Shared across process-spawning tests (promoted here so fixes reach all
 # copies — review finding r3).

@@ -1,0 +1,85 @@
+"""slicelint test fixture: one (or more) seeded violations per rule.
+
+NEVER imported — parsed by tests/test_slicelint.py, which asserts every
+rule below fires at the marked line. This directory is deliberately
+outside the ``make lint`` roots (instaslice_tpu + tools).
+"""
+
+import threading
+import time
+import urllib.request
+from threading import Lock as _AliasedLock
+from time import sleep as _aliased_sleep
+from urllib.request import urlopen as _aliased_urlopen
+
+
+def raw_http_violation(url):
+    req = urllib.request.Request(url)          # raw-http
+    return urllib.request.urlopen(req)         # raw-http
+
+
+def raw_http_via_from_import(url):
+    return _aliased_urlopen(url)               # raw-http (aliased)
+
+
+def name_literal_violation(pod):
+    ann = pod.get("annotations", {})
+    profile = ann.get("tpu.instaslice.dev/profile")      # name-literal
+    limit = pod.get("limits", {}).get("google.com/tpu")  # name-literal
+    gate = "org.instaslice/accelarator"                  # name-literal
+    return profile, limit, gate
+
+
+def broad_except_violation(fn):
+    try:
+        return fn()
+    except Exception:                          # broad-except
+        return None
+
+
+def bare_except_violation(fn):
+    try:
+        return fn()
+    except:  # noqa: E722                      # broad-except (bare)
+        return None
+
+
+def broad_except_nested_report_violation(fn, callbacks):
+    try:
+        return fn()
+    except Exception:                          # broad-except (log only
+        # inside a nested lambda — deferred, maybe never run — cannot
+        # satisfy the handler's report-or-reraise duty)
+        callbacks.append(lambda: print("later"))
+        return None
+
+
+def sleep_in_loop_violation(stop):
+    while not stop.is_set():
+        time.sleep(0.5)                        # sleep-in-loop
+
+
+def sleep_in_loop_via_from_import(stop):
+    while not stop.is_set():
+        _aliased_sleep(0.5)                    # sleep-in-loop (aliased)
+
+
+def span_leak_violation(tracer):
+    span = tracer.span("orphan")               # span-leak
+    return span
+
+
+def mutable_default_violation(items=[], index={}):   # mutable-default x2
+    items.append(1)
+    return items, index
+
+
+def raw_lock_violation():
+    lock = threading.Lock()                    # raw-lock
+    cond = threading.Condition()               # raw-lock
+    rlock = threading.RLock()                  # raw-lock
+    return lock, cond, rlock
+
+
+def raw_lock_via_from_import():
+    return _AliasedLock()                      # raw-lock (aliased)

@@ -3,7 +3,7 @@ the pieces with reference-bug history (SURVEY.md §7 quirks)."""
 
 import pytest
 
-from instaslice_tpu import GATE_NAME
+from instaslice_tpu import GATE_NAME, LEGACY_GATE_NAME
 from instaslice_tpu.agent.handoff import slice_env
 from instaslice_tpu.api import AllocationDetails, PodRef
 from instaslice_tpu.controller.gates import (
@@ -58,6 +58,14 @@ class TestGateDetection:
         p = gated_pod()
         p["metadata"]["deletionTimestamp"] = 123.0
         assert not is_pod_gated(p)
+
+    def test_legacy_reference_gate_admitted(self):
+        """Migration interop: a pod gated by a reference-era webhook
+        carries the original (misspelled) org.instaslice gate and must
+        still be picked up — otherwise a migration strands it Pending."""
+        p = gated_pod()
+        p["spec"]["schedulingGates"] = [{"name": LEGACY_GATE_NAME}]
+        assert is_pod_gated(p)
 
 
 class TestProfileExtraction:

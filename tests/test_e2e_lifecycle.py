@@ -8,7 +8,7 @@ import time
 
 import pytest
 
-from instaslice_tpu import GATE_NAME, POD_RESOURCE_PREFIX
+from instaslice_tpu import GATE_NAME, LEGACY_GATE_NAME, POD_RESOURCE_PREFIX
 from instaslice_tpu.sim import SimCluster
 
 
@@ -47,6 +47,22 @@ class TestGrantLifecycle:
         a = next(iter(allocs.values()))
         assert a["status"] == "ungated"
         assert a["profile"] == "v5e-2x2"
+
+    def test_legacy_gated_pod_granted_and_fully_ungated(self, cluster):
+        """Migration interop: a pod gated by a reference-era webhook
+        (the original misspelled ``org.instaslice/accelarator`` gate)
+        must be admitted, granted, and end up with BOTH gate spellings
+        removed — a surviving legacy gate would strand it Pending."""
+        manifest = cluster.pod_manifest("legacy", "v5e-2x2")
+        manifest["spec"]["schedulingGates"] = [
+            {"name": LEGACY_GATE_NAME},
+            {"name": GATE_NAME},
+        ]
+        cluster.kube.create("Pod", manifest)
+        assert cluster.wait_phase("legacy", "Running", timeout=10)
+        assert cluster.pod("legacy")["spec"].get("schedulingGates") == []
+        a = next(iter(cluster.allocations().values()))
+        assert a["status"] == "ungated"
 
     def test_configmap_env_handoff(self, cluster):
         cluster.submit("demo", "v5e-2x2")

@@ -1,0 +1,296 @@
+"""lockcheck: the runtime lock-order detector.
+
+The centerpiece is the deliberate ABBA deadlock: two threads acquiring
+two named locks in opposite orders on a *benign* interleaving (no actual
+deadlock occurs) — the detector must still report the cycle, because the
+hazard is the ordering, not the unlucky schedule. This is exactly what
+arming ``TPUSLICE_LOCKCHECK=1`` buys the chaos tier.
+"""
+
+import threading
+import time
+
+import pytest
+
+from instaslice_tpu.utils import lockcheck as lc
+
+
+@pytest.fixture(autouse=True)
+def armed_lockcheck():
+    """Arm + isolate per test; restore whatever the session had (under
+    ``make chaos`` with TPUSLICE_LOCKCHECK=1 the env arms the session —
+    these tests must not disarm it behind the chaos tier's back).
+
+    The session's pre-existing findings are stashed before the reset and
+    merged back after: in an armed full-suite run, a REAL project-lock
+    cycle recorded before this module must still reach the conftest
+    session gate — these tests' deliberate cycles are what gets
+    discarded, not the session's."""
+    was_armed = lc.armed()
+    stash = lc.snapshot()
+    lc.reset()
+    lc.arm()
+    yield
+    lc.reset()
+    lc.restore(stash)
+    # RESTORE, don't just conditionally disarm: TestDisarmed tests
+    # disarm in their bodies, and leaving the session disarmed would
+    # silently defeat the TPUSLICE_LOCKCHECK session gate for every
+    # test that runs after this module
+    if was_armed:
+        lc.arm()
+    else:
+        lc.disarm()
+
+
+def _run_threads(*fns, timeout=10.0):
+    threads = [threading.Thread(target=fn, daemon=True) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+        assert not t.is_alive(), "test thread wedged"
+
+
+class TestOrderGraph:
+    def test_abba_cycle_reported(self):
+        """Opposite-order acquisition across two threads: a reported
+        cycle A -> B -> A even though the interleaving never deadlocks
+        (the second thread backs off via a timed acquire)."""
+        a, b = lc.named_lock("fixture.A"), lc.named_lock("fixture.B")
+        ready = threading.Event()
+
+        def t1():
+            with a:
+                ready.set()
+                time.sleep(0.05)
+                with b:
+                    pass
+
+        def t2():
+            ready.wait(5)
+            with b:
+                time.sleep(0.1)
+                if a.acquire(timeout=0.02):   # backs off: no deadlock
+                    a.release()
+
+        _run_threads(t1, t2)
+        rep = lc.report()
+        assert rep["cycles"], rep
+        chain = rep["cycles"][0]["chain"]
+        assert chain[0] == chain[-1]
+        assert set(chain) == {"fixture.A", "fixture.B"}
+        assert len(rep["cycles"][0]["threads"]) == 2
+        with pytest.raises(lc.LockOrderError) as ei:
+            lc.assert_clean()
+        assert ei.value.report["cycles"]
+
+    def test_consistent_order_is_clean(self):
+        a, b = lc.named_lock("fixture.A"), lc.named_lock("fixture.B")
+
+        def worker():
+            for _ in range(5):
+                with a:
+                    with b:
+                        pass
+
+        _run_threads(worker, worker)
+        rep = lc.report()
+        assert not rep["cycles"], rep
+        assert {
+            (e["held"], e["acquired"]) for e in rep["edges"]
+        } == {("fixture.A", "fixture.B")}
+        lc.assert_clean()
+
+    def test_three_lock_cycle(self):
+        """Cycles longer than two: A->B, B->C, C->A."""
+        locks = {n: lc.named_lock(f"fixture.{n}") for n in "ABC"}
+
+        def pair(first, second):
+            with locks[first]:
+                got = locks[second].acquire(timeout=0.01)
+                if got:
+                    locks[second].release()
+
+        # sequential, single thread: ordering edges are recorded from
+        # the acquisition pattern alone
+        pair("A", "B")
+        pair("B", "C")
+        pair("C", "A")
+        rep = lc.report()
+        assert rep["cycles"], rep
+        assert len(rep["cycles"][0]["chain"]) == 4  # closed A..A
+
+    def test_rlock_reentry_records_no_edge(self):
+        r = lc.named_rlock("fixture.R")
+        with r:
+            with r:
+                pass
+        rep = lc.report()
+        assert rep["edges"] == []
+        assert rep["cycles"] == []
+
+    def test_self_deadlock_on_plain_lock_reported(self):
+        lock = lc.named_lock("fixture.self")
+        assert lock.acquire()
+        assert not lock.acquire(timeout=0.01)
+        lock.release()
+        rep = lc.report()
+        assert {"chain": ["fixture.self", "fixture.self"],
+                "threads": [threading.current_thread().name]} in rep["cycles"]
+
+
+class TestConditionSemantics:
+    def test_wait_suspends_the_held_entry(self):
+        """While a thread waits on a condition, the lock is RELEASED;
+        locks acquired by other threads meanwhile must not fabricate an
+        ordering edge cv -> other."""
+        cv = lc.named_condition("fixture.cv")
+        other = lc.named_lock("fixture.other")
+        woke = threading.Event()
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                woke.set()
+
+        def toucher():
+            time.sleep(0.05)
+            with other:
+                pass
+            with cv:
+                cv.notify_all()
+
+        _run_threads(waiter, toucher)
+        assert woke.is_set()
+        edges = {
+            (e["held"], e["acquired"]) for e in lc.report()["edges"]
+        }
+        assert ("fixture.cv", "fixture.other") not in edges
+
+    def test_explicit_acquire_release_instrumented(self):
+        cv = lc.named_condition("fixture.cv2")
+        inner = lc.named_lock("fixture.inner")
+        cv.acquire()
+        with inner:
+            pass
+        cv.release()
+        edges = {
+            (e["held"], e["acquired"]) for e in lc.report()["edges"]
+        }
+        assert ("fixture.cv2", "fixture.inner") in edges
+
+    def test_notify_wakes_waiter(self):
+        cv = lc.named_condition("fixture.cv3")
+        got = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                got.append(1)
+
+        def notifier():
+            time.sleep(0.05)
+            with cv:
+                cv.notify_all()
+
+        _run_threads(waiter, notifier)
+        assert got == [1]
+
+
+class TestHoldTimes:
+    def test_holds_recorded(self):
+        lock = lc.named_lock("fixture.hold")
+        with lock:
+            time.sleep(0.02)
+        holds = lc.report()["holds"]["fixture.hold"]
+        assert holds["count"] == 1
+        assert holds["maxSeconds"] >= 0.02
+        assert holds["totalSeconds"] >= 0.02
+
+    def test_long_hold_incident(self, monkeypatch):
+        monkeypatch.setattr(lc, "HOLD_WARN_SECONDS", 0.01)
+        lock = lc.named_lock("fixture.slow")
+        with lock:
+            time.sleep(0.03)
+        incidents = lc.report()["longHolds"]
+        assert any(i["name"] == "fixture.slow" for i in incidents)
+
+
+class TestSnapshotRestore:
+    def test_session_cycles_survive_a_reset_cycle(self):
+        """What the autouse fixture does on behalf of an armed session:
+        real findings stashed before reset() come back via restore()."""
+        a, b = lc.named_lock("fixture.SA"), lc.named_lock("fixture.SB")
+        with a:
+            with b:
+                pass
+        with b:
+            if a.acquire(timeout=0.01):
+                a.release()
+        assert lc.report()["cycles"]
+        stash = lc.snapshot()
+        lc.reset()
+        assert not lc.report()["cycles"]
+        # an unrelated edge recorded between reset and restore survives
+        with a:
+            with b:
+                pass
+        lc.restore(stash)
+        rep = lc.report()
+        assert any(
+            set(c["chain"]) == {"fixture.SA", "fixture.SB"}
+            for c in rep["cycles"]
+        )
+        merged = {
+            (e["held"], e["acquired"]): e["count"] for e in rep["edges"]
+        }
+        assert merged[("fixture.SA", "fixture.SB")] == 2  # 1 + restored 1
+
+
+class TestDisarmed:
+    def test_disarmed_records_nothing(self):
+        lc.disarm()
+        a, b = lc.named_lock("fixture.A"), lc.named_lock("fixture.B")
+        with a:
+            with b:
+                pass
+        with b:
+            if a.acquire(timeout=0.01):
+                a.release()
+        rep = lc.report()
+        assert rep["edges"] == [] and rep["cycles"] == []
+        assert rep["holds"] == {}
+
+    def test_disarm_mid_hold_leaves_no_stale_entry(self):
+        """Disarming between an acquire and its release must still pop
+        the per-thread held entry — a leftover would fabricate a
+        self-deadlock ``N -> N`` on the next armed acquire of the same
+        lock, plus phantom ordering edges from a lock not actually
+        held."""
+        lock = lc.named_lock("fixture.midhold")
+        other = lc.named_lock("fixture.midhold-other")
+        lock.acquire()          # armed: entry pushed
+        lc.disarm()
+        lock.release()          # disarmed: entry must STILL pop
+        lc.arm()
+        with lock:              # no false self-deadlock
+            with other:         # no edge beyond the real one
+                pass
+        rep = lc.report()
+        assert rep["cycles"] == []
+        assert {
+            (e["held"], e["acquired"]) for e in rep["edges"]
+        } == {("fixture.midhold", "fixture.midhold-other")}
+
+    def test_factory_semantics_survive_disarm(self):
+        lc.disarm()
+        lock = lc.named_lock("fixture.sem")
+        assert lock.acquire()
+        assert not lock.acquire(timeout=0.01)   # plain-lock semantics
+        lock.release()
+        assert not lock.locked()
+        r = lc.named_rlock("fixture.rsem")
+        with r:
+            with r:
+                assert r.locked()

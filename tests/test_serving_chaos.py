@@ -300,6 +300,44 @@ class TestServingChaos:
             })
             assert code == 200, out
 
+    def test_shutdown_latency(self, model):
+        """Every serving/control loop paces on a stop event, never a
+        bare time.sleep — so teardown returns within a small bound
+        instead of waiting out somebody's nap. Guards the slicelint
+        ``sleep-in-loop`` conversions at the behavioral level."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        srv = ApiServer(eng, block_size=4, request_timeout=30).start()
+        code, out, _ = post(srv.url, {"prompt": [1, 2, 3],
+                                      "max_tokens": 2})
+        assert code == 200, out
+        t0 = time.monotonic()
+        srv.stop()
+        dt_srv = time.monotonic() - t0
+        assert dt_srv < 3.0, (
+            f"ApiServer.stop() took {dt_srv:.2f}s — a loop is pacing "
+            "on time.sleep instead of the stop event"
+        )
+
+        from instaslice_tpu.sim import SimCluster
+
+        sim = SimCluster(n_nodes=1, generation="v5e",
+                         deletion_grace_seconds=0.1,
+                         health_interval=0.1).start()
+        try:
+            sim.submit("shutdown-latency-pod", "v5e-1x1")
+            assert sim.wait_phase("shutdown-latency-pod", "Running",
+                                  timeout=20)
+        finally:
+            t0 = time.monotonic()
+            sim.stop()
+            dt_sim = time.monotonic() - t0
+        assert dt_sim < 3.0, (
+            f"SimCluster.stop() took {dt_sim:.2f}s — a reconcile/agent "
+            "loop is pacing on time.sleep instead of the stop event"
+        )
+
     def test_bounded_queue_sheds_with_429(self, model):
         """Past the admission bound, requests get an immediate 429 +
         Retry-After instead of queueing into a timeout."""

@@ -1,0 +1,151 @@
+"""slicelint: the seeded-violation fixtures must flag, the clean fixture
+must pass, suppressions must hold, and — the actual gate — the repo
+itself must be clean (this test IS ``make lint`` inside the fast tier).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(REPO, "tests", "lint_fixtures")
+SLICELINT = os.path.join(REPO, "tools", "slicelint.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import slicelint  # noqa: E402
+
+
+def lint(name):
+    return slicelint.lint_file(os.path.join(FIXDIR, name))
+
+
+class TestSeededViolations:
+    @pytest.fixture(scope="class")
+    def findings(self):
+        return lint("seeded_violations.py")
+
+    def test_every_rule_fires(self, findings):
+        fired = {f.rule for f in findings}
+        assert fired == set(slicelint.RULES), (
+            f"rules that never fired on the seeded fixture: "
+            f"{set(slicelint.RULES) - fired}"
+        )
+
+    def test_expected_counts(self, findings):
+        by_rule = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        assert by_rule == {
+            "raw-http": 3,        # incl. the from-import alias
+            "name-literal": 3,
+            "broad-except": 3,    # incl. report only in a nested lambda
+            "sleep-in-loop": 2,   # incl. the from-import alias
+            "span-leak": 1,
+            "mutable-default": 2,
+            "raw-lock": 4,        # incl. the from-import alias
+        }, by_rule
+
+    def test_findings_carry_location(self, findings):
+        for f in findings:
+            assert f.path.endswith("seeded_violations.py")
+            assert f.line > 0 and f.col > 0
+            assert f.rule in str(f) and f.path in str(f)
+
+
+class TestCleanAndSuppressed:
+    def test_clean_module_passes(self):
+        assert lint("clean_module.py") == []
+
+    def test_suppressions_honored(self):
+        assert lint("suppressed.py") == []
+
+    def test_suppression_is_per_rule(self, tmp_path):
+        # a disable for one rule must not blanket-suppress another on
+        # the same line
+        p = tmp_path / "one.py"
+        p.write_text(
+            "import threading\n"
+            "x = threading.Lock()  # slicelint: disable=broad-except\n"
+        )
+        found = slicelint.lint_file(str(p))
+        assert [f.rule for f in found] == ["raw-lock"]
+
+    def test_docstring_names_not_flagged(self, tmp_path):
+        p = tmp_path / "doc.py"
+        p.write_text('"""mentions tpu.instaslice.dev/profile in prose"""\n')
+        assert slicelint.lint_file(str(p)) == []
+
+    def test_span_leak_scoped_to_tracer_receivers(self, tmp_path):
+        # re.Match.span() (any non-tracer receiver) is not a tracer span;
+        # every tracer-shaped receiver must still be policed
+        p = tmp_path / "spans.py"
+        p.write_text(
+            "def f(m, tracer, get_tracer, self):\n"
+            "    ok = m.span()\n"
+            "    bad1 = tracer.span('x')\n"
+            "    bad2 = get_tracer().span('x')\n"
+            "    bad3 = self.tracer.span('x')\n"
+        )
+        found = slicelint.lint_file(str(p))
+        assert [f.rule for f in found] == ["span-leak"] * 3
+        assert [f.line for f in found] == [3, 4, 5]
+
+    def test_broad_except_ignores_nested_defs(self, tmp_path):
+        # a raise inside a nested def runs later (if ever) — it cannot
+        # discharge the handler's report-or-reraise duty; a direct
+        # log call still does
+        p = tmp_path / "nested.py"
+        p.write_text(
+            "def f(fn, cbs, log):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception:\n"
+            "        def later():\n"
+            "            raise\n"
+            "        cbs.append(later)\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception:\n"
+            "        log.exception('ctx')\n"
+        )
+        found = slicelint.lint_file(str(p))
+        assert [(f.rule, f.line) for f in found] == [("broad-except", 4)]
+
+
+class TestRepoGate:
+    def test_repo_is_clean(self):
+        findings = slicelint.lint_paths([
+            os.path.join(REPO, "instaslice_tpu"),
+            os.path.join(REPO, "tools"),
+        ])
+        assert findings == [], "\n" + "\n".join(str(f) for f in findings)
+
+
+class TestCli:
+    def test_exit_nonzero_on_fixture(self):
+        proc = subprocess.run(
+            [sys.executable, SLICELINT,
+             os.path.join(FIXDIR, "seeded_violations.py")],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "raw-lock" in proc.stdout
+
+    def test_exit_zero_on_clean(self):
+        proc = subprocess.run(
+            [sys.executable, SLICELINT,
+             os.path.join(FIXDIR, "clean_module.py")],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_list_rules(self):
+        proc = subprocess.run(
+            [sys.executable, SLICELINT, "--list-rules"],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0
+        for rule in slicelint.RULES:
+            assert rule in proc.stdout

@@ -22,6 +22,7 @@ the ready-to-paste table.
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import os
 import signal
@@ -42,8 +43,11 @@ def _wait_healthy(url: str, proc: subprocess.Popen,
         try:
             urllib.request.urlopen(f"{url}/healthz", timeout=2)
             return
-        except Exception:
-            time.sleep(1.0)
+        except (OSError, http.client.HTTPException):
+            # server not accepting yet, or it crashed mid-reply
+            # (BadStatusLine/IncompleteRead are not OSError): deadline-
+            # bounded startup poll of a child process
+            time.sleep(1.0)  # slicelint: disable=sleep-in-loop
     raise RuntimeError(f"server not healthy within {timeout:.0f}s")
 
 
