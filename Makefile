@@ -40,15 +40,23 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check observability gate
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
+	$(MAKE) events-check
 
 .PHONY: trace-check
 trace-check:  ## Observability gate: drive the sim + a short loadgen with TPUSLICE_TRACE_FILE set, then validate the JSONL (unparseable lines, negative durations, orphan spans, broken trace propagation)
 	@f=$$(mktemp -u /tmp/tpuslice-trace-check.XXXXXX.jsonl); \
 	  echo "trace-check: $$f"; \
 	  JAX_PLATFORMS=cpu $(PY) tools/validate_trace.py --drive $$f \
+	    && rm -f $$f
+
+.PHONY: events-check
+events-check:  ## Flight-recorder gate: drive the sim (one clean grant + one injected-fault retry) and a serving drain cycle with TPUSLICE_EVENT_FILE set, then validate the journal (ordered transition chains, trace-id links, reason catalog, describe-pod rendering)
+	@f=$$(mktemp -u /tmp/tpuslice-events-check.XXXXXX.jsonl); \
+	  echo "events-check: $$f"; \
+	  JAX_PLATFORMS=cpu $(PY) tools/validate_events.py --drive $$f \
 	    && rm -f $$f
 
 .PHONY: test-all

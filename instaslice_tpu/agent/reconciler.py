@@ -19,6 +19,14 @@ import time
 from typing import Dict, List, Optional
 
 from instaslice_tpu import POD_RESOURCE_PREFIX
+from instaslice_tpu.api.constants import (
+    REASON_CHIP_HEALED,
+    REASON_CHIP_UNHEALTHY,
+    REASON_REALIZED,
+    REASON_REALIZE_FAILED,
+    REASON_TORN_DOWN,
+)
+from instaslice_tpu.obs.journal import emit_pod_event, get_journal
 from instaslice_tpu.agent.discovery import discover_node
 from instaslice_tpu.agent.handoff import configmap_manifest, slice_env
 from instaslice_tpu.api import (
@@ -174,6 +182,15 @@ class NodeAgent:
                      self.node_name, suid)
         except DeviceError as e:
             log.warning("%s: reserve %s failed: %s", self.node_name, suid, e)
+            for pod in alloc.pods_on_node(self.node_name):
+                emit_pod_event(
+                    self.client, pod.namespace, pod.pod_name,
+                    reason=REASON_REALIZE_FAILED,
+                    message=f"{self.node_name}: chip reservation failed: {e}",
+                    component=f"agent-{self.node_name}",
+                    pod_uid=pod.pod_uuid, trace_id=alloc.trace_id,
+                    event_type="Warning",
+                )
             self._mark_failed(alloc.alloc_id, f"{self.node_name}: {e}")
             if self.metrics:
                 self.metrics.device_errors.inc()
@@ -240,6 +257,15 @@ class NodeAgent:
             "%s: realized %s (%s chips %s)",
             self.node_name, alloc.alloc_id, alloc.profile, chip_ids,
         )
+        for pod in alloc.pods_on_node(self.node_name):
+            emit_pod_event(
+                self.client, pod.namespace, pod.pod_name,
+                reason=REASON_REALIZED,
+                message=(f"{self.node_name}: realized {alloc.profile} "
+                         f"(chips {chip_ids})"),
+                component=f"agent-{self.node_name}",
+                pod_uid=pod.pod_uuid, trace_id=alloc.trace_id,
+            )
 
     def _mark_failed(
         self,
@@ -316,6 +342,13 @@ class NodeAgent:
             self.client, "TpuSlice", self.namespace, self.node_name, mut
         )
         log.info("%s: tore down %s", self.node_name, alloc.alloc_id)
+        get_journal().emit(
+            f"agent-{self.node_name}",
+            reason=REASON_TORN_DOWN,
+            object_ref=f"alloc/{alloc.alloc_id}",
+            message=f"released {suid} on {self.node_name}",
+            trace_id=alloc.trace_id,
+        )
 
     # -------------------------------------------------------------- health
 
@@ -357,6 +390,14 @@ class NodeAgent:
                 )
             except NotFound:
                 return self.health_interval
+            get_journal().emit(
+                f"agent-{self.node_name}",
+                reason=(REASON_CHIP_UNHEALTHY if failed
+                        else REASON_CHIP_HEALED),
+                object_ref=f"node/{self.node_name}",
+                message=(f"chips {failed} unhealthy" if failed
+                         else "all chips healthy again"),
+            )
         if not failed:
             return self.health_interval
 

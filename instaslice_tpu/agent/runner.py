@@ -16,9 +16,11 @@ from instaslice_tpu.agent.reconciler import NodeAgent
 from instaslice_tpu.device.backend import DeviceBackend
 from instaslice_tpu.kube.client import KubeClient
 from instaslice_tpu.metrics.metrics import (
+    EventMetrics,
     OperatorMetrics,
     start_metrics_server,
 )
+from instaslice_tpu.obs import journal as obs_journal
 from instaslice_tpu.utils.probes import ProbeServer
 
 log = logging.getLogger("instaslice_tpu.agent.runner")
@@ -46,6 +48,11 @@ class AgentRunner:
         health_probe_bind_address: str = ":8085",
     ) -> None:
         self.metrics = OperatorMetrics()
+        # the journal's event counters ride this process's /metrics
+        # registry (tpuslice_events_total — docs/OBSERVABILITY.md);
+        # detached again in run()'s shutdown path
+        self._event_metrics = EventMetrics(registry=self.metrics.registry)
+        obs_journal.attach_metrics(self._event_metrics)
         self.metrics_host, self.metrics_port = _split_bind(
             metrics_bind_address
         )
@@ -106,4 +113,5 @@ class AgentRunner:
             self.agent.stop()
             if self.probes:
                 self.probes.stop()
+            obs_journal.detach_metrics(self._event_metrics)
         return 0

@@ -67,6 +67,78 @@ SLICE_DEVICE_ANNOTATION = f"{GROUP}/slice-device"
 DEVICE_PATHS_ANNOTATION = f"{GROUP}/device-paths"
 KUBELET_ENV_CHIPS_ANNOTATION = f"{GROUP}/kubelet-env-chips"
 
+#: The allocation's grant trace id, mirrored onto the Kubernetes Event
+#: objects the flight recorder posts — `kubectl get events -o yaml` links
+#: straight into the trace tooling (docs/OBSERVABILITY.md).
+TRACE_ID_ANNOTATION = f"{GROUP}/trace-id"
+
+# --------------------------------------------------------------- events
+
+#: Flight-recorder ``reason`` catalog (docs/OBSERVABILITY.md). Every
+#: journal event and every mirrored Kubernetes ``Event`` names its reason
+#: from HERE — slicelint's ``event-reason-literal`` rule fails any other
+#: module passing a string literal as a ``reason=``, so the catalog (and
+#: the dashboards / validators keyed on it) cannot drift.
+
+# allocation lifecycle transitions (AllocationDetails.set_status)
+REASON_SLICE_CREATING = "SliceCreating"
+REASON_SLICE_CREATED = "SliceCreated"
+REASON_SLICE_UNGATED = "SliceUngated"
+REASON_SLICE_FAILED = "SliceFailed"
+REASON_SLICE_DELETED = "SliceDeleted"
+
+# controller decisions (pod-scoped; mirrored as Kubernetes Events)
+REASON_ADMITTED = "Admitted"
+REASON_PLACED = "Placed"
+REASON_NO_CAPACITY = "NoCapacity"
+REASON_REJECTED = "Rejected"
+REASON_RETRYING = "Retrying"
+REASON_UNGATED = "Ungated"
+REASON_DEGRADED = "SliceDegraded"
+REASON_HEALED = "SliceHealed"
+REASON_HEALTH_EVICTED = "HealthEvicted"
+
+# node agent / device plane
+REASON_REALIZED = "SliceRealized"
+REASON_REALIZE_FAILED = "SliceRealizeFailed"
+REASON_TORN_DOWN = "SliceTornDown"
+REASON_CHIP_UNHEALTHY = "ChipUnhealthy"
+REASON_CHIP_HEALED = "ChipHealed"
+
+# kube transport
+REASON_BREAKER_OPEN = "KubeBreakerOpen"
+REASON_BACKOFF = "KubeBackoff"
+REASON_WATCH_RECONNECT = "KubeWatchReconnect"
+
+# serving data plane
+REASON_DRAIN_BEGIN = "DrainBegin"
+REASON_DRAIN_END = "DrainEnd"
+REASON_SHED = "RequestShed"
+REASON_DRAINED = "RequestDrained"
+
+#: AllocationStatus value → the journal reason its transition records.
+TRANSITION_REASONS = {
+    "creating": REASON_SLICE_CREATING,
+    "created": REASON_SLICE_CREATED,
+    "ungated": REASON_SLICE_UNGATED,
+    "failed": REASON_SLICE_FAILED,
+    "deleted": REASON_SLICE_DELETED,
+}
+
+#: Every reason the journal accepts without a drift warning — the
+#: doc-drift test asserts each appears in docs/OBSERVABILITY.md.
+EVENT_REASONS = frozenset({
+    REASON_SLICE_CREATING, REASON_SLICE_CREATED, REASON_SLICE_UNGATED,
+    REASON_SLICE_FAILED, REASON_SLICE_DELETED,
+    REASON_ADMITTED, REASON_PLACED, REASON_NO_CAPACITY, REASON_REJECTED,
+    REASON_RETRYING, REASON_UNGATED, REASON_DEGRADED, REASON_HEALED,
+    REASON_HEALTH_EVICTED,
+    REASON_REALIZED, REASON_REALIZE_FAILED, REASON_TORN_DOWN,
+    REASON_CHIP_UNHEALTHY, REASON_CHIP_HEALED,
+    REASON_BREAKER_OPEN, REASON_BACKOFF, REASON_WATCH_RECONNECT,
+    REASON_DRAIN_BEGIN, REASON_DRAIN_END, REASON_SHED, REASON_DRAINED,
+})
+
 # ------------------------------------------------------- labels / leases
 
 #: Handoff ConfigMap owner label (garbage collection + discovery).

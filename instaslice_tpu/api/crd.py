@@ -54,6 +54,21 @@ _ALLOCATION_PROPS = {
     # the field on write and silently break end-to-end trace
     # propagation (docs/OBSERVABILITY.md)
     "traceId": {"type": "string"},
+    # flight recorder: the persisted audit trail — last N status
+    # transitions with timestamps + messages (same pruning hazard as
+    # traceId; docs/OBSERVABILITY.md "Events & audit trail")
+    "transitions": {
+        "type": "array",
+        "items": {
+            "type": "object",
+            "properties": {
+                "status": {"type": "string"},
+                "ts": {"type": "number"},
+                "message": {"type": "string"},
+            },
+            "required": ["status", "ts"],
+        },
+    },
 }
 
 _PREPARED_PART_PROPS = {

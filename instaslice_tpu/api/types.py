@@ -26,7 +26,11 @@ import enum
 import time
 from typing import Dict, List, Optional, Tuple
 
-from instaslice_tpu.api.constants import API_VERSION, KIND
+from instaslice_tpu.api.constants import (
+    API_VERSION,
+    KIND,
+    TRANSITION_REASONS,
+)
 from instaslice_tpu.topology.grid import Coord, NodeGrid, Shape, get_generation
 from instaslice_tpu.topology.placement import Box, HostPart, Placement
 from instaslice_tpu.topology.profiles import TopologyProfile, parse_profile_name
@@ -69,6 +73,12 @@ _TRANSITIONS = {
     },
     AllocationStatus.DELETED: set(),
 }
+
+
+#: Audit-trail bound: the CR keeps the last N status transitions (a full
+#: grant lifecycle is ~5; retries add a few more). Bounded so a
+#: crash-looping allocation cannot grow its CR without limit.
+AUDIT_TRAIL_MAX = 10
 
 
 def check_transition(old: AllocationStatus, new: AllocationStatus) -> None:
@@ -147,6 +157,11 @@ class AllocationDetails:
     # emit for this allocation carries it, so one grant is queryable
     # end-to-end (utils/trace.py; docs/OBSERVABILITY.md)
     trace_id: str = ""
+    # audit trail: the last AUDIT_TRAIL_MAX status transitions, each
+    # {"status", "ts", "message"} — persisted through to_dict/from_dict
+    # so "why did this allocation end up here" survives controller
+    # restarts (recorded by set_status, the transition choke point)
+    transitions: List[dict] = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -165,6 +180,8 @@ class AllocationDetails:
             "createdAt": self.created_at,
             "deletionRequestedAt": self.deletion_requested_at,
             **({"traceId": self.trace_id} if self.trace_id else {}),
+            **({"transitions": [dict(t) for t in self.transitions]}
+               if self.transitions else {}),
         }
 
     @staticmethod
@@ -185,16 +202,45 @@ class AllocationDetails:
             created_at=float(d.get("createdAt", 0.0)),
             deletion_requested_at=float(d.get("deletionRequestedAt", 0.0)),
             trace_id=d.get("traceId", ""),
+            transitions=[dict(t) for t in d.get("transitions", [])],
         )
 
     def global_box(self) -> Box:
         return Box.from_key(self.box)
 
     def set_status(self, new: AllocationStatus, message: str = "") -> None:
+        """THE allocation state-transition choke point: validates the
+        edge, then records it on the persisted audit trail and in the
+        process flight recorder (obs/journal.py) with the grant's
+        trace id — one call, three observability surfaces."""
         check_transition(self.status, new)
+        old = self.status
         self.status = new
         if message:
             self.message = message
+        if new != old:
+            self._record_transition(new, message)
+
+    def _record_transition(self, status: AllocationStatus,
+                           message: str) -> None:
+        from instaslice_tpu.obs.journal import get_journal
+
+        ev = get_journal().emit(
+            "allocation",
+            reason=TRANSITION_REASONS[status.value],
+            object_ref=f"alloc/{self.alloc_id}",
+            message=message,
+            trace_id=self.trace_id,
+            status=status.value,
+        )
+        # the trail entry shares the journal event's timestamp, so the
+        # describe-pod timeline dedupes the two surfaces exactly
+        self.transitions.append({
+            "status": status.value,
+            "ts": round(ev.ts, 6),
+            "message": message,
+        })
+        del self.transitions[:-AUDIT_TRAIL_MAX]
 
     def node_for_worker(self, worker_id: int) -> Optional[str]:
         for n, (wid, _) in self.parts.items():
@@ -236,7 +282,7 @@ class AllocationDetails:
     ) -> "AllocationDetails":
         if not pods:
             raise ValueError("allocation needs at least one pod")
-        return AllocationDetails(
+        alloc = AllocationDetails(
             alloc_id=alloc_id or pods[0].pod_uuid,
             pods=list(pods),
             profile=placement.profile.name,
@@ -250,6 +296,13 @@ class AllocationDetails:
             created_at=time.time() if now is None else now,
             trace_id=trace_id,
         )
+        # seed the audit trail: a freshly placed allocation IS the
+        # creating transition (set_status only sees later edges)
+        alloc._record_transition(
+            AllocationStatus.CREATING,
+            f"{placement.profile.name} at {placement.box.key()}",
+        )
+        return alloc
 
 
 @dataclasses.dataclass

@@ -1,10 +1,15 @@
-"""``tpuslice`` operator CLI: inspect catalogs, simulate placement, demo."""
+"""``tpuslice`` operator CLI: inspect catalogs, simulate placement, demo,
+and read the observability planes (traces, flight-recorder events, the
+per-pod decision timeline)."""
 
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
+import os
 import sys
+import threading
 
 
 def _serve_bench(args) -> int:
@@ -128,6 +133,314 @@ def _trace_summary(p, args) -> int:
     return 0
 
 
+def _parse_jsonl_line(line: str):
+    """One parsed JSONL record, or None for blank/malformed lines — a
+    live, half-written tail must never crash a reader. The ONE
+    malformed-line policy for every JSONL consumer in this CLI."""
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def _read_jsonl(path: str) -> list:
+    """Parsed records from a JSONL file ([] when absent)."""
+    out = []
+    if not path or not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            rec = _parse_jsonl_line(line)
+            if rec is not None:
+                out.append(rec)
+    return out
+
+
+def _event_matches(rec: dict, args) -> bool:
+    if args.reason and rec.get("reason") != args.reason:
+        return False
+    if args.object and rec.get("objectRef") != args.object:
+        return False
+    if args.trace and rec.get("traceId") != args.trace:
+        return False
+    if args.component and rec.get("component") != args.component:
+        return False
+    return True
+
+
+def _events_cmd(p, args) -> int:
+    """``events``: the flight recorder, two sources — an offline
+    ``TPUSLICE_EVENT_FILE`` JSONL dump, or a live component's
+    ``GET /v1/debug/events`` (serving plane or operator probe plane).
+    One JSON line per event; ``--follow`` tails the source."""
+    if bool(args.file) == bool(args.url):
+        p.error("events needs a JSONL file OR --url (not both)")
+    pacer = threading.Event()  # interruptible nap (Ctrl-C ends follow)
+
+    if args.url:
+        import urllib.parse
+        import urllib.request
+
+        base = args.url.rstrip("/") + "/v1/debug/events"
+        since = 0
+        first = True
+        while True:
+            # -n bounds only the FIRST (historical) batch, like file
+            # mode; follow-up polls fetch everything past since_seq so
+            # a burst bigger than n is never silently dropped
+            query = {"n": str((args.last or 10000) if first else 100000)}
+            first = False
+            if args.reason:
+                query["reason"] = args.reason
+            if args.object:
+                query["object"] = args.object
+            if args.trace:
+                query["trace_id"] = args.trace
+            if args.component:
+                query["component"] = args.component
+            if since:
+                query["since_seq"] = str(since)
+            url = base + "?" + urllib.parse.urlencode(query)
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    out = json.loads(r.read().decode())
+            except Exception as e:  # noqa: BLE001 - CLI: message, not trace
+                print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+                return 1
+            for rec in out.get("events", []):
+                print(json.dumps(rec))
+                since = max(since, int(rec.get("seq", 0)))
+            if not args.follow:
+                return 0
+            pacer.wait(1.0)
+
+    if not os.path.exists(args.file):
+        # match URL mode's clean one-line failure, not a traceback
+        print(json.dumps({"error": f"no such file: {args.file}"}))
+        return 1
+    with open(args.file) as f:
+        # historical batch first (honoring -n like the other modes),
+        # then — under --follow — tail from the current offset
+        recs = [rec for rec in map(_parse_jsonl_line, f)
+                if rec is not None and _event_matches(rec, args)]
+        for rec in recs[-args.last:] if args.last else recs:
+            print(json.dumps(rec), flush=True)
+        if not args.follow:
+            return 0
+        while True:
+            line = f.readline()
+            if not line:
+                pacer.wait(0.25)
+                continue
+            rec = _parse_jsonl_line(line)
+            if rec is not None and _event_matches(rec, args):
+                print(json.dumps(rec), flush=True)
+
+
+def describe_pod(client, name: str, namespace: str = "default",
+                 operator_namespace: str = "instaslice-tpu-system",
+                 events_path: str = "", trace_path: str = "") -> dict:
+    """Stitch one pod's control-plane history into a single timeline:
+    the Kubernetes Events mirrored onto it, the allocation's persisted
+    audit trail (CR ``transitions``), the journal JSONL (optional), and
+    the grant trace's spans (optional). The data behind ``tpuslice
+    describe pod`` — factored for tools/validate_events.py and tests."""
+    from instaslice_tpu import KIND
+    from instaslice_tpu.api.constants import (
+        ERROR_ANNOTATION,
+        TRANSITION_REASONS,
+        UNHEALTHY_ANNOTATION,
+    )
+    from instaslice_tpu.api.types import TpuSlice
+    from instaslice_tpu.kube.client import ApiError
+    from instaslice_tpu.utils.timeutil import parse_timestamp
+
+    info: dict = {
+        "pod": name, "namespace": namespace, "phase": "Gone",
+        "gated": False, "gates": [], "error": "", "unhealthy": "",
+        "allocation": None, "traceId": "", "timeline": [],
+    }
+    try:
+        pod = client.get("Pod", namespace, name)
+    except ApiError:
+        pod = None
+    if pod is not None:
+        md = pod.get("metadata", {})
+        ann = md.get("annotations") or {}
+        gates = [g.get("name", "")
+                 for g in pod.get("spec", {}).get("schedulingGates") or []]
+        info.update(
+            phase=pod.get("status", {}).get("phase", ""),
+            gated=bool(gates), gates=gates,
+            error=ann.get(ERROR_ANNOTATION, ""),
+            unhealthy=ann.get(UNHEALTHY_ANNOTATION, ""),
+        )
+
+    timeline: list = []
+    alloc_ref = ""
+    trace_id = ""
+    seen_transitions: set = set()
+    try:
+        crs = client.list(KIND, namespace=operator_namespace)
+    except ApiError:
+        crs = []
+    for m in crs:
+        ts_obj = TpuSlice.from_manifest(m)
+        for a in ts_obj.spec.allocations.values():
+            if not any(p.pod_name == name and p.namespace == namespace
+                       for p in a.pods):
+                continue
+            if info["allocation"] is None:
+                info["allocation"] = {
+                    "id": a.alloc_id, "profile": a.profile,
+                    "box": a.box, "status": a.status.value,
+                    "nodes": sorted(a.parts), "realizedOn": [],
+                }
+            al = info["allocation"]
+            al["realizedOn"] = sorted(
+                set(al["realizedOn"]) | set(a.realized_on)
+            )
+            trace_id = trace_id or a.trace_id
+            alloc_ref = f"alloc/{a.alloc_id}"
+            # audit trail union across holder CRs: each holder of a
+            # multi-host allocation runs the same transition sequence
+            # but stamps its OWN timestamps, so the dedup key is the
+            # trail position + content, never the clock
+            for i, t in enumerate(a.transitions):
+                key = (i, t.get("status"), t.get("message"))
+                if key in seen_transitions:
+                    continue
+                seen_transitions.add(key)
+                timeline.append({
+                    "ts": float(t.get("ts", 0.0)), "source": "audit",
+                    "reason": TRANSITION_REASONS.get(
+                        t.get("status", ""), t.get("status", "")
+                    ),
+                    "message": t.get("message", ""),
+                })
+    info["traceId"] = trace_id
+
+    try:
+        kube_events = client.list("Event", namespace=namespace)
+    except ApiError:
+        kube_events = []
+    for ev in kube_events:
+        io = ev.get("involvedObject") or {}
+        if io.get("kind", "Pod") != "Pod":
+            continue  # a Deployment/Service sharing the name is not us
+        if io.get("name") != name:
+            continue
+        if io.get("namespace", namespace) != namespace:
+            continue
+        timeline.append({
+            "ts": parse_timestamp(
+                ev.get("lastTimestamp") or ev.get("firstTimestamp")
+            ),
+            "source": "event",
+            "reason": ev.get("reason", ""),
+            "message": ev.get("message", ""),
+        })
+
+    want_refs = {f"Pod/{namespace}/{name}"}
+    if alloc_ref:
+        want_refs.add(alloc_ref)
+    for rec in _read_jsonl(events_path):
+        if rec.get("objectRef") in want_refs or (
+            trace_id and rec.get("traceId") == trace_id
+        ):
+            comp = rec.get("component", "")
+            msg = rec.get("message", "")
+            timeline.append({
+                "ts": float(rec.get("ts", 0.0)), "source": "journal",
+                "reason": rec.get("reason", ""),
+                "message": f"[{comp}] {msg}".strip() if comp else msg,
+                "_key": (rec.get("reason", ""), msg),
+            })
+
+    if trace_id:
+        for rec in _read_jsonl(trace_path):
+            if rec.get("traceId") != trace_id:
+                continue
+            msg = f"{rec.get('durationMs', 0):.3f}ms"
+            if rec.get("error"):
+                msg += f" error={rec['error']}"
+            timeline.append({
+                "ts": float(rec.get("start", 0.0)), "source": "span",
+                "reason": rec.get("name", ""), "message": msg,
+                # spans are never decision mirrors: repeats (decode
+                # rounds, retried reconciles) are distinct entries
+                "_key": ("span", rec.get("name", ""),
+                         round(float(rec.get("start", 0.0)), 6)),
+            })
+
+    timeline.sort(key=lambda t: (t["ts"], t["source"]))
+    # cross-source dedup: one DECISION lands on up to three surfaces
+    # (journal + mirrored kube Event; transition journal + audit trail)
+    # — and a multi-host allocation re-records it once per holder with
+    # per-holder clocks. So the key is the decision's CONTENT (reason +
+    # message), never a timestamp; the first source in (ts, source)
+    # order wins. Journal-only events (kube transport, erased retry
+    # epochs) have no twin and survive untouched.
+    seen_keys: set = set()
+    deduped = []
+    for t in timeline:
+        key = t.pop("_key", None) or (t["reason"], t["message"])
+        if key in seen_keys:
+            continue
+        seen_keys.add(key)
+        deduped.append(t)
+    info["timeline"] = deduped
+    return info
+
+
+def render_describe(info: dict) -> str:
+    """Human rendering of :func:`describe_pod` — the "why is my pod
+    still gated?" answer (README walkthrough)."""
+    lines = [
+        f"Pod {info['namespace']}/{info['pod']}  "
+        f"phase={info['phase'] or '?'}  "
+        f"gated={'yes (' + ','.join(info['gates']) + ')' if info['gated'] else 'no'}"
+    ]
+    if info["error"]:
+        lines.append(f"  error annotation: {info['error']}")
+    if info["unhealthy"]:
+        lines.append(f"  degraded: {info['unhealthy']}")
+    al = info["allocation"]
+    if al is not None:
+        lines.append(
+            f"Allocation {al['id']}  profile={al['profile']}  "
+            f"box={al['box']}  status={al['status']}  "
+            f"realized={len(al['realizedOn'])}/{len(al['nodes'])} "
+            f"nodes={','.join(al['nodes'])}"
+        )
+    elif info["gated"] and not info["error"]:
+        lines.append(
+            "No allocation yet — the pod is waiting for the controller "
+            "(look for NoCapacity/Rejected entries below)"
+        )
+    if info["traceId"]:
+        lines.append(f"Trace {info['traceId']}  "
+                     "(tpuslice trace-summary --trace <id> drills in)")
+    lines.append(f"Timeline ({len(info['timeline'])} entries):")
+    for t in info["timeline"]:
+        when = "?" * 13  # matches the HH:MM:SS.mmmZ column width
+        if t["ts"]:
+            when = (
+                datetime.datetime.fromtimestamp(
+                    t["ts"], datetime.timezone.utc
+                ).strftime("%H:%M:%S.%f")[:-3] + "Z"
+            )
+        lines.append(
+            f"  {when:>13}  {t['source']:<7}  {t['reason']:<20}  "
+            f"{t['message']}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="tpuslice", description="instaslice_tpu operator CLI"
@@ -164,6 +477,50 @@ def main(argv=None) -> int:
     tr.add_argument("--slowest", type=int, default=0, metavar="N",
                     help="also print the N slowest trace roots "
                          "(name, traceId, durationMs)")
+
+    ev = sub.add_parser(
+        "events",
+        help="flight-recorder events from a TPUSLICE_EVENT_FILE JSONL "
+        "or a live component's GET /v1/debug/events (one JSON line per "
+        "event; --follow tails)",
+    )
+    ev.add_argument("file", nargs="?", default="",
+                    help="event JSONL path (or use --url)")
+    ev.add_argument("--url", default="",
+                    help="live base url — a tpuslice-serve server or a "
+                         "controller/agent health-probe address")
+    ev.add_argument("--reason", default="",
+                    help="only this reason (docs/OBSERVABILITY.md "
+                         "catalog)")
+    ev.add_argument("--object", default="",
+                    help="only this objectRef (e.g. Pod/default/demo)")
+    ev.add_argument("--trace", default="", metavar="TRACE_ID",
+                    help="only events linked to this trace")
+    ev.add_argument("--component", default="",
+                    help="only this emitting component")
+    ev.add_argument("-n", type=int, default=0, dest="last", metavar="N",
+                    help="only the last N matching events")
+    ev.add_argument("--follow", action="store_true",
+                    help="keep tailing the source (Ctrl-C to stop)")
+
+    de = sub.add_parser(
+        "describe",
+        help="one object's merged control-plane timeline: Kubernetes "
+        "Events + CR audit trail + journal + trace spans — the 'why is "
+        "my pod still gated?' answer",
+    )
+    de.add_argument("kind", choices=["pod"])
+    de.add_argument("name")
+    de.add_argument("--namespace", default="default")
+    de.add_argument("--operator-namespace",
+                    default="instaslice-tpu-system",
+                    help="namespace holding the TpuSlice CRs")
+    de.add_argument("--kubeconfig", default="")
+    de.add_argument("--events-file", default="",
+                    help="TPUSLICE_EVENT_FILE JSONL to merge in")
+    de.add_argument("--trace-file", default="",
+                    help="TPUSLICE_TRACE_FILE JSONL to merge in")
+    de.add_argument("--json", action="store_true", dest="as_json")
 
     st = sub.add_parser(
         "status",
@@ -302,6 +659,27 @@ def main(argv=None) -> int:
 
     if args.cmd == "trace-summary":
         return _trace_summary(p, args)
+
+    if args.cmd == "events":
+        try:
+            return _events_cmd(p, args)
+        except KeyboardInterrupt:
+            return 0  # --follow's advertised stop path, not a crash
+
+    if args.cmd == "describe":
+        from instaslice_tpu.kube.real import build_client
+
+        client = build_client(args.kubeconfig)
+        info = describe_pod(
+            client, args.name, namespace=args.namespace,
+            operator_namespace=args.operator_namespace,
+            events_path=args.events_file, trace_path=args.trace_file,
+        )
+        if args.as_json:
+            print(json.dumps(info))
+        else:
+            print(render_describe(info))
+        return 0
 
     if args.cmd == "catalog":
         from instaslice_tpu.topology import profile_catalog

@@ -23,6 +23,18 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from instaslice_tpu import FINALIZER, GATE_NAME, KIND, LEGACY_GATE_NAME
+from instaslice_tpu.api.constants import (
+    REASON_ADMITTED,
+    REASON_DEGRADED,
+    REASON_HEALED,
+    REASON_HEALTH_EVICTED,
+    REASON_NO_CAPACITY,
+    REASON_PLACED,
+    REASON_REJECTED,
+    REASON_RETRYING,
+    REASON_UNGATED,
+)
+from instaslice_tpu.obs.journal import emit_pod_event
 from instaslice_tpu.api import (
     AllocationDetails,
     AllocationStatus,
@@ -345,6 +357,15 @@ class Controller:
                     "allocation %s failed (%s); tearing down for retry",
                     alloc.alloc_id, alloc.message,
                 )
+                for ref in alloc.pods:
+                    emit_pod_event(
+                        self.client, ref.namespace, ref.pod_name,
+                        reason=REASON_RETRYING,
+                        message=(f"allocation failed: {alloc.message}; "
+                                 "tearing down for retry"),
+                        component="controller", pod_uid=ref.pod_uuid,
+                        trace_id=alloc.trace_id, event_type="Warning",
+                    )
                 # only the node(s) whose OWN CR copy reports FAILED are
                 # at fault — a healthy peer of a multi-host allocation
                 # must stay placeable or the retry can be squeezed back
@@ -471,6 +492,18 @@ class Controller:
         with self._pending_lock:
             pending_tid = self._pending_trace.get(pod_key)
         trace_id = pending_tid or new_trace_id()
+        if pending_tid is None:
+            # first attempt for this pod (capacity-starved requeues
+            # re-enter with the pending trace id and stay silent):
+            # admission into the allocation pipeline is THE "gated"
+            # stage of the grant's event chain (make events-check)
+            emit_pod_event(
+                self.client, md.get("namespace", ""), md["name"],
+                reason=REASON_ADMITTED,
+                message=f"admitted: profile {profile.name}",
+                component="controller", pod_uid=pod_uid,
+                trace_id=trace_id,
+            )
         with self.tracer.span(
             "controller.allocate", trace_id=trace_id,
             pod=pod_key, profile=profile.name,
@@ -484,6 +517,19 @@ class Controller:
             if placement is None:
                 sp.attrs["placed"] = "false"
                 sp.drop = pending_tid is not None
+                if pending_tid is None:
+                    # first no-capacity verdict only: the ~2s requeues
+                    # would otherwise flood the journal and the pod's
+                    # kubectl-describe event list
+                    emit_pod_event(
+                        self.client, md.get("namespace", ""), md["name"],
+                        reason=REASON_NO_CAPACITY,
+                        message=(f"no {profile.name} capacity; waiting "
+                                 f"(re-probing every "
+                                 f"{self.no_capacity_requeue:g}s)"),
+                        component="controller", pod_uid=pod_uid,
+                        trace_id=trace_id, event_type="Warning",
+                    )
                 with self._pending_lock:
                     self._pending_trace[pod_key] = trace_id
                 self._set_pending(pod_key, True)
@@ -514,6 +560,16 @@ class Controller:
             for p in pods:
                 self._ensure_finalizer(p)
             self._write_allocation(alloc)
+            for ref in pod_refs:
+                emit_pod_event(
+                    self.client, ref.namespace, ref.pod_name,
+                    reason=REASON_PLACED,
+                    message=(f"placed {alloc.profile} at {alloc.box} "
+                             f"across {sorted(alloc.parts)} "
+                             f"(worker {ref.worker_id})"),
+                    component="controller", pod_uid=ref.pod_uuid,
+                    trace_id=trace_id,
+                )
         if self.metrics:
             self.metrics.allocations.labels(status="creating").inc()
         log.info(
@@ -723,6 +779,18 @@ class Controller:
         transitioned = self._for_each_holder(alloc, mutate)
         for p in alloc.pods:
             self._set_pending(f"{p.namespace}/{p.pod_name}", False)
+        if transitioned:
+            # only when the CREATED→UNGATED edge actually landed: the
+            # crash-recovery re-run must not duplicate the grant event
+            for p in alloc.pods:
+                emit_pod_event(
+                    self.client, p.namespace, p.pod_name,
+                    reason=REASON_UNGATED,
+                    message=(f"slice granted: scheduling gate removed "
+                             f"({alloc.profile} at {alloc.box})"),
+                    component="controller", pod_uid=p.pod_uuid,
+                    trace_id=alloc.trace_id,
+                )
         # observe only when the CREATED→UNGATED transition actually landed
         # in a CR: the crash-recovery path (_maybe_finish_ungate) re-runs
         # _ungate_all, and keying on the stale in-memory status would
@@ -817,11 +885,25 @@ class Controller:
                             "annotations": {UNHEALTHY_ANNOTATION: None}
                         }},
                     )
+                    emit_pod_event(
+                        self.client, p.namespace, p.pod_name,
+                        reason=REASON_HEALED,
+                        message="granted chips healthy again",
+                        component="controller", pod_uid=p.pod_uuid,
+                        trace_id=alloc.trace_id,
+                    )
                 continue
             if ann.get(RESTART_ON_FAILURE_ANNOTATION) == "true":
                 log.warning(
                     "evicting pod %s/%s: %s (restart-on-failure)",
                     p.namespace, p.pod_name, message,
+                )
+                emit_pod_event(
+                    self.client, p.namespace, p.pod_name,
+                    reason=REASON_HEALTH_EVICTED,
+                    message=f"evicting (restart-on-failure): {message}",
+                    component="controller", pod_uid=p.pod_uuid,
+                    trace_id=alloc.trace_id, event_type="Warning",
                 )
                 try:
                     self.client.delete("Pod", p.namespace, p.pod_name)
@@ -835,6 +917,13 @@ class Controller:
                     {"metadata": {
                         "annotations": {UNHEALTHY_ANNOTATION: message}
                     }},
+                )
+                emit_pod_event(
+                    self.client, p.namespace, p.pod_name,
+                    reason=REASON_DEGRADED,
+                    message=f"granted slice degraded: {message}",
+                    component="controller", pod_uid=p.pod_uuid,
+                    trace_id=alloc.trace_id, event_type="Warning",
                 )
 
     # ------------------------------------------------------------ deletion
@@ -943,4 +1032,13 @@ class Controller:
                 },
             )
         except NotFound:
-            pass
+            return
+        # emit only AFTER the annotation patch landed: the annotation is
+        # this event's dedup marker, so a failed patch must not leave a
+        # Rejected event behind to be re-emitted every ~2s reconcile
+        emit_pod_event(
+            self.client, md.get("namespace", ""), md["name"],
+            reason=REASON_REJECTED, message=message[:512],
+            component="controller", pod_uid=md.get("uid", ""),
+            event_type="Warning",
+        )

@@ -16,9 +16,11 @@ from typing import Optional
 from instaslice_tpu.controller.reconciler import Controller
 from instaslice_tpu.kube.client import KubeClient
 from instaslice_tpu.metrics.metrics import (
+    EventMetrics,
     OperatorMetrics,
     start_metrics_server,
 )
+from instaslice_tpu.obs import journal as obs_journal
 from instaslice_tpu.utils.election import LeaderElector
 from instaslice_tpu.utils.probes import ProbeServer
 
@@ -55,6 +57,11 @@ class ControllerRunner:
         self.leader_elect = leader_elect
         self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
         self.metrics = OperatorMetrics()
+        # the journal's event counters ride this process's /metrics
+        # registry (tpuslice_events_total — docs/OBSERVABILITY.md);
+        # detached again in run()'s shutdown path
+        self._event_metrics = EventMetrics(registry=self.metrics.registry)
+        obs_journal.attach_metrics(self._event_metrics)
         self.metrics_host, self.metrics_port = _split_bind(
             metrics_bind_address
         )
@@ -143,4 +150,5 @@ class ControllerRunner:
                 self.elector.release()
             if self.probes:
                 self.probes.stop()
+            obs_journal.detach_metrics(self._event_metrics)
         return 0

@@ -37,10 +37,13 @@ import grpc
 
 from instaslice_tpu.api.constants import (
     CHIPS_ANNOTATION,
+    REASON_CHIP_HEALED,
+    REASON_CHIP_UNHEALTHY,
     SLICE_DEVICE_ANNOTATION,
     TPU_PROFILE_RESOURCE_PREFIX,
     TPU_RESOURCE,
 )
+from instaslice_tpu.obs.journal import get_journal
 from instaslice_tpu.device.backend import DeviceBackend, DeviceError
 from instaslice_tpu.deviceplugin import deviceplugin_pb2 as pb
 from instaslice_tpu.deviceplugin.wire import (
@@ -403,11 +406,24 @@ class TpuDevicePlugin:
 
     def set_chip_health(self, chip_id: int, healthy: bool) -> None:
         with self._health_cv:
+            flipped = healthy == (chip_id in self._unhealthy)
             if healthy:
                 self._unhealthy.discard(chip_id)
             else:
                 self._unhealthy.add(chip_id)
             self._health_cv.notify_all()
+        if flipped:
+            # journal outside the condition: emission must not add a
+            # health-cv → journal-ring lock-order edge
+            get_journal().emit(
+                "deviceplugin",
+                reason=(REASON_CHIP_HEALED if healthy
+                        else REASON_CHIP_UNHEALTHY),
+                object_ref=f"chip/{chip_id}",
+                message=(f"chip {chip_id} "
+                         f"{'healthy' if healthy else 'unhealthy'} "
+                         f"({self.resource_name})"),
+            )
 
     def wait_health_event(self, timeout: float) -> None:
         with self._health_cv:

@@ -35,6 +35,12 @@ import urllib.request
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from instaslice_tpu import GROUP, KIND, PLURAL, VERSION
+from instaslice_tpu.api.constants import (
+    REASON_BACKOFF,
+    REASON_BREAKER_OPEN,
+    REASON_WATCH_RECONNECT,
+)
+from instaslice_tpu.obs.journal import get_journal
 from instaslice_tpu.kube.client import (
     AlreadyExists,
     ApiError,
@@ -86,6 +92,9 @@ _KIND_INFO: Dict[str, Tuple[str, str, bool]] = {
     "Pod": ("api/v1", "pods", True),
     "Node": ("api/v1", "nodes", False),
     "ConfigMap": ("api/v1", "configmaps", True),
+    # flight-recorder mirroring (obs/journal.emit_pod_event): pod-scoped
+    # decisions become `kubectl describe pod` events
+    "Event": ("api/v1", "events", True),
     "Namespace": ("api/v1", "namespaces", False),
     "Lease": ("apis/coordination.k8s.io/v1", "leases", True),
     KIND: (f"apis/{GROUP}/{VERSION}", PLURAL, True),
@@ -407,6 +416,7 @@ class RealKubeClient(KubeClient):
                 )
 
     def _breaker_fail(self) -> None:
+        opened = False
         with self._breaker_lock:
             self._consecutive_failures += 1
             if self._consecutive_failures >= self.breaker_threshold:
@@ -416,14 +426,26 @@ class RealKubeClient(KubeClient):
                 # leave the count one short of the threshold: a failed
                 # half-open probe re-opens immediately, a success resets
                 self._consecutive_failures = self.breaker_threshold - 1
-                log.warning(
-                    "kube circuit breaker OPEN for %.1fs (%s)",
-                    self.breaker_cooldown, self.base_url,
-                )
-                get_tracer().record(
-                    "kube.breaker_open", 0.0,
-                    cooldown=self.breaker_cooldown, server=self.base_url,
-                )
+                opened = True
+        if opened:
+            # report outside the breaker lock: the span ring and the
+            # journal ring must not order-couple to it
+            log.warning(
+                "kube circuit breaker OPEN for %.1fs (%s)",
+                self.breaker_cooldown, self.base_url,
+            )
+            get_tracer().record(
+                "kube.breaker_open", 0.0,
+                cooldown=self.breaker_cooldown, server=self.base_url,
+            )
+            get_journal().emit(
+                "kube", reason=REASON_BREAKER_OPEN,
+                object_ref=self.base_url,
+                message=(f"circuit breaker open for "
+                         f"{self.breaker_cooldown:.1f}s after "
+                         f"{self.breaker_threshold} consecutive "
+                         "transient failures"),
+            )
 
     def _breaker_ok(self) -> None:
         with self._breaker_lock:
@@ -450,6 +472,12 @@ class RealKubeClient(KubeClient):
                     random.uniform(self.backoff_base, prev * 3))
         if retry_after is not None:
             delay = max(delay, min(retry_after, self.retry_after_cap))
+        get_journal().emit(
+            "kube", reason=REASON_BACKOFF, object_ref=self.base_url,
+            message=(f"backing off {delay:.3f}s"
+                     + (f" (Retry-After {retry_after:g}s)"
+                        if retry_after is not None else "")),
+        )
         # a span, not a log line: backoff stalls inside a reconcile show
         # up as children of that reconcile's kube.request span, so a
         # slow grant is attributable to API-server pushback
@@ -751,6 +779,12 @@ class RealKubeClient(KubeClient):
                     get_tracer().record(
                         "kube.watch_reconnect", 0.0, kind=kind,
                         cause=type(e).__name__, rv=rv or "",
+                    )
+                    get_journal().emit(
+                        "kube", reason=REASON_WATCH_RECONNECT,
+                        object_ref=f"watch/{kind}",
+                        message=(f"watch dropped ({type(e).__name__}); "
+                                 f"resuming from rv={rv or '?'}"),
                     )
                     reconnects += 1
                     if reconnects > self.watch_reconnects:

@@ -98,6 +98,35 @@ def render(metrics) -> str:
     return generate_latest(metrics.registry).decode()
 
 
+class EventMetrics:
+    """Flight-recorder counters, incremented by the event journal
+    (``obs/journal.py``) on every emit. Pass an existing holder's
+    ``registry`` to expose them on that holder's /metrics port; the
+    journal's lazily-built default uses its own registry, rendered
+    portlessly via :func:`render`."""
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None):
+        if not _PROM:
+            _warn_no_prom()
+            self.events = _NoopMetric()
+            self.last_event_ts = _NoopMetric()
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        self.events = Counter(
+            "tpuslice_events_total",
+            "Flight-recorder events emitted by the journal",
+            ["component", "reason"],
+            registry=self.registry,
+        )
+        self.last_event_ts = Gauge(
+            "tpuslice_last_event_timestamp_seconds",
+            "Unix timestamp of the most recent journal event",
+            ["component"],
+            registry=self.registry,
+        )
+
+
 class OperatorMetrics:
     """One instance per process; inject into Controller / NodeAgent."""
 

@@ -1,0 +1,14 @@
+"""Observability surfaces beyond traces/metrics: the control-plane
+flight recorder (structured event journal + Kubernetes Event mirroring
++ per-allocation audit trail). See docs/OBSERVABILITY.md."""
+
+from instaslice_tpu.obs.journal import (  # noqa: F401
+    Event,
+    Journal,
+    attach_metrics,
+    debug_events_payload,
+    detach_metrics,
+    emit_pod_event,
+    get_journal,
+    reset_journal,
+)

@@ -54,6 +54,13 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from instaslice_tpu.api.constants import (
+    REASON_DRAIN_BEGIN,
+    REASON_DRAIN_END,
+    REASON_DRAINED,
+    REASON_SHED,
+)
+from instaslice_tpu.obs.journal import debug_events_payload, get_journal
 from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
 from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.trace import (
@@ -231,16 +238,39 @@ class _Scheduler(threading.Thread):
         if self.draining.is_set():
             if is_completion:
                 self.metrics.requests.labels(outcome="drained").inc()
+                # one journal event per drained completion: the journal's
+                # RequestDrained count reconciles EXACTLY with the
+                # metrics outcome ledger (tests/test_serving_chaos.py)
+                get_journal().emit(
+                    "serving", reason=REASON_DRAINED,
+                    message="rejected at admission: server draining (503)",
+                    trace_id=pending.trace_id,
+                )
             raise Draining("server draining")
+        shed = False
         with self._submit_lock:
             if self.max_queue > 0 and (
                 self.queue.qsize() + (self._head is not None)
                 >= self.max_queue
             ):
-                if is_completion:
-                    self.metrics.requests.labels(outcome="shed").inc()
-                raise QueueFull(self.shed_retry_after)
-            self.queue.put(pending)
+                shed = True
+            else:
+                self.queue.put(pending)
+        if shed:
+            # count + journal AFTER releasing the admission lock: the
+            # journal's JSONL write is disk I/O, and overload (when
+            # shedding fires) is exactly when submitters must not
+            # serialize behind it
+            if is_completion:
+                self.metrics.requests.labels(outcome="shed").inc()
+                get_journal().emit(
+                    "serving", reason=REASON_SHED,
+                    message=(f"admission queue full "
+                             f"(max_queue={self.max_queue}): "
+                             "shed with 429"),
+                    trace_id=pending.trace_id,
+                )
+            raise QueueFull(self.shed_retry_after)
 
     # ------------------------------------------------------------ drain
 
@@ -249,18 +279,37 @@ class _Scheduler(threading.Thread):
         finish for ``budget`` seconds (default ``drain_budget``), then
         evict the rest with a clean 503. Idempotent; ``drained`` is set
         once fully quiesced."""
-        self.drain_deadline = time.monotonic() + (
-            self.drain_budget if budget is None else budget
-        )
-        self.drained.clear()
-        self.draining.set()
+        budget_s = self.drain_budget if budget is None else budget
+        with self._submit_lock:
+            # check-and-set AND emit under the lock: SIGTERM and
+            # POST /v1/drain arriving together must journal ONE
+            # DrainBegin, and a racing undrain() must not invert the
+            # Begin/End order (these two events are rare — unlike the
+            # hot shed path, lock-held I/O is fine here)
+            self.drain_deadline = time.monotonic() + budget_s
+            self.drained.clear()
+            already = self.draining.is_set()
+            self.draining.set()
+            if not already:
+                get_journal().emit(
+                    "serving", reason=REASON_DRAIN_BEGIN,
+                    message=(f"drain started: admission stopped, "
+                             f"in-flight requests get {budget_s:.1f}s"),
+                )
         self.metrics.draining.set(1)
 
     def undrain(self) -> None:
         """Resume admission after a drain (rolling-restart aborted,
         readiness restored)."""
-        self.draining.clear()
-        self.drained.clear()
+        with self._submit_lock:
+            was_draining = self.draining.is_set()
+            self.draining.clear()
+            self.drained.clear()
+            if was_draining:
+                get_journal().emit(
+                    "serving", reason=REASON_DRAIN_END,
+                    message="drain cancelled: admission resumed",
+                )
         self.metrics.draining.set(0)
 
     def _fail_shed(self, p: _Pending, shed: str, msg: str) -> None:
@@ -590,6 +639,14 @@ class _Scheduler(threading.Thread):
                        else "drained" if p.shed
                        else "error" if p.error else "ok")
             self.metrics.requests.labels(outcome=outcome).inc()
+            if outcome == "drained":
+                # queued-shed and budget-evicted requests: same journal
+                # ledger as the submit-time drain rejections above
+                get_journal().emit(
+                    "serving", reason=REASON_DRAINED,
+                    message=p.error or "drained",
+                    trace_id=p.trace_id,
+                )
             from instaslice_tpu.metrics.metrics import (
                 observe_with_exemplar,
             )
@@ -746,6 +803,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, type(self).scheduler.stats())
         elif self.path.startswith("/v1/debug/trace"):
             self._debug_trace()
+        elif self.path.startswith("/v1/debug/events"):
+            self._debug_events()
         elif self.path.rstrip("/").startswith("/v1/models"):
             # OpenAI-client compatibility probe: one entry describing
             # the engine's model and serving limits ("created"/
@@ -837,6 +896,21 @@ class _Handler(BaseHTTPRequestHandler):
             ],
             "recent": [s.to_dict() for s in tracer.spans()[-n:]],
         })
+
+    def _debug_events(self) -> None:
+        """``GET /v1/debug/events``: the process flight recorder's live
+        view (obs/journal.py) — filter with ``?reason=`` / ``?object=``
+        / ``?trace_id=`` / ``?component=`` / ``?since_seq=``; ``?n=``
+        bounds the returned tail (default 100)."""
+        qs = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query
+        )
+        try:
+            payload = debug_events_payload(qs)
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        self._send(200, payload)
 
     def do_POST(self):
         if self.path.startswith("/v1/prefixes"):

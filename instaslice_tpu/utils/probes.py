@@ -1,11 +1,18 @@
 """healthz/readyz probe endpoints (reference:
-``AddHealthzCheck``/``AddReadyzCheck``, ``cmd/*/main.go:143-150``)."""
+``AddHealthzCheck``/``AddReadyzCheck``, ``cmd/*/main.go:143-150``),
+plus the operator-plane ``GET /v1/debug/events`` view of the process
+flight recorder (obs/journal.py) — the controller and node agent have
+no serving HTTP plane, so their journal is queryable here."""
 
 from __future__ import annotations
 
+import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
+
+from instaslice_tpu.obs.journal import debug_events_payload
 
 
 class ProbeServer:
@@ -32,6 +39,21 @@ class ProbeServer:
                 pass
 
             def do_GET(self):
+                if self.path.startswith("/v1/debug/events"):
+                    qs = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query
+                    )
+                    try:
+                        code, payload = 200, debug_events_payload(qs)
+                    except ValueError as e:
+                        code, payload = 400, {"error": str(e)}
+                    body = json.dumps(payload).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if self.path.startswith("/healthz"):
                     ok = True
                 elif self.path.startswith("/readyz"):

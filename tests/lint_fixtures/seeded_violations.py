@@ -83,3 +83,11 @@ def raw_lock_violation():
 
 def raw_lock_via_from_import():
     return _AliasedLock()                      # raw-lock (aliased)
+
+
+def event_reason_literal_violation(journal, client):
+    journal.emit("controller", reason="MadeUpReason")   # event-reason-literal
+    emit_pod_event(                            # event-reason-literal
+        client, "ns", "pod", reason="AlsoMadeUp", message="x",
+        component="controller",
+    )

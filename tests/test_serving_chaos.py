@@ -18,6 +18,7 @@ Seeded via CHAOS_SEED (printed on failure) like tests/test_chaos.py.
 
 import json
 import os
+import sys
 import threading
 import time
 import urllib.request
@@ -26,7 +27,14 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+from instaslice_tpu.api.constants import (
+    REASON_DRAIN_BEGIN,
+    REASON_DRAIN_END,
+    REASON_DRAINED,
+    REASON_SHED,
+)
 from instaslice_tpu.faults import FaultPlan
+from instaslice_tpu.obs.journal import get_journal, reset_journal
 from instaslice_tpu.metrics.metrics import ServingMetrics
 from instaslice_tpu.models.lm import ModelConfig, TpuLM
 from instaslice_tpu.serving import ServingEngine
@@ -34,6 +42,7 @@ from instaslice_tpu.serving import loadgen
 from instaslice_tpu.serving.api_server import ApiServer
 
 CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "1234"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 VOCAB = 64
 OUTCOME_LABELS = ("ok", "error", "timeout", "rejected", "shed", "drained")
@@ -83,6 +92,9 @@ def metrics_outcome_counts(metrics: ServingMetrics) -> dict:
 class TestServingChaos:
     def test_faults_everywhere_plus_midrun_drain(self, model):
         print(f"chaos params: CHAOS_SEED={CHAOS_SEED}")
+        # fresh flight recorder: the journal ledger below reconciles
+        # against THIS run's metrics, not whatever earlier tests emitted
+        reset_journal()
         m, params = model
         eng = ServingEngine(m, params, max_batch=4, max_len=64,
                             prefill_len=8)
@@ -212,6 +224,44 @@ class TestServingChaos:
                 assert sum(
                     s["fired"] for s in plan.stats().values()
                 ) > 0, plan.stats()
+
+                # 3b. the flight recorder reconciles with the metrics
+                # ledger: one RequestShed journal event per shed outcome,
+                # one RequestDrained per drained outcome — same
+                # population, counted on two independent surfaces
+                journal = get_journal()
+                jcounts = journal.counts()
+                print("journal:", json.dumps(jcounts))
+                assert jcounts.get(REASON_SHED, 0) == \
+                    counted.get("shed", 0) - warm.get("shed", 0), \
+                    (jcounts, counted, warm)
+                assert jcounts.get(REASON_DRAINED, 0) == \
+                    counted.get("drained", 0) - warm.get("drained", 0), \
+                    (jcounts, counted, warm)
+                # exactly one drain cycle ran
+                assert jcounts.get(REASON_DRAIN_BEGIN, 0) == 1, jcounts
+                assert jcounts.get(REASON_DRAIN_END, 0) == 1, jcounts
+
+                # 3c. under injected faults + churn, every allocation's
+                # transition chain stays legal (stale-read tolerance:
+                # set_status emits at decision time and a CR write can
+                # lose the optimistic-concurrency race)
+                sys.path.insert(0, os.path.join(REPO, "tools"))
+                import validate_events
+
+                chain_errors = validate_events.check_chains(
+                    [e.to_dict() for e in journal.events()],
+                    strict=False,
+                )
+                assert chain_errors == [], chain_errors
+
+                # 3d. the journal is live-queryable on the serving plane
+                code, out = get(
+                    srv.url, f"/v1/debug/events?reason={REASON_DRAIN_BEGIN}"
+                )
+                assert code == 200, out
+                assert [e["reason"] for e in out["events"]] == \
+                    [REASON_DRAIN_BEGIN], out
 
                 # 4. recovery: faults off, the SAME server serves 200s
                 eng.fault_hook = None

@@ -45,6 +45,7 @@ class TestSeededViolations:
             "span-leak": 1,
             "mutable-default": 2,
             "raw-lock": 4,        # incl. the from-import alias
+            "event-reason-literal": 2,  # journal.emit + emit_pod_event
         }, by_rule
 
     def test_findings_carry_location(self, findings):

@@ -33,6 +33,11 @@ raw-lock            Locks are created via the named factory in
                     ``instaslice_tpu/utils/lockcheck.py`` so the runtime
                     lock-order detector sees every acquisition. A raw
                     ``threading.Lock()`` is invisible to it.
+event-reason-literal  Flight-recorder ``reason=`` arguments (journal
+                    ``emit``, ``emit_pod_event``) are constants from
+                    ``instaslice_tpu/api/constants.py`` — a reason
+                    inlined at the call site drifts out of the catalog,
+                    the dashboards, and ``make events-check``.
 ==================  =====================================================
 
 Suppression: append ``# slicelint: disable=<rule>[,<rule>...]`` to the
@@ -110,6 +115,11 @@ RULES: Dict[str, str] = {
         "raw threading.Lock/RLock/Condition — create locks via "
         "instaslice_tpu.utils.lockcheck's named factory so the "
         "lock-order detector sees them"
+    ),
+    "event-reason-literal": (
+        "event reason passed as a string literal — every journal/"
+        "Kubernetes event reason must be a constant from "
+        "instaslice_tpu/api/constants.py (the flight-recorder catalog)"
     ),
 }
 
@@ -296,6 +306,39 @@ class _Linter:
             and not isinstance(self.parents.get(node), ast.withitem)
         ):
             self.emit(node, "span-leak")
+        self._check_event_reason(node, dotted)
+
+    def _check_event_reason(self, node: ast.Call, dotted: str) -> None:
+        """Journal emission (``<journal>.emit(...)`` /
+        ``emit_pod_event(...)``, both with keyword-only ``reason=``)
+        must name its reason via a constant, never a string literal —
+        the reason catalog lives ONLY in api/constants.py."""
+        is_emit = (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "emit"
+            and self._is_journal_expr(node.func.value)
+        ) or dotted.rsplit(".", 1)[-1] == "emit_pod_event"
+        if not is_emit:
+            return
+        for kw in node.keywords:
+            if kw.arg == "reason" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                self.emit(
+                    node, "event-reason-literal",
+                    f"reason literal {kw.value.value!r} — use a "
+                    "constant from instaslice_tpu/api/constants.py",
+                )
+
+    def _is_journal_expr(self, node: ast.AST) -> bool:
+        """Does this receiver look like a journal? Scopes the rule to
+        ``journal.emit`` / ``self.journal.emit`` / ``get_journal().emit``
+        so unrelated ``emit()`` methods don't trip the gate."""
+        if isinstance(node, ast.Call):
+            return self._is_journal_expr(node.func)
+        dotted = self._resolve(_dotted(node))
+        if not dotted:
+            return False
+        return "journal" in dotted.rsplit(".", 1)[-1].lower()
 
     def _is_tracer_expr(self, node: ast.AST) -> bool:
         """Does this receiver look like a tracer? Scopes span-leak to

@@ -1,0 +1,366 @@
+"""Validate a ``TPUSLICE_EVENT_FILE`` JSONL dump (and optionally
+produce one first).
+
+``python tools/validate_events.py EVENTS.jsonl`` checks the structural
+invariants every consumer of the flight-recorder format (``tpuslice
+events`` / ``describe pod``, the debug endpoints, docs/OBSERVABILITY.md
+tooling) relies on:
+
+- every line parses as a JSON object with ``seq``, ``ts``,
+  ``component``, and ``reason``;
+- ``seq`` values are unique (the ring is the ordering authority; file
+  line order may interleave across threads);
+- every ``reason`` comes from the ``api/constants.py`` catalog;
+- allocation transition chains are complete and ordered: for each
+  ``alloc/<id>``, the status sequence (split into epochs at each fresh
+  ``creating`` — a controller retry tears down and re-places under a
+  new trace) follows the legal transition graph, every transition
+  event carries a non-empty ``traceId``, one trace id spans the whole
+  epoch, and any granted epoch shows creating → created → ungated in
+  order.
+
+Transition events are emitted at the ``set_status`` decision point; a
+CR write can still lose an optimistic-concurrency race, so chaos-grade
+callers pass ``strict=False`` to :func:`check_chains`, which forgives a
+"phantom" edge that is legal from an *earlier* status of the same epoch
+(a stale read whose write never landed). The ``make events-check``
+drive is quiet enough to validate strictly.
+
+``--drive`` first GENERATES the file: a SimCluster grants one clean pod
+and one pod whose first chip reservation fails (injected device error →
+``failed`` epoch → retry → grant), renders ``tpuslice describe pod``
+for both against the live fake API (asserting the merged
+event/audit/trace timeline), then runs a short loadgen burst plus a
+drain/undrain cycle through a live ApiServer. This is the
+``make events-check`` gate, next to ``trace-check`` in ``make test``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # run as tools/validate_events.py
+    sys.path.insert(0, REPO)
+
+from instaslice_tpu.api.constants import (  # noqa: E402
+    EVENT_REASONS,
+    REASON_ADMITTED,
+    REASON_DRAIN_BEGIN,
+    REASON_DRAIN_END,
+    TRANSITION_REASONS,
+)
+
+#: reason → allocation status value (the inverse of TRANSITION_REASONS)
+TRANSITION_STATUS = {r: s for s, r in TRANSITION_REASONS.items()}
+
+
+def _legal_edges() -> Dict[str, set]:
+    from instaslice_tpu.api.types import _TRANSITIONS
+
+    return {
+        old.value: {new.value for new in news}
+        for old, news in _TRANSITIONS.items()
+    }
+
+
+def check_chains(events: List[dict], strict: bool = True) -> List[str]:
+    """Transition-chain invariants over parsed event dicts (the journal
+    ring's ``to_dict`` shape == the JSONL shape). Reusable by the chaos
+    tier against the in-memory ring."""
+    errors: List[str] = []
+    legal = _legal_edges()
+    by_alloc: Dict[str, List[dict]] = {}
+    for rec in events:
+        ref = rec.get("objectRef", "")
+        if rec.get("reason") in TRANSITION_STATUS and \
+                ref.startswith("alloc/"):
+            by_alloc.setdefault(ref, []).append(rec)
+
+    for ref, recs in sorted(by_alloc.items()):
+        recs.sort(key=lambda r: r.get("seq", 0))
+        # epochs: each fresh `creating` after prior history is a
+        # re-placement (retry) — chains restart there
+        epochs: List[List[dict]] = []
+        cur: List[dict] = []
+        for rec in recs:
+            if TRANSITION_STATUS[rec["reason"]] == "creating" and cur:
+                epochs.append(cur)
+                cur = []
+            cur.append(rec)
+        if cur:
+            epochs.append(cur)
+        for n, epoch in enumerate(epochs):
+            statuses = [TRANSITION_STATUS[r["reason"]] for r in epoch]
+            if statuses[0] != "creating":
+                errors.append(
+                    f"{ref} epoch {n}: chain starts at "
+                    f"{statuses[0]!r}, not 'creating'"
+                )
+                continue
+            seen = {statuses[0]}
+            prev = statuses[0]
+            for st in statuses[1:]:
+                if st == prev:  # idempotent re-emit (conflict retry)
+                    continue
+                if st in legal[prev]:
+                    seen.add(st)
+                    prev = st
+                    continue
+                # stale-read phantom: legal from an EARLIER status of
+                # this epoch — tolerated only in non-strict mode. The
+                # phantom may be EITHER side of the illegal pair (a
+                # failed that lost to a concurrent promote reads as
+                # creating→failed→created→ungated), so re-anchor the
+                # chain on the tolerated status rather than keeping
+                # the possibly-phantom prev.
+                if not strict and any(st in legal[s] for s in seen):
+                    seen.add(st)
+                    prev = st
+                    continue
+                errors.append(
+                    f"{ref} epoch {n}: illegal transition "
+                    f"{prev!r} -> {st!r} (chain {statuses})"
+                )
+                prev = st
+                seen.add(st)
+            tids = {r.get("traceId", "") for r in epoch}
+            if "" in tids:
+                errors.append(
+                    f"{ref} epoch {n}: transition event without a "
+                    "traceId — the grant trace link is broken"
+                )
+            elif len(tids) > 1:
+                errors.append(
+                    f"{ref} epoch {n}: {len(tids)} trace ids in one "
+                    f"epoch ({sorted(tids)})"
+                )
+            if "ungated" in statuses:
+                order = [statuses.index(s)
+                         for s in ("creating", "created", "ungated")
+                         if s in statuses]
+                if len(order) < 3 or order != sorted(order):
+                    errors.append(
+                        f"{ref} epoch {n}: granted without a complete "
+                        f"creating->created->ungated chain ({statuses})"
+                    )
+    return errors
+
+
+def validate(path: str, strict: bool = True) -> dict:
+    """Structural + chain validation of one JSONL file. ``errors`` must
+    stay empty for the file to pass."""
+    errors: List[str] = []
+    events: List[dict] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {lineno}: unparseable JSONL: {e}")
+                continue
+            if not isinstance(rec, dict):
+                errors.append(f"line {lineno}: not a JSON object")
+                continue
+            missing = [k for k in ("seq", "ts", "component", "reason")
+                       if k not in rec]
+            if missing:
+                errors.append(f"line {lineno}: missing {missing}")
+                continue
+            if rec["reason"] not in EVENT_REASONS:
+                errors.append(
+                    f"line {lineno}: unknown reason {rec['reason']!r} "
+                    "— reasons live in instaslice_tpu/api/constants.py"
+                )
+            events.append(rec)
+
+    seqs = [r["seq"] for r in events]
+    if len(seqs) != len(set(seqs)):
+        dupes = sorted({s for s in seqs if seqs.count(s) > 1})
+        errors.append(f"duplicate seq values: {dupes[:10]}")
+    events.sort(key=lambda r: r["seq"])
+    errors.extend(check_chains(events, strict=strict))
+
+    reasons: Dict[str, int] = {}
+    for rec in events:
+        reasons[rec["reason"]] = reasons.get(rec["reason"], 0) + 1
+    return {
+        "file": path,
+        "events": len(events),
+        "reasons": reasons,
+        "errors": errors,
+        "_events": events,
+    }
+
+
+def check_drive_expectations(report: dict, granted_text: str,
+                             faulted_text: str) -> None:
+    """--drive extra: the file must PROVE the flight-recorder contract,
+    not just parse. Appends to ``report['errors']``."""
+    events = report["_events"]
+    reasons = report["reasons"]
+
+    # a granted chain whose Admitted event shares the grant's trace id
+    granted = False
+    for rec in events:
+        if rec.get("reason") == TRANSITION_REASONS["ungated"]:
+            tid = rec.get("traceId", "")
+            if tid and any(
+                r.get("reason") == REASON_ADMITTED
+                and r.get("traceId") == tid
+                for r in events
+            ):
+                granted = True
+                break
+    if not granted:
+        report["errors"].append(
+            "no granted chain links an Admitted event to its "
+            "SliceUngated transition by trace id"
+        )
+    if "failed" not in {
+        TRANSITION_STATUS.get(r.get("reason", "")) for r in events
+    }:
+        report["errors"].append(
+            "no failed epoch in the drive — the injected device fault "
+            "never surfaced as a SliceFailed transition"
+        )
+    for want in (REASON_DRAIN_BEGIN, REASON_DRAIN_END):
+        if not reasons.get(want):
+            report["errors"].append(
+                f"serving plane emitted no {want} event"
+            )
+    for label, text, needles in (
+        ("granted", granted_text,
+         ("SliceUngated", "controller.allocate", "Admitted")),
+        ("faulted", faulted_text,
+         ("SliceFailed", "SliceRealizeFailed")),
+    ):
+        if label == "faulted":
+            ok = any(n in text for n in needles)
+        else:
+            ok = all(n in text for n in needles)
+        if not ok:
+            report["errors"].append(
+                f"describe-pod rendering for the {label} pod is missing "
+                f"expected entries {needles}; got:\n{text}"
+            )
+
+
+def drive(path: str) -> tuple:
+    """Produce ``path``: one clean grant, one faulted-then-retried
+    grant, describe-pod renderings for both, then a serving burst with
+    a drain/undrain cycle — all recorded to the file. Returns the two
+    describe renderings."""
+    if os.path.exists(path):
+        os.unlink(path)
+    trace_path = tempfile.mktemp(prefix="tpuslice-events-check-trace.",
+                                 suffix=".jsonl")
+    os.environ["TPUSLICE_EVENT_FILE"] = path
+    os.environ["TPUSLICE_TRACE_FILE"] = trace_path
+    from instaslice_tpu.obs.journal import reset_journal
+    from instaslice_tpu.utils.trace import reset_tracer
+
+    reset_journal()  # re-read the env: events now stream to `path`
+    reset_tracer()
+    granted_text = faulted_text = ""
+    try:
+        from instaslice_tpu.cli.tpuslicectl import (
+            describe_pod,
+            render_describe,
+        )
+        from instaslice_tpu.sim import SimCluster
+
+        with SimCluster(n_nodes=1, deletion_grace_seconds=0.2) as c:
+            # the faulted pod: its first chip reservation raises, so the
+            # allocation runs creating → failed → deleted, then the
+            # controller re-places it and the retry epoch grants
+            c.backends["node-0"].inject_failures("reserve", 1)
+            c.submit("events-faulted", "v5e-1x1")
+            assert c.wait_phase("events-faulted", "Running", timeout=30), \
+                "faulted sim pod never recovered to Running"
+            c.submit("events-granted", "v5e-1x1")
+            assert c.wait_phase("events-granted", "Running", timeout=30), \
+                "sim pod never reached Running"
+            granted_text = render_describe(describe_pod(
+                c.kube, "events-granted", events_path=path,
+                trace_path=trace_path,
+            ))
+            faulted_text = render_describe(describe_pod(
+                c.kube, "events-faulted", events_path=path,
+                trace_path=trace_path,
+            ))
+            c.delete_pod("events-granted")
+            c.delete_pod("events-faulted")
+            assert c.wait_gone("events-granted", timeout=30)
+            assert c.wait_gone("events-faulted", timeout=30)
+
+        import jax
+        import jax.numpy as jnp
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine, loadgen
+        from instaslice_tpu.serving.api_server import ApiServer
+
+        cfg = ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                          n_layers=2, d_ff=64, dtype=jnp.float32,
+                          remat=False)
+        model = TpuLM(cfg)
+        eng = ServingEngine(model, model.init(jax.random.key(0)),
+                            max_batch=4, max_len=64, prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            report = loadgen.run(srv.url, requests=6, concurrency=2,
+                                 prompt_len=4, max_tokens=4, vocab=64,
+                                 stream=False, timeout=60)
+            assert report["outcomes"]["hung"] == 0, report
+            assert report["ok"] > 0, report
+            srv.drain(0.5)
+            assert srv.wait_drained(10), "drain never quiesced"
+            srv.undrain()
+    finally:
+        del os.environ["TPUSLICE_EVENT_FILE"]
+        del os.environ["TPUSLICE_TRACE_FILE"]
+        reset_journal()  # close the file handle (and detach the env)
+        reset_tracer()
+        if os.path.exists(trace_path):
+            os.unlink(trace_path)
+    return granted_text, faulted_text
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="validate_events")
+    ap.add_argument("file", help="event JSONL path")
+    ap.add_argument("--drive", action="store_true",
+                    help="first generate the file by running a sim "
+                         "grant + an injected-fault retry + a serving "
+                         "drain cycle with TPUSLICE_EVENT_FILE set, "
+                         "then also check the flight-recorder contract")
+    ap.add_argument("--lenient", action="store_true",
+                    help="tolerate stale-read phantom transitions "
+                         "(chaos-grade files)")
+    args = ap.parse_args(argv)
+    granted_text = faulted_text = ""
+    if args.drive:
+        granted_text, faulted_text = drive(args.file)
+    report = validate(args.file, strict=not args.lenient)
+    if args.drive:
+        check_drive_expectations(report, granted_text, faulted_text)
+    print(json.dumps({
+        "file": report["file"],
+        "events": report["events"],
+        "reasons": report["reasons"],
+        "errors": report["errors"][:20],
+        "ok": not report["errors"],
+    }))
+    return 0 if not report["errors"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
