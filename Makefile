@@ -40,10 +40,19 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke throughput floor
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
+	$(MAKE) bench-smoke
+
+.PHONY: bench-smoke
+bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
+	JAX_PLATFORMS=cpu $(PY) bench.py --smoke
+
+.PHONY: bench-scale
+bench-scale:  ## Fleet-scale control-plane bench: 1k nodes / 2k pending pods, grants/sec + gate→ungate p95/p99, with the serial re-list baseline ratio (docs/SCALING.md)
+	JAX_PLATFORMS=cpu $(PY) bench.py --scale --scale-baseline
 
 .PHONY: trace-check
 trace-check:  ## Observability gate: drive the sim + a short loadgen with TPUSLICE_TRACE_FILE set, then validate the JSONL (unparseable lines, negative durations, orphan spans, broken trace propagation)

@@ -35,6 +35,7 @@ import signal
 import statistics
 import subprocess
 import sys
+import threading
 import time
 
 BASELINE_S = 60.0
@@ -95,15 +96,42 @@ TPU_PHASES = [
 ]
 
 
-def bench_control_plane(transport: str = "inproc") -> float:
-    """Slice-grant p50 over 3 mixed waves on the 2-node sim. Pure control
-    plane — no jax, no chip. ``transport="http"`` runs the same waves
-    with the controller, both agents, and the submitter each on their own
-    real-HTTP connection to the served fake API (URL building, JSON
-    verbs, streaming watches — everything but a real etcd/scheduler)."""
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+def _grant_stats(grants, wall_seconds: float) -> dict:
+    """The shared BENCH_LOCAL_* result shape for any grant latency
+    sample: p50/p95/p99 + grants/sec — one format for the 2-node
+    headline waves and the 1k-node scale tier (docs/SCALING.md)."""
+    s = sorted(grants)
+    return {
+        "grants": len(s),
+        "p50_s": round(statistics.median(s), 4) if s else 0.0,
+        "p95_s": round(_percentile(s, 0.95), 4),
+        "p99_s": round(_percentile(s, 0.99), 4),
+        "grants_per_sec": (
+            round(len(s) / wall_seconds, 2) if wall_seconds > 0 else 0.0
+        ),
+    }
+
+
+def bench_control_plane(transport: str = "inproc") -> dict:
+    """Slice-grant latency stats over 3 mixed waves on the 2-node sim
+    (:func:`_grant_stats` shape — p50 is the headline, p95/p99 and
+    grants/sec ride along). Pure control plane — no jax, no chip.
+    ``transport="http"`` runs the same waves with the controller, both
+    agents, and the submitter each on their own real-HTTP connection to
+    the served fake API (URL building, JSON verbs, streaming watches —
+    everything but a real etcd/scheduler)."""
     from instaslice_tpu.sim import SimCluster
 
     grants = []
+    bench_t0 = time.monotonic()
     with SimCluster(n_nodes=2, generation="v5e",
                     deletion_grace_seconds=0.2, transport=transport) as c:
         for wave in range(WAVES):
@@ -125,7 +153,121 @@ def bench_control_plane(transport: str = "inproc") -> float:
                 c.delete_pod(name)
             for name in names:
                 c.wait_gone(name, timeout=60)
-    return statistics.median(grants)
+    return _grant_stats(grants, time.monotonic() - bench_t0)
+
+
+def bench_scale(
+    n_nodes: int = 1000,
+    n_pods: int = 2000,
+    nodes_per_group: int = 2,
+    baseline: bool = False,
+    profile: str = "v5e-1x1",
+    timeout: float = 900.0,
+    agent_workers: int = 8,
+) -> dict:
+    """Fleet-scale grants/sec: ``n_pods`` single-host pods against an
+    ``n_nodes`` sim split into ``nodes_per_group``-host torus groups,
+    driven by the fleet agent manager. Reports gate→ungate p50/p95/p99
+    (an ungate watcher timestamps the moment each pod's scheduling gate
+    comes off — the controller's half of the grant, independent of the
+    simulated kubelet bind) and grants/sec over the whole burst, plus
+    the controller's reconcile/error counters and the hot span p50s from
+    the trace profiler (which is how the informer/coalescing wins were
+    attributed — docs/SCALING.md).
+
+    ``baseline=True`` measures the pre-informer serial control plane
+    (full re-list per reconcile, one worker, uncoalesced writes) for
+    the before/after ratio."""
+    from instaslice_tpu.sim import SimCluster
+    from instaslice_tpu.utils.trace import get_tracer, reset_tracer
+
+    reset_tracer()
+    ungated_at: dict = {}
+    submitted_at: dict = {}
+    stop = threading.Event()
+
+    def watch_ungates(kube) -> None:
+        # one clean watch on Pods: record the first event showing a
+        # bench pod without its scheduling gate
+        while not stop.is_set():
+            try:
+                for event, obj in kube.watch(
+                    "Pod", replay=True, timeout=0.25
+                ):
+                    if stop.is_set():
+                        return
+                    if event in ("BOOKMARK", "DELETED"):
+                        continue
+                    md = obj.get("metadata", {})
+                    name = md.get("name", "")
+                    if name not in submitted_at or name in ungated_at:
+                        continue
+                    if not obj.get("spec", {}).get("schedulingGates"):
+                        ungated_at[name] = time.monotonic()
+            except Exception as e:  # pragma: no cover - observer only
+                print(f"[scale] ungate watcher: {e}", file=sys.stderr)
+                stop.wait(0.1)
+
+    sim = SimCluster(
+        n_nodes=n_nodes,
+        generation="v5e",
+        nodes_per_group=nodes_per_group,
+        fleet_agents=True,
+        agent_workers=agent_workers,
+        workers=1 if baseline else None,
+        use_cache=not baseline,
+        deletion_grace_seconds=0.2,
+        health_interval=0,
+    )
+    t_start = time.monotonic()
+    with sim as c:
+        watcher = threading.Thread(
+            target=watch_ungates, args=(c.backing,), daemon=True
+        )
+        for i in range(n_pods):
+            name = f"scale-{i}"
+            submitted_at[name] = time.monotonic()
+            c.submit(name, profile=profile)
+        watcher.start()
+        deadline = time.monotonic() + timeout
+        while (
+            len(ungated_at) < n_pods and time.monotonic() < deadline
+        ):
+            time.sleep(0.25)
+        stop.set()
+        done = dict(ungated_at)
+        wall = (max(done.values()) - t_start) if done else 0.0
+        grants = [done[n] - submitted_at[n] for n in done]
+        out = _grant_stats(grants, wall)
+        out.update({
+            "n_nodes": n_nodes,
+            "n_pods": n_pods,
+            "nodes_per_group": nodes_per_group,
+            "mode": "baseline-serial-relist" if baseline else "informer",
+            "completed": len(done),
+            "wall_s": round(wall, 2),
+            "reconciles": c.controller.manager.reconcile_count,
+            "reconcile_errors": c.controller.manager.error_count,
+            "kube_requests": getattr(c.backing, "request_count", None),
+        })
+        if not baseline and c.controller._cr_writer is not None:
+            w = c.controller._cr_writer
+            out["cr_write_ops"] = w.ops
+            out["cr_write_commits"] = w.commits
+        spans = {}
+        summary = get_tracer().summary()
+        for name in ("controller.reconcile", "controller.allocate",
+                     "controller.place", "controller.ungate",
+                     "agent.realize"):
+            if name in summary:
+                spans[name] = summary[name]
+        out["span_summary"] = spans
+        if len(done) < n_pods:
+            out["error"] = (
+                f"only {len(done)}/{n_pods} pods ungated within "
+                f"{timeout:.0f}s"
+            )
+    return out
 
 
 def _run_tpu_phase(phase: str, timeout: float, env: dict,
@@ -548,15 +690,68 @@ def watchdog(interval: float, max_hours: float, once: bool) -> int:
         time.sleep(interval)
 
 
+def smoke(floor: float = 5.0) -> int:
+    """``make bench-smoke``: a <60 s shrunken scale run gating the fast
+    tier — asserts a grants/sec floor and ZERO reconcile errors on a
+    sharded-worker fleet sim. Catches control-plane throughput
+    regressions (and any worker-concurrency crash) in CI, not at the
+    next 1k-node bench."""
+    t0 = time.monotonic()
+    out = bench_scale(
+        n_nodes=int(os.environ.get("TPUSLICE_SMOKE_NODES", "60")),
+        n_pods=int(os.environ.get("TPUSLICE_SMOKE_PODS", "120")),
+        timeout=50.0,
+    )
+    out["smoke_wall_s"] = round(time.monotonic() - t0, 1)
+    print(json.dumps(out))
+    failures = []
+    if out.get("error"):
+        failures.append(out["error"])
+    if out["grants_per_sec"] < floor:
+        failures.append(
+            f"grants/sec {out['grants_per_sec']} below floor {floor}"
+        )
+    if out["reconcile_errors"]:
+        failures.append(
+            f"{out['reconcile_errors']} reconcile error(s) — every "
+            "grant must reconcile clean"
+        )
+    for f in failures:
+        print(f"bench-smoke FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="bench.py",
         description="control-plane + on-chip bench; --watchdog waits out "
-        "a wedged TPU tunnel and captures phases on recovery",
+        "a wedged TPU tunnel and captures phases on recovery; --scale "
+        "runs the fleet-scale grants/sec tier; --smoke is the <60s CI "
+        "gate over a shrunken scale run",
     )
     ap.add_argument("--watchdog", action="store_true",
                     help="run the chip-health watchdog loop instead of "
                     "the one-shot bench")
+    ap.add_argument("--scale", action="store_true",
+                    help="fleet-scale control-plane bench (grants/sec + "
+                    "gate-to-ungate p95/p99 on the 1k-node sim)")
+    ap.add_argument("--scale-baseline", action="store_true",
+                    help="with --scale: also measure the serial re-list "
+                    "baseline control plane and report the ratio")
+    ap.add_argument("--nodes", type=int, default=1000,
+                    help="scale tier: simulated node count")
+    ap.add_argument("--pods", type=int, default=2000,
+                    help="scale tier: pending pod burst size")
+    ap.add_argument("--baseline-pods", type=int, default=200,
+                    help="scale tier: burst size for the (much slower) "
+                    "baseline measurement")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI gate: shrunken scale run asserting a "
+                    "grants/sec floor and zero reconcile errors")
+    ap.add_argument("--smoke-floor", type=float,
+                    default=float(os.environ.get(
+                        "TPUSLICE_SMOKE_FLOOR", "5.0")),
+                    help="bench-smoke grants/sec floor")
     ap.add_argument("--interval", type=float, default=900.0,
                     help="watchdog: seconds between probes (default 900)")
     ap.add_argument("--max-hours", type=float, default=11.0,
@@ -588,22 +783,51 @@ def main(argv=None) -> int:
         return 0
     if args.watchdog:
         return watchdog(args.interval, args.max_hours, args.once)
+    if args.smoke:
+        return smoke(floor=args.smoke_floor)
+    if args.scale:
+        result = {"metric": "scale_grants_per_sec", "unit": "grants/sec"}
+        scale = bench_scale(n_nodes=args.nodes, n_pods=args.pods)
+        result["scale"] = scale
+        result["value"] = scale["grants_per_sec"]
+        if args.scale_baseline:
+            # the serial re-list control plane is orders of magnitude
+            # slower; measure it over a smaller burst and compare rates
+            base = bench_scale(
+                n_nodes=args.nodes,
+                n_pods=min(args.pods, args.baseline_pods),
+                baseline=True,
+                timeout=1200.0,
+            )
+            result["scale_baseline"] = base
+            if base["grants_per_sec"]:
+                result["vs_baseline"] = round(
+                    scale["grants_per_sec"] / base["grants_per_sec"], 1
+                )
+        print(json.dumps(result))
+        return 0
 
     try:
-        p50 = bench_control_plane()
+        cp = bench_control_plane()
     except Exception as e:
         print(f"FATAL: control-plane bench failed: {e}", file=sys.stderr)
         return 1
 
+    p50 = cp["p50_s"]
     result = {
         "metric": "slice_grant_p50_latency",
-        "value": round(p50, 4),
+        "value": p50,
         "unit": "seconds",
         "vs_baseline": round(BASELINE_S / p50, 1) if p50 > 0 else 0,
+        # the full latency/throughput shape shared with the scale tier
+        "slice_grant_p95_latency": cp["p95_s"],
+        "slice_grant_p99_latency": cp["p99_s"],
+        "slice_grants_per_sec": cp["grants_per_sec"],
     }
     try:
-        http_p50 = bench_control_plane(transport="http")
-        result["slice_grant_p50_latency_http"] = round(http_p50, 4)
+        http_cp = bench_control_plane(transport="http")
+        result["slice_grant_p50_latency_http"] = http_cp["p50_s"]
+        result["slice_grant_p99_latency_http"] = http_cp["p99_s"]
     except Exception as e:  # noqa: BLE001 - report alongside, don't kill
         result["slice_grant_http_error"] = f"{type(e).__name__}: {e}"
     result.update(bench_tpu())

@@ -77,7 +77,13 @@ class NodeAgent:
         namespace: str = "instaslice-tpu-system",
         metrics=None,
         health_interval: float = 10.0,
+        manager: Optional[Manager] = None,
     ) -> None:
+        """``manager``: an externally-owned reconcile manager (the
+        fleet-sim case — one sharded manager driving every node's agent
+        logic, ``instaslice_tpu.sim.FleetAgents``). The agent then
+        neither builds nor starts its own watch/worker threads; requeue
+        re-adds ride the shared queue."""
         self.client = client
         # every device op this agent issues becomes a ``device.<op>``
         # span, joining whatever trace the agent has bound (the
@@ -90,7 +96,8 @@ class NodeAgent:
         self.namespace = namespace
         self.metrics = metrics
         self.health_interval = health_interval
-        self.manager = Manager(
+        self._owns_manager = manager is None
+        self.manager = manager or Manager(
             name=f"agent-{node_name}",
             client=client,
             reconcile=self.reconcile,
@@ -122,13 +129,16 @@ class NodeAgent:
 
     def start(self) -> None:
         self.boot()
+        if not self._owns_manager:
+            return  # fleet-managed: the shared manager drives us
         self.manager.start()
         self.manager.queue.add(self.node_name)
         if self.health_interval > 0:
             self.manager.queue.add(HEALTH_KEY, delay=self.health_interval)
 
     def stop(self) -> None:
-        self.manager.stop()
+        if self._owns_manager:
+            self.manager.stop()
 
     # ----------------------------------------------------------- reconcile
 

@@ -21,6 +21,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
     p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--workers", type=int, default=None,
+                   help="sharded reconcile workers (default: "
+                   "TPUSLICE_RECONCILE_WORKERS or 4; per-key ordering "
+                   "is preserved — docs/SCALING.md)")
+    p.add_argument("--shard-leases", action="store_true",
+                   help="active-active scale-out: each reconcile shard "
+                   "holds its own Lease, so multiple controller "
+                   "replicas split the shards (docs/SCALING.md)")
     p.add_argument("--kubeconfig", default="")
     p.add_argument("--deletion-grace-seconds", type=float, default=30.0)
     return p

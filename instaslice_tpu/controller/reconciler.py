@@ -44,6 +44,7 @@ from instaslice_tpu.api import (
 )
 from instaslice_tpu.controller.gates import (
     ERROR_ANNOTATION,
+    GROUP_ANNOTATION,
     GROUP_SIZE_ANNOTATION,
     HANDOFF_ANNOTATION,
     extract_profile,
@@ -55,6 +56,7 @@ from instaslice_tpu.kube.client import (
     NotFound,
     update_with_retry,
 )
+from instaslice_tpu.kube.coalesce import CoalescedWriter
 from instaslice_tpu.topology.grid import (
     NodeGrid,
     Shape,
@@ -66,10 +68,53 @@ from instaslice_tpu.topology.grid import (
 from instaslice_tpu.topology.placement import Box, Occupancy, Placement
 from instaslice_tpu.topology.policy import AllocationPolicy, get_policy
 from instaslice_tpu.topology.profiles import TopologyProfile
-from instaslice_tpu.utils.reconcile import Manager
+from instaslice_tpu.utils.reconcile import Manager, default_workers
 from instaslice_tpu.utils.trace import get_tracer, new_trace_id
 
 log = logging.getLogger("instaslice_tpu.controller")
+
+# ------------------------------------------------- informer index names
+#: gated pods by "<namespace>/<group-id>" — the namespace scan
+#: `_group_peers` used to do
+INDEX_GATED_GROUP = "gated-group"
+#: TpuSlice CRs by torus group id (spec.torusGroup, or the CR name for
+#: standalone hosts)
+INDEX_SLICE_GROUP = "torus-group"
+#: TpuSlice CRs holding an allocation for a pod, by "uid:<pod-uid>" and
+#: "key:<namespace>/<pod-name>" — makes `_find_allocation` O(holders)
+INDEX_SLICE_POD = "alloc-pod"
+
+
+def pod_indexers():
+    def gated_group(obj: dict) -> List[str]:
+        if not is_pod_gated(obj):
+            return []
+        md = obj.get("metadata", {})
+        gid = (md.get("annotations") or {}).get(GROUP_ANNOTATION, "")
+        if not gid:
+            return []
+        return [f"{md.get('namespace', '')}/{gid}"]
+
+    return {INDEX_GATED_GROUP: gated_group}
+
+
+def slice_indexers():
+    def by_group(obj: dict) -> List[str]:
+        name = obj.get("metadata", {}).get("name", "")
+        return [obj.get("spec", {}).get("torusGroup") or name]
+
+    def by_pod(obj: dict) -> List[str]:
+        keys = []
+        for alloc in obj.get("spec", {}).get("allocations", {}).values():
+            for p in alloc.get("pods", []):
+                if p.get("podUUID"):
+                    keys.append(f"uid:{p['podUUID']}")
+                keys.append(
+                    f"key:{p.get('namespace', '')}/{p.get('podName', '')}"
+                )
+        return keys
+
+    return {INDEX_SLICE_GROUP: by_group, INDEX_SLICE_POD: by_pod}
 
 
 from instaslice_tpu.utils.timeutil import parse_timestamp as _parse_timestamp
@@ -86,13 +131,31 @@ class Controller:
         no_capacity_requeue: float = 2.0,
         metrics=None,
         fence=None,
+        workers: Optional[int] = None,
+        use_cache: bool = True,
+        shard_lease: Optional[dict] = None,
     ) -> None:
         """``fence``: optional ``() -> bool`` leadership check; when it
         turns False every subsequent CR/pod write raises ``Fenced`` so a
         deposed leader cannot race its successor (update_with_retry
-        re-checks it on every conflict retry)."""
+        re-checks it on every conflict retry).
+
+        ``workers``: reconcile concurrency (key-hash sharded; per-key
+        ordering preserved). Default: ``TPUSLICE_RECONCILE_WORKERS`` or
+        4 (docs/SCALING.md).
+
+        ``use_cache=False`` restores the pre-informer serial behavior —
+        full re-list per reconcile, direct (uncoalesced) CR writes —
+        kept as the measured baseline for ``bench.py --scale``.
+
+        ``shard_lease``: per-shard Lease leadership config forwarded to
+        the :class:`Manager` (multi-replica shard splitting)."""
         self.client = client
         self.fence = fence
+        self.workers = (
+            default_workers(4) if workers is None else max(1, int(workers))
+        )
+        self._use_cache = use_cache
         self.namespace = namespace
         self.policy = (
             policy if isinstance(policy, AllocationPolicy) else get_policy(policy)
@@ -115,7 +178,30 @@ class Controller:
         #: a node with a persistently failing device API cannot capture
         #: a pod in a fail→re-place-same-node loop.
         self._failed_nodes: Dict[str, Dict[str, float]] = {}
+        self._failed_nodes_lock = named_lock("controller.failed_nodes")
         self.failed_node_avoid_seconds = 120.0
+        #: placement critical section (in-memory only — never held
+        #: across kube I/O): sharded workers compute placements one at
+        #: a time against cache + overlay, then fan the writes out in
+        #: parallel
+        self._placement_lock = named_lock("controller.placement")
+        #: alloc_id → (Box, involved node names, group id): placements
+        #: chosen but whose CR writes have not landed in the cache yet;
+        #: folded into occupancy so a concurrent worker can't hand out
+        #: the same chips
+        self._inflight: Dict[str, Tuple[Box, frozenset, str]] = {}
+        #: gid → (signature, TorusGroup): memoized group construction
+        #: for the legacy full-scan path (signature = member
+        #: names/offsets/generation — NOT allocations)
+        self._group_cache: Dict[str, Tuple[tuple, TorusGroup]] = {}
+        #: gid → (index version, members, TorusGroup): per-group view
+        #: for the indexed placement path, rebuilt only when the
+        #: informer's per-group version moved
+        self._members_cache: Dict[str, tuple] = {}
+        #: (gid, profile) → (index version, in-flight overlay signature)
+        #: under which the group had no room — an O(1) skip until one of
+        #: its CRs actually changes
+        self._no_fit: Dict[Tuple[str, str], tuple] = {}
         self.manager = Manager(
             name="controller",
             client=client,
@@ -124,6 +210,18 @@ class Controller:
                 ("Pod", None, self._pod_map),
                 (KIND, namespace, self._tpuslice_map),
             ],
+            workers=self.workers,
+            indexers={"Pod": pod_indexers(), KIND: slice_indexers()},
+            transforms={KIND: TpuSlice.from_manifest},
+            shard_lease=shard_lease,
+        )
+        self._pods_inf = self.manager.informer("Pod")
+        self._slices_inf = self.manager.informer(KIND)
+        #: batches same-CR allocation mutations from concurrent workers
+        #: into one optimistic-concurrency round-trip (kube/coalesce.py)
+        self._cr_writer = (
+            CoalescedWriter(client, KIND, namespace, fence=fence)
+            if use_cache else None
         )
 
     # --------------------------------------------------------------- wiring
@@ -152,13 +250,49 @@ class Controller:
 
     def start(self) -> None:
         self.manager.start()
+        if self._use_cache:
+            # reconcile decisions read the cache; don't let the first
+            # keys race an empty store (workers would mis-read "no
+            # capacity" / "pod gone" before the initial relist lands)
+            self.manager.wait_synced(timeout=10.0)
 
     def stop(self) -> None:
         self.manager.stop()
 
     # ---------------------------------------------------------- CR reading
 
+    def _cache_ready(self) -> bool:
+        return (
+            self._use_cache
+            and self._slices_inf is not None
+            and self._slices_inf.synced()
+        )
+
+    def _get_pod(self, namespace: str, name: str) -> dict:
+        """Pod read for reconcile decisions: informer cache once synced
+        (reconcile keys COME from its events, so the store is at least
+        as new as the event that queued us), API server before that.
+        Cache objects are shared and read-only; every pod write below
+        goes through get-mutate-update against the server."""
+        if (
+            self._use_cache
+            and self._pods_inf is not None
+            and self._pods_inf.synced()
+        ):
+            obj = self._pods_inf.get(namespace, name)
+            if obj is None:
+                raise NotFound(f"Pod {namespace}/{name} not found")
+            return obj
+        return self.client.get("Pod", namespace, name)
+
     def _load_slices(self) -> List[TpuSlice]:
+        """All TpuSlice CRs, PARSED — from the informer's transform
+        cache (one parse per stored resourceVersion) instead of a full
+        re-list + re-parse per reconcile. The returned objects are
+        shared, read-only views; mutations go through
+        ``update_with_retry`` / the coalesced writer."""
+        if self._cache_ready():
+            return self._slices_inf.list_transformed()  # type: ignore
         return [
             TpuSlice.from_manifest(m)
             for m in self.client.list(KIND, namespace=self.namespace)
@@ -177,6 +311,20 @@ class Controller:
             by_group.setdefault(gid, []).append(ts)
         out: Dict[str, Tuple[TorusGroup, List[TpuSlice]]] = {}
         for gid, members in by_group.items():
+            # memoize TorusGroup/NodeGrid construction on the topology
+            # signature — names/offsets/generation never change per
+            # grant, only allocations do, so at fleet scale this turns
+            # an O(nodes) rebuild per reconcile into a dict hit
+            sig = (
+                members[0].spec.generation,
+                tuple(sorted(
+                    (m.name, tuple(m.spec.host_offset)) for m in members
+                )),
+            )
+            cached = self._group_cache.get(gid)
+            if cached is not None and cached[0] == sig:
+                out[gid] = (cached[1], members)
+                continue
             gen = get_generation(members[0].spec.generation)
             if any(m.spec.generation != members[0].spec.generation
                    for m in members):
@@ -204,19 +352,31 @@ class Controller:
             except ValueError as e:
                 log.warning("torus group %s invalid: %s", gid, e)
                 continue
+            self._group_cache[gid] = (sig, group)
             out[gid] = (group, members)
         return out
 
-    @staticmethod
-    def _occupancy(group: TorusGroup, members: List[TpuSlice]) -> Occupancy:
+    def _occupancy(self, group: TorusGroup, members: List[TpuSlice]) -> Occupancy:
         """Union of desired (allocations) and realized (prepared) boxes,
         deduped across the member CRs an allocation is fanned out to
-        (reference scans both sources too: instaslice_controller.go:306-329).
-        Chips the agents report unhealthy are blocked last — they may sit
-        inside live boxes (that grant's fate is the health monitor's call)
-        but must never enter a new placement."""
+        (reference scans both sources too: instaslice_controller.go:306-329),
+        plus the in-flight overlay — placements another worker chose
+        whose CR writes haven't landed in the cache yet (caller holds
+        ``_placement_lock``). Chips the agents report unhealthy are
+        blocked last — they may sit inside live boxes (that grant's fate
+        is the health monitor's call) but must never enter a new
+        placement."""
         occ = Occupancy(group)
         seen: Dict[str, str] = {}
+        member_names = set(group.hosts)
+        for aid, (box, nodes, _gid) in self._inflight.items():
+            if not (nodes & member_names) or aid in seen:
+                continue
+            # same seen-key scheme as the CR loop below, so an overlay
+            # entry whose write already landed in a cached CR is not
+            # occupied twice
+            seen[aid] = box.key()
+            occ.occupy(box, owner=f"a-{aid}")
         for ts in members:
             for alloc in ts.spec.allocations.values():
                 if seen.get(alloc.alloc_id) == alloc.box:
@@ -266,9 +426,18 @@ class Controller:
         holding a copy, returning a MERGED view: each agent reports
         ``realized_on`` / status only in its own CR copy, so the union
         (and worst status) across copies is the cluster truth."""
+        if self._cache_ready():
+            # alloc-pod secondary index: only the holder CRs, not a
+            # cluster-wide scan per reconcile
+            ikey = f"uid:{pod_uid}" if pod_uid else f"key:{pod_key}"
+            candidates = self._slices_inf.by_index(  # type: ignore
+                INDEX_SLICE_POD, ikey, transformed=True
+            )
+        else:
+            candidates = slices
         copies: List[AllocationDetails] = []
         holders: List[TpuSlice] = []
-        for ts in slices:
+        for ts in candidates:
             for alloc in ts.spec.allocations.values():
                 for p in alloc.pods:
                     if (pod_uid and p.pod_uuid == pod_uid) or (
@@ -310,7 +479,7 @@ class Controller:
             self.metrics.reconciles.labels(component="controller").inc()
         ns, _, name = key.partition("/")
         try:
-            pod = self.client.get("Pod", ns, name)
+            pod = self._get_pod(ns, name)
         except NotFound:
             return self._reap_orphan(key)
 
@@ -379,19 +548,23 @@ class Controller:
                 } or set(alloc.parts)
                 now = time.monotonic()
                 deadline = now + self.failed_node_avoid_seconds
-                for ref in alloc.pods:
-                    avoid = self._failed_nodes.setdefault(ref.pod_uuid, {})
-                    for node in failing:
-                        avoid[node] = deadline
-                # global prune on write: uids that never re-place again
-                # must not pin expired entries forever
-                for uid in list(self._failed_nodes):
-                    live = {n: dl for n, dl
-                            in self._failed_nodes[uid].items() if dl > now}
-                    if live:
-                        self._failed_nodes[uid] = live
-                    else:
-                        del self._failed_nodes[uid]
+                with self._failed_nodes_lock:
+                    for ref in alloc.pods:
+                        avoid = self._failed_nodes.setdefault(
+                            ref.pod_uuid, {}
+                        )
+                        for node in failing:
+                            avoid[node] = deadline
+                    # global prune on write: uids that never re-place
+                    # again must not pin expired entries forever
+                    for uid in list(self._failed_nodes):
+                        live = {n: dl for n, dl
+                                in self._failed_nodes[uid].items()
+                                if dl > now}
+                        if live:
+                            self._failed_nodes[uid] = live
+                        else:
+                            del self._failed_nodes[uid]
                 self._mark_deleted(alloc)
                 return 0.5
             if alloc.status == AllocationStatus.UNGATED:
@@ -504,16 +677,64 @@ class Controller:
                 component="controller", pod_uid=pod_uid,
                 trace_id=trace_id,
             )
+        pod_refs = [
+            PodRef(
+                pod_uuid=p["metadata"].get("uid", ""),
+                pod_name=p["metadata"]["name"],
+                namespace=p["metadata"].get("namespace", ""),
+                worker_id=i,
+                handoff_name=(
+                    p["metadata"].get("annotations") or {}
+                ).get(HANDOFF_ANNOTATION, ""),
+            )
+            for i, p in enumerate(
+                sorted(pods, key=lambda p: p["metadata"]["name"])
+            )
+        ]
+        if gid:
+            aid = self._group_alloc_id(pod_refs[0].namespace, gid)
+        else:
+            aid = pod_refs[0].pod_uuid
         with self.tracer.span(
             "controller.allocate", trace_id=trace_id,
             pod=pod_key, profile=profile.name,
         ) as sp:
-            placement = self._place(profile, slices, avoid=avoid)
-            if placement is None and avoid:
-                # nothing fits elsewhere — the failed node may be the only
-                # capacity (single-node cluster): retry in place rather
-                # than starving the pod
-                placement = self._place(profile, slices)
+            # Placement critical section: in-memory only (cache +
+            # overlay), never held across kube I/O — sharded workers
+            # serialize the CHOICE of chips and parallelize everything
+            # else (finalizers, CR fan-out, ungates, events).
+            with self.tracer.span("controller.place") as psp, \
+                    self._placement_lock:
+                if aid in self._inflight:
+                    # a peer pod's worker is granting this very
+                    # allocation right now; take the existing path
+                    # once its writes land
+                    sp.drop = psp.drop = True
+                    return 0.1
+                if self._cache_ready():
+                    # recheck behind the lock: a peer worker may have
+                    # granted this allocation after our stale top-of-
+                    # reconcile read (write-through makes it visible)
+                    if self._find_allocation(
+                        slices, pod_uid=pod_uid
+                    ) is not None:
+                        sp.drop = psp.drop = True
+                        return 0.05
+                    # fresh cache view under the lock (the list read
+                    # at the top of the reconcile predates it)
+                    slices = self._load_slices()
+                placement = self._place(profile, slices, avoid=avoid)
+                if placement is None and avoid:
+                    # nothing fits elsewhere — the failed node may be
+                    # the only capacity (single-node cluster): retry in
+                    # place rather than starving the pod
+                    placement = self._place(profile, slices)
+                if placement is not None:
+                    self._inflight[aid] = (
+                        placement.box,
+                        frozenset(placement.node_names),
+                        placement.group_id,
+                    )
             if placement is None:
                 sp.attrs["placed"] = "false"
                 sp.drop = pending_tid is not None
@@ -536,30 +757,38 @@ class Controller:
                 return self.no_capacity_requeue
             self._set_pending(pod_key, False)
             sp.attrs["box"] = placement.box.key()
-            pod_refs = [
-                PodRef(
-                    pod_uuid=p["metadata"].get("uid", ""),
-                    pod_name=p["metadata"]["name"],
-                    namespace=p["metadata"].get("namespace", ""),
-                    worker_id=i,
-                    handoff_name=(
-                        p["metadata"].get("annotations") or {}
-                    ).get(HANDOFF_ANNOTATION, ""),
-                )
-                for i, p in enumerate(
-                    sorted(pods, key=lambda p: p["metadata"]["name"])
-                )
-            ]
-            if gid:
-                aid = self._group_alloc_id(pod_refs[0].namespace, gid)
-            else:
-                aid = pod_refs[0].pod_uuid
             alloc = AllocationDetails.from_placement(
                 placement, pod_refs, alloc_id=aid, trace_id=trace_id
             )
-            for p in pods:
-                self._ensure_finalizer(p)
-            self._write_allocation(alloc)
+            try:
+                for p in pods:
+                    self._ensure_finalizer(p)
+                placed = self._write_allocation(alloc)
+            finally:
+                # the write (or its failure) is now the source of
+                # truth: success is cache-visible via write-through,
+                # failure is retried after requeue — either way the
+                # overlay entry has served its purpose
+                with self._placement_lock:
+                    self._inflight.pop(aid, None)
+            if not placed:
+                # Server-side overlap guard refused the box on at least
+                # one CR (stale cache at placement time). Roll the
+                # partial fan-out back through the normal teardown
+                # machinery — marking the record DELETED makes the
+                # agents erase the copies that DID land; leaving them
+                # would pin chips forever (the next reconcile would
+                # find the partial allocation, take the existing path,
+                # and _repair_fanout would retry the refused write
+                # against the same overlap for eternity). Re-place
+                # after the erase, under the SAME trace id, so the
+                # retry doesn't re-emit Admitted or fork the grant
+                # across two traces.
+                sp.attrs["placed"] = "conflict"
+                self._mark_deleted(alloc)
+                with self._pending_lock:
+                    self._pending_trace[pod_key] = trace_id
+                return 0.2
             for ref in pod_refs:
                 emit_pod_event(
                     self.client, ref.namespace, ref.pod_name,
@@ -591,75 +820,228 @@ class Controller:
         return f"{gid}-{h}"
 
     def _group_peers(self, namespace: str, gid: str) -> List[dict]:
-        from instaslice_tpu.controller.gates import GROUP_ANNOTATION
-
-        peers = []
-        for p in self.client.list("Pod", namespace=namespace):
-            ann = p.get("metadata", {}).get("annotations") or {}
-            if ann.get(GROUP_ANNOTATION) == gid and is_pod_gated(p):
-                peers.append(p)
+        if (
+            self._use_cache
+            and self._pods_inf is not None
+            and self._pods_inf.synced()
+        ):
+            # gated-group secondary index: O(peers), not a full
+            # namespace scan per group reconcile
+            peers = list(
+                self._pods_inf.by_index(
+                    INDEX_GATED_GROUP, f"{namespace}/{gid}"
+                )
+            )
+        else:
+            peers = []
+            for p in self.client.list("Pod", namespace=namespace):
+                ann = p.get("metadata", {}).get("annotations") or {}
+                if ann.get(GROUP_ANNOTATION) == gid and is_pod_gated(p):
+                    peers.append(p)
         return sorted(peers, key=lambda p: p["metadata"]["name"])
 
     def _avoid_nodes_for(self, pod_uid: str) -> frozenset:
         """Nodes whose device layer recently failed this pod's
         allocation (entries expire after ``failed_node_avoid_seconds``,
         pruned here)."""
-        avoid = self._failed_nodes.get(pod_uid)
-        if not avoid:
-            return frozenset()
-        now = time.monotonic()
-        live = {n for n, dl in avoid.items() if dl > now}
-        if not live:
-            del self._failed_nodes[pod_uid]
-            return frozenset()
-        self._failed_nodes[pod_uid] = {
-            n: dl for n, dl in avoid.items() if dl > now
-        }
-        return frozenset(live)
+        with self._failed_nodes_lock:
+            avoid = self._failed_nodes.get(pod_uid)
+            if not avoid:
+                return frozenset()
+            now = time.monotonic()
+            live = {n for n, dl in avoid.items() if dl > now}
+            if not live:
+                del self._failed_nodes[pod_uid]
+                return frozenset()
+            self._failed_nodes[pod_uid] = {
+                n: dl for n, dl in avoid.items() if dl > now
+            }
+            return frozenset(live)
+
+    def _build_group(
+        self, gid: str, members: List[TpuSlice]
+    ) -> Optional[TorusGroup]:
+        """TorusGroup construction for one gid (mixed-generation and
+        invalid-bounds checks included)."""
+        gen_name = members[0].spec.generation
+        if any(m.spec.generation != gen_name for m in members):
+            log.warning("torus group %s mixes generations; skipping", gid)
+            return None
+        gen = get_generation(gen_name)
+        hb = gen.host_bounds
+        bounds: Shape = tuple(  # type: ignore[assignment]
+            max(m.spec.host_offset[i] for m in members) + hb[i]
+            for i in range(3)
+        )
+        try:
+            return TorusGroup(
+                group_id=gid,
+                generation=gen,
+                bounds=bounds,
+                hosts={
+                    m.name: NodeGrid(
+                        generation=gen,
+                        host_offset=m.spec.host_offset,
+                        torus_group=gid,
+                    )
+                    for m in members
+                },
+            )
+        except ValueError as e:
+            log.warning("torus group %s invalid: %s", gid, e)
+            return None
+
+    def _try_group(
+        self, gid: str, group: TorusGroup, members: List[TpuSlice],
+        profile: TopologyProfile, avoid: frozenset,
+    ) -> Optional[Placement]:
+        try:
+            occ = self._occupancy(group, members)
+        except ValueError as e:
+            log.warning("group %s occupancy corrupt: %s", gid, e)
+            return None
+        for m in members:
+            if m.name in avoid:
+                # blocked, not occupied: the tile may legitimately
+                # hold other pods' live boxes
+                hb = group.generation.host_bounds
+                occ.block(Box(
+                    anchor=tuple(m.spec.host_offset),  # type: ignore
+                    shape=hb,
+                ).coords())
+        return self.policy.choose(group, profile, occ)
 
     def _place(
         self, profile: TopologyProfile, slices: List[TpuSlice],
         avoid: frozenset = frozenset(),
     ) -> Optional[Placement]:
+        """Caller holds ``_placement_lock`` (via ``_handle_gated``):
+        the overlay, the group memos, and the no-fit cache are all read
+        and written under it."""
+        if self._cache_ready():
+            return self._place_indexed(profile, avoid)
+        # legacy full-scan (the measured baseline, and pre-sync startup)
         for gid, (group, members) in sorted(
             self._torus_groups(slices).items()
         ):
             if group.generation.name != profile.generation:
                 continue
-            try:
-                occ = self._occupancy(group, members)
-            except ValueError as e:
-                log.warning("group %s occupancy corrupt: %s", gid, e)
-                continue
-            for m in members:
-                if m.name in avoid:
-                    # blocked, not occupied: the tile may legitimately
-                    # hold other pods' live boxes
-                    hb = group.generation.host_bounds
-                    occ.block(Box(
-                        anchor=tuple(m.spec.host_offset),  # type: ignore
-                        shape=hb,
-                    ).coords())
-            placement = self.policy.choose(group, profile, occ)
+            placement = self._try_group(gid, group, members, profile, avoid)
             if placement is not None:
                 return placement
         return None
 
+    def _place_indexed(
+        self, profile: TopologyProfile, avoid: frozenset
+    ) -> Optional[Placement]:
+        """First-fit over the torus-group index with O(1) skip of
+        unchanged no-fit groups: the informer bumps a per-group version
+        on any member CR write, so a full group costs one dict probe
+        per pending pod — not an occupancy recomputation — until one of
+        its CRs actually changes (docs/SCALING.md)."""
+        inf = self._slices_inf
+        for gid in inf.index_keys(INDEX_SLICE_GROUP):  # type: ignore
+            ver = inf.index_version(INDEX_SLICE_GROUP, gid)  # type: ignore
+            inflight_sig = frozenset(
+                aid for aid, (_b, _n, g) in self._inflight.items()
+                if g == gid
+            )
+            fp = (ver, inflight_sig)
+            if not avoid and self._no_fit.get((gid, profile.name)) == fp:
+                continue
+            cached = self._members_cache.get(gid)
+            if cached is not None and cached[0] == ver:
+                members, group = cached[1], cached[2]
+            else:
+                members = [
+                    m for m in inf.by_index(  # type: ignore
+                        INDEX_SLICE_GROUP, gid, transformed=True
+                    )
+                    if m.status.processed and m.spec.generation
+                ]
+                group = self._build_group(gid, members) if members else None
+                self._members_cache[gid] = (ver, members, group)
+            if group is None or group.generation.name != profile.generation:
+                continue
+            placement = self._try_group(gid, group, members, profile, avoid)
+            if placement is not None:
+                self._no_fit.pop((gid, profile.name), None)
+                return placement
+            if not avoid:
+                self._no_fit[(gid, profile.name)] = fp
+        return None
+
     # --------------------------------------------------- allocation writes
 
-    def _write_allocation(self, alloc: AllocationDetails) -> None:
-        for node in alloc.parts:
-            def mut(obj: dict) -> Optional[dict]:
-                ts = TpuSlice.from_manifest(obj)
-                if alloc.alloc_id in ts.spec.allocations:
-                    return None
-                ts.spec.allocations[alloc.alloc_id] = alloc
-                return ts.to_manifest()
-
-            update_with_retry(
+    def _apply_cr(self, node: str, mut) -> Optional[dict]:
+        """One TpuSlice CR mutation: coalesced (batched per CR across
+        concurrent workers, one optimistic-concurrency round-trip per
+        burst) when the cache plane is on, the classic direct
+        ``update_with_retry`` otherwise. Server-confirmed results are
+        written through to the informer cache so this worker's next
+        placement sees its own write."""
+        if self._cr_writer is not None:
+            fence = self.fence
+            if fence is not None and self.manager.shard_lease:
+                # the batch may be committed by ANOTHER shard's worker:
+                # pin the fence to THIS worker's shard lease now, so a
+                # deposed shard's mutation is refused no matter which
+                # thread lands the batch (kube/coalesce.py)
+                shard = self.manager.current_shard()
+                mgr = self.manager
+                fence = (lambda s=shard: mgr.shard_is_leader(s))
+            stored = self._cr_writer.apply(node, mut, fence=fence)
+        else:
+            stored = update_with_retry(
                 self.client, KIND, self.namespace, node, mut,
                 fence=self.fence,
             )
+        if stored is not None and self._use_cache \
+                and self._slices_inf is not None:
+            self._slices_inf.write_through(stored)
+        return stored
+
+    def _write_allocation(self, alloc: AllocationDetails) -> bool:
+        """Fan the allocation record out to every involved CR. Returns
+        False when a CR's overlap guard refused the box — the
+        last-resort defense (a stale cache or overlay bug proposing
+        chips another allocation holds) that turns a would-be
+        double-allocation into a cheap re-place."""
+        new_box = Box.from_key(alloc.box)
+        own_suids = (
+            slice_uuid_for(alloc.alloc_id),
+            slice_uuid_for(alloc.alloc_id, multihost=True),
+        )
+        ok = True
+        for node in alloc.parts:
+            conflict = [False]
+
+            def mut(obj: dict, _c=conflict) -> Optional[dict]:
+                ts = TpuSlice.from_manifest(obj)
+                _c[0] = False  # conflict retry re-reads fresh state
+                if alloc.alloc_id in ts.spec.allocations:
+                    return None
+                for other in ts.spec.allocations.values():
+                    if Box.from_key(other.box).overlaps(new_box):
+                        _c[0] = True
+                        return None
+                for suid, prep in ts.spec.prepared.items():
+                    if suid in own_suids:
+                        continue
+                    if Box.from_key(prep.box).overlaps(new_box):
+                        _c[0] = True
+                        return None
+                ts.spec.allocations[alloc.alloc_id] = alloc
+                return ts.to_manifest()
+
+            self._apply_cr(node, mut)
+            if conflict[0]:
+                log.warning(
+                    "allocation %s: box %s overlaps existing state on "
+                    "%s; re-placing", alloc.alloc_id, alloc.box, node,
+                )
+                ok = False
+        return ok
 
     def _repair_fanout(
         self, alloc: AllocationDetails, slices: List[TpuSlice]
@@ -682,25 +1064,21 @@ class Controller:
         CR race observes the same event twice."""
         transitioned = False
         for node in alloc.parts:
-            applied = [False]
-
             def mut(obj: dict) -> Optional[dict]:
                 ts = TpuSlice.from_manifest(obj)
                 a = ts.spec.allocations.get(alloc.alloc_id)
-                applied[0] = False  # conflict retry re-reads fresh state
                 if a is None:
                     return None
                 if not mutate(a):
                     return None
-                applied[0] = True
                 return ts.to_manifest()
 
             try:
-                update_with_retry(
-                    self.client, KIND, self.namespace, node, mut,
-                    fence=self.fence,
-                )
-                transitioned = transitioned or applied[0]
+                # _apply_cr returns the stored manifest exactly when
+                # THIS mutation applied (the coalescer tracks per-op
+                # application) — the transition signal
+                stored = self._apply_cr(node, mut)
+                transitioned = transitioned or stored is not None
             except NotFound:
                 log.warning("CR %s gone while updating %s", node,
                             alloc.alloc_id)
@@ -869,7 +1247,7 @@ class Controller:
         )
         for p in alloc.pods:
             try:
-                obj = self.client.get("Pod", p.namespace, p.pod_name)
+                obj = self._get_pod(p.namespace, p.pod_name)
             except NotFound:
                 continue
             md = obj.get("metadata", {})
@@ -934,7 +1312,8 @@ class Controller:
         md = pod["metadata"]
         self._set_pending(self._pod_key(pod), False)
         # the pod is going away: its failed-node memory goes with it
-        self._failed_nodes.pop(md.get("uid", ""), None)
+        with self._failed_nodes_lock:
+            self._failed_nodes.pop(md.get("uid", ""), None)
         finalizers = md.get("finalizers", []) or []
         if FINALIZER not in finalizers:
             return None
@@ -1004,6 +1383,11 @@ class Controller:
 
     def _ensure_finalizer(self, pod: dict) -> None:
         md = pod["metadata"]
+        if FINALIZER in (md.get("finalizers") or []):
+            # already present in the view we were handed (cache or
+            # fresh get): finalizers are only ever removed on deletion,
+            # so the write (and its get round-trip) can be skipped
+            return
 
         def mut(p: dict) -> Optional[dict]:
             fins = p.setdefault("metadata", {}).setdefault("finalizers", [])

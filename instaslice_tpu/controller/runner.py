@@ -51,10 +51,19 @@ class ControllerRunner:
         health_probe_bind_address: str = ":8081",
         leader_elect: bool = False,
         identity: str = "",
+        workers: Optional[int] = None,
+        shard_leases: bool = False,
     ) -> None:
+        """``shard_leases``: instead of ONE controller lease, each
+        reconcile shard worker holds Lease ``<LEASE_NAME>-shard-<i>`` —
+        multiple replicas split the shards between them (active-active
+        horizontal scale-out) while per-key ordering still holds
+        cluster-wide, and every write is fenced on the writing shard's
+        lease (docs/SCALING.md)."""
         self.client = client
         self.namespace = namespace
         self.leader_elect = leader_elect
+        self.shard_leases = shard_leases
         self.identity = identity or f"{socket.gethostname()}-{os.getpid()}"
         self.metrics = OperatorMetrics()
         # the journal's event counters ride this process's /metrics
@@ -76,6 +85,15 @@ class ControllerRunner:
             # lease: a deposed leader raises Fenced instead of racing its
             # successor's writes (tested in tests/test_runtime.py)
             fence=self._fence,
+            workers=workers,
+            shard_lease=(
+                {
+                    "namespace": namespace,
+                    "prefix": LEASE_NAME,
+                    "identity": self.identity,
+                }
+                if shard_leases else None
+            ),
         )
         self._stop = threading.Event()
         self._ready = False
@@ -83,8 +101,12 @@ class ControllerRunner:
         self.elector: Optional[LeaderElector] = None
 
     def _fence(self) -> bool:
-        """Leadership fence for controller writes; always open when
-        election is off (single-replica / tests)."""
+        """Leadership fence for controller writes. With per-shard leases
+        the writing worker's own shard lease is the fence; with the
+        single global lease it's that lease; always open when election
+        is off (single-replica / tests)."""
+        if self.shard_leases:
+            return self.controller.manager.shard_is_leader()
         if not self.leader_elect or self.elector is None:
             return True
         return self.elector.is_leader.is_set()
@@ -101,6 +123,8 @@ class ControllerRunner:
             metrics_bind_address=args.metrics_bind_address,
             health_probe_bind_address=args.health_probe_bind_address,
             leader_elect=args.leader_elect,
+            workers=getattr(args, "workers", None),
+            shard_leases=getattr(args, "shard_leases", False),
         )
 
     # ------------------------------------------------------------------
@@ -124,7 +148,9 @@ class ControllerRunner:
         start_metrics_server(
             self.metrics, self.metrics_port, host=self.metrics_host
         )
-        if self.leader_elect:
+        if self.leader_elect and not self.shard_leases:
+            # (with per-shard leases the workers acquire their own
+            # shard Leases as they start — no global gate to wait on)
             self.elector = LeaderElector(
                 self.client, self.namespace, LEASE_NAME, self.identity
             )

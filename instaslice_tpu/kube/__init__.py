@@ -26,3 +26,5 @@ from instaslice_tpu.kube.client import (
     update_with_retry,
 )
 from instaslice_tpu.kube.fake import FakeKube
+from instaslice_tpu.kube.informer import Informer
+from instaslice_tpu.kube.coalesce import CoalescedWriter

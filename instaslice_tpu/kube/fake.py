@@ -68,6 +68,18 @@ class FakeKube(KubeClient):
         #: (seq, event, kind, namespace, snapshot) — event log for resume
         self._history: List[Tuple[int, str, str, str, dict]] = []
         self.request_count = 0  # observability for tests/bench
+        #: copy-on-read snapshots served by list(): one deepcopy per
+        #: object per resourceVersion instead of one per read. Without
+        #: this every reconcile's list() is O(cluster size) in
+        #: deepcopies — the dominant fake-apiserver cost at 1k nodes.
+        #: Snapshots are SHARED with callers: read-only by contract; a
+        #: caller mutation can never reach ``_objects`` (the store),
+        #: only other readers of the same stale snapshot. ``get()``
+        #: still deepcopies (lock-free — stored objects are immutable)
+        #: because get-mutate-update writers need a private copy; watch
+        #: streams share the frozen stored objects directly.
+        self._snapshots: Dict[_Key, dict] = {}
+        self._snapshot_rv: Dict[_Key, str] = {}
 
     # ------------------------------------------------------------- helpers
 
@@ -82,55 +94,77 @@ class FakeKube(KubeClient):
         self._rv += 1
         return str(self._rv)
 
+    def _snapshot(self, key: _Key, obj: dict) -> dict:
+        """Copy-on-read: reuse the cached deepcopy while the stored
+        resourceVersion is unchanged (invalidation keys on the rv
+        recorded at snapshot time, NOT on the snapshot's own metadata —
+        a caller scribbling on the shared snapshot must not be able to
+        confuse the cache)."""
+        rv = obj.get("metadata", {}).get("resourceVersion", "")
+        snap = self._snapshots.get(key)
+        if snap is None or self._snapshot_rv.get(key) != rv:
+            snap = copy.deepcopy(obj)
+            self._snapshots[key] = snap
+            self._snapshot_rv[key] = rv
+        return snap
+
     def _emit(self, event: str, kind: str, obj: dict) -> None:
         ns = obj.get("metadata", {}).get("namespace", "")
-        snapshot = copy.deepcopy(obj)
+        # Store invariant: objects are IMMUTABLE once stored (every
+        # write path builds a fresh object or fresh metadata before
+        # committing), so the log and every watcher can share `obj`
+        # itself — zero copies on the write path. Deepcopying here (the
+        # old behavior) held the store lock for the whole copy on EVERY
+        # write; under a dozen reconcile workers that lock convoy was
+        # the control plane's actual throughput ceiling.
         if event == "DELETED":
-            # the stored rv is stale at deletion time; stamp the event with
-            # a fresh one so resumed watches order it after the last update
-            # (the real API server does the same)
-            snapshot.setdefault("metadata", {})["resourceVersion"] = (
-                self._next_rv()
-            )
+            # the stored rv is stale at deletion time; stamp the event
+            # with a fresh one (on a private metadata dict — the stored
+            # object stays frozen) so resumed watches order it after
+            # the last update (the real API server does the same)
+            md = dict(obj.get("metadata", {}))
+            md["resourceVersion"] = self._next_rv()
+            obj = dict(obj)
+            obj["metadata"] = md
         try:
-            seq = int(snapshot["metadata"].get("resourceVersion") or self._rv)
+            seq = int(obj["metadata"].get("resourceVersion") or self._rv)
         except (ValueError, KeyError):
             seq = self._rv
-        # `snapshot` stays private to the log (every delivery below and in
-        # replay hands out its own copy), so no extra copy needed here.
         # Trim in chunks: a per-write front-del would memmove the whole
         # list on every emit at steady state.
-        self._history.append((seq, event, kind, ns, snapshot))
+        self._history.append((seq, event, kind, ns, obj))
         if len(self._history) > 2 * self.HISTORY_MAX:
             del self._history[: len(self._history) - self.HISTORY_MAX]
         for w in list(self._watchers):
             if w.matches(kind, ns):
-                w.q.put((event, copy.deepcopy(snapshot)))
+                w.q.put((event, obj))
 
     # -------------------------------------------------------------- client
 
     def create(self, kind: str, obj: dict) -> dict:
+        stored = copy.deepcopy(obj)  # outside the lock: caller's object
         with self._lock:
             self.request_count += 1
-            key = self._key(kind, obj)
+            key = self._key(kind, stored)
             if key in self._objects:
                 raise AlreadyExists(f"{kind} {key[1]}/{key[2]} exists")
-            stored = copy.deepcopy(obj)
             md = stored.setdefault("metadata", {})
             md["resourceVersion"] = self._next_rv()
             md.setdefault("uid", f"uid-{kind.lower()}-{md['name']}-{self._rv}")
             md.setdefault("creationTimestamp", time.time())
             self._objects[key] = stored
             self._emit("ADDED", kind, stored)
-            return copy.deepcopy(stored)
+        return copy.deepcopy(stored)  # stored is frozen: copy lock-free
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
         with self._lock:
             self.request_count += 1
-            key = (kind, namespace, name)
-            if key not in self._objects:
-                raise NotFound(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(self._objects[key])
+            obj = self._objects.get((kind, namespace, name))
+        if obj is None:
+            raise NotFound(f"{kind} {namespace}/{name} not found")
+        # get-mutate-update callers need a private copy; the stored
+        # object is immutable, so the deepcopy happens lock-free
+        return copy.deepcopy(obj)
 
     def list(
         self,
@@ -141,7 +175,8 @@ class FakeKube(KubeClient):
         with self._lock:
             self.request_count += 1
             out = []
-            for (k, ns, _), obj in sorted(self._objects.items()):
+            for key, obj in sorted(self._objects.items()):
+                k, ns, _ = key
                 if k != kind:
                     continue
                 if namespace is not None and ns != namespace:
@@ -150,42 +185,51 @@ class FakeKube(KubeClient):
                     labels = obj.get("metadata", {}).get("labels", {})
                     if any(labels.get(lk) != lv for lk, lv in label_selector.items()):
                         continue
-                out.append(copy.deepcopy(obj))
+                out.append(self._snapshot(key, obj))
             return out
 
     def update(self, kind: str, obj: dict) -> dict:
+        merged = copy.deepcopy(obj)  # outside the lock: caller's object
         with self._lock:
             self.request_count += 1
-            key = self._key(kind, obj)
+            key = self._key(kind, merged)
             if key not in self._objects:
                 raise NotFound(f"{kind} {key[1]}/{key[2]} not found")
             stored = self._objects[key]
-            sent_rv = obj.get("metadata", {}).get("resourceVersion", "")
+            sent_rv = merged.get("metadata", {}).get("resourceVersion", "")
             if sent_rv and sent_rv != stored["metadata"]["resourceVersion"]:
                 raise Conflict(
                     f"{kind} {key[1]}/{key[2]}: resourceVersion {sent_rv} "
                     f"!= {stored['metadata']['resourceVersion']}"
                 )
-            merged = copy.deepcopy(obj)
             md = merged.setdefault("metadata", {})
             # server-owned fields survive the replace
             md["uid"] = stored["metadata"].get("uid", "")
             md["creationTimestamp"] = stored["metadata"].get("creationTimestamp")
             if "deletionTimestamp" in stored["metadata"]:
                 md["deletionTimestamp"] = stored["metadata"]["deletionTimestamp"]
-            return self._commit(key, kind, merged)
+            out = self._commit(key, kind, merged)
+        return copy.deepcopy(out)
 
     def _commit(self, key: _Key, kind: str, obj: dict) -> dict:
         """Store + emit, honoring finalizer-gated deletion. No-op writes
         (content identical to stored) do not bump resourceVersion and emit
         no event — matching the real API server, and required so a
         reconciler re-applying its own annotation can't feed itself an
-        endless MODIFIED stream."""
+        endless MODIFIED stream.
+
+        ``obj.metadata`` must be private to this commit (callers pass a
+        deepcopy or a freshly-built metadata dict): the rv stamp below
+        must never reach a previously-stored — and therefore frozen —
+        object. Returns the stored object itself (immutable; public
+        verbs deepcopy outside the lock)."""
         md = obj["metadata"]
         if md.get("deletionTimestamp") and not md.get("finalizers"):
             del self._objects[key]
+            self._snapshots.pop(key, None)
+            self._snapshot_rv.pop(key, None)
             self._emit("DELETED", kind, obj)
-            return copy.deepcopy(obj)
+            return obj
         stored = self._objects.get(key)
         if stored is not None:
             a = {k: v for k, v in stored.items() if k != "metadata"}
@@ -194,11 +238,11 @@ class FakeKube(KubeClient):
                   if k != "resourceVersion"}
             mb = {k: v for k, v in md.items() if k != "resourceVersion"}
             if a == b and ma == mb:
-                return copy.deepcopy(stored)
+                return stored
         md["resourceVersion"] = self._next_rv()
         self._objects[key] = obj
         self._emit("MODIFIED", kind, obj)
-        return copy.deepcopy(obj)
+        return obj
 
     def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
         with self._lock:
@@ -208,8 +252,11 @@ class FakeKube(KubeClient):
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             stored = self._objects[key]
             merged = merge_patch(stored, patch)
+            # unpatched subtrees SHARE structure with the (frozen)
+            # stored object — cheap and safe; but metadata must be
+            # private so _commit's rv stamp can't touch the old version
+            merged["metadata"] = dict(merged.get("metadata", {}))
             # metadata server fields cannot be patched away
-            merged.setdefault("metadata", {})
             for f in ("uid", "creationTimestamp", "resourceVersion"):
                 if f in stored["metadata"]:
                     merged["metadata"][f] = stored["metadata"][f]
@@ -217,7 +264,8 @@ class FakeKube(KubeClient):
                 merged["metadata"]["deletionTimestamp"] = stored["metadata"][
                     "deletionTimestamp"
                 ]
-            return self._commit(key, kind, merged)
+            out = self._commit(key, kind, merged)
+        return copy.deepcopy(out)
 
     def patch_status(
         self, kind: str, namespace: str, name: str, patch: dict
@@ -234,11 +282,19 @@ class FakeKube(KubeClient):
             md = obj["metadata"]
             if md.get("finalizers"):
                 if not md.get("deletionTimestamp"):
-                    md["deletionTimestamp"] = time.time()
-                    md["resourceVersion"] = self._next_rv()
-                    self._emit("MODIFIED", kind, obj)
+                    # fresh object + metadata: stored versions are
+                    # frozen (shared with the log and every watcher)
+                    new_md = dict(md)
+                    new_md["deletionTimestamp"] = time.time()
+                    new_md["resourceVersion"] = self._next_rv()
+                    new_obj = dict(obj)
+                    new_obj["metadata"] = new_md
+                    self._objects[key] = new_obj
+                    self._emit("MODIFIED", kind, new_obj)
                 return
             del self._objects[key]
+            self._snapshots.pop(key, None)
+            self._snapshot_rv.pop(key, None)
             self._emit("DELETED", kind, obj)
 
     def watch(
@@ -266,9 +322,12 @@ class FakeKube(KubeClient):
         w = _Watcher(kind, namespace)
 
         def _relist() -> None:
-            for (k, ns, _), obj in sorted(self._objects.items()):
+            # stored objects are frozen and shared (read-only watch
+            # contract): a 1k-node resync copies nothing
+            for key, obj in sorted(self._objects.items()):
+                k, ns, _ = key
                 if k == kind and (namespace is None or ns == namespace):
-                    w.q.put(("ADDED", copy.deepcopy(obj)))
+                    w.q.put(("ADDED", obj))
 
         def _replay_log(after: int) -> None:
             for seq, ev, k, ns, snap in self._history:
@@ -277,7 +336,7 @@ class FakeKube(KubeClient):
                     and k == kind
                     and (namespace is None or ns == namespace)
                 ):
-                    w.q.put((ev, copy.deepcopy(snap)))
+                    w.q.put((ev, snap))
 
         with self._lock:
             rv: Optional[int] = None

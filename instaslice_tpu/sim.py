@@ -38,8 +38,92 @@ from instaslice_tpu.controller.gates import (
 from instaslice_tpu.device import FakeTpuBackend
 from instaslice_tpu.kube import FakeKube, NotFound
 from instaslice_tpu.topology.grid import get_generation
+from instaslice_tpu.utils.lockcheck import named_lock
 
 log = logging.getLogger("instaslice_tpu.sim")
+
+
+class _NoReservations:
+    """Backend stand-in for bulk CR publication: a fresh sim node has no
+    dangling device reservations to adopt."""
+
+    def list_reservations(self):
+        return []
+
+
+class FleetAgents:
+    """Every simulated node's agent behind ONE sharded reconcile manager.
+
+    A per-node :class:`NodeAgent` runs two threads (watch + worker);
+    at 1k nodes that is thousands of idle threads before the first
+    grant. Here a single watch on the TpuSlice namespace fans CR events
+    out to ``workers`` key-hash-sharded workers, and the per-node agent
+    objects (and their fake backends) are built lazily on first touch —
+    the "lazy node construction" half of the scale tier
+    (docs/SCALING.md). Per-key sharding keeps the per-node serialization
+    NodeAgent.reconcile always had."""
+
+    def __init__(
+        self,
+        client,
+        backend_factory,
+        namespace: str,
+        workers: int = 8,
+        metrics=None,
+        wrap_backend=None,
+    ) -> None:
+        from instaslice_tpu.utils.reconcile import Manager
+
+        self.client = client
+        self.namespace = namespace
+        self.metrics = metrics
+        self._backend_factory = backend_factory
+        self._wrap_backend = wrap_backend or (lambda b: b)
+        self._agents: Dict[str, NodeAgent] = {}
+        self._lock = named_lock("sim.fleet")
+        self.manager = Manager(
+            name="agents",
+            client=client,
+            reconcile=self._reconcile,
+            watches=[("TpuSlice", namespace, self._map_cr)],
+            workers=workers,
+        )
+
+    @staticmethod
+    def _map_cr(event: str, obj: dict) -> List[str]:
+        """Only CRs carrying agent work map to a key: an allocation-less,
+        reservation-less CR has nothing to realize or tear down, so the
+        1k-node boot burst (and every idle resync) constructs no agents
+        — this is what makes node construction actually lazy."""
+        spec = obj.get("spec", {})
+        if not spec.get("allocations") and not spec.get("prepared"):
+            return []
+        return [obj["metadata"]["name"]]
+
+    def _ensure(self, node: str) -> NodeAgent:
+        with self._lock:
+            agent = self._agents.get(node)
+            if agent is None:
+                agent = NodeAgent(
+                    self.client,
+                    self._wrap_backend(self._backend_factory(node)),
+                    node,
+                    self.namespace,
+                    metrics=self.metrics,
+                    health_interval=0,
+                    manager=self.manager,
+                )
+                self._agents[node] = agent
+            return agent
+
+    def _reconcile(self, key: str):
+        return self._ensure(key).reconcile(key)
+
+    def start(self) -> None:
+        self.manager.start()
+
+    def stop(self) -> None:
+        self.manager.stop()
 
 
 class SimCluster:
@@ -57,6 +141,12 @@ class SimCluster:
         transport: str = "inproc",
         backend: str = "fake",
         fault_plan=None,
+        nodes_per_group: Optional[int] = None,
+        fleet_agents: bool = False,
+        agent_workers: int = 8,
+        workers: Optional[int] = None,
+        use_cache: bool = True,
+        bind_latency: float = 0.0,
     ) -> None:
         """``transport="inproc"`` wires every component straight to the
         in-process FakeKube. ``transport="http"`` puts the store behind
@@ -87,7 +177,25 @@ class SimCluster:
         backend in a :class:`~instaslice_tpu.faults.FaultyBackend`, so
         any sim-driven tier runs under seeded fault injection with no
         code changes. The submit/observe client (``self.kube``) stays
-        clean — tests assert through it."""
+        clean — tests assert through it.
+
+        Scale-tier knobs (docs/SCALING.md):
+
+        - ``nodes_per_group``: split the fleet into independent torus
+          groups of this many hosts (None keeps the legacy behavior —
+          one shared torus, or standalone hosts without
+          ``shared_torus``).
+        - ``fleet_agents``: drive all node agents from ONE sharded
+          reconcile manager (``agent_workers`` workers) with lazy
+          per-node construction, instead of two threads per node —
+          required to simulate 1k+ nodes. Forces ``backend="fake"``,
+          no device plugins, health sweeps off.
+        - ``workers`` / ``use_cache``: controller reconcile concurrency
+          and informer-cache plane (``use_cache=False`` +
+          ``workers=1`` is the measured serial re-list baseline of
+          ``bench.py --scale``).
+        - ``bind_latency``: the simulated kubelet's delay between an
+          ungated Pending pod appearing and its bind to Running."""
         from instaslice_tpu.faults import (
             FaultPlan,
             FaultyBackend,
@@ -124,14 +232,33 @@ class SimCluster:
             self._wrap_backend = lambda b: b
         self.namespace = namespace
         self.generation = generation
+        self.bind_latency = max(0.0, bind_latency)
         gen = get_generation(generation)
         hb = gen.host_bounds
         self.backends: Dict[str, FakeTpuBackend] = {}
         self.agents: Dict[str, NodeAgent] = {}
         self.mock_servers: Dict[str, object] = {}
+        self.fleet: Optional[FleetAgents] = None
         if backend not in ("fake", "cloudtpu"):
             raise ValueError(f"unknown sim backend {backend!r}")
-        group = "sim-torus" if shared_torus and n_nodes > 1 else ""
+        if fleet_agents and (backend != "fake" or device_plugins):
+            raise ValueError(
+                "fleet_agents supports only backend='fake' without "
+                "device plugins"
+            )
+
+        def topo_for(i: int):
+            """(torus group id, host offset) for node index ``i``."""
+            if nodes_per_group is not None and nodes_per_group >= 1:
+                g = f"sim-torus-{i // nodes_per_group}"
+                return g, ((i % nodes_per_group) * hb[0], 0, 0)
+            if shared_torus and n_nodes > 1:
+                return "sim-torus", (i * hb[0], 0, 0)
+            return "", (0, 0, 0)
+
+        self._node_topo = {
+            f"node-{i}": topo_for(i) for i in range(n_nodes)
+        }
         for i in range(n_nodes):
             node = f"node-{i}"
             self.kube.create(
@@ -143,7 +270,9 @@ class SimCluster:
                     "status": {"capacity": {}, "allocatable": {}},
                 },
             )
-            host_offset = (i * hb[0], 0, 0) if group else (0, 0, 0)
+            if fleet_agents:
+                continue  # backends + agents built lazily by the fleet
+            group, host_offset = self._node_topo[node]
             if backend == "cloudtpu":
                 from instaslice_tpu.device.cloudtpu import CloudTpuBackend
                 from instaslice_tpu.device.cloudtpu_mock import (
@@ -174,12 +303,23 @@ class SimCluster:
                 node, namespace,
                 metrics=metrics, health_interval=health_interval,
             )
+        if fleet_agents:
+            self.fleet = FleetAgents(
+                self._client_for(),
+                self._fleet_backend,
+                namespace,
+                workers=agent_workers,
+                metrics=metrics,
+                wrap_backend=self._wrap_backend,
+            )
         self.controller = Controller(
             self._client_for(),
             namespace=namespace,
             policy=policy,
             deletion_grace_seconds=deletion_grace_seconds,
             metrics=metrics,
+            workers=workers,
+            use_cache=use_cache,
         )
         # Optional fake-kubelet tier: a per-node SlicePluginManager serving
         # real gRPC device plugins over unix sockets; the sim scheduler
@@ -198,35 +338,97 @@ class SimCluster:
                     register_with_kubelet=False,
                 )
                 self._dp_allocated[node] = set()
-        self._sched_stop = threading.Event()
-        self._sched = threading.Thread(
-            target=self._scheduler_loop, name="sim-scheduler", daemon=True
+        # Watch-driven kube-scheduler emulator: pod events feed a
+        # single-worker reconcile manager instead of a 20 ms full-pod
+        # poll (O(pods) per sweep — at 10k pending pods the old sweep
+        # burned more CPU than the operator it was hosting). Node
+        # capacity lookups ride a resource-indexed Node informer.
+        from instaslice_tpu.utils.reconcile import Manager
+
+        self._first_bindable: Dict[str, float] = {}
+        self._sched_mgr = Manager(
+            name="sim-scheduler",
+            client=self.kube,
+            reconcile=self._bind_pod,
+            watches=[
+                ("Pod", None, self._sched_pod_map),
+                ("Node", None, lambda ev, obj: []),
+            ],
+            indexers={"Node": {"resource": self._node_resources}},
+            workers=1,
+            # the relist safety net for any missed event; events do the
+            # real-time work so this can stay cheap
+            resync_period=2.0,
         )
+
+    # ------------------------------------------------------------ fleet
+
+    def _fleet_backend(self, node: str) -> FakeTpuBackend:
+        """Lazy per-node backend for fleet mode (cached for observers —
+        tests read ``sim.backends[node]`` for the clean view)."""
+        b = self.backends.get(node)
+        if b is None:
+            group, host_offset = self._node_topo[node]
+            b = FakeTpuBackend(
+                generation=self.generation,
+                host_offset=host_offset,
+                torus_group=group,
+            )
+            self.backends[node] = b
+        return b
+
+    def _publish_fleet_crs(self) -> None:
+        """Bulk CR publication for fleet mode: what each agent's
+        ``boot()`` would have created, without constructing 1k agents
+        up front. The controller needs every node's capacity visible
+        before the first placement."""
+        from instaslice_tpu.agent.discovery import build_tpuslice
+        from instaslice_tpu.device.backend import NodeInventory
+
+        gen = get_generation(self.generation)
+        n = gen.chips_per_host
+        client = self.fleet.client
+        for node, (group, host_offset) in self._node_topo.items():
+            inv = NodeInventory(
+                generation=self.generation,
+                chip_paths={i: f"/dev/accel{i}" for i in range(n)},
+                host_offset=host_offset,
+                torus_group=group,
+                source="fake",
+            )
+            ts = build_tpuslice(
+                node, self.namespace, inv, _NoReservations()
+            )
+            client.create("TpuSlice", ts.to_manifest())
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "SimCluster":
         for agent in self.agents.values():
             agent.start()
+        if self.fleet is not None:
+            self._publish_fleet_crs()
+            self.fleet.start()
         for mgr in self.plugin_managers.values():
             mgr.start()
         self.controller.start()
-        self._sched.start()
+        self._sched_mgr.start()
         return self
 
     def stop(self) -> None:
-        self._sched_stop.set()
         self.controller.stop()
         for mgr in self.plugin_managers.values():
             mgr.stop()
+        if self.fleet is not None:
+            self.fleet.stop()
         for agent in self.agents.values():
             agent.stop()
+        self._sched_mgr.stop(timeout=2)
         self.backing.stop_watches()
         for srv in self.mock_servers.values():
             srv.stop()
         if self.server is not None:
             self.server.stop()
-        self._sched.join(timeout=2)
 
     def __enter__(self) -> "SimCluster":
         return self.start()
@@ -351,44 +553,68 @@ class SimCluster:
 
     # ----------------------------------------------- kube-scheduler emulator
 
-    def _scheduler_loop(self) -> None:
-        """Bind ungated Pending pods to the node advertising their per-pod
-        extended resource; fall back to any node when the pod requests no
-        pinning resource. Sets phase=Running (container start is out of
-        scope for the sim)."""
-        while not self._sched_stop.is_set():
-            try:
-                for pod in self.kube.list("Pod"):
-                    md = pod["metadata"]
-                    spec = pod.get("spec", {})
-                    if md.get("deletionTimestamp"):
-                        continue
-                    if spec.get("schedulingGates"):
-                        continue
-                    if pod.get("status", {}).get("phase") != "Pending":
-                        continue
-                    node = self._node_for(pod)
-                    if node is None:
-                        continue
-                    patch = {
-                        "spec": {"nodeName": node},
-                        "status": {"phase": "Running"},
-                    }
-                    dp_profile = self._device_resource_profile(pod)
-                    if self.plugin_managers and dp_profile:
-                        granted = self._kubelet_allocate(node, dp_profile)
-                        if granted is None:
-                            continue  # no device yet: stays Pending
-                        patch["metadata"] = {"annotations": granted}
-                    self.kube.patch(
-                        "Pod", md.get("namespace", ""), md["name"], patch,
-                    )
-            except Exception:
-                # a mid-churn list/patch can hit injected kube faults or
-                # a pod deleted under us; the next 20ms sweep retries —
-                # but leave a trail for chaos debugging
-                log.debug("sim scheduler sweep failed", exc_info=True)
-            self._sched_stop.wait(0.02)
+    @staticmethod
+    def _sched_pod_map(event: str, obj: dict) -> List[str]:
+        if event == "DELETED":
+            return []
+        md = obj.get("metadata", {})
+        if md.get("deletionTimestamp"):
+            return []
+        if obj.get("spec", {}).get("schedulingGates"):
+            return []  # still gated: the ungate event re-maps it
+        if obj.get("status", {}).get("phase") != "Pending":
+            return []
+        return [f"{md.get('namespace', '')}/{md.get('name', '')}"]
+
+    @staticmethod
+    def _node_resources(obj: dict) -> List[str]:
+        cap = obj.get("status", {}).get("capacity", {}) or {}
+        return [res for res, val in cap.items() if val == "1"]
+
+    def _bind_pod(self, key: str) -> Optional[float]:
+        """Bind one ungated Pending pod to the node advertising its
+        per-pod extended resource (fallback: any node when the pod pins
+        nothing). Sets phase=Running — container start is out of scope
+        for the sim. ``bind_latency`` models kubelet/scheduler latency:
+        a pod binds only after being bindable that long (returned as a
+        requeue delay)."""
+        ns, _, name = key.partition("/")
+        try:
+            pod = self.kube.get("Pod", ns, name)
+        except NotFound:
+            return None
+        md = pod["metadata"]
+        if md.get("deletionTimestamp"):
+            return None
+        if pod.get("spec", {}).get("schedulingGates"):
+            return None
+        if pod.get("status", {}).get("phase") != "Pending":
+            return None
+        if self.bind_latency > 0:
+            uid = md.get("uid", name)
+            t0 = self._first_bindable.setdefault(uid, time.monotonic())
+            remain = self.bind_latency - (time.monotonic() - t0)
+            if remain > 0:
+                return max(0.01, remain)
+        node = self._node_for(pod)
+        if node is None:
+            return 0.05  # capacity not advertised yet; retry shortly
+        patch = {
+            "spec": {"nodeName": node},
+            "status": {"phase": "Running"},
+        }
+        dp_profile = self._device_resource_profile(pod)
+        if self.plugin_managers and dp_profile:
+            granted = self._kubelet_allocate(node, dp_profile)
+            if granted is None:
+                return 0.05  # no device yet: stays Pending, re-probe
+            patch["metadata"] = {"annotations": granted}
+        try:
+            self.kube.patch("Pod", ns, name, patch)
+        except NotFound:
+            return None
+        self._first_bindable.pop(md.get("uid", name), None)
+        return None
 
     @staticmethod
     def _device_resource_profile(pod: dict) -> str:
@@ -448,8 +674,11 @@ class SimCluster:
             for key in ((ctr.get("resources") or {}).get("limits") or {}):
                 if key.startswith(POD_RESOURCE_PREFIX):
                     wanted = key
-        for nodem in self.kube.list("Node"):
-            cap = nodem.get("status", {}).get("capacity", {}) or {}
-            if wanted is None or cap.get(wanted) == "1":
-                return nodem["metadata"]["name"]
-        return None
+        nodes = self._sched_mgr.informer("Node")
+        if nodes is None:
+            return None
+        if wanted is None:
+            names = sorted(n["metadata"]["name"] for n in nodes.list())
+            return names[0] if names else None
+        advertising = nodes.by_index("resource", wanted)
+        return advertising[0]["metadata"]["name"] if advertising else None

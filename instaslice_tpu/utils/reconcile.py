@@ -4,19 +4,26 @@ The reference gets watch-driven, key-deduplicated, requeue-capable
 reconcile loops from controller-runtime (``SetupWithManager`` at
 ``instaslice_controller.go:410-424`` / ``instaslice_daemonset.go:500-552``;
 requeue-after plumbing throughout). This module provides the same
-contract in ~150 lines: a reconciler receives a key, returns an optional
-requeue delay; watches map events to keys; a dedup workqueue drives a
-worker thread; keys are never reconciled concurrently with themselves.
+contract: informer-backed watches map events to keys; dedup workqueues
+drive N key-hash-sharded worker threads (``MaxConcurrentReconciles``);
+a given key always lands on the same shard, so keys are never reconciled
+concurrently with themselves while distinct keys proceed in parallel.
+Optional per-shard Lease leadership (``utils/election.py``) splits the
+shards across multiple controller replicas (docs/SCALING.md).
 """
 
 from __future__ import annotations
 
 import heapq
 import logging
+import os
 import threading
 import time
 import traceback
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
+
+from instaslice_tpu.kube.informer import Informer
 from instaslice_tpu.utils.lockcheck import named_condition
 
 log = logging.getLogger("instaslice_tpu")
@@ -25,16 +32,47 @@ log = logging.getLogger("instaslice_tpu")
 #: instaslice_controller.go:398-407)
 MapFunc = Callable[[str, dict], List[str]]
 
+#: env knob for reconcile concurrency (controller-runtime's
+#: ``MaxConcurrentReconciles``); consumers pass the result as ``workers``
+WORKERS_ENV = "TPUSLICE_RECONCILE_WORKERS"
+
+
+def default_workers(fallback: int = 1) -> int:
+    """Worker count from :data:`WORKERS_ENV`, else ``fallback``."""
+    raw = os.environ.get(WORKERS_ENV, "")
+    try:
+        n = int(raw) if raw else fallback
+    except ValueError:
+        n = fallback
+    return max(1, n)
+
+
+def shard_for(key: str, shards: int) -> int:
+    """Stable key→shard assignment (crc32, not ``hash()`` — the builtin
+    is salted per process, and two controller replicas splitting shards
+    by Lease must agree on the mapping)."""
+    if shards <= 1:
+        return 0
+    return zlib.crc32(key.encode()) % shards
+
 
 class WorkQueue:
     """Deduplicating delayed work queue. ``add`` with delay=0 enqueues
     immediately; a key already queued is not duplicated; delayed adds keep
-    the earliest due time."""
+    the earliest due time. Stale heap entries (superseded by an earlier
+    due time) are counted and compacted once they outnumber the live
+    ones, so repeated delayed re-adds of one key can't grow the heap
+    without bound."""
+
+    #: compaction floor: below this many stale entries the O(n) rebuild
+    #: costs more than the garbage
+    COMPACT_MIN = 64
 
     def __init__(self) -> None:
         self._cond = named_condition("reconcile.workqueue")
         self._due: Dict[str, float] = {}
         self._heap: List[Tuple[float, str]] = []
+        self._stale = 0
         self._closed = False
 
     def add(self, key: str, delay: float = 0.0) -> None:
@@ -45,8 +83,17 @@ class WorkQueue:
             cur = self._due.get(key)
             if cur is not None and cur <= due:
                 return
+            if cur is not None:
+                self._stale += 1  # the old heap entry just went stale
             self._due[key] = due
             heapq.heappush(self._heap, (due, key))
+            if (
+                self._stale >= self.COMPACT_MIN
+                and self._stale > len(self._due)
+            ):
+                self._heap = [(d, k) for k, d in self._due.items()]
+                heapq.heapify(self._heap)
+                self._stale = 0
             self._cond.notify_all()
 
     def get(self, timeout: Optional[float] = None) -> Optional[str]:
@@ -61,6 +108,7 @@ class WorkQueue:
                     due, key = self._heap[0]
                     if self._due.get(key) != due:
                         heapq.heappop(self._heap)  # stale entry
+                        self._stale = max(0, self._stale - 1)
                         continue
                     break
                 if self._heap:
@@ -90,15 +138,58 @@ class WorkQueue:
         with self._cond:
             return len(self._due)
 
+    def heap_size(self) -> int:
+        """Observability for tests: live + stale heap entries."""
+        with self._cond:
+            return len(self._heap)
+
+
+class ShardedQueue:
+    """Facade routing one logical queue onto per-shard
+    :class:`WorkQueue` instances by stable key hash. Presents the same
+    ``add``/``close``/``len`` surface callers always used, so a
+    single-worker Manager and a 16-way one look identical from the
+    outside."""
+
+    def __init__(self, shards: int) -> None:
+        self.shards = [WorkQueue() for _ in range(max(1, shards))]
+
+    def add(self, key: str, delay: float = 0.0) -> None:
+        self.shards[shard_for(key, len(self.shards))].add(key, delay)
+
+    def close(self) -> None:
+        for q in self.shards:
+            q.close()
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.shards)
+
 
 class Manager:
-    """Runs one reconciler: N watch threads feeding a workqueue, one
-    worker thread calling ``reconcile(key)``.
+    """Runs one reconciler: informer-backed watches feeding sharded
+    workqueues, N worker threads calling ``reconcile(key)``.
 
     ``reconcile`` returns None (done) or a float (requeue after seconds —
     the reference's ``RequeueAfter`` pattern, e.g.
     instaslice_controller.go:93,201,225). Exceptions are logged and the
     key is requeued with backoff instead of crashing the loop.
+
+    ``workers`` > 1 shards keys by :func:`shard_for`: per-key ordering
+    is preserved (a key is only ever handled by its shard's single
+    worker) while distinct keys reconcile in parallel.
+
+    ``indexers`` / ``transforms``: per-kind secondary indexes and parse
+    caches installed on the informers (``manager.informer(kind)`` hands
+    the cache to the reconciler — this is what kills per-reconcile
+    re-listing).
+
+    ``shard_lease`` (dict with ``namespace``, ``prefix``, ``identity``,
+    optional ``lease_seconds``/``retry_seconds``): each shard worker
+    acquires Lease ``<prefix>-<shard>`` before draining its queue, so
+    multiple controller replicas split the shards between them while a
+    key still only ever runs on one replica (per-shard leadership,
+    docs/SCALING.md). :meth:`shard_is_leader` exposes the calling
+    worker's leadership for write fencing.
     """
 
     def __init__(
@@ -110,6 +201,10 @@ class Manager:
         resync_period: float = 30.0,
         error_backoff: float = 0.5,
         tracer=None,
+        workers: int = 1,
+        indexers: Optional[Dict[str, Dict[str, Callable]]] = None,
+        transforms: Optional[Dict[str, Callable[[dict], object]]] = None,
+        shard_lease: Optional[dict] = None,
     ) -> None:
         self.name = name
         self.client = client
@@ -121,11 +216,39 @@ class Manager:
         # process default, reconcile spans must land in the NEW tracer,
         # not an orphaned closed ring
         self._tracer = tracer
-        self.queue = WorkQueue()
+        self.workers = max(1, int(workers))
+        self.queue = ShardedQueue(self.workers)
+        self.shard_lease = shard_lease
+        self._informers: Dict[Tuple[str, Optional[str]], Informer] = {}
+        for kind, ns, fn in watches:
+            ikey = (kind, ns)
+            inf = self._informers.get(ikey)
+            if inf is None:
+                inf = Informer(
+                    client,
+                    kind,
+                    namespace=ns,
+                    resync_period=resync_period,
+                    error_backoff=error_backoff,
+                    indexers=(indexers or {}).get(kind),
+                    transform=(transforms or {}).get(kind),
+                    name=f"{name}-watch-{kind}",
+                )
+                self._informers[ikey] = inf
+            inf.add_handler(self._make_handler(fn))
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
-        self.reconcile_count = 0
-        self.error_count = 0
+        self._reconcile_counts = [0] * self.workers
+        self._error_counts = [0] * self.workers
+        self._electors: Dict[int, object] = {}
+        self._local = threading.local()
+
+    def _make_handler(self, fn: MapFunc) -> Callable[[str, dict], None]:
+        def handler(event: str, obj: dict) -> None:
+            for key in fn(event, obj):
+                self.queue.add(key)
+
+        return handler
 
     @property
     def tracer(self):
@@ -135,151 +258,135 @@ class Manager:
 
         return get_tracer()
 
-    # ------------------------------------------------------------------
+    # ------------------------------------------------------------ counters
 
-    def _watch_loop(self, kind: str, namespace: Optional[str], fn: MapFunc):
-        from instaslice_tpu.kube.client import ResourceVersionExpired
+    @property
+    def reconcile_count(self) -> int:
+        return sum(self._reconcile_counts)
 
-        # Replay (list+watch) on the first establishment and then once per
-        # resync_period — not on every re-establishment, which would
-        # re-reconcile every object ~4x/sec on a quiet cluster. Between
-        # replays, re-establish with the last seen resourceVersion so
-        # events emitted while the watch was down are replayed, not lost.
-        # -inf, not 0.0: monotonic() is small right after host boot, and
-        # the first pass (and any forced relist) must replay regardless
-        last_replay = float("-inf")
-        force_replay = True
-        # "0" = resume from the beginning of the event log, so that even a
-        # watch that has never seen an event (empty store at startup) can't
-        # lose ones emitted while it was re-establishing
-        last_rv: Optional[str] = "0"
-        # real API servers hold watches open cheaply (the client advertises
-        # a long preferred timeout); the in-process fake polls fast
-        watch_timeout = getattr(self.client, "preferred_watch_timeout", 0.25)
-        # informer-style store: last-seen object per (namespace, name).
-        # A replay relist is diffed against it so objects deleted while
-        # the watch was down — invisible to any relist — still fire their
-        # DELETED map-func (a real API server has no log-tail replay).
-        store: Dict[Tuple[str, str], dict] = {}
-        while not self._stop.is_set():
-            replay = (
-                force_replay
-                or time.monotonic() - last_replay >= self.resync_period
-            )
-            if replay:
-                force_replay = False
-                last_replay = time.monotonic()
-            listed: set = set()
-            in_burst = replay  # relist burst runs until the first BOOKMARK
-            started = time.monotonic()
-            events = 0
-            try:
-                # resource_version is ALWAYS passed: a resync relist alone
-                # cannot show objects deleted while the watch was down, so
-                # the log replay must ride along with it
-                for event, obj in self.client.watch(
-                    kind,
-                    namespace=namespace,
-                    replay=replay,
-                    timeout=watch_timeout,
-                    resource_version=last_rv,
-                ):
-                    if self._stop.is_set():
-                        return
-                    md = obj.get("metadata", {})
-                    rv = md.get("resourceVersion")
-                    if rv:
-                        last_rv = rv
-                    if event == "BOOKMARK":
-                        if in_burst:
-                            # end of the relist burst: anything we knew
-                            # that the relist did not show is gone
-                            in_burst = False
-                            for skey in set(store) - listed:
-                                gone = store.pop(skey)
-                                for key in fn("DELETED", gone):
-                                    self.queue.add(key)
-                        continue  # resume-point advance only, no object
-                    events += 1  # real (non-BOOKMARK) events only
-                    okey = (md.get("namespace", ""), md.get("name", ""))
-                    if event == "DELETED":
-                        store.pop(okey, None)
-                    else:
-                        store[okey] = obj
-                        if in_burst:
-                            listed.add(okey)
-                    for key in fn(event, obj):
-                        self.queue.add(key)
-            except ResourceVersionExpired:
-                # stale resume point: resuming with it would hot-loop 410s
-                # — drop it and force a relist on the next establishment
-                log.info(
-                    "%s: watch %s resourceVersion expired; relisting",
-                    self.name, kind,
-                )
-                last_rv = None
-                force_replay = True
-                self._stop.wait(self.error_backoff)
-            except Exception:
-                log.warning(
-                    "%s: watch %s failed:\n%s",
-                    self.name, kind, traceback.format_exc(),
-                )
-                self._stop.wait(self.error_backoff)
-            else:
-                # a healthy stream lives for ~watch_timeout; one that dies
-                # instantly with nothing to say is a broken server or a
-                # stale-rv loop — pace it like an error, don't hammer
-                if events == 0 and time.monotonic() - started < 0.05:
-                    self._stop.wait(self.error_backoff)
-            # watch ended (timeout/quiet) → re-establish; brief pause keeps
-            # the fake-kube polling cheap
-            self._stop.wait(0.02)
+    @property
+    def error_count(self) -> int:
+        return sum(self._error_counts)
 
-    def _worker(self) -> None:
+    # ------------------------------------------------------------ informers
+
+    def informer(self, kind: str) -> Optional[Informer]:
+        for (k, _), inf in self._informers.items():
+            if k == kind:
+                return inf
+        return None
+
+    def wait_synced(self, timeout: float = 10.0) -> bool:
+        """Block until every informer finished its initial relist."""
+        deadline = time.monotonic() + timeout
+        for inf in self._informers.values():
+            if not inf.wait_synced(max(0.0, deadline - time.monotonic())):
+                return False
+        return True
+
+    # ----------------------------------------------------------- sharding
+
+    def current_shard(self) -> Optional[int]:
+        """The calling worker thread's shard id (None off a worker).
+        Capture this BEFORE handing a write to a cross-thread committer
+        (the coalesced writer) so the fence stays bound to the
+        enqueueing worker's lease, not the committing thread's."""
+        return getattr(self._local, "shard", None)
+
+    def shard_is_leader(self, shard: Optional[int] = None) -> bool:
+        """True when ``shard``'s Lease is held (default: the calling
+        worker thread's shard; always True without ``shard_lease`` or
+        off a worker thread). Reconcilers use this as a write fence
+        piece: a worker whose shard Lease was lost must not land
+        writes racing the replica that took the shard over."""
+        if not self.shard_lease:
+            return True
+        if shard is None:
+            shard = self.current_shard()
+        if shard is None:
+            return True
+        elector = self._electors.get(shard)
+        return elector is None or elector.is_leader.is_set()
+
+    def _shard_elector(self, shard: int):
+        from instaslice_tpu.utils.election import LeaderElector
+
+        cfg = self.shard_lease
+        return LeaderElector(
+            self.client,
+            cfg["namespace"],
+            f"{cfg['prefix']}-shard-{shard}",
+            cfg["identity"],
+            lease_seconds=cfg.get("lease_seconds", 15.0),
+            retry_seconds=cfg.get("retry_seconds", 2.0),
+        )
+
+    # ------------------------------------------------------------- worker
+
+    def _worker(self, shard: int) -> None:
+        self._local.shard = shard
+        elector = None
+        if self.shard_lease:
+            elector = self._shard_elector(shard)
+            self._electors[shard] = elector
+        queue = self.queue.shards[shard]
         while True:
-            key = self.queue.get(timeout=0.25)
+            if elector is not None and not elector.is_leader.is_set():
+                # (re)acquire the shard Lease before draining the queue;
+                # level-triggered reconciles make the handover backlog
+                # safe to replay
+                if not elector.acquire(self._stop):
+                    return  # stopped while waiting for leadership
+                elector.start_renewing(on_lost=lambda: None)
+                log.info("%s: shard %d leadership acquired",
+                         self.name, shard)
+            key = queue.get(timeout=0.25)
             if key is None:
                 if self._stop.is_set():
                     return
                 continue
-            self.reconcile_count += 1
+            self._reconcile_counts[shard] += 1
             try:
                 with self.tracer.span(
-                    f"{self.name}.reconcile", key=key
+                    f"{self.name}.reconcile", key=key, shard=shard
                 ):
                     requeue = self.reconcile(key)
             except Exception:
-                self.error_count += 1
+                self._error_counts[shard] += 1
                 log.warning(
                     "%s: reconcile(%s) raised:\n%s",
                     self.name, key, traceback.format_exc(),
                 )
                 requeue = self.error_backoff
             if requeue is not None and not self._stop.is_set():
-                self.queue.add(key, delay=requeue)
+                queue.add(key, delay=requeue)
 
     # ------------------------------------------------------------------
 
     def start(self) -> None:
-        for kind, ns, fn in self.watches:
-            t = threading.Thread(
-                target=self._watch_loop, args=(kind, ns, fn),
-                name=f"{self.name}-watch-{kind}", daemon=True,
+        for inf in self._informers.values():
+            inf.start()
+        for shard in range(self.workers):
+            w = threading.Thread(
+                target=self._worker, args=(shard,),
+                name=f"{self.name}-worker-{shard}", daemon=True,
             )
-            t.start()
-            self._threads.append(t)
-        w = threading.Thread(
-            target=self._worker, name=f"{self.name}-worker", daemon=True
-        )
-        w.start()
-        self._threads.append(w)
+            w.start()
+            self._threads.append(w)
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self.queue.close()
+        for inf in self._informers.values():
+            inf.stop(timeout=timeout)
         for t in self._threads:
             t.join(timeout=timeout)
+        for elector in self._electors.values():
+            try:
+                elector.release()
+            except Exception:
+                log.warning("%s: shard lease release failed", self.name,
+                            exc_info=True)
 
     def wait_idle(self, timeout: float = 10.0, settle: float = 0.15) -> bool:
         """Test helper: block until the queue stays empty for ``settle``

@@ -1,0 +1,743 @@
+"""Fleet-scale control-plane tier (docs/SCALING.md): the informer cache,
+sharded reconcile workers, coalesced CR writes, workqueue compaction,
+per-shard Lease leadership, and the fleet-agent sim — the machinery that
+turns the serial re-list loop into a 1k-node control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from instaslice_tpu.kube import CoalescedWriter, FakeKube, Informer, NotFound
+from instaslice_tpu.kube.client import Conflict
+from instaslice_tpu.utils.reconcile import (
+    Manager,
+    ShardedQueue,
+    WorkQueue,
+    shard_for,
+)
+
+
+def pod(name, ns="default", gated=True, labels=None, group=""):
+    from instaslice_tpu import GATE_NAME
+    from instaslice_tpu.api.constants import GROUP_ANNOTATION
+
+    ann = {GROUP_ANNOTATION: group} if group else {}
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "uid": f"uid-{name}",
+            "labels": labels or {},
+            "annotations": ann,
+        },
+        "spec": {
+            "schedulingGates": (
+                [{"name": GATE_NAME}] if gated else []
+            ),
+        },
+        "status": {"phase": "Pending"},
+    }
+
+
+def wait_until(fn, timeout=5.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(every)
+    return False
+
+
+# =========================================================== informer
+
+
+class TestInformer:
+    def test_sync_list_get_and_watch_updates(self):
+        kube = FakeKube()
+        kube.create("Pod", pod("a"))
+        inf = Informer(kube, "Pod").start()
+        try:
+            assert inf.wait_synced(5)
+            assert inf.get("default", "a")["metadata"]["name"] == "a"
+            kube.create("Pod", pod("b"))
+            assert wait_until(lambda: inf.get("default", "b") is not None)
+            assert len(inf.list()) == 2
+            kube.delete("Pod", "default", "a")
+            assert wait_until(lambda: inf.get("default", "a") is None)
+            assert [o["metadata"]["name"] for o in inf.list()] == ["b"]
+        finally:
+            inf.stop()
+
+    def test_secondary_index_tracks_membership(self):
+        kube = FakeKube()
+        inf = Informer(
+            kube, "Pod",
+            indexers={"by-phase": lambda o: [
+                o.get("status", {}).get("phase", "")
+            ]},
+        ).start()
+        try:
+            assert inf.wait_synced(5)
+            kube.create("Pod", pod("a"))
+            assert wait_until(
+                lambda: len(inf.by_index("by-phase", "Pending")) == 1
+            )
+            # index keys move with the object
+            kube.patch("Pod", "default", "a",
+                       {"status": {"phase": "Running"}})
+            assert wait_until(
+                lambda: len(inf.by_index("by-phase", "Running")) == 1
+            )
+            assert inf.by_index("by-phase", "Pending") == []
+            kube.delete("Pod", "default", "a")
+            assert wait_until(
+                lambda: inf.by_index("by-phase", "Running") == []
+            )
+        finally:
+            inf.stop()
+
+    def test_transform_cached_per_version(self):
+        calls = []
+
+        def parse(obj):
+            calls.append(obj["metadata"]["resourceVersion"])
+            return obj["metadata"]["name"].upper()
+
+        kube = FakeKube()
+        kube.create("Pod", pod("a"))
+        inf = Informer(kube, "Pod", transform=parse).start()
+        try:
+            assert inf.wait_synced(5)
+            before = len(calls)
+            for _ in range(10):
+                assert inf.list_transformed() == ["A"]
+            # reads never re-parse; only a new version does
+            assert len(calls) == before
+        finally:
+            inf.stop()
+
+    def test_write_through_visible_immediately_and_stale_ignored(self):
+        kube = FakeKube()
+        kube.create("Pod", pod("a"))
+        inf = Informer(kube, "Pod").start()
+        try:
+            assert inf.wait_synced(5)
+            old = inf.get("default", "a")
+            stored = kube.patch("Pod", "default", "a",
+                                {"metadata": {"labels": {"x": "1"}}})
+            inf.write_through(stored)
+            got = inf.get("default", "a")
+            assert got["metadata"]["labels"] == {"x": "1"}
+            # replaying the OLD version (watch catching up) can't regress
+            inf.write_through(old)
+            assert inf.get("default", "a")["metadata"]["labels"] == {
+                "x": "1"
+            }
+        finally:
+            inf.stop()
+
+    def test_resync_relist_does_not_bump_index_versions(self):
+        # an equal-rv re-delivery (what every resync relist is) must
+        # not re-transform or invalidate derived memos
+        calls = []
+        kube = FakeKube()
+        kube.create("Pod", pod("a"))
+        inf = Informer(
+            kube, "Pod", resync_period=0.1,
+            indexers={"by-ns": lambda o: [
+                o.get("metadata", {}).get("namespace", "")
+            ]},
+            transform=lambda o: calls.append(1) or o,
+        ).start()
+        try:
+            assert inf.wait_synced(5)
+            v0 = inf.index_version("by-ns", "default")
+            parses0 = len(calls)
+            time.sleep(0.5)  # several resync relists
+            assert inf.index_version("by-ns", "default") == v0
+            assert len(calls) == parses0
+            # a REAL change still bumps + re-parses
+            kube.patch("Pod", "default", "a",
+                       {"metadata": {"labels": {"x": "1"}}})
+            assert wait_until(
+                lambda: inf.index_version("by-ns", "default") > v0
+            )
+            assert len(calls) > parses0
+        finally:
+            inf.stop()
+
+    def test_relist_diff_synthesizes_deletes_to_handlers(self):
+        kube = FakeKube()
+        kube.create("Pod", pod("a"))
+        kube.create("Pod", pod("b"))
+        inf = Informer(kube, "Pod", resync_period=0.2)
+        seen = []
+        lock = threading.Lock()
+
+        def handler(event, obj):
+            with lock:
+                seen.append((event, obj["metadata"]["name"]))
+
+        inf.add_handler(handler)
+        inf.start()
+        try:
+            assert inf.wait_synced(5)
+            # delete straight from the store, then drop the event from
+            # history so only a relist diff can reveal it
+            with kube._lock:
+                del kube._objects[("Pod", "default", "b")]
+                kube._history.clear()
+                kube._snapshots.clear()
+            assert wait_until(
+                lambda: inf.get("default", "b") is None, timeout=5
+            )
+            with lock:
+                assert ("DELETED", "b") in seen
+        finally:
+            inf.stop()
+
+
+# ==================================================== fake copy-on-read
+
+
+class TestFakeCopyOnRead:
+    def test_list_mutation_cannot_corrupt_store(self):
+        kube = FakeKube()
+        kube.create("Pod", pod("a"))
+        listed = kube.list("Pod")[0]
+        # scribble all over the returned snapshot
+        listed["metadata"]["name"] = "evil"
+        listed["spec"]["schedulingGates"] = []
+        listed["status"]["phase"] = "Hacked"
+        # the store (and every write path reading it) is untouched
+        fresh = kube.get("Pod", "default", "a")
+        assert fresh["metadata"]["name"] == "a"
+        assert fresh["spec"]["schedulingGates"]
+        assert fresh["status"]["phase"] == "Pending"
+        # a write still round-trips cleanly and invalidates the snapshot
+        kube.patch("Pod", "default", "a", {"status": {"phase": "Running"}})
+        relisted = kube.list("Pod")[0]
+        assert relisted["metadata"]["name"] == "a"
+        assert relisted["status"]["phase"] == "Running"
+
+    def test_list_reuses_snapshot_until_write(self):
+        kube = FakeKube()
+        kube.create("Pod", pod("a"))
+        first = kube.list("Pod")[0]
+        second = kube.list("Pod")[0]
+        assert first is second  # one deepcopy per version, not per read
+        kube.patch("Pod", "default", "a", {"metadata": {"labels": {"x": "1"}}})
+        third = kube.list("Pod")[0]
+        assert third is not first
+        # get() callers mutate their copy (update_with_retry contract):
+        # always private
+        g1 = kube.get("Pod", "default", "a")
+        g2 = kube.get("Pod", "default", "a")
+        assert g1 is not g2
+
+
+# ========================================================== workqueue
+
+
+class TestWorkQueueCompaction:
+    def test_heap_bounded_under_repeated_delayed_readds(self):
+        q = WorkQueue()
+        # the same key re-added with ever-earlier due times: every add
+        # strands a stale heap entry; compaction must keep the heap
+        # proportional to the live key count
+        for i in range(5000):
+            q.add("hot", delay=10.0 - i * 0.001)
+        for i in range(64):
+            q.add(f"k{i}", delay=5.0)
+        assert len(q) == 65
+        assert q.heap_size() < 1000, q.heap_size()
+
+    def test_earliest_due_still_wins_after_compaction(self):
+        q = WorkQueue()
+        for i in range(200):
+            q.add("a", delay=2.0 - i * 0.005)
+        q.add("b", delay=0.0)
+        assert q.get(timeout=1.0) == "b"
+        q.add("a", delay=0.0)  # supersede to immediate
+        assert q.get(timeout=1.0) == "a"
+        assert len(q) == 0
+
+    def test_sharded_queue_routes_stably(self):
+        sq = ShardedQueue(4)
+        keys = [f"key-{i}" for i in range(100)]
+        for k in keys:
+            sq.add(k)
+        assert len(sq) == 100
+        for k in keys:
+            # same key, same shard — per-key ordering's foundation
+            assert shard_for(k, 4) == shard_for(k, 4)
+        sq.close()
+
+
+# ============================================== manager resync + shards
+
+
+class _CountingClient(FakeKube):
+    """FakeKube that counts watch establishments + replays."""
+
+    preferred_watch_timeout = 0.05
+
+    def __init__(self):
+        super().__init__()
+        self.watch_calls = []
+
+    def watch(self, kind, namespace=None, replay=True, timeout=None,
+              resource_version=None):
+        self.watch_calls.append(replay)
+        return super().watch(
+            kind, namespace=namespace, replay=replay, timeout=timeout,
+            resource_version=resource_version,
+        )
+
+
+class TestManagerResync:
+    def test_resync_fires_on_period_not_reestablishment(self):
+        client = _CountingClient()
+        client.create("Pod", pod("a"))
+        fired = []
+        lock = threading.Lock()
+
+        def mapper(event, obj):
+            with lock:
+                fired.append(event)
+            return []
+
+        mgr = Manager(
+            "t", client, reconcile=lambda key: None,
+            watches=[("Pod", None, mapper)],
+            resync_period=300.0, error_backoff=0.01,
+        )
+        mgr.start()
+        try:
+            # let the first establishment (relist + log-tail replay)
+            # finish, then count its map-func fires
+            assert wait_until(lambda: len(client.watch_calls) >= 2,
+                              timeout=5)
+            with lock:
+                adds_after_first = fired.count("ADDED")
+            assert adds_after_first >= 1
+            # ...then several more re-establishments (0.05s timeout)...
+            assert wait_until(lambda: len(client.watch_calls) >= 8,
+                              timeout=5)
+            with lock:
+                adds = fired.count("ADDED")
+            # ...which must NOT replay: resync_period hasn't elapsed,
+            # so re-establishing resumes from the last resourceVersion
+            # without re-mapping the object
+            assert adds == adds_after_first, fired
+            assert client.watch_calls[0] is True
+            assert not any(client.watch_calls[1:8])
+        finally:
+            mgr.stop()
+
+    def test_resync_refires_after_period(self):
+        client = _CountingClient()
+        client.create("Pod", pod("a"))
+        fired = []
+        lock = threading.Lock()
+
+        def mapper(event, obj):
+            with lock:
+                fired.append(event)
+            return []
+
+        mgr = Manager(
+            "t", client, reconcile=lambda key: None,
+            watches=[("Pod", None, mapper)],
+            resync_period=0.15, error_backoff=0.01,
+        )
+        mgr.start()
+        try:
+            assert wait_until(
+                lambda: fired.count("ADDED") >= 3, timeout=5
+            ), fired
+        finally:
+            mgr.stop()
+
+
+class TestShardedWorkers:
+    def test_per_key_ordering_with_cross_key_parallelism(self):
+        client = FakeKube()
+        active = {}
+        overlaps = []
+        parallel_seen = [0]
+        lock = threading.Lock()
+
+        def reconcile(key):
+            with lock:
+                if active.get(key):
+                    overlaps.append(key)  # per-key concurrency = bug
+                active[key] = True
+                busy = sum(1 for v in active.values() if v)
+                parallel_seen[0] = max(parallel_seen[0], busy)
+            time.sleep(0.02)
+            with lock:
+                active[key] = False
+            return None
+
+        mgr = Manager(
+            "t", client, reconcile=reconcile, watches=[], workers=4,
+        )
+        mgr.start()
+        try:
+            keys = [f"pod-{i}" for i in range(12)]
+            for _ in range(6):
+                for k in keys:
+                    mgr.queue.add(k)
+                time.sleep(0.03)
+            assert mgr.wait_idle(timeout=10)
+            assert overlaps == [], overlaps
+            # distinct keys genuinely ran concurrently
+            assert parallel_seen[0] > 1
+            assert mgr.error_count == 0
+            assert mgr.reconcile_count >= 12
+        finally:
+            mgr.stop()
+
+    def test_same_key_burst_never_overlaps(self):
+        client = FakeKube()
+        running = [0]
+        max_running = [0]
+        lock = threading.Lock()
+
+        def reconcile(key):
+            with lock:
+                running[0] += 1
+                max_running[0] = max(max_running[0], running[0])
+            time.sleep(0.01)
+            with lock:
+                running[0] -= 1
+            return 0.005 if key == "again" else None
+
+        mgr = Manager(
+            "t", client, reconcile=reconcile, watches=[], workers=8,
+        )
+        mgr.start()
+        try:
+            for _ in range(30):
+                mgr.queue.add("again")
+                time.sleep(0.002)
+            assert wait_until(lambda: mgr.reconcile_count >= 5)
+        finally:
+            mgr.stop()
+        assert max_running[0] == 1, max_running[0]
+
+
+class TestShardLeases:
+    def test_two_replicas_split_shards_exclusively(self):
+        kube = FakeKube()
+        seen = {"m1": set(), "m2": set()}
+        lock = threading.Lock()
+
+        def rec(owner):
+            def reconcile(key):
+                with lock:
+                    seen[owner].add(key)
+                return None
+            return reconcile
+
+        def mk(owner):
+            return Manager(
+                owner, kube, reconcile=rec(owner), watches=[],
+                workers=2,
+                shard_lease={
+                    "namespace": "ns",
+                    "prefix": "ctl",
+                    "identity": owner,
+                    "lease_seconds": 2.0,
+                    "retry_seconds": 0.05,
+                },
+            )
+
+        m1, m2 = mk("m1"), mk("m2")
+        m1.start()
+        # m1 grabs both shard leases first
+        assert wait_until(
+            lambda: len(m1._electors) == 2
+            and all(e.is_leader.is_set() for e in m1._electors.values()),
+            timeout=5,
+        )
+        m2.start()
+        try:
+            keys = [f"k{i}" for i in range(16)]
+            for k in keys:
+                m1.queue.add(k)
+                m2.queue.add(k)
+            assert wait_until(lambda: len(seen["m1"]) == 16, timeout=5)
+            time.sleep(0.3)
+            # m2 holds no lease: its queues must not drain
+            assert seen["m2"] == set()
+            # every shard Lease names m1
+            for i in range(2):
+                lease = kube.get("Lease", "ns", f"ctl-shard-{i}")
+                assert lease["spec"]["holderIdentity"] == "m1"
+        finally:
+            m1.stop()
+            m2.stop()
+
+    def test_failover_hands_shard_to_second_replica(self):
+        kube = FakeKube()
+        got = []
+        lock = threading.Lock()
+
+        def reconcile(key):
+            with lock:
+                got.append(key)
+            return None
+
+        def mk(owner):
+            return Manager(
+                owner, kube, reconcile=reconcile, watches=[],
+                workers=1,
+                shard_lease={
+                    "namespace": "ns",
+                    "prefix": "ctl",
+                    "identity": owner,
+                    "lease_seconds": 0.4,
+                    "retry_seconds": 0.05,
+                },
+            )
+
+        m1, m2 = mk("m1"), mk("m2")
+        m1.start()
+        assert wait_until(
+            lambda: m1._electors
+            and m1._electors[0].is_leader.is_set(), timeout=5,
+        )
+        m2.start()
+        m2.queue.add("after-failover")
+        time.sleep(0.2)
+        assert got == []  # m2 waits for the lease
+        m1.stop()  # releases the lease -> m2 takes over
+        try:
+            assert wait_until(lambda: "after-failover" in got, timeout=10)
+        finally:
+            m2.stop()
+
+
+# ===================================================== coalesced writes
+
+
+class TestCoalescedWriter:
+    def _cr(self, kube, name="node-0"):
+        kube.create("TpuSlice", {
+            "apiVersion": "tpu.instaslice.dev/v1alpha1",
+            "kind": "TpuSlice",
+            "metadata": {"name": name, "namespace": "ns"},
+            "spec": {"counters": {}},
+        })
+
+    def test_concurrent_mutations_all_land_with_fewer_roundtrips(self):
+        kube = FakeKube()
+        self._cr(kube)
+        # model a real API server's write latency: while the elected
+        # leader's round-trip is in flight, the other callers' mutations
+        # pile into the next batch (the in-process fake commits too fast
+        # to observe batching otherwise)
+        real_update = kube.update
+
+        def slow_update(kind, obj):
+            time.sleep(0.01)
+            return real_update(kind, obj)
+
+        kube.update = slow_update
+        w = CoalescedWriter(kube, "TpuSlice", "ns")
+        n = 24
+        barrier = threading.Barrier(n)
+        errors = []
+
+        def worker(i):
+            def mut(obj):
+                obj["spec"]["counters"][f"w{i}"] = i
+                return obj
+
+            barrier.wait()
+            try:
+                out = w.apply("node-0", mut)
+                assert out is not None
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert not errors
+        stored = kube.get("TpuSlice", "ns", "node-0")
+        assert len(stored["spec"]["counters"]) == n
+        # the whole point: mutations shared round-trips
+        assert w.commits < w.ops, (w.commits, w.ops)
+
+    def test_abort_returns_none_and_skips_write(self):
+        kube = FakeKube()
+        self._cr(kube)
+        w = CoalescedWriter(kube, "TpuSlice", "ns")
+        rv = kube.get("TpuSlice", "ns", "node-0")["metadata"][
+            "resourceVersion"
+        ]
+        assert w.apply("node-0", lambda obj: None) is None
+        assert kube.get("TpuSlice", "ns", "node-0")["metadata"][
+            "resourceVersion"
+        ] == rv
+
+    def test_notfound_raises_in_caller(self):
+        kube = FakeKube()
+        w = CoalescedWriter(kube, "TpuSlice", "ns")
+        with pytest.raises(NotFound):
+            w.apply("missing", lambda obj: obj)
+
+    def test_per_op_fence_blocks_only_the_deposed_op(self):
+        from instaslice_tpu.kube.client import Fenced
+
+        kube = FakeKube()
+        self._cr(kube)
+        w = CoalescedWriter(kube, "TpuSlice", "ns")
+
+        def mut_ok(obj):
+            obj["spec"]["counters"]["ok"] = 1
+            return obj
+
+        def mut_deposed(obj):  # pragma: no cover - must never run
+            obj["spec"]["counters"]["deposed"] = 1
+            return obj
+
+        # the fence travels with the op: even though the committing
+        # thread is this (lease-holding) one, the deposed op is refused
+        results = {}
+
+        def deposed_caller():
+            try:
+                w.apply("node-0", mut_deposed, fence=lambda: False)
+            except Fenced:
+                results["fenced"] = True
+
+        t = threading.Thread(target=deposed_caller)
+        t.start()
+        out = w.apply("node-0", mut_ok, fence=lambda: True)
+        t.join(5)
+        assert out is not None
+        assert results.get("fenced") is True
+        stored = kube.get("TpuSlice", "ns", "node-0")
+        assert stored["spec"]["counters"] == {"ok": 1}
+
+    def test_conflict_retry_reapplies_batch(self):
+        kube = FakeKube()
+        self._cr(kube)
+        # interleave an external writer: first update attempt conflicts
+        real_update = kube.update
+        raced = [False]
+
+        def racing_update(kind, obj):
+            if not raced[0]:
+                raced[0] = True
+                fresh = kube.get("TpuSlice", "ns", "node-0")
+                fresh["spec"]["counters"]["external"] = 99
+                real_update(kind, fresh)  # bumps rv under the caller
+            return real_update(kind, obj)
+
+        kube.update = racing_update
+        w = CoalescedWriter(kube, "TpuSlice", "ns")
+
+        def mut(obj):
+            obj["spec"]["counters"]["mine"] = 1
+            return obj
+
+        out = w.apply("node-0", mut)
+        assert out is not None
+        stored = kube.get("TpuSlice", "ns", "node-0")
+        assert stored["spec"]["counters"] == {"external": 99, "mine": 1}
+
+
+# ======================================================== fleet-scale sim
+
+
+class TestFleetScaleSim:
+    def test_fleet_sim_grants_burst_with_sharded_workers(self):
+        from instaslice_tpu.sim import SimCluster
+
+        n_pods = 24
+        with SimCluster(
+            n_nodes=12, generation="v5e", nodes_per_group=2,
+            fleet_agents=True, agent_workers=4, workers=4,
+            deletion_grace_seconds=0.2, health_interval=0,
+        ) as c:
+            for i in range(n_pods):
+                c.submit(f"burst-{i}", profile="v5e-1x1")
+            for i in range(n_pods):
+                assert c.wait_phase(f"burst-{i}", "Running", timeout=30), \
+                    f"burst-{i}: {c.pod_phase(f'burst-{i}')}"
+            # lazy node construction: agents exist only for nodes whose
+            # CRs carried work (allocation-less CR events map to no key)
+            assert c.fleet is not None
+            assert 1 <= len(c.fleet._agents) <= 12
+            assert c.controller.manager.error_count == 0
+            # no double-allocation anywhere: every allocation's box is
+            # disjoint per torus group
+            from instaslice_tpu.topology.placement import Box
+            by_group = {}
+            for m in c.kube.list("TpuSlice", namespace=c.namespace):
+                gid = m["spec"].get("torusGroup") or m["metadata"]["name"]
+                for aid, a in m["spec"].get("allocations", {}).items():
+                    by_group.setdefault(gid, {})[aid] = a["box"]
+            placed = sum(len(v) for v in by_group.values())
+            assert placed >= n_pods // 2  # grants happened at all
+            for gid, boxes in by_group.items():
+                items = sorted(boxes.items())
+                for i, (aid_a, ka) in enumerate(items):
+                    for aid_b, kb in items[i + 1:]:
+                        assert not Box.from_key(ka).overlaps(
+                            Box.from_key(kb)
+                        ), (gid, aid_a, aid_b)
+
+    def test_bind_latency_delays_running(self):
+        from instaslice_tpu.sim import SimCluster
+
+        with SimCluster(
+            n_nodes=1, generation="v5e", deletion_grace_seconds=0.2,
+            bind_latency=0.5,
+        ) as c:
+            t0 = time.monotonic()
+            c.submit("slowbind", profile="v5e-1x1")
+            assert c.wait_phase("slowbind", "Running", timeout=20)
+            # the simulated kubelet waited its latency before binding
+            assert time.monotonic() - t0 >= 0.5
+
+
+class TestOverlapGuard:
+    def test_write_allocation_refuses_overlapping_box(self):
+        from instaslice_tpu.api import AllocationDetails, PodRef
+        from instaslice_tpu.sim import SimCluster
+
+        with SimCluster(n_nodes=1, generation="v5e",
+                        deletion_grace_seconds=0.2) as c:
+            c.submit("first", profile="v5e-2x2")
+            assert c.wait_phase("first", "Running", timeout=20)
+            allocs = c.allocations()
+            assert len(allocs) == 1
+            box = next(iter(allocs.values()))["box"]
+            # forge a second allocation claiming the same chips
+            forged = AllocationDetails(
+                alloc_id="forged",
+                pods=[PodRef(pod_uuid="uid-forged", pod_name="forged",
+                             namespace="default", worker_id=0)],
+                profile="v5e-2x2",
+                torus_group="node-0",
+                box=box,
+                parts={"node-0": (0, box)},
+            )
+            ok = c.controller._write_allocation(forged)
+            assert ok is False
+            assert "forged" not in c.allocations()
