@@ -40,15 +40,24 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke throughput floor
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
 	$(MAKE) bench-smoke
+	$(MAKE) bench-defrag-smoke
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
 	JAX_PLATFORMS=cpu $(PY) bench.py --smoke
+
+.PHONY: bench-defrag-smoke
+bench-defrag-smoke:  ## <60 s churn run: fragment a group, assert the repacker recovers the utilization floor (TPUSLICE_DEFRAG_FLOOR), grants every blocked pod, and keeps every transition chain legal (events-check strict)
+	JAX_PLATFORMS=cpu $(PY) bench.py --defrag-smoke
+
+.PHONY: bench-defrag
+bench-defrag:  ## Full defrag tier: frag-aware + repacker vs first-fit-no-repack (capacity utilization, NoCapacity-wait p95) plus the mid-migration chaos arm (docs/SCALING.md)
+	JAX_PLATFORMS=cpu $(PY) bench.py --defrag
 
 .PHONY: bench-scale
 bench-scale:  ## Fleet-scale control-plane bench: 1k nodes / 2k pending pods, grants/sec + gate→ungate p95/p99, with the serial re-list baseline ratio (docs/SCALING.md)

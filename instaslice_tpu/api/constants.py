@@ -60,6 +60,12 @@ UNHEALTHY_ANNOTATION = f"{GROUP}/slice-unhealthy"
 RESTART_ON_FAILURE_ANNOTATION = f"{GROUP}/restart-on-failure"
 ERROR_ANNOTATION = f"{GROUP}/error"
 
+#: Repacker opt-out: pods annotated ``"true"`` are never selected as
+#: migration victims by the defragmentation loop (controller/defrag.py)
+#: — a workload that cannot tolerate a drain→re-grant cycle pins its
+#: chips for life.
+REPACK_OPTOUT_ANNOTATION = f"{GROUP}/no-repack"
+
 #: Device-plugin allocate-response annotations (surfaced on the pod by
 #: the kubelet / the sim's kubelet emulator).
 CHIPS_ANNOTATION = f"{GROUP}/chips"
@@ -98,6 +104,15 @@ REASON_DEGRADED = "SliceDegraded"
 REASON_HEALED = "SliceHealed"
 REASON_HEALTH_EVICTED = "HealthEvicted"
 
+# repacker (controller/defrag.py): live slice defragmentation. Each
+# migration is one drain→teardown→re-grant epoch under its own trace id;
+# Planned lands on the capacity-starved pod that triggered the plan,
+# Migrating/Done/Failed land on the migrated pods.
+REASON_REPACK_PLANNED = "RepackPlanned"
+REASON_REPACK_MIGRATING = "RepackMigrating"
+REASON_REPACK_DONE = "RepackDone"
+REASON_REPACK_FAILED = "RepackFailed"
+
 # node agent / device plane
 REASON_REALIZED = "SliceRealized"
 REASON_REALIZE_FAILED = "SliceRealizeFailed"
@@ -133,6 +148,8 @@ EVENT_REASONS = frozenset({
     REASON_ADMITTED, REASON_PLACED, REASON_NO_CAPACITY, REASON_REJECTED,
     REASON_RETRYING, REASON_UNGATED, REASON_DEGRADED, REASON_HEALED,
     REASON_HEALTH_EVICTED,
+    REASON_REPACK_PLANNED, REASON_REPACK_MIGRATING, REASON_REPACK_DONE,
+    REASON_REPACK_FAILED,
     REASON_REALIZED, REASON_REALIZE_FAILED, REASON_TORN_DOWN,
     REASON_CHIP_UNHEALTHY, REASON_CHIP_HEALED,
     REASON_BREAKER_OPEN, REASON_BACKOFF, REASON_WATCH_RECONNECT,

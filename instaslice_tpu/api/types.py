@@ -279,7 +279,12 @@ class AllocationDetails:
         alloc_id: str = "",
         now: Optional[float] = None,
         trace_id: str = "",
+        note: str = "",
     ) -> "AllocationDetails":
+        """``note`` is appended to the seed transition's message — the
+        repacker stamps its re-grants with it so a migration epoch is
+        distinguishable from an original grant in the audit trail and
+        the ``describe pod`` timeline."""
         if not pods:
             raise ValueError("allocation needs at least one pod")
         alloc = AllocationDetails(
@@ -300,7 +305,8 @@ class AllocationDetails:
         # creating transition (set_status only sees later edges)
         alloc._record_transition(
             AllocationStatus.CREATING,
-            f"{placement.profile.name} at {placement.box.key()}",
+            f"{placement.profile.name} at {placement.box.key()}"
+            + (f" ({note})" if note else ""),
         )
         return alloc
 

@@ -14,10 +14,36 @@ def build_parser() -> argparse.ArgumentParser:
     )
     from instaslice_tpu.topology.policy import policy_names
 
+    def policy_arg(value: str) -> str:
+        # validate at parse time (clean exit-2 usage error, like the
+        # old choices= did) while leaving the default to the env-var
+        # resolution in ControllerRunner
+        if value not in policy_names():
+            raise argparse.ArgumentTypeError(
+                f"unknown policy {value!r}; registered: "
+                + ", ".join(policy_names())
+            )
+        return value
+
     p.add_argument("--namespace", default="instaslice-tpu-system",
                    help="namespace for operator-owned objects")
-    p.add_argument("--policy", default="first-fit", choices=policy_names(),
-                   help="allocation policy")
+    p.add_argument("--policy", default=None, type=policy_arg,
+                   help="allocation policy (default: the "
+                   "TPUSLICE_PLACEMENT_POLICY env var, else first-fit); "
+                   "registered: " + ", ".join(policy_names()))
+    p.add_argument("--repack", action="store_true",
+                   help="run the live-defragmentation loop: migrate "
+                   "relocatable slices (drain->teardown->re-grant) when "
+                   "a pending profile is blocked only by stranded "
+                   "capacity (docs/SCALING.md; opt pods out with the "
+                   "no-repack annotation)")
+    p.add_argument("--repack-interval", type=float, default=5.0,
+                   help="seconds between repacker passes")
+    p.add_argument("--repack-max-concurrent", type=int, default=2,
+                   help="max in-flight slice migrations")
+    p.add_argument("--repack-cooldown", type=float, default=300.0,
+                   help="per-pod seconds between migrations (thrash "
+                   "brake)")
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
     p.add_argument("--leader-elect", action="store_true")

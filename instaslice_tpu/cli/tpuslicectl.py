@@ -434,8 +434,15 @@ def render_describe(info: dict) -> str:
                     t["ts"], datetime.timezone.utc
                 ).strftime("%H:%M:%S.%f")[:-3] + "Z"
             )
+        # migration epochs render distinctly: every repacker decision
+        # (Repack* reasons) and every transition the repacker stamped
+        # "(repack" into gets the ⟳ marker, so a drain→teardown→re-grant
+        # chain is visually separable from the original grant's chain
+        mark = " "
+        if t["reason"].startswith("Repack") or "(repack" in t["message"]:
+            mark = "⟳"
         lines.append(
-            f"  {when:>13}  {t['source']:<7}  {t['reason']:<20}  "
+            f"{mark} {when:>13}  {t['source']:<7}  {t['reason']:<20}  "
             f"{t['message']}"
         )
     return "\n".join(lines)

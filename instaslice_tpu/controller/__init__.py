@@ -13,3 +13,4 @@ from instaslice_tpu.controller.gates import (
     pod_group,
 )
 from instaslice_tpu.controller.reconciler import Controller
+from instaslice_tpu.controller.defrag import Repacker

@@ -1,0 +1,725 @@
+"""Live slice defragmentation: the repacker control loop.
+
+Fragmentation-aware *placement* (``topology/frag.py`` +
+``FragAwarePolicy``) slows fragmentation down; under a churny
+multi-profile workload it still accumulates — four scattered 1x1s end
+up blocking every 2x2 anchor while 75% of the chips sit free. The
+repacker closes that gap the way "Serving DNN Models with
+Multi-Instance GPUs" frames it (reconfigurable machine scheduling,
+PAPERS.md): migration is a first-class scheduling move.
+
+The loop watches two signals it already has for free: the controller's
+capacity-starved pod set (``Controller.pending_requests()`` — pods the
+once-per-wait ``NoCapacity`` event fired for) and group occupancy via
+the informer indexes. When a pending profile is blocked *only by
+relocatable smaller slices*, it plans a bounded migration set and
+drives each migration through the existing lifecycle — no new state
+machine edges:
+
+1. **reserve** the victim's destination box in the controller's
+   in-flight overlay (so neither the pending pod nor a concurrent grant
+   can steal it mid-move);
+2. **drain/teardown**: ``Controller._mark_deleted`` on the old record —
+   the node agent releases the chips and erases the record, exactly as
+   for a deleted pod;
+3. **re-grant**: a fresh allocation epoch (same alloc id, same pods,
+   new box, a new migration trace id) written through
+   ``_write_allocation``'s overlap guard, realized by the destination
+   agent, then promoted created → ungated. The pod was never gated, so
+   the ungate is a pure status edge and the journal chain stays legal
+   (``make events-check`` strict).
+
+A realize failure mid-migration is rolled back via ``_mark_deleted``
+exactly like the PR 6 partial-fan-out path: the failed epoch tears
+down, the slice is re-granted *anywhere* (usually its old box — chips
+were freed, nothing else fits the pending profile either), and the
+migration is recorded failed. The pod is chip-less only between erase
+and re-grant — the same window a controller-retried device failure
+always had.
+
+Safety rails: at most ``max_concurrent`` in-flight migrations, a
+per-pod ``cooldown`` after any move (successful or rolled back — also
+the thrash brake), at most ``max_moves`` victims per target box, the
+``tpu.instaslice.dev/no-repack`` pod annotation opts a workload out
+entirely, and only single-host UNGATED slices strictly smaller than
+the blocked profile are movable. Every decision is journaled
+(``RepackPlanned/Migrating/Done/Failed``) and every migration epoch is
+trace-correlated under its own trace id (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from instaslice_tpu.api import AllocationDetails, AllocationStatus
+from instaslice_tpu.api.constants import (
+    REASON_REPACK_DONE,
+    REASON_REPACK_FAILED,
+    REASON_REPACK_MIGRATING,
+    REASON_REPACK_PLANNED,
+    REPACK_OPTOUT_ANNOTATION,
+)
+from instaslice_tpu.controller.reconciler import INDEX_SLICE_GROUP
+from instaslice_tpu.obs.journal import emit_pod_event, get_journal
+from instaslice_tpu.topology.placement import (
+    Box,
+    Occupancy,
+    Placement,
+    find_placements,
+    legal_placements,
+)
+from instaslice_tpu.topology.profiles import parse_profile_name
+from instaslice_tpu.utils.trace import get_tracer, new_trace_id
+
+log = logging.getLogger("instaslice_tpu.controller.defrag")
+
+COMPONENT = "repacker"
+
+
+@dataclasses.dataclass
+class Migration:
+    """One in-flight slice migration — one allocation, one fresh epoch
+    under one migration trace id."""
+
+    alloc_id: str
+    group_id: str
+    profile: str
+    old_box: str
+    #: planned destination box key (None after a failure: rollback mode,
+    #: re-place anywhere)
+    dest_box: Optional[str]
+    #: the box being cleared for the blocked profile (avoided while
+    #: re-placing the victim, unless rolling back)
+    target_box: str
+    #: profile name of the pending request this migration serves
+    pending_profile: str
+    pods: List  # PodRef snapshot from the evicted allocation
+    trace_id: str
+    phase: str = "evicting"  # evicting | realizing
+    rollback: bool = False
+    attempts: int = 0
+    started: float = 0.0
+    warned_stuck: bool = False
+
+
+class Repacker:
+    """Defragmentation reconcile loop riding a :class:`Controller`'s
+    informer caches, placement lock, and write machinery. Start after
+    the controller; stop before it."""
+
+    def __init__(
+        self,
+        controller,
+        interval: float = 1.0,
+        max_concurrent: int = 2,
+        cooldown: float = 60.0,
+        max_moves: int = 4,
+        stuck_warn_seconds: float = 60.0,
+    ) -> None:
+        self.controller = controller
+        self.interval = interval
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.cooldown = cooldown
+        self.max_moves = max(1, int(max_moves))
+        self.stuck_warn_seconds = stuck_warn_seconds
+        self._active: Dict[str, Migration] = {}
+        self._cooldown_until: Dict[str, float] = {}  # pod uid → monotonic
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.migrations_done = 0
+        self.migrations_failed = 0
+        self.plans = 0
+
+    @property
+    def tracer(self):
+        # resolved per use (never cached): reset_tracer() test isolation,
+        # same contract as Controller.tracer
+        return get_tracer()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "Repacker":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="repacker", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.run_once()
+            except Exception:
+                # one bad tick must not kill the loop; the next tick
+                # re-reads everything from the caches
+                log.exception("repacker tick failed")
+
+    # ------------------------------------------------------------ main tick
+
+    def run_once(self) -> None:
+        """One repacker pass: advance in-flight migrations, then (under
+        the concurrency cap) plan new ones for capacity-starved pods.
+        Safe to call directly from tests for deterministic stepping."""
+        c = self.controller
+        if (
+            not c._cache_ready()
+            or c._pods_inf is None
+            or not c._pods_inf.synced()
+        ):
+            # the repacker only runs against the informer plane — BOTH
+            # caches: pod liveness checks happen under the placement
+            # lock, where a live API fallback would stall every grant
+            return
+        for mig in list(self._active.values()):
+            try:
+                self._advance(mig)
+            except Exception:
+                log.exception("migration %s advance failed", mig.alloc_id)
+        if len(self._active) >= self.max_concurrent:
+            return
+        pending = c.pending_requests()
+        if not pending:
+            return
+        # pods per pending profile vs migrations already serving it: a
+        # plan clears room for ONE pod, so never queue more migrations
+        # than there are starved pods
+        want: Dict[str, int] = {}
+        for profile_name in pending.values():
+            want[profile_name] = want.get(profile_name, 0) + 1
+        serving: Dict[str, int] = {}
+        for mig in self._active.values():
+            serving[mig.pending_profile] = (
+                serving.get(mig.pending_profile, 0) + 1
+            )
+        for pod_key, profile_name in sorted(pending.items()):
+            if len(self._active) >= self.max_concurrent:
+                return
+            if serving.get(profile_name, 0) >= want[profile_name]:
+                continue
+            try:
+                profile = parse_profile_name(profile_name)
+            except ValueError:
+                continue
+            if self._plan_and_start(pod_key, profile):
+                serving[profile_name] = serving.get(profile_name, 0) + 1
+
+    # ------------------------------------------------------------- planning
+
+    def _plan_and_start(self, pod_key: str, profile) -> bool:
+        """Find one group where ``profile`` is blocked only by movable
+        slices, and start the plan's migrations (up to the concurrency
+        cap). Destinations are reserved in the in-flight overlay UNDER
+        THE SAME LOCK HOLD as the plan, so no concurrent grant can
+        invalidate a destination between choice and reservation.
+        Returns True when at least one migration started."""
+        c = self.controller
+        inf = c._slices_inf
+        for gid in sorted(inf.index_keys(INDEX_SLICE_GROUP)):
+            members = [
+                m for m in inf.by_index(
+                    INDEX_SLICE_GROUP, gid, transformed=True
+                )
+                if m.status.processed and m.spec.generation
+            ]
+            if not members:
+                continue
+            group = c._build_group(gid, members)
+            if group is None or group.generation.name != profile.generation:
+                continue
+            launches = []
+            with c._placement_lock:
+                plan = self._plan_group(gid, group, members, profile)
+                if plan is not None:
+                    target_box, moves = plan
+                    for alloc, dest in moves:
+                        if len(self._active) >= self.max_concurrent:
+                            break
+                        mig = Migration(
+                            alloc_id=alloc.alloc_id,
+                            group_id=gid,
+                            profile=alloc.profile,
+                            old_box=alloc.box,
+                            dest_box=dest.box.key(),
+                            target_box=target_box.key(),
+                            pending_profile=profile.name,
+                            pods=list(alloc.pods),
+                            trace_id=new_trace_id(),
+                            started=time.monotonic(),
+                        )
+                        # reserve the destination BEFORE the drain: the
+                        # overlay entry keeps the pending pod and every
+                        # concurrent grant off the victim's landing box
+                        # for the whole migration. Registering in
+                        # _active here too makes the reservation
+                        # crash-safe: even if the launch below dies
+                        # mid-way, _advance owns the migration and its
+                        # cleanup (the eviction nudge retries the drain)
+                        c._inflight[mig.alloc_id] = (
+                            dest.box, frozenset(dest.node_names), gid,
+                        )
+                        self._active[mig.alloc_id] = mig
+                        launches.append((mig, alloc))
+            if plan is None or not launches:
+                continue
+            self.plans += 1
+            ns, _, pod_name = pod_key.partition("/")
+            with c._pending_lock:
+                pending_tid = c._pending_trace.get(pod_key, "")
+            emit_pod_event(
+                c.client, ns, pod_name,
+                reason=REASON_REPACK_PLANNED,
+                message=(
+                    f"repacking {len(launches)} slice(s) in {gid} to "
+                    f"clear {plan[0].key()} for {profile.name}"
+                ),
+                component=COMPONENT, trace_id=pending_tid,
+            )
+            for mig, alloc in launches:
+                self._launch(mig, alloc)
+            return True
+        return False
+
+    def _plan_group(
+        self, gid: str, group, members, profile
+    ) -> Optional[Tuple[Box, List[Tuple[AllocationDetails, Placement]]]]:
+        """One group's migration plan: the target box needing the fewest
+        moves whose blockers are all movable AND all re-placeable outside
+        it. Caller holds the placement lock (occupancy contract)."""
+        c = self.controller
+        try:
+            occ = c._occupancy(group, members)
+        except ValueError as e:
+            log.warning("group %s occupancy corrupt: %s", gid, e)
+            return None
+        if find_placements(group, profile, occ):
+            return None  # already fits: the controller's requeue grants it
+        movable = self._movable_allocs(group, members, profile)
+        if not movable:
+            return None
+        taken = occ.taken
+        movable_boxes = {
+            aid: Box.from_key(a.box) for aid, a in movable.items()
+        }
+        # cheap pass first (overlap checks only): candidate target
+        # boxes ordered by (fewest moves, lowest corner). The expensive
+        # per-blocker policy feasibility below then runs only until the
+        # FIRST feasible candidate — same selection criterion, a
+        # fraction of the work inside the placement lock.
+        cands = []
+        for pl in legal_placements(group, profile):
+            cover = [
+                aid for aid, b in movable_boxes.items()
+                if b.overlaps(pl.box)
+            ]
+            if not cover or len(cover) > self.max_moves:
+                continue
+            blocker_coords = {
+                co for aid in cover
+                for co in movable_boxes[aid].coords()
+            }
+            # every occupied chip inside the target must belong to a
+            # movable blocker — an immovable slice, an unhealthy chip,
+            # or an in-flight grant disqualifies the box
+            if any(
+                co in taken and co not in blocker_coords
+                for co in pl.box.coords()
+            ):
+                continue
+            cands.append(
+                ((len(cover), sum(pl.box.anchor), pl.box.anchor),
+                 pl.box, cover)
+            )
+        for _key, target, cover in sorted(cands, key=lambda t: t[0]):
+            # feasibility: relocate each blocker (largest first) into a
+            # simulated occupancy where EVERY currently-held chip stays
+            # held (the victims have not moved yet — their destinations
+            # are reserved in the overlay while their old boxes still
+            # stand, so a dest overlapping ANY live box would corrupt
+            # occupancy) and the target box is off-limits
+            sim = Occupancy(group)
+            sim.block(list(taken))
+            sim.block(target.coords())
+            moves: List[Tuple[AllocationDetails, Placement]] = []
+            feasible = True
+            for aid in sorted(
+                cover,
+                key=lambda a: (-movable_boxes[a].chip_count, a),
+            ):
+                try:
+                    bp = parse_profile_name(movable[aid].profile)
+                except ValueError:
+                    feasible = False
+                    break
+                dest = c.policy.choose(group, bp, sim)
+                if dest is None:
+                    feasible = False
+                    break
+                sim.occupy(dest.box)
+                moves.append((movable[aid], dest))
+            if feasible:
+                return target, moves
+        return None
+
+    def _movable_allocs(
+        self, group, members, profile
+    ) -> Dict[str, AllocationDetails]:
+        """Relocatable allocations: UNGATED, single-host, strictly
+        smaller than the blocked profile, not already migrating or
+        overlaid, pods alive / not deleting / not opted out / off
+        cooldown."""
+        c = self.controller
+        now = time.monotonic()
+        allocs: Dict[str, AllocationDetails] = {}
+        for ts in members:
+            for a in ts.spec.allocations.values():
+                allocs.setdefault(a.alloc_id, a)
+        out: Dict[str, AllocationDetails] = {}
+        for aid, a in allocs.items():
+            if a.status != AllocationStatus.UNGATED:
+                continue
+            if len(a.parts) != 1 or not a.pods:
+                continue
+            if aid in self._active or aid in c._inflight:
+                continue
+            try:
+                if parse_profile_name(a.profile).chip_count >= \
+                        profile.chip_count:
+                    continue
+            except ValueError:
+                continue
+            if any(
+                now < self._cooldown_until.get(p.pod_uuid, 0.0)
+                for p in a.pods
+            ):
+                continue
+            if not all(self._pod_movable(p) for p in a.pods):
+                continue
+            out[aid] = a
+        return out
+
+    def _pod_movable(self, ref) -> bool:
+        pod = self._live_pod(ref)
+        if pod is None:
+            return False
+        ann = pod.get("metadata", {}).get("annotations") or {}
+        return ann.get(REPACK_OPTOUT_ANNOTATION) != "true"
+
+    def _live_pod(self, ref) -> Optional[dict]:
+        """The pod behind ``ref``, or None when it is gone, deleting,
+        or its name was reused by a different pod (uid mismatch) — the
+        ONE liveness check for planning and re-granting."""
+        pod = self._get_pod(ref.namespace, ref.pod_name)
+        if pod is None:
+            return None
+        md = pod.get("metadata", {})
+        if md.get("deletionTimestamp"):
+            return None
+        if ref.pod_uuid and md.get("uid") and md["uid"] != ref.pod_uuid:
+            return None
+        return pod
+
+    def _get_pod(self, namespace: str, name: str) -> Optional[dict]:
+        """Informer-only pod read: callers run under the placement lock
+        (planning), where kube I/O is forbidden — ``run_once`` gates on
+        the pod informer being synced, so this is always a dict hit."""
+        c = self.controller
+        if c._pods_inf is None or not c._pods_inf.synced():
+            return None
+        return c._pods_inf.get(namespace, name)
+
+    # ------------------------------------------------------------ execution
+
+    def _launch(self, mig: Migration, alloc: AllocationDetails) -> None:
+        """Start one migration already registered (reservation +
+        ``_active``) by ``_plan_and_start`` under the planning lock:
+        journal it and open the drain. A failure here is recoverable —
+        ``_advance``'s eviction nudge re-issues the drain."""
+        c = self.controller
+        for ref in mig.pods:
+            emit_pod_event(
+                c.client, ref.namespace, ref.pod_name,
+                reason=REASON_REPACK_MIGRATING,
+                message=(
+                    f"slice migrating {mig.old_box} -> {mig.dest_box} "
+                    f"(defragmentation: clearing {mig.target_box} for "
+                    f"{mig.pending_profile})"
+                ),
+                component=COMPONENT, pod_uid=ref.pod_uuid,
+                trace_id=mig.trace_id,
+            )
+        log.info(
+            "repack %s: %s %s -> %s (clearing %s for %s, trace %s)",
+            mig.alloc_id, mig.profile, mig.old_box, mig.dest_box,
+            mig.target_box, mig.pending_profile, mig.trace_id,
+        )
+        with self.tracer.span(
+            "repacker.evict", trace_id=mig.trace_id, alloc=mig.alloc_id,
+        ):
+            c._mark_deleted(alloc)
+
+    def _advance(self, mig: Migration) -> None:
+        if (
+            not mig.warned_stuck
+            and time.monotonic() - mig.started > self.stuck_warn_seconds
+        ):
+            mig.warned_stuck = True
+            log.warning(
+                "migration %s stuck in %s for %.0fs (old %s dest %s)",
+                mig.alloc_id, mig.phase,
+                time.monotonic() - mig.started, mig.old_box, mig.dest_box,
+            )
+        if mig.phase == "evicting":
+            if self._record_gone(mig):
+                self._place_migrated(mig)
+            else:
+                self._nudge_teardown(mig)
+            return
+        # realizing: drive the fresh epoch to UNGATED (or roll it back)
+        c = self.controller
+        found = None
+        for ref in mig.pods:
+            found = c._find_allocation(
+                c._load_slices(), pod_uid=ref.pod_uuid
+            )
+            if found is not None:
+                break
+        if found is None:
+            # record vanished under us (pod force-deleted → orphan
+            # reaper, or an agent-side erase): nothing left to migrate
+            self._finish(mig, ok=False,
+                         msg="allocation record vanished mid-migration")
+            return
+        merged, _holders = found
+        if merged.status == AllocationStatus.CREATING:
+            if merged.fully_realized():
+                c._promote_created(merged)
+                merged.status = AllocationStatus.CREATED
+            else:
+                return  # agents still realizing
+        if merged.status in (AllocationStatus.CREATED,
+                             AllocationStatus.UNGATED):
+            if merged.status == AllocationStatus.CREATED:
+                def mutate(a: AllocationDetails) -> bool:
+                    if a.status != AllocationStatus.CREATED:
+                        return False
+                    a.set_status(AllocationStatus.UNGATED)
+                    return True
+
+                c._for_each_holder(merged, mutate)
+            if mig.rollback:
+                self._finish(
+                    mig, ok=False,
+                    msg=(f"migration failed; rolled back to "
+                         f"{merged.box}"),
+                    final_box=merged.box,
+                )
+            else:
+                self._finish(mig, ok=True, final_box=merged.box)
+            return
+        if merged.status == AllocationStatus.FAILED:
+            # mid-migration realize failure: roll back exactly like the
+            # partial fan-out path — tear the failed epoch down, then
+            # re-grant anywhere (usually the old box, which we freed)
+            log.warning(
+                "migration %s realize failed (%s); rolling back",
+                mig.alloc_id, merged.message,
+            )
+            get_journal().emit(
+                COMPONENT, reason=REASON_REPACK_FAILED,
+                object_ref=f"alloc/{mig.alloc_id}",
+                message=(f"destination realize failed: {merged.message}; "
+                         "tearing down for rollback"),
+                trace_id=mig.trace_id,
+            )
+            c._mark_deleted(merged)
+            mig.rollback = True
+            mig.dest_box = None
+            mig.attempts += 1
+            mig.phase = "evicting"
+            with c._placement_lock:
+                c._inflight.pop(mig.alloc_id, None)
+            return
+        # DELETED: someone else is tearing the epoch down (pod deletion
+        # mid-migration); wait for the erase, then bail in _record_gone
+        if merged.status == AllocationStatus.DELETED:
+            mig.phase = "evicting"
+            mig.rollback = True
+            mig.dest_box = None
+
+    def _record_gone(self, mig: Migration) -> bool:
+        c = self.controller
+        for ts in c._slices_inf.by_index(
+            INDEX_SLICE_GROUP, mig.group_id, transformed=True
+        ):
+            if mig.alloc_id in ts.spec.allocations:
+                return False
+        return True
+
+    def _nudge_teardown(self, mig: Migration) -> None:
+        """The drain write is one ``_mark_deleted`` call and can fail
+        transiently (exhausted conflict retries, an API blip) — without
+        a retry the migration would wedge in ``evicting`` forever,
+        pinning its destination reservation and a concurrency slot.
+        Re-issue the idempotent teardown for any holder copy that is
+        still not DELETED; copies already DELETED are the agents'
+        business and are left alone."""
+        c = self.controller
+        for ts in c._slices_inf.by_index(
+            INDEX_SLICE_GROUP, mig.group_id, transformed=True
+        ):
+            a = ts.spec.allocations.get(mig.alloc_id)
+            if a is not None and a.status != AllocationStatus.DELETED:
+                c._mark_deleted(a)
+                return
+
+    def _place_migrated(self, mig: Migration) -> None:
+        """Old record fully erased: write the fresh epoch. Placement
+        choice (in-memory) happens under the placement lock; the CR
+        fan-out happens outside it, like every controller grant."""
+        c = self.controller
+        if not all(self._live_pod(p) is not None for p in mig.pods):
+            self._finish(mig, ok=False,
+                         msg="pod gone mid-migration; not re-granting")
+            return
+        try:
+            profile = parse_profile_name(mig.profile)
+        except ValueError as e:
+            self._finish(mig, ok=False, msg=f"unparseable profile: {e}")
+            return
+        with self.tracer.span(
+            "repacker.migrate", trace_id=mig.trace_id,
+            alloc=mig.alloc_id, profile=mig.profile,
+        ) as sp:
+            group_gone = False
+            placement: Optional[Placement] = None
+            with c._placement_lock:
+                members = [
+                    m for m in c._slices_inf.by_index(
+                        INDEX_SLICE_GROUP, mig.group_id, transformed=True
+                    )
+                    if m.status.processed and m.spec.generation
+                ]
+                group = (
+                    c._build_group(mig.group_id, members)
+                    if members else None
+                )
+                if group is None:
+                    group_gone = True
+                else:
+                    # our own reservation must not block the fit check
+                    c._inflight.pop(mig.alloc_id, None)
+                    try:
+                        occ = c._occupancy(group, members)
+                    except ValueError as e:
+                        log.warning("group %s occupancy corrupt: %s",
+                                    mig.group_id, e)
+                        return  # retry next tick
+                    if mig.dest_box:
+                        dest = Box.from_key(mig.dest_box)
+                        if occ.fits(dest):
+                            placement = next(
+                                (pl for pl
+                                 in legal_placements(group, profile)
+                                 if pl.box == dest),
+                                None,
+                            )
+                    if placement is None and not mig.rollback:
+                        # planned destination raced away: re-place
+                        # anywhere except the box we are clearing
+                        occ.block(Box.from_key(mig.target_box).coords())
+                        placement = c.policy.choose(group, profile, occ)
+                    if placement is None:
+                        # rollback / last resort: anywhere at all (fresh
+                        # occupancy — the target block polluted occ)
+                        occ2 = c._occupancy(group, members)
+                        placement = c.policy.choose(group, profile, occ2)
+                    if placement is not None:
+                        c._inflight[mig.alloc_id] = (
+                            placement.box,
+                            frozenset(placement.node_names),
+                            mig.group_id,
+                        )
+            if group_gone:
+                sp.attrs["placed"] = "no-group"
+                self._finish(mig, ok=False,
+                             msg="torus group vanished mid-migration")
+                return
+            if placement is None:
+                # nothing fits this tick (transient churn): keep the
+                # migration open and retry — the victim's chips stay
+                # released, so this is the state to escape fastest
+                sp.attrs["placed"] = "retry"
+                mig.dest_box = None
+                mig.attempts += 1
+                return
+            sp.attrs["box"] = placement.box.key()
+            new_alloc = AllocationDetails.from_placement(
+                placement, mig.pods, alloc_id=mig.alloc_id,
+                trace_id=mig.trace_id,
+                note="repack rollback" if mig.rollback else "repack",
+            )
+            try:
+                placed = c._write_allocation(new_alloc)
+            finally:
+                with c._placement_lock:
+                    c._inflight.pop(mig.alloc_id, None)
+        if not placed:
+            # server-side overlap guard refused a node's copy: roll the
+            # partial fan-out back through the normal teardown machinery
+            # (the PR 6 path) and re-place after the erase
+            log.warning("migration %s: overlap conflict; re-placing",
+                        mig.alloc_id)
+            c._mark_deleted(new_alloc)
+            mig.dest_box = None
+            mig.attempts += 1
+            return
+        mig.phase = "realizing"
+
+    # ------------------------------------------------------------ completion
+
+    def _finish(self, mig: Migration, ok: bool, msg: str = "",
+                final_box: str = "") -> None:
+        c = self.controller
+        with c._placement_lock:
+            c._inflight.pop(mig.alloc_id, None)
+        if ok:
+            self.migrations_done += 1
+            for ref in mig.pods:
+                emit_pod_event(
+                    c.client, ref.namespace, ref.pod_name,
+                    reason=REASON_REPACK_DONE,
+                    message=(f"slice migrated {mig.old_box} -> "
+                             f"{final_box or mig.dest_box} "
+                             "(defragmentation)"),
+                    component=COMPONENT, pod_uid=ref.pod_uuid,
+                    trace_id=mig.trace_id,
+                )
+            log.info("repack %s done: %s -> %s", mig.alloc_id,
+                     mig.old_box, final_box or mig.dest_box)
+        else:
+            self.migrations_failed += 1
+            get_journal().emit(
+                COMPONENT, reason=REASON_REPACK_FAILED,
+                object_ref=f"alloc/{mig.alloc_id}",
+                message=msg or "migration failed",
+                trace_id=mig.trace_id,
+            )
+            log.warning("repack %s failed: %s", mig.alloc_id, msg)
+        now = time.monotonic()
+        for ref in mig.pods:
+            self._cooldown_until[ref.pod_uuid] = now + self.cooldown
+        for uid in [u for u, dl in self._cooldown_until.items()
+                    if dl <= now]:
+            del self._cooldown_until[uid]
+        self._active.pop(mig.alloc_id, None)

@@ -65,6 +65,7 @@ from instaslice_tpu.topology.grid import (
     id_to_coord,
     volume,
 )
+from instaslice_tpu.topology.frag import frag_metrics, snapshot_line
 from instaslice_tpu.topology.placement import Box, Occupancy, Placement
 from instaslice_tpu.topology.policy import AllocationPolicy, get_policy
 from instaslice_tpu.topology.profiles import TopologyProfile
@@ -165,6 +166,11 @@ class Controller:
         self.metrics = metrics
         self._pending_lock = named_lock("controller.pending")
         self._pending: set = set()
+        #: pod key → requested profile name for capacity-starved pods —
+        #: the repacker's trigger set (controller/defrag.py): a pending
+        #: 2x2 here plus only-relocatable 1x1s in the way is exactly the
+        #: stranded-capacity pattern it exists to clear
+        self._pending_profiles: Dict[str, str] = {}
         #: pod key → trace id minted on the pod's FIRST no-capacity
         #: attempt: every ~2s requeue re-probes under the SAME trace id
         #: (and only the first attempt records a span), so a pod waiting
@@ -198,10 +204,13 @@ class Controller:
         #: for the indexed placement path, rebuilt only when the
         #: informer's per-group version moved
         self._members_cache: Dict[str, tuple] = {}
-        #: (gid, profile) → (index version, in-flight overlay signature)
-        #: under which the group had no room — an O(1) skip until one of
-        #: its CRs actually changes
-        self._no_fit: Dict[Tuple[str, str], tuple] = {}
+        #: (gid, profile, policy name) → (index version, in-flight
+        #: overlay signature) under which the group had no room — an
+        #: O(1) skip until one of its CRs actually changes. The policy
+        #: name is part of the key: a runtime policy swap (or a policy
+        #: that declines candidates a scan-order policy would take)
+        #: must never inherit another policy's stale no-fit verdicts.
+        self._no_fit: Dict[Tuple[str, str, str], tuple] = {}
         self.manager = Manager(
             name="controller",
             client=client,
@@ -735,6 +744,15 @@ class Controller:
                         frozenset(placement.node_names),
                         placement.group_id,
                     )
+                frag_note = ""
+                if placement is None and pending_tid is None:
+                    # the once-per-wait NoCapacity event carries a
+                    # fragmentation snapshot (largest free box per
+                    # group), so an operator can tell "chips free but
+                    # scattered" from true exhaustion without tooling;
+                    # computed here because occupancy reads require the
+                    # placement lock
+                    frag_note = self._frag_note(profile, slices)
             if placement is None:
                 sp.attrs["placed"] = "false"
                 sp.drop = pending_tid is not None
@@ -747,13 +765,15 @@ class Controller:
                         reason=REASON_NO_CAPACITY,
                         message=(f"no {profile.name} capacity; waiting "
                                  f"(re-probing every "
-                                 f"{self.no_capacity_requeue:g}s)"),
+                                 f"{self.no_capacity_requeue:g}s)"
+                                 + (f"; {frag_note}" if frag_note
+                                    else "")),
                         component="controller", pod_uid=pod_uid,
                         trace_id=trace_id, event_type="Warning",
                     )
                 with self._pending_lock:
                     self._pending_trace[pod_key] = trace_id
-                self._set_pending(pod_key, True)
+                self._set_pending(pod_key, True, profile=profile.name)
                 return self.no_capacity_requeue
             self._set_pending(pod_key, False)
             sp.attrs["box"] = placement.box.key()
@@ -947,7 +967,8 @@ class Controller:
                 if g == gid
             )
             fp = (ver, inflight_sig)
-            if not avoid and self._no_fit.get((gid, profile.name)) == fp:
+            memo_key = (gid, profile.name, self.policy.name)
+            if not avoid and self._no_fit.get(memo_key) == fp:
                 continue
             cached = self._members_cache.get(gid)
             if cached is not None and cached[0] == ver:
@@ -965,11 +986,43 @@ class Controller:
                 continue
             placement = self._try_group(gid, group, members, profile, avoid)
             if placement is not None:
-                self._no_fit.pop((gid, profile.name), None)
+                self._no_fit.pop(memo_key, None)
                 return placement
             if not avoid:
-                self._no_fit[(gid, profile.name)] = fp
+                self._no_fit[memo_key] = fp
         return None
+
+    def _frag_note(self, profile: TopologyProfile,
+                   slices: List[TpuSlice],
+                   max_groups: int = 4) -> str:
+        """Per-group fragmentation snapshot for the profile's generation
+        (caller holds ``_placement_lock`` and passes the slices it
+        already loaded — no kube I/O under the lock). Runs once per
+        capacity wait, not per requeue, so the O(group) metric sweep
+        stays off the hot path."""
+        parts: List[str] = []
+        try:
+            for gid, (group, members) in sorted(
+                self._torus_groups(slices).items()
+            ):
+                if group.generation.name != profile.generation:
+                    continue
+                try:
+                    occ = self._occupancy(group, members)
+                except ValueError:
+                    continue
+                parts.append(
+                    f"{gid}: {snapshot_line(frag_metrics(group, occ))}"
+                )
+                if len(parts) >= max_groups:
+                    parts.append("...")
+                    break
+        except Exception:
+            # snapshot is observability garnish: it must never turn a
+            # NoCapacity verdict into a reconcile error
+            log.debug("fragmentation snapshot failed", exc_info=True)
+            return ""
+        return "; ".join(parts)
 
     # --------------------------------------------------- allocation writes
 
@@ -1369,17 +1422,27 @@ class Controller:
         md = pod.get("metadata", {})
         return f"{md.get('namespace', '')}/{md.get('name', '')}"
 
-    def _set_pending(self, key: str, pending: bool) -> None:
+    def _set_pending(self, key: str, pending: bool,
+                     profile: str = "") -> None:
         """Track the set of capacity-starved pods; the gauge reports its
         size (a constant 0/1 would lie with >1 pending pod)."""
         with self._pending_lock:
             if pending:
                 self._pending.add(key)
+                if profile:
+                    self._pending_profiles[key] = profile
             else:
                 self._pending.discard(key)
                 self._pending_trace.pop(key, None)
+                self._pending_profiles.pop(key, None)
             if self.metrics:
                 self.metrics.pending_pods.set(len(self._pending))
+
+    def pending_requests(self) -> Dict[str, str]:
+        """pod key → profile name for every capacity-starved pod (the
+        repacker's stranded-capacity trigger)."""
+        with self._pending_lock:
+            return dict(self._pending_profiles)
 
     def _ensure_finalizer(self, pod: dict) -> None:
         md = pod["metadata"]

@@ -45,7 +45,7 @@ class ControllerRunner:
         self,
         client: KubeClient,
         namespace: str = "instaslice-tpu-system",
-        policy: str = "first-fit",
+        policy: str = "",
         deletion_grace_seconds: float = 30.0,
         metrics_bind_address: str = ":8080",
         health_probe_bind_address: str = ":8081",
@@ -53,14 +53,31 @@ class ControllerRunner:
         identity: str = "",
         workers: Optional[int] = None,
         shard_leases: bool = False,
+        repack: bool = False,
+        repack_interval: float = 5.0,
+        repack_max_concurrent: int = 2,
+        repack_cooldown: float = 300.0,
     ) -> None:
         """``shard_leases``: instead of ONE controller lease, each
         reconcile shard worker holds Lease ``<LEASE_NAME>-shard-<i>`` —
         multiple replicas split the shards between them (active-active
         horizontal scale-out) while per-key ordering still holds
         cluster-wide, and every write is fenced on the writing shard's
-        lease (docs/SCALING.md)."""
+        lease (docs/SCALING.md).
+
+        ``policy`` resolution: the explicit argument, else the
+        ``TPUSLICE_PLACEMENT_POLICY`` env var, else first-fit —
+        ``get_policy`` rejects unknown names with the registered list.
+
+        ``repack``: run the defragmentation loop
+        (:class:`~instaslice_tpu.controller.defrag.Repacker`) next to
+        the reconcile workers (docs/SCALING.md knobs)."""
         self.client = client
+        policy = (
+            policy
+            or os.environ.get("TPUSLICE_PLACEMENT_POLICY", "")
+            or "first-fit"
+        )
         self.namespace = namespace
         self.leader_elect = leader_elect
         self.shard_leases = shard_leases
@@ -95,6 +112,16 @@ class ControllerRunner:
                 if shard_leases else None
             ),
         )
+        self.repacker = None
+        if repack:
+            from instaslice_tpu.controller.defrag import Repacker
+
+            self.repacker = Repacker(
+                self.controller,
+                interval=repack_interval,
+                max_concurrent=repack_max_concurrent,
+                cooldown=repack_cooldown,
+            )
         self._stop = threading.Event()
         self._ready = False
         self.probes: Optional[ProbeServer] = None
@@ -118,13 +145,19 @@ class ControllerRunner:
         return cls(
             build_client(getattr(args, "kubeconfig", "")),
             namespace=args.namespace,
-            policy=args.policy,
+            policy=args.policy or "",
             deletion_grace_seconds=args.deletion_grace_seconds,
             metrics_bind_address=args.metrics_bind_address,
             health_probe_bind_address=args.health_probe_bind_address,
             leader_elect=args.leader_elect,
             workers=getattr(args, "workers", None),
             shard_leases=getattr(args, "shard_leases", False),
+            repack=getattr(args, "repack", False),
+            repack_interval=getattr(args, "repack_interval", 5.0),
+            repack_max_concurrent=getattr(
+                args, "repack_max_concurrent", 2
+            ),
+            repack_cooldown=getattr(args, "repack_cooldown", 300.0),
         )
 
     # ------------------------------------------------------------------
@@ -160,11 +193,17 @@ class ControllerRunner:
                 return 0  # stopped while waiting
             self.elector.start_renewing(on_lost=self.stop)
         self.controller.start()
+        if self.repacker is not None:
+            self.repacker.start()
+            log.info("repacker running (interval=%.1fs)",
+                     self.repacker.interval)
         self._ready = True
         log.info("controller running (namespace=%s)", self.namespace)
         try:
             self._stop.wait()
         finally:
+            if self.repacker is not None:
+                self.repacker.stop()
             # readiness drops FIRST (readyz → 503 "draining") so the
             # Service routes around this replica while the reconcile
             # loops finish their in-flight keys; liveness stays green

@@ -147,6 +147,10 @@ class SimCluster:
         workers: Optional[int] = None,
         use_cache: bool = True,
         bind_latency: float = 0.0,
+        repack: bool = False,
+        repack_interval: float = 0.25,
+        repack_max_concurrent: int = 2,
+        repack_cooldown: float = 1.0,
     ) -> None:
         """``transport="inproc"`` wires every component straight to the
         in-process FakeKube. ``transport="http"`` puts the store behind
@@ -195,7 +199,13 @@ class SimCluster:
           ``workers=1`` is the measured serial re-list baseline of
           ``bench.py --scale``).
         - ``bind_latency``: the simulated kubelet's delay between an
-          ungated Pending pod appearing and its bind to Running."""
+          ungated Pending pod appearing and its bind to Running.
+        - ``repack``: run the defragmentation loop
+          (:class:`~instaslice_tpu.controller.defrag.Repacker`) against
+          the controller — requires ``use_cache`` (the repacker reads
+          the informer plane). ``repack_interval`` /
+          ``repack_max_concurrent`` / ``repack_cooldown`` tune it for
+          sim timescales."""
         from instaslice_tpu.faults import (
             FaultPlan,
             FaultyBackend,
@@ -321,6 +331,21 @@ class SimCluster:
             workers=workers,
             use_cache=use_cache,
         )
+        self.repacker = None
+        if repack:
+            if not use_cache:
+                raise ValueError(
+                    "repack=True requires use_cache=True (the repacker "
+                    "reads the informer plane)"
+                )
+            from instaslice_tpu.controller.defrag import Repacker
+
+            self.repacker = Repacker(
+                self.controller,
+                interval=repack_interval,
+                max_concurrent=repack_max_concurrent,
+                cooldown=repack_cooldown,
+            )
         # Optional fake-kubelet tier: a per-node SlicePluginManager serving
         # real gRPC device plugins over unix sockets; the sim scheduler
         # plays kubelet (GetPreferredAllocation → Allocate) when binding
@@ -412,10 +437,14 @@ class SimCluster:
         for mgr in self.plugin_managers.values():
             mgr.start()
         self.controller.start()
+        if self.repacker is not None:
+            self.repacker.start()
         self._sched_mgr.start()
         return self
 
     def stop(self) -> None:
+        if self.repacker is not None:
+            self.repacker.stop()
         self.controller.stop()
         for mgr in self.plugin_managers.values():
             mgr.stop()

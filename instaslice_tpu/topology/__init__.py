@@ -30,5 +30,12 @@ from instaslice_tpu.topology.policy import (
     AllocationPolicy,
     FirstFitPolicy,
     BestFitPolicy,
+    FragAwarePolicy,
     get_policy,
+)
+from instaslice_tpu.topology.frag import (
+    FragMetrics,
+    frag_metrics,
+    free_fit_boxes,
+    weighted_free_capacity,
 )

@@ -103,6 +103,44 @@ class PackedFitPolicy(AllocationPolicy):
         )
 
 
+class FragAwarePolicy(AllocationPolicy):
+    """Fragmentation-cost scoring: pick the candidate that preserves the
+    most chip-count-weighted free capacity.
+
+    :class:`BestFitPolicy` counts surviving free boxes; this policy
+    weights each survivor by its chip count
+    (:func:`~instaslice_tpu.topology.frag.weighted_free_capacity`), so
+    destroying a free 2x2 box costs 4x what nibbling an already-broken
+    quad costs — small slices are steered into fragments and large
+    contiguous boxes stay whole for large requests (the
+    fragmentation-gradient scoring of the MIG fragmentation paper,
+    PAPERS.md). Ties break toward the origin corner. Pairs with the
+    repacker (``controller/defrag.py``), which recovers the capacity
+    this policy alone cannot protect under churn."""
+
+    name = "frag-aware"
+
+    def choose(self, group, profile, occupancy):
+        from instaslice_tpu.topology.frag import (
+            free_fit_boxes,
+            weighted_free_capacity,
+        )
+
+        cands = find_placements(group, profile, occupancy)
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        boxes = free_fit_boxes(group, occupancy)
+        return max(
+            cands,
+            key=lambda c: (
+                weighted_free_capacity(boxes, excluding=c.box),
+                [-v for v in c.box.anchor],
+            ),
+        )
+
+
 class LeftToRightPolicy(AllocationPolicy):
     """Lowest anchor along the x axis (ties: y, then z) — the policy the
     reference declares but leaves as an empty stub
@@ -144,6 +182,7 @@ _REGISTRY: Dict[str, Type[AllocationPolicy]] = {
     for p in (
         FirstFitPolicy,
         BestFitPolicy,
+        FragAwarePolicy,
         PackedFitPolicy,
         LeftToRightPolicy,
         RightToLeftPolicy,
@@ -156,7 +195,9 @@ def get_policy(name: str) -> AllocationPolicy:
         return _REGISTRY[name]()
     except KeyError:
         raise KeyError(
-            f"unknown allocation policy {name!r}; known: {sorted(_REGISTRY)}"
+            f"unknown allocation policy {name!r}; registered policies: "
+            f"{', '.join(sorted(_REGISTRY))} (select with --policy or "
+            "the TPUSLICE_PLACEMENT_POLICY env var)"
         ) from None
 
 

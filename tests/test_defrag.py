@@ -1,0 +1,478 @@
+"""Defragmentation tier: fragmentation metrics (`topology/frag.py`),
+the FragAwarePolicy, per-policy no-fit memo keying in the indexed
+placement path, the NoCapacity fragmentation snapshot, the repacker
+(`controller/defrag.py`) end to end in the sim — including the
+mid-migration chaos rollback — and the describe-pod rendering of
+migration epochs (docs/SCALING.md "Fragmentation-aware placement &
+the repacker")."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import pytest
+
+from instaslice_tpu.api.constants import (
+    REASON_NO_CAPACITY,
+    REASON_REPACK_DONE,
+    REASON_REPACK_MIGRATING,
+    REPACK_OPTOUT_ANNOTATION,
+)
+from instaslice_tpu.obs.journal import get_journal, reset_journal
+from instaslice_tpu.topology.frag import (
+    frag_metrics,
+    free_fit_boxes,
+    snapshot_line,
+    weighted_free_capacity,
+)
+from instaslice_tpu.topology.grid import NodeGrid, TorusGroup, get_generation
+from instaslice_tpu.topology.placement import Box, Occupancy
+from instaslice_tpu.topology.policy import get_policy, policy_names
+from instaslice_tpu.topology.profiles import parse_profile_name
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import validate_events  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_journal():
+    reset_journal()
+    yield
+    reset_journal()
+
+
+def two_host_group() -> TorusGroup:
+    """Two v5e hosts side by side: one 4x4 torus, four 2x2 quads."""
+    gen = get_generation("v5e")
+    hb = gen.host_bounds
+    hosts = {
+        f"node-{i}": NodeGrid(gen, host_offset=(i * hb[0], 0, 0))
+        for i in range(2)
+    }
+    return TorusGroup("g", gen, (4, 4, 1), hosts)
+
+
+def carve_survivors(c, fillers):
+    """Delete all but one (first-seen) filler per 2x2-aligned quad;
+    returns the surviving pod names."""
+    keep = {}
+    doomed = []
+    for _aid, a in sorted(c.allocations().items()):
+        box = Box.from_key(a["box"])
+        quad = (a.get("torusGroup", ""),
+                box.anchor[0] // 2 * 2, box.anchor[1] // 2 * 2)
+        name = a["pods"][0]["podName"]
+        if name not in fillers:
+            continue
+        if quad in keep:
+            doomed.append(name)
+        else:
+            keep[quad] = name
+    for name in doomed:
+        c.delete_pod(name)
+    for name in doomed:
+        assert c.wait_gone(name, timeout=30), name
+    return sorted(keep.values())
+
+
+# ========================================================== frag metrics
+
+
+class TestFragMetrics:
+    def test_empty_group_is_unfragmented(self):
+        g = two_host_group()
+        m = frag_metrics(g, Occupancy(g))
+        assert m.free_chips == 16
+        assert m.largest_free_box == "v5e-4x4"
+        assert m.stranded_free_chips == 0
+        assert m.fit_counts["v5e-2x2"] == 4
+
+    def test_one_survivor_per_quad_blocks_2x2_and_strands(self):
+        g = two_host_group()
+        occ = Occupancy(g)
+        for q in [(0, 0), (2, 0), (0, 2), (2, 2)]:
+            occ.occupy(Box((q[0], q[1], 0), (1, 1, 1)))
+        m = frag_metrics(g, occ)
+        assert m.free_chips == 12
+        assert m.fit_counts["v5e-2x2"] == 0
+        # the fragmentation signature: plenty free, big boxes gone
+        assert m.largest_free_chips < 12
+        assert m.stranded_free_chips > 0
+        assert 0 < m.stranded_fraction < 1
+        line = snapshot_line(m)
+        assert "12/16 chips free" in line
+        assert "largest free box" in line
+        assert "stranded" in line
+
+    def test_snapshot_line_exhausted_and_fully_fragmented(self):
+        g = two_host_group()
+        occ = Occupancy(g)
+        for c in [(x, y, 0) for x in range(4) for y in range(4)]:
+            occ.occupy(Box(c, (1, 1, 1)))
+        assert "exhausted" in snapshot_line(frag_metrics(g, occ))
+
+    def test_weighted_capacity_prices_big_boxes_higher(self):
+        g = two_host_group()
+        boxes = free_fit_boxes(g, Occupancy(g))
+        whole = weighted_free_capacity(boxes)
+        # destroying a quad costs more weighted capacity than one cell
+        quad_hit = weighted_free_capacity(
+            boxes, excluding=Box((0, 0, 0), (2, 2, 1))
+        )
+        cell_hit = weighted_free_capacity(
+            boxes, excluding=Box((0, 0, 0), (1, 1, 1))
+        )
+        assert whole > cell_hit > quad_hit
+
+
+# ======================================================= frag-aware policy
+
+
+class TestFragAwarePolicy:
+    def test_registered_and_helpful_error(self):
+        assert "frag-aware" in policy_names()
+        assert get_policy("frag-aware").name == "frag-aware"
+        with pytest.raises(KeyError) as ei:
+            get_policy("no-such-policy")
+        msg = str(ei.value)
+        for name in policy_names():
+            assert name in msg
+        assert "TPUSLICE_PLACEMENT_POLICY" in msg
+
+    def test_consolidates_into_broken_quad(self):
+        """A 1x1 must land in the quad that already lost its 2x2 —
+        preserving every other quad's 2x2 fit."""
+        g = two_host_group()
+        occ = Occupancy(g)
+        occ.occupy(Box((1, 1, 0), (1, 1, 1)))  # breaks quad (0,0)
+        pl = get_policy("frag-aware").choose(
+            g, parse_profile_name("v5e-1x1"), occ
+        )
+        assert pl is not None
+        ax, ay, _ = pl.box.anchor
+        assert (ax // 2 * 2, ay // 2 * 2) == (0, 0), pl.box.key()
+
+    def test_preserves_largest_box_for_2x1(self):
+        g = two_host_group()
+        occ = Occupancy(g)
+        occ.occupy(Box((0, 0, 0), (1, 1, 1)))
+        pl = get_policy("frag-aware").choose(
+            g, parse_profile_name("v5e-2x1"), occ
+        )
+        assert pl is not None
+        occ.occupy(pl.box)
+        # after the placement, three full quads must survive
+        assert frag_metrics(g, occ).fit_counts["v5e-2x2"] == 3
+
+
+# ==================================== indexed placement + no-fit memo
+
+
+class TestNoFitMemoPerPolicy:
+    def _synced_sim(self):
+        from instaslice_tpu.sim import SimCluster
+
+        return SimCluster(
+            n_nodes=1, generation="v5e", policy="best-fit",
+            deletion_grace_seconds=0.2, health_interval=0,
+        )
+
+    def _wait_group(self, ctl, gid="node-0", timeout=10.0):
+        from instaslice_tpu.controller.reconciler import INDEX_SLICE_GROUP
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if ctl._cache_ready() and any(
+                m.status.processed
+                for m in ctl._slices_inf.by_index(
+                    INDEX_SLICE_GROUP, gid, transformed=True
+                )
+            ):
+                return
+            time.sleep(0.02)  # slicelint: disable=sleep-in-loop
+        raise AssertionError("informer never served the node CR")
+
+    def test_policies_exercised_and_memo_keyed_per_policy(self):
+        with self._synced_sim() as c:
+            ctl = c.controller
+            self._wait_group(ctl)
+            fits = parse_profile_name("v5e-2x2")
+            too_big = parse_profile_name("v5e-4x4")  # host is 2x4
+
+            # BestFit through the indexed path: places
+            with ctl._placement_lock:
+                p1 = ctl._place_indexed(fits, frozenset())
+            assert p1 is not None
+
+            # no-fit memo lands keyed by (gid, profile, policy name)
+            with ctl._placement_lock:
+                assert ctl._place_indexed(too_big, frozenset()) is None
+            assert ("node-0", "v5e-4x4", "best-fit") in ctl._no_fit
+
+            # swap to PackedFit: the stale best-fit memo must NOT be
+            # consulted — _try_group runs again under the new key
+            calls = []
+            orig = ctl._try_group
+
+            def spy(*a, **kw):
+                calls.append(1)
+                return orig(*a, **kw)
+
+            ctl._try_group = spy
+            ctl.policy = get_policy("packed-fit")
+            with ctl._placement_lock:
+                assert ctl._place_indexed(too_big, frozenset()) is None
+            assert calls, "policy swap did not invalidate the no-fit memo"
+            assert ("node-0", "v5e-4x4", "packed-fit") in ctl._no_fit
+            assert ("node-0", "v5e-4x4", "best-fit") in ctl._no_fit
+
+            # and with an unchanged group + same policy, the memo DOES
+            # short-circuit (no _try_group call)
+            calls.clear()
+            with ctl._placement_lock:
+                assert ctl._place_indexed(too_big, frozenset()) is None
+            assert not calls
+
+            # PackedFit through the indexed path: corner placement
+            with ctl._placement_lock:
+                p2 = ctl._place_indexed(fits, frozenset())
+            assert p2 is not None
+            assert p2.box.anchor == (0, 0, 0)
+
+
+# =========================================== NoCapacity frag snapshot
+
+
+class TestNoCapacityFragSnapshot:
+    def test_event_message_names_largest_free_box(self):
+        from instaslice_tpu.sim import SimCluster
+
+        with SimCluster(
+            n_nodes=1, generation="v5e", policy="first-fit",
+            deletion_grace_seconds=0.2, health_interval=0,
+        ) as c:
+            fillers = [f"f-{i}" for i in range(8)]
+            for n in fillers:
+                c.submit(n, profile="v5e-1x1")
+            for n in fillers:
+                assert c.wait_phase(n, "Running", timeout=30), n
+            # carve: free 6 of 8 chips but keep both 2x2 areas broken
+            survivors = carve_survivors(c, fillers)
+            assert len(survivors) == 2
+            c.submit("blocked", profile="v5e-2x2")
+            deadline = time.monotonic() + 10
+            evs = []
+            while time.monotonic() < deadline and not evs:
+                evs = get_journal().events(reason=REASON_NO_CAPACITY)
+                time.sleep(0.02)  # slicelint: disable=sleep-in-loop
+            assert evs, "NoCapacity never emitted"
+            msg = evs[0].message
+            assert "6/8 chips free" in msg, msg
+            assert "largest free box" in msg, msg
+
+
+# ================================================================ repacker
+
+
+class TestRepacker:
+    def _fragmented_sim(self, **kw):
+        from instaslice_tpu.sim import SimCluster
+
+        defaults = dict(
+            n_nodes=2, generation="v5e", nodes_per_group=2,
+            policy="frag-aware", repack=True, repack_interval=0.1,
+            repack_cooldown=0.4, deletion_grace_seconds=0.2,
+            health_interval=0,
+        )
+        defaults.update(kw)
+        return SimCluster(**defaults)
+
+    def _fill_and_carve(self, c, annotations=None):
+        fillers = [f"fill-{i}" for i in range(16)]
+        for n in fillers:
+            c.submit(n, profile="v5e-1x1", annotations=annotations)
+        for n in fillers:
+            assert c.wait_phase(n, "Running", timeout=30), n
+        return carve_survivors(c, set(fillers))
+
+    def test_stranded_2x2_recovered_by_migration(self):
+        with self._fragmented_sim() as c:
+            survivors = self._fill_and_carve(c)
+            assert len(survivors) == 4  # one per quad: every 2x2 blocked
+            c.submit("big-0", profile="v5e-2x2")
+            c.submit("big-1", profile="v5e-2x2")
+            assert c.wait_phase("big-0", "Running", timeout=30)
+            assert c.wait_phase("big-1", "Running", timeout=30)
+            assert c.repacker.migrations_done >= 2
+            # survivors are still Running (migrated, not evicted)
+            for n in survivors:
+                assert c.pod_phase(n) == "Running", n
+            # no double allocation anywhere
+            boxes = [
+                Box.from_key(a["box"])
+                for a in c.allocations().values()
+                if a["status"] != "deleted"
+            ]
+            for i, a in enumerate(boxes):
+                for b in boxes[i + 1:]:
+                    assert not a.overlaps(b), (a.key(), b.key())
+            # every epoch — original grants AND migration epochs — is a
+            # legal chain under the strict events-check validator
+            errs = validate_events.check_chains(
+                [e.to_dict() for e in get_journal().events()],
+                strict=True,
+            )
+            assert errs == []
+            done = get_journal().events(reason=REASON_REPACK_DONE)
+            assert done
+            # migration epochs are trace-correlated: the RepackDone
+            # trace id matches the fresh epoch's transition events
+            tid = done[0].trace_id
+            assert tid
+            assert any(
+                e.trace_id == tid
+                for e in get_journal().events(reason="SliceUngated")
+            )
+
+    def test_optout_annotation_pins_slices(self):
+        with self._fragmented_sim() as c:
+            survivors = self._fill_and_carve(
+                c, annotations={REPACK_OPTOUT_ANNOTATION: "true"}
+            )
+            assert len(survivors) == 4
+            c.submit("big-0", profile="v5e-2x2")
+            # give the repacker ~15 ticks: it must refuse to move
+            # opted-out slices, so the pod stays Pending
+            assert not c.wait_phase("big-0", "Running", timeout=1.5)
+            assert c.repacker.migrations_done == 0
+            assert c.repacker.plans == 0
+            for n in survivors:
+                assert c.pod_phase(n) == "Running", n
+
+    def test_chaos_realize_failure_mid_migration_rolls_back(self):
+        with self._fragmented_sim() as c:
+            self._fill_and_carve(c)
+            # every node's NEXT chip reservation fails: the first
+            # migration's destination realize dies mid-flight
+            for node in list(c.backends):
+                c.backends[node].inject_failures("reserve", 1)
+            c.submit("big-0", profile="v5e-2x2")
+            assert c.wait_phase("big-0", "Running", timeout=45)
+            # rollback happened (FAILED epoch) and nothing leaked:
+            # device reservations match the CRs' prepared records
+            for node, backend in c.backends.items():
+                ts = c.kube.get("TpuSlice", c.namespace, node)
+                prepared = set(ts["spec"].get("prepared", {}))
+                reserved = {
+                    r.slice_uuid for r in backend.list_reservations()
+                }
+                assert prepared == reserved, (node, prepared, reserved)
+            boxes = [
+                Box.from_key(a["box"])
+                for a in c.allocations().values()
+                if a["status"] != "deleted"
+            ]
+            for i, a in enumerate(boxes):
+                for b in boxes[i + 1:]:
+                    assert not a.overlaps(b), (a.key(), b.key())
+            errs = validate_events.check_chains(
+                [e.to_dict() for e in get_journal().events()],
+                strict=True,
+            )
+            assert errs == []
+
+
+# ===================================================== describe rendering
+
+
+class TestDescribeMigration:
+    def test_migrated_pod_timeline_shows_repack_chain(self):
+        from instaslice_tpu.cli.tpuslicectl import (
+            describe_pod,
+            render_describe,
+        )
+        from instaslice_tpu.sim import SimCluster
+
+        with SimCluster(
+            n_nodes=2, generation="v5e", nodes_per_group=2,
+            policy="frag-aware", repack=True, repack_interval=0.1,
+            repack_cooldown=0.4, deletion_grace_seconds=0.2,
+            health_interval=0,
+        ) as c:
+            fillers = [f"fill-{i}" for i in range(16)]
+            for n in fillers:
+                c.submit(n, profile="v5e-1x1")
+            for n in fillers:
+                assert c.wait_phase(n, "Running", timeout=30), n
+            carve_survivors(c, set(fillers))
+            c.submit("big-0", profile="v5e-2x2")
+            assert c.wait_phase("big-0", "Running", timeout=30)
+            moved = {
+                e.object_ref.rpartition("/")[2]
+                for e in get_journal().events(
+                    reason=REASON_REPACK_MIGRATING
+                )
+            }
+            assert moved
+            name = sorted(moved)[0]
+            text = render_describe(describe_pod(c.kube, name))
+            # the repack reason chain is visible and marked distinctly
+            assert "RepackMigrating" in text
+            assert "RepackDone" in text
+            assert "⟳" in text
+            # the migration epoch's creating transition is stamped
+            assert "(repack)" in text
+
+
+# ==================================================== runtime selection
+
+
+class TestPolicyRuntimeSelection:
+    @staticmethod
+    def _detach(runner):
+        from instaslice_tpu.obs import journal as obs_journal
+
+        obs_journal.detach_metrics(runner._event_metrics)
+
+    def test_env_var_selects_policy_on_runner(self, monkeypatch):
+        from instaslice_tpu.controller.runner import ControllerRunner
+        from instaslice_tpu.kube import FakeKube
+
+        monkeypatch.setenv("TPUSLICE_PLACEMENT_POLICY", "frag-aware")
+        runner = ControllerRunner(FakeKube())
+        self._detach(runner)
+        assert runner.controller.policy.name == "frag-aware"
+
+    def test_explicit_policy_beats_env(self, monkeypatch):
+        from instaslice_tpu.controller.runner import ControllerRunner
+        from instaslice_tpu.kube import FakeKube
+
+        monkeypatch.setenv("TPUSLICE_PLACEMENT_POLICY", "frag-aware")
+        runner = ControllerRunner(FakeKube(), policy="packed-fit")
+        self._detach(runner)
+        assert runner.controller.policy.name == "packed-fit"
+
+    def test_unknown_env_policy_raises_with_catalog(self, monkeypatch):
+        from instaslice_tpu.controller.runner import ControllerRunner
+        from instaslice_tpu.kube import FakeKube
+
+        monkeypatch.setenv("TPUSLICE_PLACEMENT_POLICY", "bogus")
+        with pytest.raises(KeyError) as ei:
+            ControllerRunner(FakeKube())
+        assert "frag-aware" in str(ei.value)
+
+    def test_controller_main_flags(self):
+        from instaslice_tpu.cli.controller_main import build_parser
+
+        args = build_parser().parse_args(
+            ["--repack", "--repack-interval", "2",
+             "--policy", "frag-aware"]
+        )
+        assert args.repack
+        assert args.repack_interval == 2.0
+        assert args.policy == "frag-aware"
+        # default: policy defers to env resolution in the runner
+        assert build_parser().parse_args([]).policy is None
