@@ -40,12 +40,13 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke floors
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
 	$(MAKE) bench-smoke
 	$(MAKE) bench-defrag-smoke
+	$(MAKE) bench-serving-smoke
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
@@ -58,6 +59,14 @@ bench-defrag-smoke:  ## <60 s churn run: fragment a group, assert the repacker r
 .PHONY: bench-defrag
 bench-defrag:  ## Full defrag tier: frag-aware + repacker vs first-fit-no-repack (capacity utilization, NoCapacity-wait p95) plus the mid-migration chaos arm (docs/SCALING.md)
 	JAX_PLATFORMS=cpu $(PY) bench.py --defrag
+
+.PHONY: bench-serving-smoke
+bench-serving-smoke:  ## <60 s mixed-SLO serving run over the continuous scheduler: asserts latency-class SLO attainment ≥ TPUSLICE_SERVING_SLO_FLOOR, paged kv utilization ≥ TPUSLICE_SERVING_KV_FLOOR (and > the legacy stripe metric), zero hung requests
+	JAX_PLATFORMS=cpu $(PY) bench.py --serving-smoke
+
+.PHONY: bench-serving
+bench-serving:  ## Full serving tier: continuous-batching scheduler vs the fixed-decode-round baseline on the mixed-SLO multi-tenant scenario (tok/s, per-class TTFT p95, SLO attainment, paged-vs-legacy kv utilization) — records BENCH_SERVING_r09.json (docs/SERVING.md)
+	JAX_PLATFORMS=cpu $(PY) bench.py --serving
 
 .PHONY: bench-scale
 bench-scale:  ## Fleet-scale control-plane bench: 1k nodes / 2k pending pods, grants/sec + gate→ungate p95/p99, with the serial re-list baseline ratio (docs/SCALING.md)

@@ -544,6 +544,210 @@ def smoke_defrag(floor: float = 0.5) -> int:
     return 1 if failures else 0
 
 
+#: the mixed-SLO tenant scenario both serving arms run: a weighted
+#: latency tenant with a real TTFT target, a standard tenant, and a
+#: best-effort tenant the scheduler may preempt/degrade. ONE spec
+#: string — the server policy and the loadgen traffic mix share it
+#: (serving/scheduler.py grammar).
+SERVING_TENANTS = (
+    "gold:3:latency:2.0,silver:2:standard,bronze:1:best-effort:30"
+)
+
+
+def bench_serving(
+    mode: str = "continuous",
+    requests: int = 48,
+    concurrency: int = 12,
+    prompt_len: int = 24,
+    max_tokens: int = 32,
+    jitter: float = 0.9,
+    seed: int = 9,
+    max_batch: int = 8,
+    block_size: int = 16,
+    d_model: int = 128,
+) -> dict:
+    """One serving-scheduler arm (docs/SERVING.md "Continuous batching
+    & tenant SLOs"): a CPU-sized engine behind the real ApiServer, a
+    mixed-SLO multi-tenant loadgen run at mixed sequence lengths, and
+    a sampler thread reading /v1/stats so the paged-vs-legacy
+    kv-utilization split is measured UNDER load, not at the idle end.
+
+    ``mode="fixed"`` is the classic static-batching baseline the
+    continuous scheduler is judged against (ROADMAP item 3's "fixed
+    decode rounds"): FIFO admission with head-of-line blocking and
+    full ``block_size`` decode rounds regardless of per-request
+    budgets — requests that finish mid-round hold their slot (and
+    their blocks) to the round's end. The loop this PR replaced
+    already trimmed rounds to budgets, so the ratio below isolates
+    the cost of fixed rounds themselves, not a literal before/after
+    of one commit."""
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.metrics.metrics import ServingMetrics
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.obs.journal import Journal, get_journal, \
+        reset_journal
+    from instaslice_tpu.serving import ServingEngine
+    from instaslice_tpu.serving.api_server import ApiServer
+    from instaslice_tpu.serving.loadgen import run as loadgen_run
+
+    reset_journal(Journal(capacity=65536))
+    # heavy enough that a decode STEP costs real compute relative to a
+    # dispatch — the regime real serving lives in (decode is HBM/FLOP
+    # bound at batch); a micro-model would make wasted slot-steps look
+    # free and reward exactly the wrong scheduler
+    cfg = ModelConfig(
+        vocab_size=128, d_model=d_model, n_heads=4, n_layers=4,
+        d_ff=4 * d_model, dtype=jnp.float32, remat=False,
+    )
+    model = TpuLM(cfg)
+    params = model.init(jax.random.key(0))
+    eng = ServingEngine(model, params, max_batch=max_batch,
+                        max_len=128, prefill_len=8, kv_block_size=16)
+    metrics = ServingMetrics()
+    samples: list = []
+    stop = threading.Event()
+    try:
+        with ApiServer(eng, block_size=block_size, metrics=metrics,
+                       tenants=SERVING_TENANTS, mode=mode,
+                       preempt_margin=0.3,
+                       request_timeout=180) as srv:
+
+            def probe(path="/v1/stats"):
+                import urllib.request
+
+                with urllib.request.urlopen(srv.url + path,
+                                            timeout=5) as r:
+                    return json.loads(r.read())
+
+            def sampler():
+                while not stop.is_set():
+                    try:
+                        s = probe()
+                        if s["live_slots"]:
+                            samples.append((
+                                s["kv"]["utilization"],
+                                s["kv"]["utilization_legacy"],
+                                s["live_slots"],
+                            ))
+                    except Exception as e:  # pragma: no cover
+                        print(f"[serving] sampler: {e}",
+                              file=sys.stderr)
+                    stop.wait(0.05)
+
+            # warm the compiled prefill/decode programs out of the
+            # measured window with an UNMEASURED burst of the same
+            # traffic shape: both arms must be judged on scheduling,
+            # not on who paid the jit compiles (CPU compiles dominate a
+            # seconds-long run; the arms share a process, so without
+            # this the second arm would free-ride the first's cache)
+            loadgen_run(
+                srv.url, requests=12, concurrency=4,
+                prompt_len=prompt_len, max_tokens=max_tokens, vocab=128,
+                stream=True, timeout=180, seed=seed + 1,
+                tenants=SERVING_TENANTS, jitter=jitter,
+            )
+            warm_stats = srv.scheduler.stats()
+            t = threading.Thread(target=sampler, daemon=True)
+            t.start()
+            t0 = time.monotonic()
+            report = loadgen_run(
+                srv.url, requests=requests, concurrency=concurrency,
+                prompt_len=prompt_len, max_tokens=max_tokens, vocab=128,
+                stream=True, timeout=180, seed=seed,
+                tenants=SERVING_TENANTS, jitter=jitter,
+            )
+            wall = time.monotonic() - t0
+            stop.set()
+            t.join(timeout=2)
+            # counters are cumulative from server start: subtract the
+            # warm-up burst so the arm reports ITS window only
+            end = srv.scheduler.stats()
+            stats = dict(end)
+            for key in ("preempted", "resumed", "parked_shed",
+                        "slo_misses"):
+                stats[key] = end[key] - warm_stats[key]
+    finally:
+        stop.set()
+        reset_journal()
+    kv_util = [s[0] for s in samples]
+    kv_legacy = [s[1] for s in samples]
+    gold = report["tenants"]["gold"]
+    bronze = report["tenants"]["bronze"]
+    return {
+        "mode": mode,
+        "seed": seed,
+        "requests": requests,
+        "concurrency": concurrency,
+        "max_batch": max_batch,
+        "jitter": jitter,
+        "ok": report["ok"],
+        "hung": report["outcomes"]["hung"],
+        "errors": report["errors"],
+        "wall_s": round(wall, 2),
+        "client_tokens_per_sec": report["client_tokens_per_sec"],
+        "ttft_p50_s": report["ttft_p50"],
+        "ttft_p95_s": report["ttft_p95"],
+        "gold_ttft_p95_s": gold["ttft_p95"],
+        "gold_slo_attainment": gold.get("slo_attainment", 0.0),
+        "gold_ttft_slo_s": gold.get("ttft_slo", 0.0),
+        "bronze_ttft_p95_s": bronze["ttft_p95"],
+        "tenants": report["tenants"],
+        "kv_util_mean": round(
+            statistics.mean(kv_util), 4
+        ) if kv_util else 0.0,
+        "kv_util_legacy_mean": round(
+            statistics.mean(kv_legacy), 4
+        ) if kv_legacy else 0.0,
+        "kv_samples": len(samples),
+        "preempted": stats["preempted"],
+        "resumed": stats["resumed"],
+        "parked_shed": stats["parked_shed"],
+        "slo_misses": stats["slo_misses"],
+    }
+
+
+def smoke_serving(slo_floor: float = 0.75, kv_floor: float = 0.5) -> int:
+    """``make bench-serving-smoke``: a <60 s mixed-SLO loadgen run over
+    the continuous scheduler gating the fast tier — asserts every
+    request terminates, latency-class SLO attainment holds a floor,
+    and paged kv utilization beats both its floor and the legacy
+    stripe metric."""
+    out = bench_serving(
+        mode="continuous",
+        requests=int(os.environ.get("TPUSLICE_SERVING_SMOKE_REQS",
+                                    "24")),
+        concurrency=5,
+        seed=int(os.environ.get("TPUSLICE_SERVING_SEED", "9")),
+    )
+    print(json.dumps(out))
+    failures = []
+    if out["hung"]:
+        failures.append(f"{out['hung']} request(s) HUNG")
+    if out["errors"]:
+        failures.append(f"{out['errors']} loadgen error(s)")
+    if out["gold_slo_attainment"] < slo_floor:
+        failures.append(
+            f"latency-class SLO attainment {out['gold_slo_attainment']}"
+            f" below floor {slo_floor}"
+        )
+    if out["kv_util_mean"] < kv_floor:
+        failures.append(
+            f"kv utilization {out['kv_util_mean']} below floor "
+            f"{kv_floor}"
+        )
+    if out["kv_util_mean"] <= out["kv_util_legacy_mean"]:
+        failures.append(
+            "paged kv utilization did not beat the legacy stripe "
+            f"metric ({out['kv_util_mean']} vs "
+            f"{out['kv_util_legacy_mean']})"
+        )
+    for f in failures:
+        print(f"bench-serving-smoke FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def _run_tpu_phase(phase: str, timeout: float, env: dict,
                    pass_fds=()) -> dict:
     """One phase in its own subprocess; returns its JSON fragment or a
@@ -1042,6 +1246,31 @@ def main(argv=None) -> int:
                     help="bench-defrag-smoke utilization floor")
     ap.add_argument("--defrag-seed", type=int, default=7,
                     help="defrag tier: churn workload seed")
+    ap.add_argument("--serving", action="store_true",
+                    help="serving-scheduler tier: mixed-SLO multi-"
+                    "tenant loadgen at mixed sequence lengths, "
+                    "continuous-batching scheduler vs the fixed-"
+                    "decode-round baseline (tok/s, per-class TTFT, "
+                    "SLO attainment, paged-vs-legacy kv utilization)")
+    ap.add_argument("--serving-smoke", action="store_true",
+                    help="CI gate: <60 s mixed-SLO serving run "
+                    "asserting latency-class SLO attainment and a kv-"
+                    "utilization floor (TPUSLICE_SERVING_SLO_FLOOR / "
+                    "TPUSLICE_SERVING_KV_FLOOR)")
+    ap.add_argument("--serving-slo-floor", type=float,
+                    default=float(os.environ.get(
+                        "TPUSLICE_SERVING_SLO_FLOOR", "0.75")),
+                    help="serving-smoke: latency-class SLO attainment "
+                    "floor")
+    ap.add_argument("--serving-kv-floor", type=float,
+                    default=float(os.environ.get(
+                        "TPUSLICE_SERVING_KV_FLOOR", "0.5")),
+                    help="serving-smoke: mean paged kv-utilization "
+                    "floor under load")
+    ap.add_argument("--serving-seed", type=int,
+                    default=int(os.environ.get(
+                        "TPUSLICE_SERVING_SEED", "9")),
+                    help="serving tier: loadgen scenario seed")
     ap.add_argument("--interval", type=float, default=900.0,
                     help="watchdog: seconds between probes (default 900)")
     ap.add_argument("--max-hours", type=float, default=11.0,
@@ -1077,6 +1306,64 @@ def main(argv=None) -> int:
         return smoke(floor=args.smoke_floor)
     if args.defrag_smoke:
         return smoke_defrag(floor=args.defrag_floor)
+    if args.serving_smoke:
+        return smoke_serving(slo_floor=args.serving_slo_floor,
+                             kv_floor=args.serving_kv_floor)
+    if args.serving:
+        result = {
+            "metric": "serving_tokens_per_sec",
+            "unit": "tokens/s",
+        }
+        # best-of-N per arm, interleaved: the arms run identical
+        # workloads, so on a noisy shared-core machine (CI is nproc=1)
+        # the best observation per arm is the one least polluted by OS
+        # scheduling — a single-sample comparison flips on noise alone
+        reps = max(1, int(os.environ.get(
+            "TPUSLICE_SERVING_REPEATS", "2")))
+        conts, fixeds = [], []
+        for _ in range(reps):
+            conts.append(
+                bench_serving(mode="continuous", seed=args.serving_seed)
+            )
+            fixeds.append(
+                bench_serving(mode="fixed", seed=args.serving_seed)
+            )
+        cont = max(conts, key=lambda r: r["client_tokens_per_sec"])
+        fixed = max(fixeds, key=lambda r: r["client_tokens_per_sec"])
+        result["serving_continuous"] = cont
+        result["serving_fixed_baseline"] = fixed
+        result["repeats"] = reps
+        result["tokens_per_sec_runs"] = {
+            "continuous": [r["client_tokens_per_sec"] for r in conts],
+            "fixed": [r["client_tokens_per_sec"] for r in fixeds],
+        }
+        result["value"] = cont["client_tokens_per_sec"]
+        if fixed["client_tokens_per_sec"]:
+            result["vs_baseline"] = round(
+                cont["client_tokens_per_sec"]
+                / fixed["client_tokens_per_sec"], 2
+            )
+        result["gold_ttft_p95_s"] = cont["gold_ttft_p95_s"]
+        result["gold_ttft_p95_baseline_s"] = fixed["gold_ttft_p95_s"]
+        result["kv_util_mean"] = cont["kv_util_mean"]
+        result["kv_util_legacy_mean"] = cont["kv_util_legacy_mean"]
+        print(json.dumps(result))
+        ok = (
+            cont["hung"] == 0 and fixed["hung"] == 0
+            and cont["errors"] == 0
+            # continuous beats the fixed-round baseline on sustained
+            # useful tok/s at equal capacity...
+            and cont["client_tokens_per_sec"]
+            > fixed["client_tokens_per_sec"]
+            # ...keeps the latency class inside its TTFT SLO while
+            # best-effort degrades gracefully (still terminates)...
+            and cont["gold_ttft_p95_s"] <= cont["gold_ttft_slo_s"]
+            # ...and the paged metric reports strictly higher (true)
+            # utilization than the legacy stripe metric at mixed
+            # sequence lengths
+            and cont["kv_util_mean"] > cont["kv_util_legacy_mean"]
+        )
+        return 0 if ok else 1
     if args.defrag:
         result = {
             "metric": "defrag_capacity_utilization",

@@ -131,6 +131,14 @@ REASON_DRAIN_END = "DrainEnd"
 REASON_SHED = "RequestShed"
 REASON_DRAINED = "RequestDrained"
 
+# serving scheduler (serving/scheduler.py): SLO-aware preemption. A
+# best-effort request parked so a latency-class request makes its TTFT
+# target; Resumed when a slot frees, SLOMissed when a completed
+# request's TTFT/TPOT exceeded its tenant class target.
+REASON_PREEMPTED = "RequestPreempted"
+REASON_RESUMED = "RequestResumed"
+REASON_SLO_MISSED = "SLOMissed"
+
 #: AllocationStatus value → the journal reason its transition records.
 TRANSITION_REASONS = {
     "creating": REASON_SLICE_CREATING,
@@ -154,6 +162,7 @@ EVENT_REASONS = frozenset({
     REASON_CHIP_UNHEALTHY, REASON_CHIP_HEALED,
     REASON_BREAKER_OPEN, REASON_BACKOFF, REASON_WATCH_RECONNECT,
     REASON_DRAIN_BEGIN, REASON_DRAIN_END, REASON_SHED, REASON_DRAINED,
+    REASON_PREEMPTED, REASON_RESUMED, REASON_SLO_MISSED,
 })
 
 # ------------------------------------------------------- labels / leases

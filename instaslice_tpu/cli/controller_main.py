@@ -44,6 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--repack-cooldown", type=float, default=300.0,
                    help="per-pod seconds between migrations (thrash "
                    "brake)")
+    p.add_argument("--repack-frag-threshold", type=float, default=None,
+                   help="proactive repacking: also plan when a group's "
+                   "stranded-capacity fraction (topology/frag.py) "
+                   "exceeds this, not only on a starved pod (default: "
+                   "TPUSLICE_REPACK_FRAG_THRESHOLD env var, else off)")
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
     p.add_argument("--leader-elect", action="store_true")

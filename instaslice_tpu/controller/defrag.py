@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -119,6 +120,7 @@ class Repacker:
         cooldown: float = 60.0,
         max_moves: int = 4,
         stuck_warn_seconds: float = 60.0,
+        frag_threshold: Optional[float] = None,
     ) -> None:
         self.controller = controller
         self.interval = interval
@@ -126,6 +128,20 @@ class Repacker:
         self.cooldown = cooldown
         self.max_moves = max(1, int(max_moves))
         self.stuck_warn_seconds = stuck_warn_seconds
+        # proactive repacking (ROADMAP item 1 headroom): when a group's
+        # stranded-capacity fraction (topology/frag.py) exceeds this,
+        # plan a consolidation for the largest currently-unplaceable
+        # profile WITHOUT waiting for a pod to starve. 0/unset = off —
+        # the default stays reactive so idle clusters don't churn.
+        if frag_threshold is None:
+            env = os.environ.get("TPUSLICE_REPACK_FRAG_THRESHOLD", "")
+            frag_threshold = float(env) if env else 0.0
+        if not 0.0 <= frag_threshold <= 1.0:
+            raise ValueError(
+                f"frag_threshold must be in [0, 1], got {frag_threshold}"
+            )
+        self.frag_threshold = frag_threshold
+        self.proactive_plans = 0
         self._active: Dict[str, Migration] = {}
         self._cooldown_until: Dict[str, float] = {}  # pod uid → monotonic
         self._stop = threading.Event()
@@ -190,6 +206,8 @@ class Repacker:
             return
         pending = c.pending_requests()
         if not pending:
+            if self.frag_threshold > 0:
+                self._proactive_pass()
             return
         # pods per pending profile vs migrations already serving it: a
         # plan clears room for ONE pod, so never queue more migrations
@@ -216,16 +234,80 @@ class Repacker:
 
     # ------------------------------------------------------------- planning
 
-    def _plan_and_start(self, pod_key: str, profile) -> bool:
+    def _proactive_pass(self) -> None:
+        """Repack below a fragmentation threshold, not only on a
+        starved pod: for each group whose stranded-capacity fraction
+        exceeds ``frag_threshold``, plan a consolidation for the
+        largest catalog profile that currently has no free placement
+        but would after the moves — the next big request then grants
+        instantly instead of waiting out a reactive repack."""
+        from instaslice_tpu.topology.frag import frag_metrics
+        from instaslice_tpu.topology.profiles import profile_catalog
+
+        c = self.controller
+        inf = c._slices_inf
+        for gid in sorted(inf.index_keys(INDEX_SLICE_GROUP)):
+            if len(self._active) >= self.max_concurrent:
+                return
+            members = [
+                m for m in inf.by_index(
+                    INDEX_SLICE_GROUP, gid, transformed=True
+                )
+                if m.status.processed and m.spec.generation
+            ]
+            if not members:
+                continue
+            group = c._build_group(gid, members)
+            if group is None:
+                continue
+            with c._placement_lock:
+                try:
+                    occ = c._occupancy(group, members)
+                except ValueError as e:
+                    log.warning("group %s occupancy corrupt: %s", gid, e)
+                    continue
+            # the enumeration (every aligned box x the whole catalog)
+            # runs OUTSIDE the placement lock — it is advisory, every
+            # grant serializes behind that lock, and _plan_group
+            # recomputes occupancy under its own hold anyway
+            m = frag_metrics(group, occ)
+            if m.stranded_fraction <= self.frag_threshold:
+                continue
+            # largest-first: clearing the biggest unplaceable box
+            # recovers the most stranded capacity per migration set
+            catalog = profile_catalog(
+                group.generation.name, group.chip_count
+            )
+            for profile in sorted(
+                catalog, key=lambda p: -p.chip_count
+            ):
+                if m.fit_counts.get(profile.name, 0):
+                    continue
+                if profile.chip_count > m.free_chips:
+                    continue
+                if self._plan_and_start(None, profile, only_gid=gid,
+                                        stranded=m.stranded_fraction):
+                    self.proactive_plans += 1
+                    break
+
+    def _plan_and_start(self, pod_key: Optional[str], profile,
+                        only_gid: Optional[str] = None,
+                        stranded: float = 0.0) -> bool:
         """Find one group where ``profile`` is blocked only by movable
         slices, and start the plan's migrations (up to the concurrency
         cap). Destinations are reserved in the in-flight overlay UNDER
         THE SAME LOCK HOLD as the plan, so no concurrent grant can
         invalidate a destination between choice and reservation.
-        Returns True when at least one migration started."""
+        Returns True when at least one migration started.
+
+        ``pod_key`` None = a proactive (threshold-triggered) plan: no
+        starved pod exists, so the RepackPlanned event lands on the
+        group (``only_gid`` restricts the search to it)."""
         c = self.controller
         inf = c._slices_inf
-        for gid in sorted(inf.index_keys(INDEX_SLICE_GROUP)):
+        gids = ([only_gid] if only_gid is not None
+                else sorted(inf.index_keys(INDEX_SLICE_GROUP)))
+        for gid in gids:
             members = [
                 m for m in inf.by_index(
                     INDEX_SLICE_GROUP, gid, transformed=True
@@ -273,18 +355,33 @@ class Repacker:
             if plan is None or not launches:
                 continue
             self.plans += 1
-            ns, _, pod_name = pod_key.partition("/")
-            with c._pending_lock:
-                pending_tid = c._pending_trace.get(pod_key, "")
-            emit_pod_event(
-                c.client, ns, pod_name,
-                reason=REASON_REPACK_PLANNED,
-                message=(
-                    f"repacking {len(launches)} slice(s) in {gid} to "
-                    f"clear {plan[0].key()} for {profile.name}"
-                ),
-                component=COMPONENT, trace_id=pending_tid,
-            )
+            if pod_key is not None:
+                ns, _, pod_name = pod_key.partition("/")
+                with c._pending_lock:
+                    pending_tid = c._pending_trace.get(pod_key, "")
+                emit_pod_event(
+                    c.client, ns, pod_name,
+                    reason=REASON_REPACK_PLANNED,
+                    message=(
+                        f"repacking {len(launches)} slice(s) in {gid} "
+                        f"to clear {plan[0].key()} for {profile.name}"
+                    ),
+                    component=COMPONENT, trace_id=pending_tid,
+                )
+            else:
+                # proactive: no starved pod to pin the event on — the
+                # journal records the group-level decision instead
+                get_journal().emit(
+                    COMPONENT, reason=REASON_REPACK_PLANNED,
+                    object_ref=f"group/{gid}",
+                    message=(
+                        f"proactive repack (stranded fraction "
+                        f"{stranded:.2f} > threshold "
+                        f"{self.frag_threshold:.2f}): repacking "
+                        f"{len(launches)} slice(s) to clear "
+                        f"{plan[0].key()} for {profile.name}"
+                    ),
+                )
             for mig, alloc in launches:
                 self._launch(mig, alloc)
             return True

@@ -57,6 +57,7 @@ class ControllerRunner:
         repack_interval: float = 5.0,
         repack_max_concurrent: int = 2,
         repack_cooldown: float = 300.0,
+        repack_frag_threshold: Optional[float] = None,
     ) -> None:
         """``shard_leases``: instead of ONE controller lease, each
         reconcile shard worker holds Lease ``<LEASE_NAME>-shard-<i>`` —
@@ -121,6 +122,7 @@ class ControllerRunner:
                 interval=repack_interval,
                 max_concurrent=repack_max_concurrent,
                 cooldown=repack_cooldown,
+                frag_threshold=repack_frag_threshold,
             )
         self._stop = threading.Event()
         self._ready = False
@@ -158,6 +160,9 @@ class ControllerRunner:
                 args, "repack_max_concurrent", 2
             ),
             repack_cooldown=getattr(args, "repack_cooldown", 300.0),
+            repack_frag_threshold=getattr(
+                args, "repack_frag_threshold", None
+            ),
         )
 
     # ------------------------------------------------------------------

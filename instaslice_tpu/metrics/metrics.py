@@ -211,6 +211,15 @@ class ServingMetrics:
             self.phase_seconds = _NoopMetric()
             self.batch_occupancy = _NoopMetric()
             self.kv_cache_utilization = _NoopMetric()
+            self.kv_cache_utilization_legacy = _NoopMetric()
+            self.kv_blocks_free = _NoopMetric()
+            self.kv_blocks_used = _NoopMetric()
+            self.kv_blocks_cow = _NoopMetric()
+            self.class_ttft_seconds = _NoopMetric()
+            self.class_tpot_seconds = _NoopMetric()
+            self.preemptions = _NoopMetric()
+            self.resumes = _NoopMetric()
+            self.slo_missed = _NoopMetric()
             self.registry = None
             return
         self.registry = registry or CollectorRegistry()
@@ -289,9 +298,70 @@ class ServingMetrics:
             "Live slots / max_batch (decode batch utilization)",
             registry=self.registry,
         )
+        # paged KV-cache (serving/kvcache.py): true block occupancy —
+        # resident tokens over the capacity of the blocks they hold
         self.kv_cache_utilization = Gauge(
             "tpuslice_serve_kv_cache_utilization",
-            "Occupied KV-cache positions / total cache positions",
+            "Resident tokens / capacity of allocated KV blocks",
+            registry=self.registry,
+        )
+        # the pre-paging stripe metric, kept ONE release under _legacy
+        # so dashboards keyed on the old semantics don't silently shift
+        self.kv_cache_utilization_legacy = Gauge(
+            "tpuslice_serve_kv_cache_utilization_legacy",
+            "DEPRECATED pre-paging metric: live tokens / (max_batch x "
+            "max_len); replaced by tpuslice_serve_kv_cache_utilization",
+            registry=self.registry,
+        )
+        self.kv_blocks_free = Gauge(
+            "tpuslice_kv_blocks_free",
+            "KV block pool: blocks free for admission",
+            registry=self.registry,
+        )
+        self.kv_blocks_used = Gauge(
+            "tpuslice_kv_blocks_used",
+            "KV block pool: blocks held by live + parked requests",
+            registry=self.registry,
+        )
+        self.kv_blocks_cow = Gauge(
+            "tpuslice_kv_blocks_cow",
+            "KV block pool: blocks copy-on-write shared by >1 holder",
+            registry=self.registry,
+        )
+        # --- multi-tenant SLO scheduler (serving/scheduler.py) ---
+        # per-tenant-class latency: the histograms SLO attainment and
+        # the (future) autoscaler read; class ∈ latency/standard/
+        # best-effort (plus whatever a custom tenant spec names)
+        self.class_ttft_seconds = Histogram(
+            "tpuslice_serve_class_ttft_seconds",
+            "Time to first token by tenant class",
+            ["tenant_class"],
+            buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5,
+                     5, 10, 30, 60),
+            registry=self.registry,
+        )
+        self.class_tpot_seconds = Histogram(
+            "tpuslice_serve_class_tpot_seconds",
+            "Per-request mean time per output token by tenant class",
+            ["tenant_class"],
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1, 2.5),
+            registry=self.registry,
+        )
+        self.preemptions = Counter(
+            "tpuslice_serve_preemptions_total",
+            "Requests parked so a latency-class request made its TTFT",
+            registry=self.registry,
+        )
+        self.resumes = Counter(
+            "tpuslice_serve_resumes_total",
+            "Parked requests resumed into a freed slot",
+            registry=self.registry,
+        )
+        self.slo_missed = Counter(
+            "tpuslice_serve_slo_missed_total",
+            "Completed requests that exceeded their class SLO target",
+            ["tenant_class", "slo"],
             registry=self.registry,
         )
 

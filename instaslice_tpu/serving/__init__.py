@@ -8,5 +8,6 @@ mesh.
 """
 
 from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+from instaslice_tpu.serving.kvcache import KVBlockPool
 
-__all__ = ["ServingEngine", "GenerationResult"]
+__all__ = ["ServingEngine", "GenerationResult", "KVBlockPool"]

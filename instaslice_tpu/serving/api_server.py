@@ -30,12 +30,14 @@ batched completions over HTTP.
   ``DELETE /v1/prefixes`` with the same body frees the stripe.
 
 One scheduler thread owns the engine (the engine is not thread-safe by
-design — XLA dispatch is serialized anyway): it admits queued requests
-as slots free up, decodes in on-device blocks sized to the smallest
-remaining budget (one dispatch, one readback per block — the tunnel/
-dispatch-latency lesson from the bench), enforces per-request budgets,
-evicts requests whose client already got a 503 (their slots go back to
-the batch instead of decoding tokens nobody reads), and resolves
+design — XLA dispatch is serialized anyway). The decision loop lives
+in :mod:`instaslice_tpu.serving.scheduler`: continuous batching
+(admit/evict at every decode-block boundary, blocks trimmed to the
+smallest remaining budget), tenant priority classes + weighted fair
+share (``X-Tenant`` header / ``"tenant"`` field, policy via
+``--tenants`` / ``TPUSLICE_TENANTS``), SLO-aware preemption of
+best-effort requests (parked KV, cheap resume), per-request budgets,
+eviction of requests whose client already got a 503, and delivery to
 waiting HTTP threads. Run via ``tpuslice-serve`` or
 ``python -m instaslice_tpu.serving.api_server``.
 """
@@ -54,19 +56,17 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
-from instaslice_tpu.api.constants import (
-    REASON_DRAIN_BEGIN,
-    REASON_DRAIN_END,
-    REASON_DRAINED,
-    REASON_SHED,
+from instaslice_tpu.obs.journal import debug_events_payload
+from instaslice_tpu.serving.engine import ServingEngine
+from instaslice_tpu.serving.scheduler import (
+    Draining,
+    Pending,
+    QueueFull,
+    Scheduler,
 )
-from instaslice_tpu.obs.journal import debug_events_payload, get_journal
-from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
-from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.trace import (
     TRACE_ID_SAFE,
     get_tracer,
-    new_span_id,
     new_trace_id,
 )
 
@@ -93,674 +93,12 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, str(default)))
 
 
-class QueueFull(Exception):
-    """Admission queue at capacity: the request was shed (HTTP 429 with
-    Retry-After) instead of joining a line it would only time out in."""
-
-    def __init__(self, retry_after: float = 1.0):
-        super().__init__("admission queue full")
-        self.retry_after = retry_after
-
-
-class Draining(Exception):
-    """The server is draining (SIGTERM / POST /v1/drain): no new
-    admissions; clients get a clean 503 and should hit another replica."""
-
-
-class _Pending:
-    def __init__(self, prompt: List[int], max_tokens: int,
-                 prefix_op: str = "", stream: bool = False,
-                 stop: Optional[List[List[int]]] = None,
-                 want_logprobs: bool = False, n: int = 1,
-                 adapter: int = 0, trace_id: str = ""):
-        self.prompt = prompt
-        self.max_tokens = max_tokens
-        #: the request's trace id (minted/accepted at HTTP admission);
-        #: every span of this request's lifecycle carries it, and the
-        #: root ``serve.request`` span uses ``span_id`` so children
-        #: recorded earlier parent correctly
-        self.trace_id = trace_id
-        self.span_id = new_span_id() if trace_id else ""
-        #: set when the engine samples this request's first token
-        #: (admission prefill) — TTFT = first_token_at - t0
-        self.first_token_at: Optional[float] = None
-        self.stop = stop or []         # normalized token-id sequences
-        self.want_logprobs = want_logprobs
-        self.n = n                     # parallel samples (OpenAI "n")
-        self.adapter = adapter         # LoRA adapter id (0 = base)
-        # "register"/"drop" → not a completion: mutate the engine's
-        # prefix cache on the scheduler thread (the engine owner)
-        self.prefix_op = prefix_op
-        self.done = threading.Event()
-        self.rid_index: Dict[int, int] = {}    # engine rid → choice idx
-        self.results: Dict[int, GenerationResult] = {}  # choice idx → r
-        self.error: str = ""
-        # load-shedding/drain disposition ("" = normal): "drain" — was
-        # queued when the drain started; "evicted" — in flight past the
-        # drain budget. Either way the client gets a clean 503 and the
-        # metrics outcome is "drained", never "error"/"ok".
-        self.shed: str = ""
-        self.timed_out = False        # set by the HTTP layer on 503,
-        #                               or on a broken streaming socket
-        # serializes the timeout decision against completion: the HTTP
-        # thread may only flag timed_out while done is still unset (via
-        # flag_timeout), and the scheduler decides the metrics outcome +
-        # sets done under the same lock — so a request can never be
-        # 503'd AND counted ok
-        self.lock = named_lock("serve.pending")
-        self.server_fault = False     # engine-side failure (HTTP 500),
-        #                               vs a client mistake (HTTP 400)
-        self.t0 = time.monotonic()
-        self.t0_wall = time.time()    # span start timestamps
-        # streaming: the scheduler pushes dict events after every decode
-        # block ({"kind": "delta"/"final", "index": choice, ...}); a str
-        # is a pre-admission error. ``sent`` tracks per-rid delivery.
-        self.stream_q: Optional["queue.Queue"] = (
-            queue.Queue() if stream else None
-        )
-        self.sent: Dict[int, int] = {}
-
-    def flag_timeout(self) -> None:
-        """Mark this request timed out / abandoned — unless it already
-        completed, in which case the scheduler's ok-count stands and
-        the flag stays clear. Every timeout writer (sync wait expiry,
-        broken streaming socket) must come through here."""
-        with self.lock:
-            if not self.done.is_set():
-                self.timed_out = True
-
-    @property
-    def result(self) -> Optional[GenerationResult]:
-        """First choice (the n == 1 common case)."""
-        return self.results.get(0)
-
-
-class _Scheduler(threading.Thread):
-    """Owns the engine: admission, block decode, budgets, delivery.
-
-    Also the serving plane's profiler: it owns every timestamp a
-    request's latency decomposes into (queue wait, prefill, decode
-    rounds, delivery), so TTFT/TPOT histograms, the per-round step-time
-    and occupancy gauges, and the per-request trace spans are all
-    emitted from here."""
-
-    #: Retry-After hint on a 429 shed: one block decode is the natural
-    #: re-try grain — by then the queue has moved
-    shed_retry_after = 1.0
-
-    def __init__(self, engine: ServingEngine, block_size: int = 16,
-                 metrics=None, max_queue: int = 0,
-                 drain_budget: float = 30.0, fault_hook=None):
-        super().__init__(name="serve-scheduler", daemon=True)
-        self.engine = engine
-        self.block_size = block_size
-        self.queue: "queue.Queue[_Pending]" = queue.Queue()
-        self.stop_flag = threading.Event()
-        self._by_rid: Dict[int, _Pending] = {}
-        self._budget: Dict[int, int] = {}
-        # popped but unadmittable head-of-line request (needs more free
-        # slots than currently available); retried next round, FIFO kept
-        self._head: Optional[_Pending] = None
-        #: admission bound (0 = unbounded): past it, submit() sheds with
-        #: 429 instead of queueing a request that would 503 at timeout.
-        #: The lock makes bound-check + enqueue atomic across the HTTP
-        #: threads (one per request): without it, C concurrent
-        #: submitters could all pass the check and overshoot by C-1.
-        self.max_queue = max_queue
-        self._submit_lock = named_lock("serve.submit")
-        self.drain_budget = drain_budget
-        #: flipped by drain()/undrain(); while set, /readyz is 503, no
-        #: admissions, queued requests shed, in-flight finish until the
-        #: deadline then evict
-        self.draining = threading.Event()
-        self.drain_deadline = 0.0
-        #: set once a drain has fully quiesced (no queue, no in-flight)
-        self.drained = threading.Event()
-        #: faults.scheduler_fault_hook seam: consulted once per loop
-        #: round inside the round guard — an injected raise must never
-        #: kill the serving thread
-        self.fault_hook = fault_hook
-        if metrics is None:
-            from instaslice_tpu.metrics.metrics import ServingMetrics
-
-            metrics = ServingMetrics()
-        self.metrics = metrics
-
-    def submit(self, pending: _Pending) -> None:
-        """Admit into the scheduler queue, or shed: :class:`Draining`
-        while a drain is on (503), :class:`QueueFull` past the
-        admission bound (429 + Retry-After). Shed requests are counted
-        here — exactly one metrics outcome per request, always."""
-        # prefix-cache mutations are not completions: they never enter
-        # the outcome ledger (here or in _maybe_complete), so the
-        # requests_total counters reconcile against completion traffic
-        is_completion = not pending.prefix_op
-        if self.draining.is_set():
-            if is_completion:
-                self.metrics.requests.labels(outcome="drained").inc()
-                # one journal event per drained completion: the journal's
-                # RequestDrained count reconciles EXACTLY with the
-                # metrics outcome ledger (tests/test_serving_chaos.py)
-                get_journal().emit(
-                    "serving", reason=REASON_DRAINED,
-                    message="rejected at admission: server draining (503)",
-                    trace_id=pending.trace_id,
-                )
-            raise Draining("server draining")
-        shed = False
-        with self._submit_lock:
-            if self.max_queue > 0 and (
-                self.queue.qsize() + (self._head is not None)
-                >= self.max_queue
-            ):
-                shed = True
-            else:
-                self.queue.put(pending)
-        if shed:
-            # count + journal AFTER releasing the admission lock: the
-            # journal's JSONL write is disk I/O, and overload (when
-            # shedding fires) is exactly when submitters must not
-            # serialize behind it
-            if is_completion:
-                self.metrics.requests.labels(outcome="shed").inc()
-                get_journal().emit(
-                    "serving", reason=REASON_SHED,
-                    message=(f"admission queue full "
-                             f"(max_queue={self.max_queue}): "
-                             "shed with 429"),
-                    trace_id=pending.trace_id,
-                )
-            raise QueueFull(self.shed_retry_after)
-
-    # ------------------------------------------------------------ drain
-
-    def drain(self, budget: Optional[float] = None) -> None:
-        """Stop admission, flip readiness, let in-flight requests
-        finish for ``budget`` seconds (default ``drain_budget``), then
-        evict the rest with a clean 503. Idempotent; ``drained`` is set
-        once fully quiesced."""
-        budget_s = self.drain_budget if budget is None else budget
-        with self._submit_lock:
-            # check-and-set AND emit under the lock: SIGTERM and
-            # POST /v1/drain arriving together must journal ONE
-            # DrainBegin, and a racing undrain() must not invert the
-            # Begin/End order (these two events are rare — unlike the
-            # hot shed path, lock-held I/O is fine here)
-            self.drain_deadline = time.monotonic() + budget_s
-            self.drained.clear()
-            already = self.draining.is_set()
-            self.draining.set()
-            if not already:
-                get_journal().emit(
-                    "serving", reason=REASON_DRAIN_BEGIN,
-                    message=(f"drain started: admission stopped, "
-                             f"in-flight requests get {budget_s:.1f}s"),
-                )
-        self.metrics.draining.set(1)
-
-    def undrain(self) -> None:
-        """Resume admission after a drain (rolling-restart aborted,
-        readiness restored)."""
-        with self._submit_lock:
-            was_draining = self.draining.is_set()
-            self.draining.clear()
-            self.drained.clear()
-            if was_draining:
-                get_journal().emit(
-                    "serving", reason=REASON_DRAIN_END,
-                    message="drain cancelled: admission resumed",
-                )
-        self.metrics.draining.set(0)
-
-    def _fail_shed(self, p: _Pending, shed: str, msg: str) -> None:
-        p.shed = shed
-        p.error = p.error or msg
-        if p.stream_q is not None:
-            p.stream_q.put(p.error)
-        self._maybe_complete(p)
-
-    def _shed_queued(self) -> None:
-        """Draining: everything still queued gets its terminal 503 NOW
-        — a queued request can only get worse by waiting out the drain."""
-        while True:
-            if self._head is not None:
-                p, self._head = self._head, None
-            else:
-                try:
-                    p = self.queue.get_nowait()
-                except queue.Empty:
-                    return
-            self._fail_shed(p, "drain",
-                            "server draining: request not admitted")
-
-    def _evict_for_drain(self) -> None:
-        """Drain budget exhausted: in-flight requests are evicted with
-        a clean 503 (their tokens were never delivered)."""
-        eng = self.engine
-        for slot, req in list(eng.slots.items()):
-            p = self._by_rid.pop(req.request_id, None)
-            self._budget.pop(req.request_id, None)
-            if p is None:
-                continue
-            eng.evict_slot(slot)
-            self._fail_shed(p, "evicted",
-                            "evicted: drain budget exceeded")
-
-    # ------------------------------------------------------------- loop
-
-    def run(self) -> None:
-        while not self.stop_flag.is_set():
-            try:
-                self._round()
-            except Exception as e:  # noqa: BLE001 - keep serving
-                # one bad round (injected fault, transient device error
-                # outside the decode guard) must never kill the
-                # scheduler thread — recover poisoned state, carry on
-                log.exception("scheduler round failed: %s", e)
-                if self.engine.cache_poisoned():
-                    self._recover_engine(e)
-
-    def _round(self) -> None:
-        eng = self.engine
-        if self.fault_hook is not None:
-            self.fault_hook()   # may raise (injected); run() recovers
-        if self.draining.is_set():
-            # no admission; shed the queue, enforce the drain budget
-            self._shed_queued()
-            if time.monotonic() >= self.drain_deadline:
-                self._evict_for_drain()
-            if not self._by_rid:
-                self.drained.set()
-        else:
-            self._admit()
-        # evict abandoned requests: the HTTP layer already 503'd the
-        # client, so decoding the slot to its budget would burn
-        # batch capacity producing tokens nobody reads
-        for slot, req in list(eng.slots.items()):
-            p = self._by_rid.get(req.request_id)
-            if p is not None and p.timed_out:
-                eng.evict_slot(slot)
-                self._by_rid.pop(req.request_id, None)
-                self._budget.pop(req.request_id, None)
-                self._maybe_complete(p)
-        # budget enforcement BEFORE decoding (add_request already
-        # produced one token, so a max_tokens=1 arrival is done on
-        # admission — decoding first would waste a batch-wide step
-        # whose tokens get truncated away; same ordering rationale
-        # as ServingEngine.generate())
-        for slot, req in list(eng.slots.items()):
-            b = self._budget.get(req.request_id)
-            if b is not None and len(req.generated) >= b:
-                eng.finish_slot(slot, n_keep=b)
-        self._deliver()
-        if not eng.slots:
-            self.stop_flag.wait(0.005)
-            return
-        # block bounded by the smallest remaining budget among OUR
-        # requests and the cache headroom (same shape as generate())
-        owned = [
-            r for r in eng.slots.values()
-            if r.request_id in self._budget
-        ]
-        n = self.block_size
-        if owned:
-            # at-budget slots were just removed: remaining >= 1
-            n = min(n, min(
-                self._budget[r.request_id] - len(r.generated)
-                for r in owned
-            ))
-        worst = max(
-            len(r.prompt) + len(r.generated)
-            for r in eng.slots.values()
-        )
-        n = min(n, eng.max_len - 2 - worst)
-        phase = "spec" if eng.draft_model is not None else "decode"
-        round_rids = [r.request_id for r in eng.slots.values()]
-        t_step = time.monotonic()
-        try:
-            if eng.draft_model is not None:
-                eng.spec_step()
-            elif n >= 1:
-                eng.decode_block(n)
-            else:
-                eng.step()
-        except Exception as e:  # noqa: BLE001 - recover, keep serving
-            log.exception("decode failed: %s", e)
-            if eng.cache_poisoned():
-                # the failed call consumed its donated cache buffer:
-                # carrying on would raise "Array has been deleted"
-                # on every later decode — reset the device state,
-                # fail the in-flight requests, keep serving
-                self._recover_engine(e)
-        finally:
-            self._observe_round(
-                phase, time.monotonic() - t_step, n, round_rids
-            )
-        self._deliver()
-
-    def _observe_round(self, phase: str, dt: float, n_steps: int,
-                       rids: List[int]) -> None:
-        """Profiler output for one engine dispatch: step-time histogram,
-        prefill-vs-decode time split, and one ``serve.decode_round``
-        span per participating request — every trace shows which rounds
-        its tokens came from and what each cost."""
-        self.metrics.step_seconds.labels(phase=phase).observe(dt)
-        self.metrics.phase_seconds.labels(phase=phase).inc(dt)
-        tracer = get_tracer()
-        start = time.time() - dt
-        seen = set()
-        for rid in rids:
-            p = self._by_rid.get(rid)
-            if p is None or not p.trace_id or id(p) in seen:
-                continue  # untraced (prefix op) or n>1 fork already done
-            seen.add(id(p))
-            tracer.record(
-                "serve.decode_round", dt * 1e3, trace_id=p.trace_id,
-                parent_id=p.span_id, start=start, phase=phase,
-                n_steps=n_steps, batch=len(rids),
-            )
-
-    def _record_request_span(self, p: _Pending, outcome: str) -> None:
-        """The request's ROOT span, recorded at its terminal moment
-        (assembled here rather than held open: the lifecycle crosses
-        the HTTP and scheduler threads). Shed/timeout/drain requests
-        get one too — a 429 must be traceable, not just counted."""
-        if not p.trace_id:
-            return
-        get_tracer().record(
-            "serve.request", (time.monotonic() - p.t0) * 1e3,
-            trace_id=p.trace_id, span_id=p.span_id, start=p.t0_wall,
-            error=p.error if outcome == "error" else "",
-            outcome=outcome,
-            tokens=sum(len(r.tokens) for r in p.results.values()),
-        )
-
-    def _admit(self) -> None:
-        eng = self.engine
-        # admit while there is room (FIFO; a head-of-line request
-        # needing more slots than free waits for the next round)
-        while True:
-                if self._head is not None:
-                    p, self._head = self._head, None
-                else:
-                    try:
-                        p = self.queue.get_nowait()
-                    except queue.Empty:
-                        break
-                if p.timed_out:
-                    # queued past its HTTP deadline: the client is gone.
-                    # Completions get the full ledger treatment —
-                    # outcome counter AND latency observation (the
-                    # slowest requests must not vanish from the
-                    # histogram) AND root span; prefix ops stay out of
-                    # the completion ledger like everywhere else
-                    if not p.prefix_op:
-                        self.metrics.requests.labels(
-                            outcome="timeout"
-                        ).inc()
-                        from instaslice_tpu.metrics.metrics import (
-                            observe_with_exemplar,
-                        )
-
-                        observe_with_exemplar(
-                            self.metrics.request_seconds,
-                            time.monotonic() - p.t0,
-                            trace_id=p.trace_id,
-                        )
-                        self._record_request_span(p, "timeout")
-                    p.done.set()
-                    continue
-                if p.prefix_op:
-                    # register needs a free slot to prefill through
-                    if not eng.free_slots():
-                        self._head = p
-                        break
-                    try:
-                        if p.prefix_op == "register":
-                            eng.register_prefix(p.prompt)
-                        elif not eng.drop_prefix(p.prompt):
-                            p.error = "ValueError: no such prefix"
-                    except Exception as e:
-                        p.error = f"{type(e).__name__}: {e}"
-                        # surfaced to the client via p.error, but the
-                        # server log must show engine-side failures too
-                        log.warning("prefix %s failed: %s",
-                                    p.prefix_op, p.error)
-                        # register_prefix prefills through donating jits
-                        if eng.cache_poisoned():
-                            p.server_fault = True
-                            self._recover_engine(e)
-                    p.done.set()
-                    continue
-                if eng.free_slots() < p.n:
-                    self._head = p
-                    break
-                tracer = get_tracer()
-                t_admit = time.monotonic()
-                if p.trace_id:
-                    # queue-wait span: submit → the moment a slot freed
-                    tracer.record(
-                        "serve.queue", (t_admit - p.t0) * 1e3,
-                        trace_id=p.trace_id, parent_id=p.span_id,
-                        start=p.t0_wall,
-                    )
-                try:
-                    with tracer.span(
-                        "serve.prefill", trace_id=p.trace_id or None,
-                        parent_id=p.span_id or None,
-                        tokens=len(p.prompt), n=p.n,
-                    ):
-                        rids = eng.add_request_n(p.prompt, p.n,
-                                                 stop=p.stop,
-                                                 adapter=p.adapter)
-                    dt_admit = time.monotonic() - t_admit
-                    p.first_token_at = time.monotonic()
-                    self.metrics.step_seconds.labels(
-                        phase="prefill"
-                    ).observe(dt_admit)
-                    self.metrics.phase_seconds.labels(
-                        phase="prefill"
-                    ).inc(dt_admit)
-                except Exception as e:
-                    p.error = f"{type(e).__name__}: {e}"
-                    # client mistakes are the client's problem (400,
-                    # below); an engine-side admission failure must
-                    # also land in the server log, not just the 500
-                    if not isinstance(e, (ValueError, TypeError)):
-                        log.warning("admission failed: %s", p.error)
-                    # ValueError/TypeError = the client's prompt was
-                    # bad (too long, empty, unknown adapter) → 400 +
-                    # outcome "rejected". ANYTHING else (device error,
-                    # injected fault, transient host failure) is the
-                    # server's problem → 500 + outcome "error" — a
-                    # transient engine failure must never be pinned on
-                    # the client
-                    client_mistake = isinstance(e, (ValueError, TypeError))
-                    p.server_fault = not client_mistake
-                    self.metrics.requests.labels(
-                        outcome="rejected" if client_mistake else "error"
-                    ).inc()
-                    # admission prefills through DONATING jits: a
-                    # device-side failure mid-prefill consumed the
-                    # cache, and without recovery every later call
-                    # would raise "Array has been deleted" forever
-                    if eng.cache_poisoned():
-                        self._recover_engine(e)
-                    if p.stream_q is not None:
-                        p.stream_q.put(p.error)
-                    self._record_request_span(
-                        p, "rejected" if client_mistake else "error"
-                    )
-                    p.done.set()
-                    continue
-                for i, rid in enumerate(rids):
-                    p.rid_index[rid] = i
-                    self._by_rid[rid] = p
-                    self._budget[rid] = p.max_tokens
-
-    def _recover_engine(self, e: Exception) -> None:
-        """Reset poisoned device state and fail every in-flight request
-        whose KV went with the old cache (500s, not silent drops)."""
-        log.warning("recovering engine after device failure: %s", e)
-        for rid in self.engine.recover():
-            p = self._by_rid.pop(rid, None)
-            self._budget.pop(rid, None)
-            if p is None:
-                continue
-            p.server_fault = True
-            p.error = p.error or (
-                "engine recovered after device failure: "
-                f"{type(e).__name__}: {e}"
-            )
-            if p.stream_q is not None:
-                p.stream_q.put(p.error)
-            self._maybe_complete(p)
-
-    def _maybe_complete(self, p: _Pending) -> None:
-        """Finalize a pending once NONE of its engine rids are live:
-        metrics count the HTTP request once, waiters wake once."""
-        if p.done.is_set():
-            return
-        if any(rid in self._by_rid for rid in p.rid_index):
-            return
-        if p.prefix_op:
-            # prefix-cache mutations stay out of the completion ledger
-            # (their normal path completes inline in _admit, uncounted
-            # — counting only the shed ones would skew reconciliation)
-            with p.lock:
-                p.done.set()
-            return
-        # a request the HTTP layer already 503'd must not read as a
-        # success on the dashboard — the client never got the tokens.
-        # Outcome read + done.set() are atomic under p.lock so the HTTP
-        # thread's expiring wait cannot interleave (503 counted as ok).
-        with p.lock:
-            outcome = ("timeout" if p.timed_out
-                       else "drained" if p.shed
-                       else "error" if p.error else "ok")
-            self.metrics.requests.labels(outcome=outcome).inc()
-            if outcome == "drained":
-                # queued-shed and budget-evicted requests: same journal
-                # ledger as the submit-time drain rejections above
-                get_journal().emit(
-                    "serving", reason=REASON_DRAINED,
-                    message=p.error or "drained",
-                    trace_id=p.trace_id,
-                )
-            from instaslice_tpu.metrics.metrics import (
-                observe_with_exemplar,
-            )
-
-            now = time.monotonic()
-            observe_with_exemplar(
-                self.metrics.request_seconds, now - p.t0,
-                trace_id=p.trace_id,
-            )
-            if p.first_token_at is not None:
-                observe_with_exemplar(
-                    self.metrics.ttft_seconds, p.first_token_at - p.t0,
-                    trace_id=p.trace_id,
-                )
-                tokens = sum(len(r.tokens) for r in p.results.values())
-                if outcome == "ok" and tokens > 1:
-                    # mean inter-token gap over the decode phase: the
-                    # per-request TPOT the client experienced
-                    self.metrics.tpot_seconds.observe(
-                        (now - p.first_token_at) / (tokens - 1)
-                    )
-            self._record_request_span(p, outcome)
-            p.done.set()
-
-    def _deliver(self) -> None:
-        eng = self.engine
-        # the parked head-of-line request is queued pressure too
-        self.metrics.queue_depth.set(
-            self.queue.qsize() + (self._head is not None)
-        )
-        self.metrics.live_slots.set(len(eng.slots))
-        self.metrics.batch_occupancy.set(
-            len(eng.slots) / max(1, eng.max_batch)
-        )
-        self.metrics.kv_cache_utilization.set(eng.kv_utilization())
-        # stream incremental tokens for live slots (capped at the
-        # request budget so a truncated tail is never streamed)
-        for req in eng.slots.values():
-            p = self._by_rid.get(req.request_id)
-            if p is None or p.stream_q is None:
-                continue
-            have = len(req.generated)
-            if p.stop:
-                # hold back the longest-stop-minus-one tail: those
-                # tokens could still become part of a stop match
-                # spanning the next block and be truncated away
-                have -= max(len(s) for s in p.stop) - 1
-            b = self._budget.get(req.request_id)
-            if b is not None:
-                have = min(have, b)
-            sent = p.sent.get(req.request_id, 0)
-            if have > sent:
-                p.stream_q.put({
-                    "kind": "delta",
-                    "index": p.rid_index[req.request_id],
-                    "tokens": list(req.generated[sent:have]),
-                    "logprobs": list(req.logprobs[sent:have]),
-                })
-                p.sent[req.request_id] = have
-        keep: List[GenerationResult] = []
-        for r in eng.finished:
-            p = self._by_rid.pop(r.request_id, None)
-            if p is None:
-                keep.append(r)        # not ours (direct engine use)
-                continue
-            b = self._budget.pop(r.request_id, None)
-            if b is not None and len(r.tokens) > b:
-                r.tokens = r.tokens[:b]
-                r.logprobs = r.logprobs[:b]
-                # the cut can drop the evidence the engine finished on —
-                # the client-visible reason must describe the tokens it
-                # got: a dropped eos, or a stop match that sat beyond
-                # the budget (stop matches at the original length since
-                # the match itself is excluded), read as plain budget
-                # exhaustion
-                if (r.finished_reason == "stop"
-                        or (r.finished_reason == "eos"
-                            and self.engine.eos_id not in r.tokens)):
-                    r.finished_reason = "max_new_tokens"
-            idx = p.rid_index[r.request_id]
-            p.results[idx] = r
-            if not p.timed_out:
-                self.metrics.tokens.inc(len(r.tokens))
-            if p.stream_q is not None:
-                sent = p.sent.get(r.request_id, 0)
-                if len(r.tokens) > sent:
-                    p.stream_q.put({
-                        "kind": "delta", "index": idx,
-                        "tokens": list(r.tokens[sent:]),
-                        "logprobs": list(r.logprobs[sent:]),
-                    })
-                    p.sent[r.request_id] = len(r.tokens)
-                p.stream_q.put({"kind": "final", "index": idx,
-                                "result": r})
-            self._maybe_complete(p)
-        eng.finished = keep
-
-    def stats(self) -> dict:
-        eng = self.engine
-        return {
-            "live_slots": len(eng.slots),
-            "free_slots": eng.free_slots(),
-            "draining": self.draining.is_set(),
-            "max_queue": self.max_queue,
-            "queued": self.queue.qsize() + (self._head is not None),
-            "tokens_generated": eng.tokens_generated,
-            "max_batch": eng.max_batch,
-            "max_len": eng.max_len,
-            "speculative": eng.draft_model is not None,
-            "mesh": dict(eng.mesh.shape) if eng.mesh is not None else None,
-            "prefixes": len(eng.prefixes),
-            "prefix_hits": eng.prefix_hits,
-            "prefix_tokens_saved": eng.prefix_tokens_saved,
-        }
+#: the decision loop lives in serving/scheduler.py (continuous
+#: batching, tenant classes, weighted fair share, SLO preemption); the
+#: old private names stay importable — tests and embedders constructed
+#: _Scheduler/_Pending directly
+_Pending = Pending
+_Scheduler = Scheduler
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -996,6 +334,16 @@ class _Handler(BaseHTTPRequestHandler):
                         f"(running with {key}={have}); restart "
                         f"tpuslice-serve with --{key.replace('_', '-')}"
                     )
+            # tenant is routing metadata for the SLO scheduler: the
+            # header wins (proxies inject it), the body field is the
+            # curl-friendly spelling; unknown tenants ride the default
+            # class — never a 400
+            tenant = (self.headers.get("X-Tenant")
+                      or req.get("tenant") or "")
+            if not isinstance(tenant, str) or len(tenant) > 64:
+                raise ValueError(
+                    "tenant must be a string of <= 64 chars"
+                )
         except (ValueError, TypeError, json.JSONDecodeError) as e:
             self._send(400, {"error": str(e)}, trace_id=tid)
             return
@@ -1003,7 +351,8 @@ class _Handler(BaseHTTPRequestHandler):
                            stream=bool(req.get("stream", False)),
                            stop=stop,
                            want_logprobs=bool(req.get("logprobs", False)),
-                           n=n, adapter=adapter, trace_id=tid)
+                           n=n, adapter=adapter, trace_id=tid,
+                           tenant=tenant)
         if not self._submit_or_shed(pending):
             return
         if pending.stream_q is not None:
@@ -1018,8 +367,12 @@ class _Handler(BaseHTTPRequestHandler):
             # client mistakes are 400s; an engine-side failure that
             # killed the request is the server's fault
             if pending.shed:
+                # pressure sheds (kv blocks, parked timeout) hint one
+                # decode round; drain sheds hint the drain budget
                 self._send(503, {"error": pending.error},
-                           retry_after=type(self).scheduler.drain_budget,
+                           retry_after=(pending.retry_after
+                                        or type(self)
+                                        .scheduler.drain_budget),
                            trace_id=tid)
             else:
                 self._send(500 if pending.server_fault else 400,
@@ -1254,13 +607,17 @@ class ApiServer:
                  request_timeout: Optional[float] = None,
                  max_queue: Optional[int] = None,
                  drain_budget: Optional[float] = None,
-                 fault_plan=None):
+                 fault_plan=None, tenants=None,
+                 mode: Optional[str] = None,
+                 preempt_margin: Optional[float] = None):
         if request_timeout is None:
             request_timeout = _env_float("TPUSLICE_REQUEST_TIMEOUT", 300)
         if max_queue is None:
             max_queue = _env_int("TPUSLICE_MAX_QUEUE", 0)
         if drain_budget is None:
             drain_budget = _env_float("TPUSLICE_DRAIN_BUDGET", 30)
+        if preempt_margin is None:
+            preempt_margin = _env_float("TPUSLICE_PREEMPT_MARGIN", 0.5)
         sched_hook = None
         if fault_plan is not None:
             from instaslice_tpu.faults import (
@@ -1273,7 +630,9 @@ class ApiServer:
         self.scheduler = _Scheduler(engine, block_size=block_size,
                                     metrics=metrics, max_queue=max_queue,
                                     drain_budget=drain_budget,
-                                    fault_hook=sched_hook)
+                                    fault_hook=sched_hook,
+                                    tenants=tenants, mode=mode,
+                                    preempt_margin=preempt_margin)
         handler = type("BoundHandler", (_Handler,),
                        {"scheduler": self.scheduler,
                         "request_timeout": request_timeout})
@@ -1338,6 +697,33 @@ def build_parser() -> argparse.ArgumentParser:
                          "after SIGTERM / POST /v1/drain before "
                          "eviction with a clean 503 (env: "
                          "TPUSLICE_DRAIN_BUDGET)")
+    ap.add_argument("--tenants", default=os.environ.get(
+                        "TPUSLICE_TENANTS", ""),
+                    help="multi-tenant SLO policy: comma-separated "
+                         "name:weight:class[:ttft_slo[:tpot_slo]] "
+                         "(class in latency/standard/best-effort; SLOs "
+                         "in seconds, 0 = none). Requests pick a "
+                         "tenant via the X-Tenant header or the "
+                         "\"tenant\" field; unknown tenants ride the "
+                         "standard class at weight 1 (env: "
+                         "TPUSLICE_TENANTS)")
+    ap.add_argument("--sched-mode", default=None,
+                    choices=["continuous", "fixed"],
+                    help="continuous (default): per-step admission, "
+                         "fair share, SLO preemption; fixed: the naive "
+                         "fixed-decode-round FIFO baseline the serving "
+                         "bench measures against (env: "
+                         "TPUSLICE_SCHED_MODE)")
+    ap.add_argument("--preempt-margin", type=float,
+                    default=_env_float("TPUSLICE_PREEMPT_MARGIN", 0.5),
+                    help="preempt a best-effort slot once a latency-"
+                         "class request has waited this fraction of "
+                         "its TTFT SLO (env: TPUSLICE_PREEMPT_MARGIN)")
+    ap.add_argument("--kv-block-size", type=int, default=16,
+                    help="paged KV-cache block size in tokens "
+                         "(serving/kvcache.py): admission, preemption "
+                         "and the kv_blocks_* gauges account in these "
+                         "units")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="Prometheus /metrics port (0 = off)")
     ap.add_argument("--max-batch", type=int, default=8)
@@ -1529,6 +915,7 @@ def build_engine(args) -> ServingEngine:
         lora_adapters=adapters or None,
         lora_alphas=alphas or None,
         lora_names=names or None,
+        kv_block_size=getattr(args, "kv_block_size", 16),
     )
     #: single-adapter merge: remember the name so a request naming it
     #: gets a helpful error (the adapter is always on; omit the field)
@@ -1585,7 +972,9 @@ def main(argv=None) -> int:
                     request_timeout=args.request_timeout,
                     max_queue=args.max_queue,
                     drain_budget=args.drain_budget,
-                    fault_plan=FaultPlan.from_env()).start()
+                    fault_plan=FaultPlan.from_env(),
+                    tenants=args.tenants, mode=args.sched_mode,
+                    preempt_margin=args.preempt_margin).start()
     if args.metrics_port:
         from instaslice_tpu.metrics.metrics import start_metrics_server
 

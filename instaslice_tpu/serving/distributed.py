@@ -26,6 +26,9 @@ Wire format (one JSON object per line)::
     {"op": "drop_prefix", "tokens": [...]}
     {"op": "finish_slot", "slot": 0, "n_keep": 5, "reason": "..."}
     {"op": "evict_slot", "slot": 0}
+    {"op": "preempt_slot", "slot": 0}
+    {"op": "resume_request", "rid": 7}
+    {"op": "drop_parked", "rid": 7}
     {"op": "shutdown"}
 
 Usage — driver (worker 0)::
@@ -206,6 +209,23 @@ class DistributedEngine:
         self._bcast({"op": "evict_slot", "slot": slot})
         self.engine.evict_slot(slot)
 
+    def preempt_slot(self, slot: int) -> int:
+        # preemption/resume change slot occupancy AND dispatch stripe
+        # read/write jits, so they are broadcast surface exactly like
+        # finish_slot; parked state replays deterministically per host
+        self._bcast({"op": "preempt_slot", "slot": slot})
+        return self.engine.preempt_slot(slot)
+
+    def resume_request(self, rid: int) -> int:
+        if rid not in self.engine.parked:
+            raise ValueError(f"request {rid} is not parked")
+        self._bcast({"op": "resume_request", "rid": rid})
+        return self.engine.resume_request(rid)
+
+    def drop_parked(self, rid: int) -> bool:
+        self._bcast({"op": "drop_parked", "rid": rid})
+        return self.engine.drop_parked(rid)
+
     def generate(self, prompts, max_new_tokens, block_size: int = 32,
                  stop=None):
         # ServingEngine.generate drives everything through the public
@@ -265,7 +285,9 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                 return applied
             if kind not in ("add_request", "step", "decode_block",
                             "spec_step", "register_prefix",
-                            "drop_prefix", "finish_slot", "evict_slot"):
+                            "drop_prefix", "finish_slot", "evict_slot",
+                            "preempt_slot", "resume_request",
+                            "drop_parked"):
                 # a protocol mismatch is NOT deterministic-skip
                 # territory: replicas are about to diverge — die loudly
                 raise RuntimeError(f"unknown op {kind!r} in op stream")
@@ -289,6 +311,12 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                                        reason=op["reason"])
                 elif kind == "evict_slot":
                     engine.evict_slot(op["slot"])
+                elif kind == "preempt_slot":
+                    engine.preempt_slot(op["slot"])
+                elif kind == "resume_request":
+                    engine.resume_request(op["rid"])
+                elif kind == "drop_parked":
+                    engine.drop_parked(op["rid"])
             except (ValueError, KeyError, RuntimeError) as e:
                 # deterministic host-side validation failure: the
                 # driver hit (or pre-screened) the exact same error, so

@@ -64,6 +64,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from instaslice_tpu.models.lm import Params, TpuLM, param_specs
+from instaslice_tpu.serving.kvcache import BlockTable, KVBlockPool
 from instaslice_tpu.serving.sampling import (
     apply_repetition_penalty,
     filter_logits,
@@ -107,6 +108,19 @@ class _Prefix:
     draft_stripe: Optional[Params]     # ditto for the speculative draft
 
 
+@dataclasses.dataclass
+class _Parked:
+    """A preempted request: its host state plus its KV stripe(s), read
+    out of the cache so the slot could go back to the batch. The block
+    table stays allocated (``ServingEngine._tables``) — resume is one
+    stripe write, never a re-prefill."""
+    req: "_Slot"
+    stripe: Params
+    draft_stripe: Optional[Params]
+    length: int                        # resident cache positions
+    adapter: int = 0
+
+
 class ServingEngine:
     def __init__(
         self,
@@ -129,6 +143,7 @@ class ServingEngine:
         min_p: float = 0.0,
         repetition_penalty: float = 1.0,
         max_prefixes: int = 8,
+        kv_block_size: int = 16,
         lora_adapters=None,
         lora_alphas=None,
         lora_names=None,
@@ -253,6 +268,36 @@ class ServingEngine:
         self.slots: Dict[int, _Slot] = {}          # slot index → request
         self.finished: List[GenerationResult] = []
         self.tokens_generated = 0
+        # paged KV accounting (serving/kvcache.py): a block pool over
+        # the cache's (max_batch x max_len) position space. Block
+        # tables per request replace per-slot max_len reservations —
+        # admission/eviction/preemption reason in blocks, and
+        # kv_utilization reports true block occupancy.
+        if not 1 <= kv_block_size <= max_len:
+            raise ValueError(
+                f"kv_block_size must be in [1, max_len], got "
+                f"{kv_block_size}"
+            )
+        self.kv_block_size = kv_block_size
+        # per-row capacity is ceil(max_len / block_size) blocks (the
+        # tail partial block is real, writable positions) — floor
+        # division would undersize the pool whenever max_len is not a
+        # block multiple and let LIVE slots exhaust it mid-decode
+        self.kv = KVBlockPool(
+            max_batch * (-(-max_len // kv_block_size)),
+            kv_block_size,
+        )
+        #: request id → block table (live slots AND parked requests)
+        self._tables: Dict[int, BlockTable] = {}
+        #: registered prefix key → pinned read-only block table; slot
+        #: tables fork these copy-on-write at prefix-hit admission
+        self._prefix_tables: Dict[tuple, BlockTable] = {}
+        #: preempted requests parked off-batch (request id → state)
+        self.parked: Dict[int, _Parked] = {}
+        #: host mirror of slot_adapter (preemption must not sync)
+        self._slot_adapter_host: Dict[int, int] = {}
+        self.preempted_total = 0
+        self.resumed_total = 0
         # prefix cache: registered prompt prefixes → stored KV stripes
         # (:meth:`register_prefix`); admission auto-matches the longest.
         # Each stripe pins HBM for the engine's lifetime, so the count is
@@ -627,18 +672,88 @@ class ServingEngine:
     def free_slots(self) -> int:
         return self.max_batch - len(self.slots)
 
+    def _resident_tokens(self) -> int:
+        """Tokens holding KV blocks right now: live slots plus parked
+        (preempted) requests — host-side bookkeeping, no device sync.
+        list() snapshots the dict views first: /v1/stats reads this
+        from HTTP handler threads while the scheduler mutates the
+        dicts (a point-in-time approximation is fine for a gauge; a
+        'changed size during iteration' crash is not)."""
+        live = sum(
+            len(r.prompt) + len(r.generated)
+            for r in list(self.slots.values())
+        )
+        return live + sum(p.length for p in list(self.parked.values()))
+
     def kv_utilization(self) -> float:
-        """Fraction of the KV cache's (max_batch × max_len) positions
-        holding live-slot context — host-side bookkeeping only, no
-        device sync. Feeds ``tpuslice_serve_kv_cache_utilization``;
-        MIG-serving reconfiguration papers key decisions off exactly
-        this occupancy signal."""
+        """True block-pool occupancy: resident tokens / capacity of the
+        blocks actually allocated for them. Stays high under mixed
+        sequence lengths — a request holds only the blocks its tokens
+        fill, never a ``max_len`` stripe. Feeds
+        ``tpuslice_serve_kv_cache_utilization``; MIG-serving
+        reconfiguration papers key decisions off exactly this occupancy
+        signal. The pre-paging stripe metric survives as
+        :meth:`kv_utilization_legacy` (gauge ``..._legacy``) for one
+        release so dashboards don't silently shift."""
+        return self.kv.utilization(self._resident_tokens())
+
+    def kv_utilization_legacy(self) -> float:
+        """The pre-paging metric: live tokens over the whole
+        (max_batch x max_len) rectangle — misleadingly low at mixed
+        sequence lengths (it charges every slot its full stripe) and
+        blind to parked state. Kept one release for dashboard
+        continuity; prefer :meth:`kv_utilization`."""
         if not self.slots:
             return 0.0
         used = sum(
-            len(r.prompt) + len(r.generated) for r in self.slots.values()
+            len(r.prompt) + len(r.generated)
+            for r in list(self.slots.values())
         )
         return min(1.0, used / float(self.max_batch * self.max_len))
+
+    def kv_stats(self) -> dict:
+        """Block-pool gauges (free/used/cow + parked count) for
+        /v1/stats and the ``tpuslice_kv_blocks_*`` metrics. dict()
+        snapshots the table map — this runs on HTTP handler threads
+        concurrently with the scheduler's mutations."""
+        out = self.kv.stats(dict(self._tables))
+        out["parked"] = len(self.parked)
+        out["utilization"] = self.kv_utilization()
+        out["utilization_legacy"] = self.kv_utilization_legacy()
+        return out
+
+    def _release_table(self, rid: int) -> None:
+        t = self._tables.pop(rid, None)
+        if t is not None:
+            self.kv.release(t)
+
+    def _sync_tables(self) -> None:
+        """Grow every live slot's block table to its token count —
+        called after each decode dispatch so freed/grown blocks are
+        visible to the very next admission decision. Never raises for
+        engine-only use: live tables cannot exceed the pool (each slot
+        is bounded by its row); only parked state can over-subscribe,
+        and the scheduler's headroom guard sheds it first."""
+        for slot, req in self.slots.items():
+            t = self._tables.get(req.request_id)
+            if t is not None:
+                self.kv.ensure(t, len(req.prompt) + len(req.generated))
+
+    def can_admit(self, prompt_len: int, n: int = 1) -> bool:
+        """Step-level admission check: free slots AND free KV blocks.
+        The scheduler gates on this each step instead of slot count
+        alone, so parked blocks correctly push back on admission.
+
+        The block count mirrors :meth:`_alloc_tables` exactly (forks
+        share the prompt's full blocks and pay one boundary block
+        each), so any HTTP-valid request fits an empty pool — a False
+        here always means "blocks will free", never "never". Prefix
+        sharing can only need fewer (conservative is safe: the caller
+        retries next step)."""
+        if self.free_slots() < n:
+            return False
+        need = self.kv.blocks_for(prompt_len + 1) + (n - 1)
+        return need <= self.kv.free_blocks()
 
     def finish_slot(self, slot: int, n_keep: Optional[int] = None,
                     reason: str = "max_new_tokens") -> None:
@@ -652,6 +767,7 @@ class ServingEngine:
         removals (eos/stop/max_len in ``_maybe_finish``) replay
         deterministically from the op stream and need no broadcast."""
         req = self.slots.pop(slot)
+        self._release_table(req.request_id)
         toks = req.generated if n_keep is None else req.generated[:n_keep]
         lps = req.logprobs if n_keep is None else req.logprobs[:n_keep]
         self.finished.append(
@@ -661,8 +777,97 @@ class ServingEngine:
 
     def evict_slot(self, slot: int) -> None:
         """Drop a live slot with NO result (abandoned request): the
-        tokens were never delivered to anyone."""
-        self.slots.pop(slot)
+        tokens were never delivered to anyone. Its blocks are free for
+        the next admission immediately."""
+        req = self.slots.pop(slot)
+        self._release_table(req.request_id)
+
+    # ------------------------------------------------------ preempt/resume
+
+    def preempt_slot(self, slot: int) -> int:
+        """Park a live request off-batch: read its KV stripe out of the
+        cache, free the slot, KEEP its block table — the cheap half of
+        SLO preemption (resume is one stripe write, no re-prefill).
+        Part of the multi-host broadcast surface like finish_slot (slot
+        occupancy feeds the compiled decode's attend window); returns
+        the parked request id."""
+        if self.fault_hook is not None:
+            self.fault_hook("prefill")
+        req = self.slots[slot]
+        # resident positions: generated[-1] is the pending last_token,
+        # not yet written to the cache (see _step_inner)
+        length = len(req.prompt) + len(req.generated) - 1
+        # stripe lengths round up to block multiples: one compile per
+        # distinct rounded length, bounded by max_len / kv_block_size
+        rounded = min(
+            self.max_len,
+            self.kv.blocks_for(max(1, length)) * self.kv_block_size,
+        )
+        stripe = self._read_stripe(self.cache, slot, length=rounded)
+        draft_stripe = None
+        if self.draft_model is not None:
+            draft_stripe = self._read_stripe(
+                self.draft_cache, slot, length=rounded
+            )
+        del self.slots[slot]
+        self.parked[req.request_id] = _Parked(
+            req, stripe, draft_stripe, length,
+            adapter=self._slot_adapter_host.get(slot, 0),
+        )
+        self.preempted_total += 1
+        return req.request_id
+
+    def resume_request(self, rid: int) -> int:
+        """Un-park a preempted request into a free slot: write its
+        stripe back (positions are absolute — RoPE bakes them into K,
+        so the stripe is row-position-exact), restore decode state, and
+        return the slot. Raises when no slot is free or the rid is not
+        parked (callers check, like add_request's capacity)."""
+        if rid not in self.parked:
+            raise ValueError(f"request {rid} is not parked")
+        slot = self._first_free_slot("no free slot to resume into")
+        if self.fault_hook is not None:
+            self.fault_hook("prefill")
+        # the entry stays parked until the device writes land: a
+        # failed stripe write must leave the rid findable by
+        # drop_parked (the scheduler's cleanup path), or its block
+        # table would leak out of the pool forever
+        parked = self.parked[rid]
+        req = parked.req
+        self.cache = self._write_stripe(self.cache, parked.stripe, slot)
+        if self.draft_model is not None and parked.draft_stripe is not None:
+            self.draft_cache = self._write_stripe(
+                self.draft_cache, parked.draft_stripe, slot
+            )
+        del self.parked[rid]
+        self.lengths = self.lengths.at[slot].set(parked.length)
+        self.last_token = self.last_token.at[slot].set(
+            req.generated[-1]
+        )
+        if self.lora is not None:
+            self.slot_adapter = self.slot_adapter.at[slot].set(
+                parked.adapter
+            )
+        self._slot_adapter_host[slot] = parked.adapter
+        if self.track_seen:
+            seen_toks = jnp.asarray(
+                list(req.prompt) + list(req.generated), jnp.int32
+            )
+            self.seen = self.seen.at[slot].set(False)
+            self.seen = self.seen.at[slot, seen_toks].set(True)
+        self.slots[slot] = req
+        self.resumed_total += 1
+        return slot
+
+    def drop_parked(self, rid: int) -> bool:
+        """Shed a parked request entirely (KV-pressure eviction or a
+        client that 503'd while parked): its blocks return to the pool
+        NOW — eviction frees blocks, not stripes."""
+        parked = self.parked.pop(rid, None)
+        if parked is None:
+            return False
+        self._release_table(rid)
+        return True
 
     def cache_poisoned(self) -> bool:
         """True when a donated cache buffer was consumed by a FAILED
@@ -697,6 +902,8 @@ class ServingEngine:
         import jax.numpy as jnp
 
         lost = [r.request_id for r in self.slots.values()]
+        for rid in lost:
+            self._release_table(rid)
         self.slots.clear()
         self.cache = self.model.init_cache(
             self.max_batch, self.max_len, quant=self.kv_quant
@@ -834,6 +1041,10 @@ class ServingEngine:
                 self.draft_cache, slot, length=len(prefix)
             )
         self.prefixes[key] = _Prefix(key, stripe, draft_stripe)
+        # pinned read-only blocks OUTSIDE the allocatable pool (the
+        # stripe is a separate HBM array, not a slot row); prefix-hit
+        # admissions fork this table copy-on-write
+        self._prefix_tables[key] = self.kv.pin(len(prefix))
 
     def _validate_prefix(self, prefix: List[int]) -> None:
         """Host-side registration checks, raised BEFORE any device op
@@ -861,8 +1072,14 @@ class ServingEngine:
         self._first_free_slot("no free slots to prefill the prefix")
 
     def drop_prefix(self, prefix: List[int]) -> bool:
-        """Free a registered prefix's stored stripe (HBM)."""
-        return self.prefixes.pop(tuple(prefix), None) is not None
+        """Free a registered prefix's stored stripe (HBM). Its pinned
+        blocks unpin too; copies shared into live tables survive until
+        those tables release them."""
+        key = tuple(prefix)
+        table = self._prefix_tables.pop(key, None)
+        if table is not None:
+            self.kv.release(table)
+        return self.prefixes.pop(key, None) is not None
 
     @staticmethod
     def _normalize_stop(stop) -> List[List[int]]:
@@ -926,6 +1143,38 @@ class ServingEngine:
             rids = self._add_request_n_inner(prompt, n, stop, adapter, sp)
         return rids
 
+    def _alloc_tables(self, prompt_len: int, n: int, pref):
+        """Block tables for an n-way admission, all-or-nothing. The
+        first table forks the matched prefix's pinned table (its blocks
+        are copy-on-write shared — zero pool cost until divergence);
+        forks 2..n share the first table's blocks the same way."""
+        from instaslice_tpu.serving.kvcache import BlockPoolExhausted
+
+        tables: List[BlockTable] = []
+        try:
+            base = (self._prefix_tables.get(pref.tokens)
+                    if pref is not None else None)
+            t0 = (self.kv.fork(base, len(pref.tokens))
+                  if base is not None else self.kv.allocate(0))
+            tables.append(t0)
+            # +1: admission samples each request's first token
+            self.kv.ensure(t0, prompt_len + 1)
+            for _ in range(n - 1):
+                # forks share the PROMPT's blocks only — their first
+                # sampled tokens diverge, so the boundary block copies
+                # right here rather than pretending to be shared
+                t = self.kv.fork(t0, prompt_len)
+                tables.append(t)
+                self.kv.ensure(t, prompt_len + 1)
+        except BlockPoolExhausted as e:
+            for t in tables:
+                self.kv.release(t)
+            raise RuntimeError(
+                f"kv block pool cannot admit this request: {e} "
+                "(shed parked state or wait for a release)"
+            ) from None
+        return tables
+
     def _add_request_n_inner(self, prompt: List[int], n: int,
                              stop, adapter: int, sp) -> List[int]:
         stop = self._normalize_stop(stop)
@@ -936,19 +1185,37 @@ class ServingEngine:
             )
         self._check_prompt_fits(prompt)
         self._check_capacity(n)
+        # registered-prefix stripes hold BASE-model KV: an adapter
+        # request must recompute its whole prompt through the adapter
+        # (reusing base KV would serve a silent base/adapter hybrid)
+        pref = self._match_prefix(prompt) if adapter == 0 else None
+        tables = self._alloc_tables(len(prompt), n, pref)
+        try:
+            return self._admit_with_tables(
+                prompt, n, stop, adapter, sp, pref, tables
+            )
+        except BaseException:
+            # a failed admission (injected fault, device error) must
+            # not leak the blocks it reserved — the caller's recovery
+            # path only releases REGISTERED tables
+            for t in tables:
+                self.kv.release(t)
+            raise
+
+    def _admit_with_tables(self, prompt: List[int], n: int, stop,
+                           adapter: int, sp, pref,
+                           tables: List[BlockTable]) -> List[int]:
         if self.fault_hook is not None:
             self.fault_hook("prefill")
         slots = self._free_slot_indices()[:n]
         first = slots[0]
+        for s in slots:
+            self._slot_adapter_host[s] = adapter
         if self.lora is not None:
             self.slot_adapter = self.slot_adapter.at[
                 jnp.asarray(slots)
             ].set(adapter)
         start_chunk = 0
-        # registered-prefix stripes hold BASE-model KV: an adapter
-        # request must recompute its whole prompt through the adapter
-        # (reusing base KV would serve a silent base/adapter hybrid)
-        pref = self._match_prefix(prompt) if adapter == 0 else None
         if pref is not None:
             sp.attrs["prefix_hit"] = str(len(pref.tokens))
             self.cache = self._write_stripe(self.cache, pref.stripe,
@@ -1009,6 +1276,7 @@ class ServingEngine:
             self.lengths = self.lengths.at[s].set(len(prompt))
             self.slots[s] = _Slot(rid, list(prompt), [int(toks[i])],
                                   list(stop), logprobs=[float(lps[i])])
+            self._tables[rid] = tables[i]
             self.tokens_generated += 1
             self._maybe_finish(s)
             rids.append(rid)
@@ -1064,6 +1332,7 @@ class ServingEngine:
         self.lengths = jnp.where(live, self.lengths + 1, self.lengths)
         for slot in list(self.slots):
             self._maybe_finish(slot)
+        self._sync_tables()
         return out
 
     def decode_block(self, n_steps: int) -> Dict[int, List[int]]:
@@ -1147,6 +1416,7 @@ class ServingEngine:
             self.tokens_generated += len(seq)
             out[req.request_id] = seq
             self._maybe_finish(slot)
+        self._sync_tables()
         return out
 
     def spec_step(self) -> Dict[int, List[int]]:
@@ -1219,6 +1489,7 @@ class ServingEngine:
             self.tokens_generated += len(seq)
             out[req.request_id] = seq
             self._maybe_finish(slot)
+        self._sync_tables()
         return out
 
     @staticmethod
@@ -1267,6 +1538,7 @@ class ServingEngine:
                 )
             )
             del self.slots[slot]
+            self._release_table(req.request_id)
 
     def generate(
         self, prompts: List[List[int]], max_new_tokens: int,

@@ -72,11 +72,12 @@ def _classify(err: Optional[str], code: Optional[int]) -> str:
 
 def _one_request(url: str, prompt: List[int], max_tokens: int,
                  stream: bool, timeout: float, adapter: str = "",
-                 trace_id: str = ""):
+                 trace_id: str = "", tenant: str = ""):
     """Returns (latency_s, ttft_s or None, tokens, error or None,
     http_code or None). ``trace_id`` rides the ``X-Trace-Id`` header,
     so every loadgen request is findable in the server's
-    ``/v1/debug/trace`` ring / ``TPUSLICE_TRACE_FILE`` dump."""
+    ``/v1/debug/trace`` ring / ``TPUSLICE_TRACE_FILE`` dump; ``tenant``
+    rides ``X-Tenant`` — the SLO scheduler's routing key."""
     body = {"prompt": prompt, "max_tokens": max_tokens}
     if adapter:
         body["adapter"] = adapter
@@ -85,6 +86,8 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
     headers = {"Content-Type": "application/json"}
     if trace_id:
         headers["X-Trace-Id"] = trace_id
+    if tenant:
+        headers["X-Tenant"] = tenant
     req = urllib.request.Request(
         url + "/v1/completions",
         data=json.dumps(body).encode(),
@@ -155,19 +158,53 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
 
 def run(url: str, requests: int, concurrency: int, prompt_len: int,
         max_tokens: int, vocab: int, stream: bool, timeout: float,
-        seed: int = 0, adapters: List[str] = ()) -> dict:
+        seed: int = 0, adapters: List[str] = (),
+        tenants=None, jitter: float = 0.0) -> dict:
     """``adapters``: multi-LoRA names assigned round-robin across
     requests ("" rides the base model) — load-tests the batched
-    per-request adapter path."""
+    per-request adapter path.
+
+    ``tenants``: a ``{name: TenantSpec}`` dict (or the spec string the
+    server's ``--tenants`` takes — ONE grammar, serving/scheduler.py):
+    requests draw a tenant by weight (seeded), send it in ``X-Tenant``,
+    and the report gains per-tenant TTFT/TPOT p50/p95/p99 plus an
+    **SLO-attainment fraction** — ok requests whose TTFT met the
+    tenant's target (streaming; sync runs use total latency, the
+    conservative stand-in)."""
+    from instaslice_tpu.serving.scheduler import parse_tenant_specs
+
     rng = random.Random(seed)
+    if isinstance(tenants, str):
+        tenants = parse_tenant_specs(tenants) if tenants else None
+    tenant_of: List[str] = [""] * requests
+    if tenants:
+        names = sorted(tenants)
+        weights = [tenants[n].weight for n in names]
+        tenant_of = rng.choices(names, weights=weights, k=requests)
     # per-run nonce in every trace id: two runs with the same seed
     # against one long-lived server must not reuse ids, or the
     # documented `--trace` drill-down would merge unrelated requests'
     # spans from the server's ring (stays within TRACE_ID_SAFE)
     run_id = uuid.uuid4().hex[:6]
-    prompts = [
-        [rng.randrange(1, vocab) for _ in range(prompt_len)]
+    if not 0.0 <= jitter < 1.0:
+        raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+    # mixed sequence lengths (seeded): each request draws its prompt
+    # length and budget from [ceil(x*(1-jitter)), x] — the scenario
+    # paged KV accounting and budget-trimmed rounds exist for. 0 keeps
+    # the historical fixed-shape behavior.
+    plens = [
+        rng.randint(max(1, int(prompt_len * (1 - jitter))), prompt_len)
+        if jitter else prompt_len
         for _ in range(requests)
+    ]
+    budgets = [
+        rng.randint(max(1, int(max_tokens * (1 - jitter))), max_tokens)
+        if jitter else max_tokens
+        for _ in range(requests)
+    ]
+    prompts = [
+        [rng.randrange(1, vocab) for _ in range(plens[i])]
+        for i in range(requests)
     ]
     lat: List[float] = []
     ttfts: List[float] = []
@@ -176,6 +213,12 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     outcomes = {k: 0 for k in OUTCOMES}
     status_counts: dict = {}
     tokens = [0]
+    # per-tenant ledgers (tenant name → list); populated only when a
+    # tenant mix is configured
+    t_lat: dict = {}
+    t_ttft: dict = {}
+    t_tpot: dict = {}
+    t_outcomes: dict = {}
     lock = named_lock("loadgen.results")
     it = iter(range(requests))
 
@@ -186,25 +229,38 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
             if i is None:
                 return
             dt, ttft, toks, err, code = _one_request(
-                url, prompts[i], max_tokens, stream, timeout,
+                url, prompts[i], budgets[i], stream, timeout,
                 adapter=adapters[i % len(adapters)] if adapters else "",
                 trace_id=f"lg-{seed}-{run_id}-{i}",
+                tenant=tenant_of[i],
             )
             with lock:
                 outcomes[_classify(err, code)] += 1
                 key = str(code) if code is not None else "none"
                 status_counts[key] = status_counts.get(key, 0) + 1
+                t = tenant_of[i]
+                if t:
+                    t_outcomes.setdefault(t, {k: 0 for k in OUTCOMES})
+                    t_outcomes[t][_classify(err, code)] += 1
                 if err is None:
                     lat.append(dt)
                     tokens[0] += toks
+                    if t:
+                        t_lat.setdefault(t, []).append(dt)
                     if ttft is not None:
                         ttfts.append(ttft)
+                        if t:
+                            t_ttft.setdefault(t, []).append(ttft)
                         if toks > 1:
                             # the client-observed mean inter-token gap
                             # over the decode phase — the number the
                             # server-side TPOT histogram must reconcile
                             # with (chaos tier cross-check)
                             tpots.append((dt - ttft) / (toks - 1))
+                            if t:
+                                t_tpot.setdefault(t, []).append(
+                                    (dt - ttft) / (toks - 1)
+                                )
                 else:
                     errors.append(err)
 
@@ -238,6 +294,51 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     }
     if adapters:
         out["adapters"] = list(adapters)
+    if tenants:
+        per_tenant = {}
+        for name in sorted(tenants):
+            spec = tenants[name]
+            oks = t_lat.get(name, [])
+            ttl = t_ttft.get(name, [])
+            tpl = t_tpot.get(name, [])
+            entry = {
+                "class": spec.tenant_class,
+                "weight": spec.weight,
+                "requests": sum(
+                    t_outcomes.get(name, {}).values()
+                ),
+                "ok": len(oks),
+                "outcomes": t_outcomes.get(
+                    name, {k: 0 for k in OUTCOMES}
+                ),
+                "latency_p50": round(_percentile(oks, 0.5), 4),
+                "latency_p95": round(_percentile(oks, 0.95), 4),
+                "latency_p99": round(_percentile(oks, 0.99), 4),
+                "ttft_p50": round(_percentile(ttl, 0.5), 4),
+                "ttft_p95": round(_percentile(ttl, 0.95), 4),
+                "ttft_p99": round(_percentile(ttl, 0.99), 4),
+                "tpot_p50": round(_percentile(tpl, 0.5), 5),
+                "tpot_p95": round(_percentile(tpl, 0.95), 5),
+                "tpot_p99": round(_percentile(tpl, 0.99), 5),
+            }
+            if spec.ttft_slo > 0:
+                # attainment over ok requests: TTFT when measured
+                # (streaming), else total latency — the conservative
+                # stand-in (latency >= ttft always)
+                measured = ttl if stream else oks
+                entry["ttft_slo"] = spec.ttft_slo
+                entry["slo_attainment"] = round(
+                    sum(1 for x in measured if x <= spec.ttft_slo)
+                    / len(measured), 4
+                ) if measured else 0.0
+            if spec.tpot_slo > 0:
+                entry["tpot_slo"] = spec.tpot_slo
+                entry["tpot_attainment"] = round(
+                    sum(1 for x in tpl if x <= spec.tpot_slo)
+                    / len(tpl), 4
+                ) if tpl else 0.0
+            per_tenant[name] = entry
+        out["tenants"] = per_tenant
     if stream:
         out["ttft_p50"] = round(_percentile(ttfts, 0.5), 4)
         out["ttft_p95"] = round(_percentile(ttfts, 0.95), 4)
@@ -273,6 +374,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "assigned round-robin across requests (an "
                          "empty entry rides the base model, e.g. "
                          "',billing,support')")
+    ap.add_argument("--jitter", type=float, default=0.0,
+                    help="mixed sequence lengths: each request draws "
+                         "prompt-len and max-tokens from "
+                         "[x*(1-jitter), x] (seeded); 0 = fixed shapes")
+    ap.add_argument("--tenants", default="",
+                    help="multi-tenant scenario: comma-separated "
+                         "name:weight:class[:ttft_slo[:tpot_slo]] — "
+                         "the SAME grammar tpuslice-serve --tenants "
+                         "takes. Requests draw a tenant by weight "
+                         "(seeded) and send it via X-Tenant; the "
+                         "report gains per-tenant TTFT/TPOT p50/p95/"
+                         "p99 and an SLO-attainment fraction")
     ap.add_argument("--sweep", default="",
                     help="comma-separated concurrency levels (e.g. "
                          "'1,2,4,8'): run --requests at EACH level and "
@@ -285,6 +398,17 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     adapters = ([a.strip() for a in args.adapters.split(",")]
                 if args.adapters else [])
+    if args.tenants:
+        from instaslice_tpu.serving.scheduler import parse_tenant_specs
+
+        try:
+            tenants = parse_tenant_specs(args.tenants)
+        except ValueError as e:
+            # scripted callers parse stdout JSON — never a traceback
+            print(json.dumps({"error": f"bad --tenants: {e}"}))
+            return 1
+    else:
+        tenants = None
     if args.sweep:
         try:
             levels = [int(x) for x in args.sweep.split(",")
@@ -299,7 +423,8 @@ def main(argv=None) -> int:
         for c in levels:
             r = run(args.url, args.requests, c, args.prompt_len,
                     args.max_tokens, args.vocab, args.stream,
-                    args.timeout, seed=args.seed, adapters=adapters)
+                    args.timeout, seed=args.seed, adapters=adapters,
+                    tenants=tenants, jitter=args.jitter)
             curve.append(r)
         errors = sum(r["errors"] for r in curve)
         hung = sum(r["outcomes"]["hung"] for r in curve)
@@ -322,7 +447,7 @@ def main(argv=None) -> int:
     out = run(args.url, args.requests, args.concurrency,
               args.prompt_len, args.max_tokens, args.vocab,
               args.stream, args.timeout, seed=args.seed,
-              adapters=adapters)
+              adapters=adapters, tenants=tenants, jitter=args.jitter)
     print(json.dumps(out))
     return 2 if out["outcomes"]["hung"] else (1 if out["errors"] else 0)
 

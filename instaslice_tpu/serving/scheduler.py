@@ -1,0 +1,1279 @@
+"""Continuous-batching, tenant-aware serving scheduler.
+
+The serving plane's decision loop, split out of ``api_server.py`` (the
+HTTP front-end keeps parsing/transport; this module owns everything
+between "request submitted" and "result delivered"). It replaces the
+old fixed decode rounds with per-step scheduling in the sense the
+MIG-serving reconfigurable-scheduling paper (arXiv:2109.11067) frames:
+*which requests run each step*, not just which slice they land on.
+
+What it decides, every round:
+
+- **Admission** is priority-ordered, not FIFO: requests carry a tenant
+  (``X-Tenant`` header / ``tenant`` field), tenants map to priority
+  classes (``latency`` > ``standard`` > ``best-effort``) with weighted
+  fair-share inside a class (start-time virtual clock: admitting a
+  request advances its tenant's virtual time by ``max_tokens/weight``,
+  and the lowest virtual time goes first — a heavy tenant cannot starve
+  a light one, a weighted tenant gets its share). Admission gates on
+  free *KV blocks* as well as free slots (``ServingEngine.can_admit``),
+  so parked and pinned blocks push back on new work.
+- **Decode rounds are right-sized**: bounded by the smallest remaining
+  budget among live requests (a finished request's slot — and blocks —
+  are reusable on the very next step) and shortened while requests
+  wait, so admission latency is a few steps, not a full block.
+  ``mode="fixed"`` reconstructs classic static batching (FIFO with
+  head-of-line blocking, full ``block_size`` rounds regardless of
+  budgets — ROADMAP item 3's "fixed decode rounds") as the measured
+  baseline for ``bench.py --serving``. NB the loop this module
+  replaced already trimmed rounds to the smallest budget; fixed mode
+  isolates what full fixed rounds cost, it is not a byte-for-byte
+  replay of the old scheduler.
+- **SLO-aware preemption**: when a latency-class request has waited
+  past ``preempt_margin`` of its TTFT target and no slot is free, the
+  newest lowest-class live request is *parked* —
+  ``ServingEngine.preempt_slot`` reads its KV stripe out beside its
+  block table, so resuming (``resume_request``) is one stripe write,
+  never a re-prefill. Parked state holds its blocks; under block
+  pressure the scheduler sheds parked best-effort requests (clean 503)
+  — eviction frees blocks, not stripes.
+- **Per-adapter LoRA grouping**: among equally-ranked admission
+  candidates, requests whose adapter matches one already decoding are
+  preferred, concentrating each decode step on fewer adapters (the
+  measured multi-adapter overhead is the per-row one-hot gather over
+  the full adapter stack; fewer distinct adapters per step is the
+  schedulable half of that cost).
+
+Every decision is journaled (``RequestPreempted`` / ``RequestResumed``
+/ ``SLOMissed``) under the request's trace id, and per-tenant-class
+TTFT/TPOT histograms feed SLO attainment (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from instaslice_tpu.api.constants import (
+    REASON_DRAIN_BEGIN,
+    REASON_DRAIN_END,
+    REASON_DRAINED,
+    REASON_PREEMPTED,
+    REASON_RESUMED,
+    REASON_SHED,
+    REASON_SLO_MISSED,
+)
+from instaslice_tpu.obs.journal import get_journal
+from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+from instaslice_tpu.utils.lockcheck import named_lock
+from instaslice_tpu.utils.trace import get_tracer, new_span_id
+
+log = logging.getLogger("instaslice_tpu.serving.scheduler")
+
+#: priority classes, best first. Admission and preemption order by
+#: rank; unknown class names rank as "standard".
+CLASS_RANK = {"latency": 0, "standard": 1, "best-effort": 2}
+
+
+def class_rank(name: str) -> int:
+    return CLASS_RANK.get(name, CLASS_RANK["standard"])
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's scheduling contract: fair-share ``weight`` inside
+    its class, and optional TTFT/TPOT SLO targets in seconds (0 = no
+    target — nothing to miss, nothing to preempt for)."""
+
+    name: str
+    weight: float = 1.0
+    tenant_class: str = "standard"
+    ttft_slo: float = 0.0
+    tpot_slo: float = 0.0
+
+
+#: what an unknown (or absent) tenant gets
+DEFAULT_SPEC = TenantSpec(name="", weight=1.0, tenant_class="standard")
+
+
+def parse_tenant_specs(spec: str) -> Dict[str, TenantSpec]:
+    """``name:weight:class[:ttft_slo[:tpot_slo]]``, comma-separated —
+    the ONE tenant grammar, shared by the server (``--tenants`` /
+    ``TPUSLICE_TENANTS``) and loadgen's traffic generator so a bench
+    scenario and the policy it runs against cannot drift.
+
+    >>> parse_tenant_specs("gold:4:latency:0.5,free:1:best-effort")
+    """
+    out: Dict[str, TenantSpec] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if not fields[0]:
+            raise ValueError(f"tenant spec {part!r}: empty name")
+        name = fields[0]
+        try:
+            weight = float(fields[1]) if len(fields) > 1 and fields[1] \
+                else 1.0
+            ttft = float(fields[3]) if len(fields) > 3 and fields[3] \
+                else 0.0
+            tpot = float(fields[4]) if len(fields) > 4 and fields[4] \
+                else 0.0
+        except ValueError:
+            raise ValueError(
+                f"tenant spec {part!r}: weight/slo must be numbers "
+                "(name:weight:class[:ttft_slo[:tpot_slo]])"
+            ) from None
+        cls = fields[2] if len(fields) > 2 and fields[2] else "standard"
+        if cls not in CLASS_RANK:
+            raise ValueError(
+                f"tenant spec {part!r}: class {cls!r} not one of "
+                f"{sorted(CLASS_RANK)}"
+            )
+        if weight <= 0:
+            raise ValueError(f"tenant spec {part!r}: weight must be > 0")
+        if name in out:
+            raise ValueError(f"tenant {name!r} given twice")
+        out[name] = TenantSpec(name, weight, cls, ttft, tpot)
+    return out
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity: the request was shed (HTTP 429 with
+    Retry-After) instead of joining a line it would only time out in."""
+
+    def __init__(self, retry_after: float = 1.0):
+        super().__init__("admission queue full")
+        self.retry_after = retry_after
+
+
+class Draining(Exception):
+    """The server is draining (SIGTERM / POST /v1/drain): no new
+    admissions; clients get a clean 503 and should hit another replica."""
+
+
+class Pending:
+    def __init__(self, prompt: List[int], max_tokens: int,
+                 prefix_op: str = "", stream: bool = False,
+                 stop: Optional[List[List[int]]] = None,
+                 want_logprobs: bool = False, n: int = 1,
+                 adapter: int = 0, trace_id: str = "",
+                 tenant: str = ""):
+        self.prompt = prompt
+        self.max_tokens = max_tokens
+        #: the request's trace id (minted/accepted at HTTP admission);
+        #: every span of this request's lifecycle carries it, and the
+        #: root ``serve.request`` span uses ``span_id`` so children
+        #: recorded earlier parent correctly
+        self.trace_id = trace_id
+        self.span_id = new_span_id() if trace_id else ""
+        #: set when the engine samples this request's first token
+        #: (admission prefill) — TTFT = first_token_at - t0
+        self.first_token_at: Optional[float] = None
+        self.stop = stop or []         # normalized token-id sequences
+        self.want_logprobs = want_logprobs
+        self.n = n                     # parallel samples (OpenAI "n")
+        self.adapter = adapter         # LoRA adapter id (0 = base)
+        #: tenant name from the X-Tenant header / "tenant" field; the
+        #: scheduler binds the policy spec (class/weight/SLOs) at submit
+        self.tenant = tenant
+        self.spec: TenantSpec = DEFAULT_SPEC
+        #: submit-order sequence number (FIFO tiebreak), stamped by the
+        #: scheduler at submit
+        self.seq = 0
+        self.preemptions = 0           # times this request was parked
+        # "register"/"drop" → not a completion: mutate the engine's
+        # prefix cache on the scheduler thread (the engine owner)
+        self.prefix_op = prefix_op
+        self.done = threading.Event()
+        self.rid_index: Dict[int, int] = {}    # engine rid → choice idx
+        self.results: Dict[int, GenerationResult] = {}  # choice idx → r
+        self.error: str = ""
+        #: shed-specific Retry-After override (seconds); None = the
+        #: handler's default (drain budget) — pressure sheds hint ONE
+        #: decode round instead
+        self.retry_after: Optional[float] = None
+        # load-shedding/drain disposition ("" = normal): "drain" — was
+        # queued when the drain started; "evicted" — in flight past the
+        # drain budget (or parked state shed under KV-block pressure).
+        # Either way the client gets a clean 503 and the metrics outcome
+        # is "drained", never "error"/"ok".
+        self.shed: str = ""
+        self.timed_out = False        # set by the HTTP layer on 503,
+        #                               or on a broken streaming socket
+        # serializes the timeout decision against completion: the HTTP
+        # thread may only flag timed_out while done is still unset (via
+        # flag_timeout), and the scheduler decides the metrics outcome +
+        # sets done under the same lock — so a request can never be
+        # 503'd AND counted ok
+        self.lock = named_lock("serve.pending")
+        self.server_fault = False     # engine-side failure (HTTP 500),
+        #                               vs a client mistake (HTTP 400)
+        self.t0 = time.monotonic()
+        self.t0_wall = time.time()    # span start timestamps
+        # streaming: the scheduler pushes dict events after every decode
+        # block ({"kind": "delta"/"final", "index": choice, ...}); a str
+        # is a pre-admission error. ``sent`` tracks per-rid delivery.
+        self.stream_q: Optional["queue.Queue"] = (
+            queue.Queue() if stream else None
+        )
+        self.sent: Dict[int, int] = {}
+
+    def flag_timeout(self) -> None:
+        """Mark this request timed out / abandoned — unless it already
+        completed, in which case the scheduler's ok-count stands and
+        the flag stays clear. Every timeout writer (sync wait expiry,
+        broken streaming socket) must come through here."""
+        with self.lock:
+            if not self.done.is_set():
+                self.timed_out = True
+
+    @property
+    def result(self) -> Optional[GenerationResult]:
+        """First choice (the n == 1 common case)."""
+        return self.results.get(0)
+
+
+class Scheduler(threading.Thread):
+    """Owns the engine: admission, block decode, budgets, preemption,
+    delivery.
+
+    Also the serving plane's profiler: it owns every timestamp a
+    request's latency decomposes into (queue wait, prefill, decode
+    rounds, delivery), so TTFT/TPOT histograms (global and per tenant
+    class), the per-round step-time and occupancy gauges, the KV-block
+    gauges, and the per-request trace spans are all emitted from here.
+
+    ``mode``: ``"continuous"`` (default) enables priority/fair-share
+    admission, budget-trimmed rounds, and SLO preemption;
+    ``"fixed"`` is the classic static-batching baseline the bench
+    measures against (FIFO + head-of-line blocking, full-block rounds
+    decoded past every budget — see the module docstring for how it
+    relates to the loop this class replaced).
+    """
+
+    #: Retry-After hint on a 429 shed: one block decode is the natural
+    #: re-try grain — by then the queue has moved
+    shed_retry_after = 1.0
+
+    def __init__(self, engine: ServingEngine, block_size: int = 16,
+                 metrics=None, max_queue: int = 0,
+                 drain_budget: float = 30.0, fault_hook=None,
+                 tenants=None, mode: Optional[str] = None,
+                 preempt_margin: float = 0.5):
+        super().__init__(name="serve-scheduler", daemon=True)
+        self.engine = engine
+        self.block_size = block_size
+        self.queue: "queue.Queue[Pending]" = queue.Queue()
+        self.stop_flag = threading.Event()
+        self._by_rid: Dict[int, Pending] = {}
+        self._budget: Dict[int, int] = {}
+        #: submitted-but-unadmitted requests, in arrival order; the
+        #: admission pass reorders by (class, fair-share) each round —
+        #: there is no FIFO head-of-line parking in continuous mode
+        self._ready: List[Pending] = []
+        #: preempted requests: engine rid → Pending (their engine-side
+        #: state is parked in ``engine.parked`` under the same rid)
+        self._parked: Dict[int, Pending] = {}
+        if mode is None:
+            mode = os.environ.get("TPUSLICE_SCHED_MODE", "continuous")
+        if mode not in ("continuous", "fixed"):
+            raise ValueError(
+                f"mode must be 'continuous' or 'fixed', got {mode!r}"
+            )
+        self.mode = mode
+        if tenants is None:
+            tenants = os.environ.get("TPUSLICE_TENANTS", "")
+        self.tenants: Dict[str, TenantSpec] = (
+            parse_tenant_specs(tenants) if isinstance(tenants, str)
+            else dict(tenants or {})
+        )
+        self.preempt_margin = preempt_margin
+        #: per-tenant virtual time (weighted fair share inside a class)
+        self._vtime: Dict[str, float] = {}
+        self._vclock = 0.0
+        self._seq = 0
+        self.preempted = 0            # scheduler-side ledger (journal +
+        self.resumed = 0              # metrics reconcile against these)
+        self.parked_shed = 0
+        self.slo_misses = 0
+        #: admission bound (0 = unbounded): past it, submit() sheds with
+        #: 429 instead of queueing a request that would 503 at timeout.
+        #: The lock makes bound-check + enqueue atomic across the HTTP
+        #: threads (one per request): without it, C concurrent
+        #: submitters could all pass the check and overshoot by C-1.
+        self.max_queue = max_queue
+        self._submit_lock = named_lock("serve.submit")
+        self.drain_budget = drain_budget
+        #: flipped by drain()/undrain(); while set, /readyz is 503, no
+        #: admissions, queued requests shed, in-flight finish until the
+        #: deadline then evict
+        self.draining = threading.Event()
+        self.drain_deadline = 0.0
+        #: set once a drain has fully quiesced (no queue, no in-flight)
+        self.drained = threading.Event()
+        #: faults.scheduler_fault_hook seam: consulted once per loop
+        #: round inside the round guard — an injected raise must never
+        #: kill the serving thread
+        self.fault_hook = fault_hook
+        if metrics is None:
+            from instaslice_tpu.metrics.metrics import ServingMetrics
+
+            metrics = ServingMetrics()
+        self.metrics = metrics
+
+    @property
+    def _head(self) -> Optional[Pending]:
+        """The oldest unadmitted request (diagnostics + the bounded-
+        queue tests' visibility hook; admission itself no longer parks
+        a head-of-line request)."""
+        return self._ready[0] if self._ready else None
+
+    def _bind_tenant(self, pending: Pending) -> None:
+        spec = self.tenants.get(pending.tenant)
+        if spec is None:
+            # unknown tenants get the default class at weight 1 — a
+            # tenant header is routing metadata, never a 400
+            spec = DEFAULT_SPEC if not pending.tenant else TenantSpec(
+                name=pending.tenant
+            )
+        pending.spec = spec
+
+    def submit(self, pending: Pending) -> None:
+        """Admit into the scheduler queue, or shed: :class:`Draining`
+        while a drain is on (503), :class:`QueueFull` past the
+        admission bound (429 + Retry-After). Shed requests are counted
+        here — exactly one metrics outcome per request, always."""
+        # prefix-cache mutations are not completions: they never enter
+        # the outcome ledger (here or in _maybe_complete), so the
+        # requests_total counters reconcile against completion traffic
+        is_completion = not pending.prefix_op
+        self._bind_tenant(pending)
+        if self.draining.is_set():
+            if is_completion:
+                self.metrics.requests.labels(outcome="drained").inc()
+                # one journal event per drained completion: the journal's
+                # RequestDrained count reconciles EXACTLY with the
+                # metrics outcome ledger (tests/test_serving_chaos.py)
+                get_journal().emit(
+                    "serving", reason=REASON_DRAINED,
+                    message="rejected at admission: server draining (503)",
+                    trace_id=pending.trace_id,
+                )
+            raise Draining("server draining")
+        shed = False
+        with self._submit_lock:
+            if self.max_queue > 0 and (
+                self.queue.qsize() + len(self._ready) >= self.max_queue
+            ):
+                shed = True
+            else:
+                pending.seq = self._seq = self._seq + 1
+                self.queue.put(pending)
+        if shed:
+            # count + journal AFTER releasing the admission lock: the
+            # journal's JSONL write is disk I/O, and overload (when
+            # shedding fires) is exactly when submitters must not
+            # serialize behind it
+            if is_completion:
+                self.metrics.requests.labels(outcome="shed").inc()
+                get_journal().emit(
+                    "serving", reason=REASON_SHED,
+                    message=(f"admission queue full "
+                             f"(max_queue={self.max_queue}): "
+                             "shed with 429"),
+                    trace_id=pending.trace_id,
+                )
+            raise QueueFull(self.shed_retry_after)
+
+    # ------------------------------------------------------------ drain
+
+    def drain(self, budget: Optional[float] = None) -> None:
+        """Stop admission, flip readiness, let in-flight requests
+        finish for ``budget`` seconds (default ``drain_budget``), then
+        evict the rest with a clean 503. Idempotent; ``drained`` is set
+        once fully quiesced."""
+        budget_s = self.drain_budget if budget is None else budget
+        with self._submit_lock:
+            # check-and-set AND emit under the lock: SIGTERM and
+            # POST /v1/drain arriving together must journal ONE
+            # DrainBegin, and a racing undrain() must not invert the
+            # Begin/End order (these two events are rare — unlike the
+            # hot shed path, lock-held I/O is fine here)
+            self.drain_deadline = time.monotonic() + budget_s
+            self.drained.clear()
+            already = self.draining.is_set()
+            self.draining.set()
+            if not already:
+                get_journal().emit(
+                    "serving", reason=REASON_DRAIN_BEGIN,
+                    message=(f"drain started: admission stopped, "
+                             f"in-flight requests get {budget_s:.1f}s"),
+                )
+        self.metrics.draining.set(1)
+
+    def undrain(self) -> None:
+        """Resume admission after a drain (rolling-restart aborted,
+        readiness restored)."""
+        with self._submit_lock:
+            was_draining = self.draining.is_set()
+            self.draining.clear()
+            self.drained.clear()
+            if was_draining:
+                get_journal().emit(
+                    "serving", reason=REASON_DRAIN_END,
+                    message="drain cancelled: admission resumed",
+                )
+        self.metrics.draining.set(0)
+
+    def _fail_shed(self, p: Pending, shed: str, msg: str,
+                   retry_after: Optional[float] = None) -> None:
+        p.shed = shed
+        p.retry_after = retry_after
+        p.error = p.error or msg
+        if p.stream_q is not None:
+            p.stream_q.put(p.error)
+        self._maybe_complete(p)
+
+    def _shed_queued(self) -> None:
+        """Draining: everything still queued gets its terminal 503 NOW
+        — a queued request can only get worse by waiting out the drain."""
+        self._pump()
+        ready, self._ready = self._ready, []
+        for p in ready:
+            self._fail_shed(p, "drain",
+                            "server draining: request not admitted")
+
+    def _evict_for_drain(self) -> None:
+        """Drain budget exhausted: in-flight requests — live slots AND
+        parked preemptees — are evicted with a clean 503 (their tokens
+        were never delivered)."""
+        eng = self.engine
+        for slot, req in list(eng.slots.items()):
+            p = self._by_rid.pop(req.request_id, None)
+            self._budget.pop(req.request_id, None)
+            if p is None:
+                continue
+            eng.evict_slot(slot)
+            self._fail_shed(p, "evicted",
+                            "evicted: drain budget exceeded")
+        for rid, p in list(self._parked.items()):
+            self._drop_parked(rid, p, "evicted: drain budget exceeded")
+
+    def _drop_parked(self, rid: int, p: Pending, msg: str) -> None:
+        """Shed one parked request (drain eviction or KV pressure):
+        blocks free NOW, client gets a clean 503."""
+        self.engine.drop_parked(rid)
+        self._parked.pop(rid, None)
+        self._by_rid.pop(rid, None)
+        self._budget.pop(rid, None)
+        self.parked_shed += 1
+        # NOT a drain: the eviction just freed blocks, so the right
+        # client back-off is one decode round, not the drain budget
+        self._fail_shed(p, "evicted", msg,
+                        retry_after=self.shed_retry_after)
+
+    # ------------------------------------------------------------- loop
+
+    def run(self) -> None:
+        while not self.stop_flag.is_set():
+            try:
+                self._round()
+            except Exception as e:  # noqa: BLE001 - keep serving
+                # one bad round (injected fault, transient device error
+                # outside the decode guard) must never kill the
+                # scheduler thread — recover poisoned state, carry on
+                log.exception("scheduler round failed: %s", e)
+                if self.engine.cache_poisoned():
+                    self._recover_engine(e)
+
+    def _pump(self) -> None:
+        """Move newly-submitted requests from the handoff queue into
+        the admission list (under the submit lock so the bound check in
+        :meth:`submit` counts exactly one population)."""
+        with self._submit_lock:
+            while True:
+                try:
+                    self._ready.append(self.queue.get_nowait())
+                except queue.Empty:
+                    return
+
+    def _round(self) -> None:
+        eng = self.engine
+        if self.fault_hook is not None:
+            self.fault_hook()   # may raise (injected); run() recovers
+        if self.draining.is_set():
+            # no admission; shed the queue, enforce the drain budget.
+            # Parked preemptees are IN-FLIGHT work: the drain budget is
+            # theirs too, so resume them into freeing slots instead of
+            # letting resumable KV sit until the deadline 503
+            self._shed_queued()
+            if self.mode == "continuous":
+                self._resume_parked()
+            if time.monotonic() >= self.drain_deadline:
+                self._evict_for_drain()
+            if not self._by_rid:
+                self.drained.set()
+        else:
+            self._pump()
+            self._sweep_timeouts()
+            if self.mode == "continuous":
+                self._resume_parked()
+                self._relieve_block_pressure()
+                self._maybe_preempt()
+            self._admit()
+        # evict abandoned requests: the HTTP layer already 503'd the
+        # client, so decoding the slot to its budget would burn
+        # batch capacity producing tokens nobody reads
+        for slot, req in list(eng.slots.items()):
+            p = self._by_rid.get(req.request_id)
+            if p is not None and p.timed_out:
+                eng.evict_slot(slot)
+                self._by_rid.pop(req.request_id, None)
+                self._budget.pop(req.request_id, None)
+                self._maybe_complete(p)
+        for rid, p in list(self._parked.items()):
+            if p.timed_out:
+                self._drop_parked(rid, p, "timed out while parked")
+        # budget enforcement BEFORE decoding (add_request already
+        # produced one token, so a max_tokens=1 arrival is done on
+        # admission — decoding first would waste a batch-wide step
+        # whose tokens get truncated away; same ordering rationale
+        # as ServingEngine.generate())
+        for slot, req in list(eng.slots.items()):
+            b = self._budget.get(req.request_id)
+            if b is not None and len(req.generated) >= b:
+                eng.finish_slot(slot, n_keep=b)
+        self._deliver()
+        self._export_kv_gauges()
+        if not eng.slots:
+            self.stop_flag.wait(0.005)
+            return
+        n = self._select_steps()
+        phase = "spec" if eng.draft_model is not None else "decode"
+        round_rids = [r.request_id for r in eng.slots.values()]
+        self._ensure_block_headroom(
+            eng.spec_k + 1 if eng.draft_model is not None else max(1, n)
+        )
+        t_step = time.monotonic()
+        try:
+            if eng.draft_model is not None:
+                eng.spec_step()
+            elif n >= 1:
+                eng.decode_block(n)
+            else:
+                eng.step()
+        except Exception as e:  # noqa: BLE001 - recover, keep serving
+            log.exception("decode failed: %s", e)
+            if eng.cache_poisoned():
+                # the failed call consumed its donated cache buffer:
+                # carrying on would raise "Array has been deleted"
+                # on every later decode — reset the device state,
+                # fail the in-flight requests, keep serving
+                self._recover_engine(e)
+        finally:
+            self._observe_round(
+                phase, time.monotonic() - t_step, n, round_rids
+            )
+        self._deliver()
+
+    def _select_steps(self) -> int:
+        """This round's decode-block length. Continuous: trimmed to the
+        smallest remaining budget (the freed slot readmits at the very
+        next boundary) and shortened while requests wait so admission
+        latency is a few steps. Fixed (the bench baseline): always the
+        full block — requests that finish mid-round hold their slot to
+        the round's end, which is exactly the waste continuous batching
+        removes."""
+        eng = self.engine
+        n = self.block_size
+        if self.mode == "continuous":
+            owned = [
+                r for r in eng.slots.values()
+                if r.request_id in self._budget
+            ]
+            if owned:
+                # at-budget slots were just removed: remaining >= 1
+                n = min(n, min(
+                    self._budget[r.request_id] - len(r.generated)
+                    for r in owned
+                ))
+            # shorten rounds only when someone LATENCY-sensitive is
+            # waiting (a queued latency-class request or a parked
+            # preemptee): their TTFT is bounded by the round length.
+            # A best-effort backlog keeps full blocks — shrinking for
+            # it would trade fleet throughput for latency nobody asked
+            # for.
+            if self._parked or any(
+                not p.prefix_op
+                and class_rank(p.spec.tenant_class)
+                == CLASS_RANK["latency"]
+                for p in self._ready
+            ):
+                n = min(n, max(1, self.block_size // 4))
+        worst = max(
+            len(r.prompt) + len(r.generated)
+            for r in eng.slots.values()
+        )
+        n = min(n, eng.max_len - 2 - worst)
+        # round DOWN to a power of two LAST (after the cache-headroom
+        # clamp, or a slot nearing max_len would reintroduce arbitrary
+        # step counts): each distinct n_steps is a separate compiled
+        # scan, and budget-trimmed blocks would otherwise touch every
+        # value in [1, block_size] — a bounded {1,2,4,8,...} set keeps
+        # the compile cache warm while still never overshooting
+        if self.mode == "continuous" and n > 1:
+            n = 1 << (n.bit_length() - 1)
+        return n
+
+    def _ensure_block_headroom(self, n_steps: int) -> None:
+        """Guarantee the pool covers this round's table growth: shed
+        parked requests (newest, lowest class first) until the worst-
+        case growth fits. Live tables alone can never exceed the pool
+        (each slot is bounded by its row) — only parked state
+        over-subscribes, and it is exactly the state with the weakest
+        claim on the blocks."""
+        eng = self.engine
+        need = 0
+        for req in eng.slots.values():
+            t = eng._tables.get(req.request_id)
+            if t is None:
+                continue
+            after = len(req.prompt) + len(req.generated) + n_steps
+            # THE cost model is ensure()'s own (growth blocks + a
+            # boundary copy-on-write only when genuinely shared) — a
+            # hand-copied condition here would drift and either shed
+            # parked clients needlessly or let ensure() raise mid-round
+            need += eng.kv.growth_cost(t, after)
+        if need <= eng.kv.free_blocks():
+            return
+        for rid, p in sorted(
+            self._parked.items(),
+            key=lambda kv: (class_rank(kv[1].spec.tenant_class),
+                            kv[1].t0),
+            reverse=True,
+        ):
+            if need <= eng.kv.free_blocks():
+                return
+            self._drop_parked(
+                rid, p,
+                "evicted: kv block pressure while parked",
+            )
+
+    def _observe_round(self, phase: str, dt: float, n_steps: int,
+                       rids: List[int]) -> None:
+        """Profiler output for one engine dispatch: step-time histogram,
+        prefill-vs-decode time split, and one ``serve.decode_round``
+        span per participating request — every trace shows which rounds
+        its tokens came from and what each cost."""
+        self.metrics.step_seconds.labels(phase=phase).observe(dt)
+        self.metrics.phase_seconds.labels(phase=phase).inc(dt)
+        tracer = get_tracer()
+        start = time.time() - dt
+        seen = set()
+        for rid in rids:
+            p = self._by_rid.get(rid)
+            if p is None or not p.trace_id or id(p) in seen:
+                continue  # untraced (prefix op) or n>1 fork already done
+            seen.add(id(p))
+            tracer.record(
+                "serve.decode_round", dt * 1e3, trace_id=p.trace_id,
+                parent_id=p.span_id, start=start, phase=phase,
+                n_steps=n_steps, batch=len(rids),
+            )
+
+    def _record_request_span(self, p: Pending, outcome: str) -> None:
+        """The request's ROOT span, recorded at its terminal moment
+        (assembled here rather than held open: the lifecycle crosses
+        the HTTP and scheduler threads). Shed/timeout/drain requests
+        get one too — a 429 must be traceable, not just counted."""
+        if not p.trace_id:
+            return
+        get_tracer().record(
+            "serve.request", (time.monotonic() - p.t0) * 1e3,
+            trace_id=p.trace_id, span_id=p.span_id, start=p.t0_wall,
+            error=p.error if outcome == "error" else "",
+            outcome=outcome,
+            tokens=sum(len(r.tokens) for r in p.results.values()),
+        )
+
+    # -------------------------------------------------------- admission
+
+    def _sweep_timeouts(self) -> None:
+        """Unadmitted requests past their HTTP deadline leave the
+        admission list with the full ledger treatment — outcome counter
+        AND latency observation (the slowest requests must not vanish
+        from the histogram) AND root span; prefix ops stay out of the
+        completion ledger like everywhere else."""
+        keep: List[Pending] = []
+        for p in self._ready:
+            if not p.timed_out:
+                keep.append(p)
+                continue
+            if not p.prefix_op:
+                self.metrics.requests.labels(outcome="timeout").inc()
+                from instaslice_tpu.metrics.metrics import (
+                    observe_with_exemplar,
+                )
+
+                observe_with_exemplar(
+                    self.metrics.request_seconds,
+                    time.monotonic() - p.t0,
+                    trace_id=p.trace_id,
+                )
+                self._record_request_span(p, "timeout")
+            p.done.set()
+        self._ready = keep
+
+    def _live_adapters(self) -> set:
+        eng = self.engine
+        return {
+            eng._slot_adapter_host.get(s, 0) for s in eng.slots
+        }
+
+    def _admission_order(self) -> List[Pending]:
+        """Continuous: (class rank, tenant virtual time, adapter
+        affinity, arrival) — weighted fair share inside each priority
+        class, with a bias toward adapters already decoding so each
+        step runs fewer distinct LoRA deltas. Fixed: pure arrival
+        order (the FIFO baseline). Prefix ops sort first either way —
+        they are cheap engine mutations, not batch work."""
+        if self.mode == "fixed":
+            return sorted(self._ready,
+                          key=lambda p: (0 if p.prefix_op else 1, p.seq))
+        live = self._live_adapters()
+        return sorted(
+            self._ready,
+            key=lambda p: (
+                -1 if p.prefix_op else class_rank(p.spec.tenant_class),
+                self._vtime.get(self._vtime_key(p), 0.0),
+                0 if (p.adapter in live or not live) else 1,
+                p.seq,
+            ),
+        )
+
+    def _vtime_key(self, p: Pending) -> str:
+        """Configured tenants get their own virtual clock; every
+        unknown tenant shares one — X-Tenant is untrusted input, and a
+        client cycling fresh names per request must not grow the dict
+        (or dodge fair share) forever."""
+        return p.tenant if p.tenant in self.tenants else ""
+
+    def _charge(self, p: Pending) -> None:
+        """Advance the tenant's virtual clock by the admitted work over
+        its weight — start-time weighted fair queueing, floored at the
+        global clock so an idle tenant cannot bank unbounded credit."""
+        v = max(self._vtime.get(self._vtime_key(p), 0.0), self._vclock)
+        self._vtime[self._vtime_key(p)] = v + max(
+            1, p.max_tokens
+        ) / max(p.spec.weight, 1e-6)
+        self._vclock = v
+
+    def _admit(self) -> None:
+        eng = self.engine
+        for p in self._admission_order():
+            if p.prefix_op:
+                # register needs a free slot to prefill through
+                if not eng.free_slots():
+                    if self.mode == "fixed":
+                        break
+                    continue
+                # leave _ready BEFORE the engine call: an in-flight
+                # admission no longer occupies a queue position, so
+                # the max_queue bound counts exactly the waiting set
+                # (the pre-scheduler semantics the shed tests pin)
+                self._ready.remove(p)
+                try:
+                    if p.prefix_op == "register":
+                        eng.register_prefix(p.prompt)
+                    elif not eng.drop_prefix(p.prompt):
+                        p.error = "ValueError: no such prefix"
+                except Exception as e:
+                    p.error = f"{type(e).__name__}: {e}"
+                    # surfaced to the client via p.error, but the
+                    # server log must show engine-side failures too
+                    log.warning("prefix %s failed: %s",
+                                p.prefix_op, p.error)
+                    # register_prefix prefills through donating jits
+                    if eng.cache_poisoned():
+                        p.server_fault = True
+                        self._recover_engine(e)
+                p.done.set()
+                continue
+            if not eng.can_admit(len(p.prompt), p.n):
+                # a request the engine would REJECT (prompt too long
+                # for the cache) must fail fast with its 400, not
+                # starve behind a block gate until the HTTP timeout
+                try:
+                    eng._check_prompt_fits(p.prompt)
+                except ValueError:
+                    self._ready.remove(p)
+                    self._admit_one(p)    # raises inside → 400 path
+                    continue
+                if self.mode == "fixed":
+                    break   # head-of-line blocking: the FIFO baseline
+                continue    # a smaller/later request may still fit
+            self._ready.remove(p)
+            self._admit_one(p)
+
+    def _admit_one(self, p: Pending) -> None:
+        eng = self.engine
+        tracer = get_tracer()
+        t_admit = time.monotonic()
+        if p.trace_id:
+            # queue-wait span: submit → the moment a slot freed
+            tracer.record(
+                "serve.queue", (t_admit - p.t0) * 1e3,
+                trace_id=p.trace_id, parent_id=p.span_id,
+                start=p.t0_wall,
+            )
+        try:
+            with tracer.span(
+                "serve.prefill", trace_id=p.trace_id or None,
+                parent_id=p.span_id or None,
+                tokens=len(p.prompt), n=p.n,
+            ):
+                rids = eng.add_request_n(p.prompt, p.n,
+                                         stop=p.stop,
+                                         adapter=p.adapter)
+            dt_admit = time.monotonic() - t_admit
+            p.first_token_at = time.monotonic()
+            self.metrics.step_seconds.labels(
+                phase="prefill"
+            ).observe(dt_admit)
+            self.metrics.phase_seconds.labels(
+                phase="prefill"
+            ).inc(dt_admit)
+        except Exception as e:
+            p.error = f"{type(e).__name__}: {e}"
+            # client mistakes are the client's problem (400,
+            # below); an engine-side admission failure must
+            # also land in the server log, not just the 500
+            if not isinstance(e, (ValueError, TypeError)):
+                log.warning("admission failed: %s", p.error)
+            # ValueError/TypeError = the client's prompt was
+            # bad (too long, empty, unknown adapter) → 400 +
+            # outcome "rejected". ANYTHING else (device error,
+            # injected fault, transient host failure) is the
+            # server's problem → 500 + outcome "error" — a
+            # transient engine failure must never be pinned on
+            # the client
+            client_mistake = isinstance(e, (ValueError, TypeError))
+            p.server_fault = not client_mistake
+            self.metrics.requests.labels(
+                outcome="rejected" if client_mistake else "error"
+            ).inc()
+            # admission prefills through DONATING jits: a
+            # device-side failure mid-prefill consumed the
+            # cache, and without recovery every later call
+            # would raise "Array has been deleted" forever
+            if eng.cache_poisoned():
+                self._recover_engine(e)
+            if p.stream_q is not None:
+                p.stream_q.put(p.error)
+            self._record_request_span(
+                p, "rejected" if client_mistake else "error"
+            )
+            p.done.set()
+            return
+        self._charge(p)
+        for i, rid in enumerate(rids):
+            p.rid_index[rid] = i
+            self._by_rid[rid] = p
+            self._budget[rid] = p.max_tokens
+
+    # ------------------------------------------------- preempt / resume
+
+    def _resume_parked(self) -> None:
+        """Un-park preempted requests as slots free — best class first,
+        then longest-parked. A resumed request was already admitted
+        once, so it outranks everything still in the queue."""
+        if not self._parked:
+            return
+        eng = self.engine
+        # a latency-class waiter past its preempt margin has first
+        # claim on freed slots: resuming a lower-class preemptee into
+        # one would just re-park it next round — a stripe-transfer
+        # ping-pong that serves nobody
+        waiters = self._preempt_waiters()
+        for rid, p in sorted(
+            self._parked.items(),
+            key=lambda kv: (class_rank(kv[1].spec.tenant_class),
+                            kv[1].t0),
+        ):
+            if not eng.free_slots():
+                return
+            if waiters and class_rank(p.spec.tenant_class) \
+                    > CLASS_RANK["latency"]:
+                continue
+            try:
+                eng.resume_request(rid)
+            except Exception as e:  # noqa: BLE001 - keep serving
+                # a failed resume (injected fault mid stripe-write)
+                # must not wedge the parked request forever: fail it
+                # cleanly and recover any poisoned cache
+                log.warning("resume of rid %d failed: %s", rid, e)
+                if eng.cache_poisoned():
+                    self._recover_engine(e)
+                self._drop_parked(rid, p, f"resume failed: {e}")
+                continue
+            self._parked.pop(rid, None)
+            self.resumed += 1
+            self.metrics.resumes.inc()
+            get_journal().emit(
+                "serving", reason=REASON_RESUMED,
+                message=(f"resumed after {p.preemptions} preemption(s) "
+                         f"(tenant {p.tenant or 'default'!r}, class "
+                         f"{p.spec.tenant_class})"),
+                trace_id=p.trace_id,
+            )
+            if p.trace_id:
+                get_tracer().record(
+                    "serve.resume", 0.0, trace_id=p.trace_id,
+                    parent_id=p.span_id,
+                )
+
+    def _relieve_block_pressure(self) -> None:
+        """A latency-class waiter past its preempt margin that cannot
+        admit for lack of BLOCKS (slots may well be free — this must
+        not hide behind the slot-preemption path): shed parked
+        lower-class requests, newest first, until its blocks exist.
+        Without this the waiter would livelock — parked state holds
+        the pool, resume refuses to hand it a slot, and nothing else
+        sheds parked blocks when no live slot needs growth."""
+        waiters = self._preempt_waiters()
+        if not waiters or not self._parked:
+            return
+        eng = self.engine
+        waiter = min(
+            waiters,
+            key=lambda p: (self._vtime.get(self._vtime_key(p), 0.0),
+                           p.seq),
+        )
+        need = eng.kv.blocks_for(len(waiter.prompt) + 1)
+        if eng.kv.free_blocks() >= need:
+            return
+        for rid, p in sorted(
+            self._parked.items(),
+            key=lambda kv: (class_rank(kv[1].spec.tenant_class),
+                            kv[1].t0),
+            reverse=True,
+        ):
+            if class_rank(p.spec.tenant_class) \
+                    <= class_rank(waiter.spec.tenant_class):
+                break
+            self._drop_parked(
+                rid, p,
+                "evicted: kv block pressure from a latency-class "
+                "admission",
+            )
+            if eng.kv.free_blocks() >= need:
+                return
+
+    def _preempt_waiters(self) -> List[Pending]:
+        """Latency-class completions that have waited past the preempt
+        margin of their TTFT target and still can't admit. Multi-choice
+        requests (n > 1) deliberately don't qualify: preemption frees
+        ONE slot per round, and n-way admission is all-or-nothing — an
+        n>1 latency request rides ordinary class-ordered admission and
+        forgoes preemption (documented in docs/SERVING.md)."""
+        now = time.monotonic()
+        return [
+            p for p in self._ready
+            if not p.prefix_op and not p.timed_out and p.n == 1
+            and class_rank(p.spec.tenant_class) == CLASS_RANK["latency"]
+            and p.spec.ttft_slo > 0
+            and now - p.t0 > self.preempt_margin * p.spec.ttft_slo
+        ]
+
+    def _maybe_preempt(self) -> None:
+        """SLO-aware preemption: park the newest lowest-class live
+        request so a latency-class request about to miss its TTFT
+        target gets the slot. One preemption per round — the margin
+        check re-fires next round if the pressure persists."""
+        eng = self.engine
+        waiters = self._preempt_waiters()
+        if not waiters or eng.free_slots():
+            return
+        waiter = min(
+            waiters,
+            key=lambda p: (self._vtime.get(self._vtime_key(p), 0.0),
+                           p.seq),
+        )
+        # preemption frees a SLOT, never blocks (the victim parks with
+        # its table): when the waiter is still block-starved after
+        # _relieve_block_pressure, parking someone cannot admit it
+        if eng.kv.free_blocks() < eng.kv.blocks_for(
+            len(waiter.prompt) + 1
+        ):
+            return
+        victims = [
+            (slot, vp) for slot, req in eng.slots.items()
+            for vp in (self._by_rid.get(req.request_id),)
+            if vp is not None and vp.n == 1
+            and class_rank(vp.spec.tenant_class)
+            > class_rank(waiter.spec.tenant_class)
+        ]
+        if not victims:
+            return
+        slot, vp = max(
+            victims,
+            key=lambda sv: (class_rank(sv[1].spec.tenant_class),
+                            sv[1].t0),
+        )
+        try:
+            rid = eng.preempt_slot(slot)
+        except Exception as e:  # noqa: BLE001 - keep serving
+            log.warning("preempt of slot %d failed: %s", slot, e)
+            if eng.cache_poisoned():
+                self._recover_engine(e)
+            return
+        vp.preemptions += 1
+        self._parked[rid] = vp
+        self.preempted += 1
+        self.metrics.preemptions.inc()
+        get_journal().emit(
+            "serving", reason=REASON_PREEMPTED,
+            message=(f"parked (class {vp.spec.tenant_class}) so a "
+                     f"latency-class request makes its "
+                     f"{waiter.spec.ttft_slo:.2f}s TTFT target"),
+            trace_id=vp.trace_id,
+        )
+        if vp.trace_id:
+            get_tracer().record(
+                "serve.preempt", 0.0, trace_id=vp.trace_id,
+                parent_id=vp.span_id,
+            )
+
+    # --------------------------------------------------------- delivery
+
+    def _recover_engine(self, e: Exception) -> None:
+        """Reset poisoned device state and fail every in-flight request
+        whose KV went with the old cache (500s, not silent drops).
+        Parked stripes are independent copies and survive."""
+        log.warning("recovering engine after device failure: %s", e)
+        for rid in self.engine.recover():
+            p = self._by_rid.pop(rid, None)
+            self._budget.pop(rid, None)
+            if p is None:
+                continue
+            p.server_fault = True
+            p.error = p.error or (
+                "engine recovered after device failure: "
+                f"{type(e).__name__}: {e}"
+            )
+            if p.stream_q is not None:
+                p.stream_q.put(p.error)
+            self._maybe_complete(p)
+
+    def _observe_slo(self, p: Pending, now: float) -> None:
+        """Per-class latency histograms + the SLO-miss ledger, emitted
+        once at the request's successful completion."""
+        cls = p.spec.tenant_class
+        tokens = sum(len(r.tokens) for r in p.results.values())
+        ttft = tpot = None
+        if p.first_token_at is not None:
+            ttft = p.first_token_at - p.t0
+            self.metrics.class_ttft_seconds.labels(
+                tenant_class=cls
+            ).observe(ttft)
+            if tokens > 1:
+                tpot = (now - p.first_token_at) / (tokens - 1)
+                self.metrics.class_tpot_seconds.labels(
+                    tenant_class=cls
+                ).observe(tpot)
+        missed = []
+        if p.spec.ttft_slo > 0 and ttft is not None \
+                and ttft > p.spec.ttft_slo:
+            missed.append(("ttft", ttft, p.spec.ttft_slo))
+        if p.spec.tpot_slo > 0 and tpot is not None \
+                and tpot > p.spec.tpot_slo:
+            missed.append(("tpot", tpot, p.spec.tpot_slo))
+        for kind, actual, target in missed:
+            self.slo_misses += 1
+            self.metrics.slo_missed.labels(
+                tenant_class=cls, slo=kind
+            ).inc()
+            get_journal().emit(
+                "serving", reason=REASON_SLO_MISSED,
+                message=(f"{kind} {actual:.3f}s exceeded the "
+                         f"{target:.3f}s target (tenant "
+                         f"{p.tenant or 'default'!r}, class {cls})"),
+                trace_id=p.trace_id,
+            )
+
+    def _maybe_complete(self, p: Pending) -> None:
+        """Finalize a pending once NONE of its engine rids are live:
+        metrics count the HTTP request once, waiters wake once."""
+        if p.done.is_set():
+            return
+        if any(rid in self._by_rid for rid in p.rid_index):
+            return
+        if p.prefix_op:
+            # prefix-cache mutations stay out of the completion ledger
+            # (their normal path completes inline in _admit, uncounted
+            # — counting only the shed ones would skew reconciliation)
+            with p.lock:
+                p.done.set()
+            return
+        # a request the HTTP layer already 503'd must not read as a
+        # success on the dashboard — the client never got the tokens.
+        # Outcome read + done.set() are atomic under p.lock so the HTTP
+        # thread's expiring wait cannot interleave (503 counted as ok).
+        with p.lock:
+            outcome = ("timeout" if p.timed_out
+                       else "drained" if p.shed
+                       else "error" if p.error else "ok")
+            self.metrics.requests.labels(outcome=outcome).inc()
+            if outcome == "drained":
+                # queued-shed and budget-evicted requests: same journal
+                # ledger as the submit-time drain rejections above
+                get_journal().emit(
+                    "serving", reason=REASON_DRAINED,
+                    message=p.error or "drained",
+                    trace_id=p.trace_id,
+                )
+            from instaslice_tpu.metrics.metrics import (
+                observe_with_exemplar,
+            )
+
+            now = time.monotonic()
+            observe_with_exemplar(
+                self.metrics.request_seconds, now - p.t0,
+                trace_id=p.trace_id,
+            )
+            if p.first_token_at is not None:
+                observe_with_exemplar(
+                    self.metrics.ttft_seconds, p.first_token_at - p.t0,
+                    trace_id=p.trace_id,
+                )
+                tokens = sum(len(r.tokens) for r in p.results.values())
+                if outcome == "ok" and tokens > 1:
+                    # mean inter-token gap over the decode phase: the
+                    # per-request TPOT the client experienced
+                    self.metrics.tpot_seconds.observe(
+                        (now - p.first_token_at) / (tokens - 1)
+                    )
+            if outcome == "ok":
+                self._observe_slo(p, now)
+            self._record_request_span(p, outcome)
+            p.done.set()
+
+    def _export_kv_gauges(self) -> None:
+        """The block-pool gauges cost a full table scan (cow count) —
+        refreshed once per round, not in every _deliver call."""
+        eng = self.engine
+        self.metrics.kv_cache_utilization.set(eng.kv_utilization())
+        self.metrics.kv_cache_utilization_legacy.set(
+            eng.kv_utilization_legacy()
+        )
+        kv = eng.kv_stats()
+        self.metrics.kv_blocks_free.set(kv["free"])
+        self.metrics.kv_blocks_used.set(kv["used"])
+        self.metrics.kv_blocks_cow.set(kv["cow"])
+
+    def _deliver(self) -> None:
+        eng = self.engine
+        self.metrics.queue_depth.set(
+            self.queue.qsize() + len(self._ready)
+        )
+        self.metrics.live_slots.set(len(eng.slots))
+        self.metrics.batch_occupancy.set(
+            len(eng.slots) / max(1, eng.max_batch)
+        )
+        # stream incremental tokens for live slots (capped at the
+        # request budget so a truncated tail is never streamed)
+        for req in eng.slots.values():
+            p = self._by_rid.get(req.request_id)
+            if p is None or p.stream_q is None:
+                continue
+            have = len(req.generated)
+            if p.stop:
+                # hold back the longest-stop-minus-one tail: those
+                # tokens could still become part of a stop match
+                # spanning the next block and be truncated away
+                have -= max(len(s) for s in p.stop) - 1
+            b = self._budget.get(req.request_id)
+            if b is not None:
+                have = min(have, b)
+            sent = p.sent.get(req.request_id, 0)
+            if have > sent:
+                p.stream_q.put({
+                    "kind": "delta",
+                    "index": p.rid_index[req.request_id],
+                    "tokens": list(req.generated[sent:have]),
+                    "logprobs": list(req.logprobs[sent:have]),
+                })
+                p.sent[req.request_id] = have
+        keep: List[GenerationResult] = []
+        for r in eng.finished:
+            p = self._by_rid.pop(r.request_id, None)
+            if p is None:
+                keep.append(r)        # not ours (direct engine use)
+                continue
+            b = self._budget.pop(r.request_id, None)
+            if b is not None and len(r.tokens) > b:
+                r.tokens = r.tokens[:b]
+                r.logprobs = r.logprobs[:b]
+                # the cut can drop the evidence the engine finished on —
+                # the client-visible reason must describe the tokens it
+                # got: a dropped eos, or a stop match that sat beyond
+                # the budget (stop matches at the original length since
+                # the match itself is excluded), read as plain budget
+                # exhaustion
+                if (r.finished_reason == "stop"
+                        or (r.finished_reason == "eos"
+                            and self.engine.eos_id not in r.tokens)):
+                    r.finished_reason = "max_new_tokens"
+            idx = p.rid_index[r.request_id]
+            p.results[idx] = r
+            if not p.timed_out:
+                self.metrics.tokens.inc(len(r.tokens))
+            if p.stream_q is not None:
+                sent = p.sent.get(r.request_id, 0)
+                if len(r.tokens) > sent:
+                    p.stream_q.put({
+                        "kind": "delta", "index": idx,
+                        "tokens": list(r.tokens[sent:]),
+                        "logprobs": list(r.logprobs[sent:]),
+                    })
+                    p.sent[r.request_id] = len(r.tokens)
+                p.stream_q.put({"kind": "final", "index": idx,
+                                "result": r})
+            self._maybe_complete(p)
+        eng.finished = keep
+
+    def stats(self) -> dict:
+        eng = self.engine
+        out = {
+            "live_slots": len(eng.slots),
+            "free_slots": eng.free_slots(),
+            "draining": self.draining.is_set(),
+            "max_queue": self.max_queue,
+            "queued": self.queue.qsize() + len(self._ready),
+            "tokens_generated": eng.tokens_generated,
+            "max_batch": eng.max_batch,
+            "max_len": eng.max_len,
+            "speculative": eng.draft_model is not None,
+            "mesh": dict(eng.mesh.shape) if eng.mesh is not None else None,
+            "prefixes": len(eng.prefixes),
+            "prefix_hits": eng.prefix_hits,
+            "prefix_tokens_saved": eng.prefix_tokens_saved,
+            "mode": self.mode,
+            "parked": len(self._parked),
+            "preempted": self.preempted,
+            "resumed": self.resumed,
+            "parked_shed": self.parked_shed,
+            "slo_misses": self.slo_misses,
+            "kv": eng.kv_stats(),
+            "tenant_classes": {
+                name: s.tenant_class for name, s in self.tenants.items()
+            },
+        }
+        return out

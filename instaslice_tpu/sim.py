@@ -151,6 +151,7 @@ class SimCluster:
         repack_interval: float = 0.25,
         repack_max_concurrent: int = 2,
         repack_cooldown: float = 1.0,
+        repack_frag_threshold: Optional[float] = None,
     ) -> None:
         """``transport="inproc"`` wires every component straight to the
         in-process FakeKube. ``transport="http"`` puts the store behind
@@ -345,6 +346,7 @@ class SimCluster:
                 interval=repack_interval,
                 max_concurrent=repack_max_concurrent,
                 cooldown=repack_cooldown,
+                frag_threshold=repack_frag_threshold,
             )
         # Optional fake-kubelet tier: a per-node SlicePluginManager serving
         # real gRPC device plugins over unix sockets; the sim scheduler

@@ -60,6 +60,7 @@ SLOW_MODULES = {
     "test_decode_equivalence",  # decode-vs-oracle cross-product compiles
     "test_flash_decode",  # fused decode-attention kernel (interpret)
     "test_serving_chaos",  # fault-injected serving + drain under load
+    "test_serving_sched",  # SLO scheduler + preempt/resume engine paths
 }
 
 

@@ -1,0 +1,258 @@
+"""Paged KV-cache block pool (serving/kvcache.py) and the tenant/SLO
+scheduling policy units (serving/scheduler.py) — the pure host-side
+halves of the continuous-batching subsystem. The engine-integrated
+paths (preempt/resume round-trips, SLO preemption over HTTP) live in
+tests/test_serving_sched.py (slow tier)."""
+
+from __future__ import annotations
+
+import pytest
+
+from instaslice_tpu.serving.kvcache import (
+    BlockPoolExhausted,
+    KVBlockPool,
+)
+from instaslice_tpu.serving.scheduler import (
+    CLASS_RANK,
+    DEFAULT_SPEC,
+    Pending,
+    Scheduler,
+    TenantSpec,
+    class_rank,
+    parse_tenant_specs,
+)
+
+
+class TestBlockPool:
+    def test_allocate_rounds_up_and_frees(self):
+        pool = KVBlockPool(8, 16)
+        t = pool.allocate(17)               # 2 blocks
+        assert len(t.blocks) == 2 and pool.used_blocks() == 2
+        assert pool.free_blocks() == 6
+        pool.release(t)
+        assert pool.used_blocks() == 0 and pool.free_blocks() == 8
+        assert len(t.blocks) == 0
+
+    def test_zero_token_table(self):
+        pool = KVBlockPool(4, 16)
+        t = pool.allocate(0)
+        assert len(t.blocks) == 0 and pool.used_blocks() == 0
+
+    def test_ensure_grows_incrementally(self):
+        pool = KVBlockPool(8, 4)
+        t = pool.allocate(3)
+        assert len(t.blocks) == 1
+        pool.ensure(t, 4)                   # exactly full: no new block
+        assert len(t.blocks) == 1
+        pool.ensure(t, 5)
+        assert len(t.blocks) == 2
+        pool.ensure(t, 5)                   # idempotent
+        assert len(t.blocks) == 2
+
+    def test_exhaustion_raises_table_unchanged(self):
+        pool = KVBlockPool(2, 4)
+        t = pool.allocate(8)                # both blocks
+        t2 = pool.allocate(0)
+        with pytest.raises(BlockPoolExhausted):
+            pool.ensure(t2, 1)
+        assert len(t2.blocks) == 0 and t2.tokens == 0
+        pool.release(t)
+        pool.ensure(t2, 1)                  # now it fits
+
+    def test_fork_shares_and_cow_copies_boundary(self):
+        pool = KVBlockPool(8, 4)
+        parent = pool.allocate(6)           # 2 blocks, boundary half full
+        assert pool.used_blocks() == 2
+        child = pool.fork(parent)
+        # zero pool cost: the child references the parent's blocks
+        assert pool.used_blocks() == 2
+        stats = pool.stats({1: parent, 2: child})
+        assert stats["cow"] == 2
+        # the child's first divergent token copies ONLY the boundary
+        pool.ensure(child, 7)
+        assert pool.used_blocks() == 3
+        assert pool.cow_copies == 1
+        assert child.blocks[0] is parent.blocks[0]      # still shared
+        assert child.blocks[1] is not parent.blocks[1]  # copied
+        # parent growing afterwards must also copy ITS boundary — the
+        # child still references the original
+        pool.release(child)
+        assert pool.used_blocks() == 2
+
+    def test_parent_growth_cows_when_child_references(self):
+        pool = KVBlockPool(8, 4)
+        parent = pool.allocate(6)
+        child = pool.fork(parent)
+        old_boundary = parent.blocks[1]
+        pool.ensure(parent, 7)
+        assert parent.blocks[1] is not old_boundary
+        assert child.blocks[1] is old_boundary
+        assert pool.cow_copies == 1
+
+    def test_fork_prefix_share_is_trimmed(self):
+        pool = KVBlockPool(8, 4)
+        parent = pool.allocate(8)           # 2 full blocks
+        child = pool.fork(parent, 4)        # share only the first
+        assert len(child.blocks) == 1 and child.tokens == 4
+        pool.ensure(child, 5)               # full boundary: plain grow
+        assert pool.cow_copies == 0
+        assert len(child.blocks) == 2
+
+    def test_pinned_tables_outside_pool(self):
+        pool = KVBlockPool(4, 4)
+        pre = pool.pin(8)                   # 2 pinned blocks
+        assert pool.used_blocks() == 0      # no pool cost
+        assert pool.pinned_blocks() == 2
+        assert pool.free_blocks() == 4
+        child = pool.fork(pre)
+        pool.ensure(child, 9)               # grows past the pin
+        assert pool.used_blocks() == 1
+        pool.release(pre)
+        assert pool.pinned_blocks() == 2    # child still references
+        pool.release(child)
+        assert pool.pinned_blocks() == 0
+        assert pool.used_blocks() == 0
+
+    def test_pinned_boundary_write_adopts_pool_block(self):
+        pool = KVBlockPool(4, 4)
+        pre = pool.pin(6)                   # boundary half full
+        child = pool.fork(pre)
+        pool.ensure(child, 7)               # writes INTO the pinned block
+        assert pool.cow_copies == 1
+        assert child.blocks[1] is not pre.blocks[1]
+        assert pool.used_blocks() == 1      # the adopted copy
+
+    def test_utilization_true_block_occupancy(self):
+        pool = KVBlockPool(8, 16)
+        t = pool.allocate(24)               # 2 blocks = 32 capacity
+        assert t.tokens == 24
+        assert pool.utilization(24) == 24 / 32
+        assert pool.utilization(0) == 0.0
+        pool.release(t)
+        assert pool.utilization(0) == 0.0   # empty pool: no capacity
+
+    def test_utilization_counts_pinned_capacity(self):
+        """Prefix-covered resident tokens live in pinned blocks: the
+        capacity they divide by must include them, or any prefix-hit
+        traffic saturates the gauge at 1.0."""
+        pool = KVBlockPool(8, 4)
+        pool.pin(8)                         # 2 pinned blocks
+        t = pool.allocate(2)                # 1 allocated block
+        # 10 resident tokens (8 prefix + 2 own) over 3 blocks of 4
+        assert pool.utilization(10) == 10 / 12
+        pool.release(t)
+
+    def test_bad_construction(self):
+        with pytest.raises(ValueError):
+            KVBlockPool(0, 16)
+        with pytest.raises(ValueError):
+            KVBlockPool(8, 0)
+
+
+class TestTenantSpecs:
+    def test_full_grammar(self):
+        specs = parse_tenant_specs(
+            "gold:4:latency:0.5:0.05,free:1:best-effort:30,plain"
+        )
+        assert specs["gold"] == TenantSpec("gold", 4.0, "latency",
+                                           0.5, 0.05)
+        assert specs["free"].tenant_class == "best-effort"
+        assert specs["free"].ttft_slo == 30.0
+        assert specs["plain"].tenant_class == "standard"
+        assert specs["plain"].weight == 1.0
+
+    def test_errors(self):
+        with pytest.raises(ValueError, match="class"):
+            parse_tenant_specs("a:1:platinum")
+        with pytest.raises(ValueError, match="weight"):
+            parse_tenant_specs("a:0:latency")
+        with pytest.raises(ValueError, match="numbers"):
+            parse_tenant_specs("a:heavy:latency")
+        with pytest.raises(ValueError, match="twice"):
+            parse_tenant_specs("a:1,a:2")
+        with pytest.raises(ValueError, match="empty name"):
+            parse_tenant_specs(":1:latency")
+
+    def test_class_rank_default(self):
+        assert class_rank("latency") < class_rank("standard")
+        assert class_rank("standard") < class_rank("best-effort")
+        assert class_rank("nonsense") == CLASS_RANK["standard"]
+
+
+class _StubEngine:
+    """Just enough engine for the pure scheduling-order units."""
+
+    def __init__(self):
+        self.slots = {}
+        self._slot_adapter_host = {}
+        self.draft_model = None
+        self.max_batch = 4
+        self.max_len = 64
+
+
+class TestAdmissionOrder:
+    def _sched(self, tenants="", mode="continuous"):
+        return Scheduler(_StubEngine(), tenants=tenants, mode=mode)
+
+    def _pend(self, tenant, sched, seq, max_tokens=8, adapter=0):
+        p = Pending([1, 2], max_tokens, tenant=tenant, adapter=adapter)
+        sched._bind_tenant(p)
+        p.seq = seq
+        return p
+
+    def test_class_rank_orders_admission(self):
+        s = self._sched("gold:1:latency,bronze:1:best-effort")
+        be = self._pend("bronze", s, 1)
+        std = self._pend("", s, 2)
+        gold = self._pend("gold", s, 3)
+        s._ready = [be, std, gold]
+        assert [p.tenant for p in s._admission_order()] == \
+            ["gold", "", "bronze"]
+
+    def test_weighted_fair_share_within_class(self):
+        s = self._sched("heavy:4:standard,light:1:standard")
+        # heavy admitted twice already: its vtime advanced by
+        # 2 * 8/4 = 4; light once: 8/1 = 8 → heavy still goes first
+        for _ in range(2):
+            s._charge(self._pend("heavy", s, 0))
+        s._charge(self._pend("light", s, 0))
+        h = self._pend("heavy", s, 5)
+        li = self._pend("light", s, 4)
+        s._ready = [li, h]
+        assert [p.tenant for p in s._admission_order()] == \
+            ["heavy", "light"]
+        # one more heavy admission tips the balance past light's 8
+        for _ in range(3):
+            s._charge(self._pend("heavy", s, 0))
+        assert [p.tenant for p in s._admission_order()] == \
+            ["light", "heavy"]
+
+    def test_adapter_affinity_tiebreak(self):
+        s = self._sched()
+        s.engine.slots = {0: object()}
+        s.engine._slot_adapter_host = {0: 2}
+        # same tenant (same vtime), different adapters, FIFO says a
+        # first — affinity with the live adapter 2 wins the tiebreak
+        a = self._pend("", s, 1, adapter=1)
+        b = self._pend("", s, 2, adapter=2)
+        s._ready = [a, b]
+        assert s._admission_order()[0] is b
+
+    def test_fixed_mode_is_fifo(self):
+        s = self._sched("gold:1:latency", mode="fixed")
+        gold = self._pend("gold", s, 2)
+        std = self._pend("", s, 1)
+        s._ready = [gold, std]
+        assert [p.seq for p in s._admission_order()] == [1, 2]
+
+    def test_unknown_tenant_gets_default_class(self):
+        s = self._sched("gold:1:latency")
+        p = self._pend("mystery", s, 1)
+        assert p.spec.tenant_class == "standard"
+        assert p.spec.weight == 1.0
+        anon = self._pend("", s, 2)
+        assert anon.spec is DEFAULT_SPEC
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            Scheduler(_StubEngine(), mode="sometimes")

@@ -1,0 +1,482 @@
+"""Continuous-batching scheduler over the real engine: preempt/resume
+KV round-trips (token-identical to uninterrupted decode), block-pool
+admission pressure, SLO preemption end to end over HTTP, the fixed-
+round baseline mode, tenanted loadgen reports, and the paged-vs-legacy
+kv-utilization split (docs/SERVING.md "Continuous batching & tenant
+SLOs")."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.api.constants import (
+    REASON_PREEMPTED,
+    REASON_RESUMED,
+    REASON_SLO_MISSED,
+)
+from instaslice_tpu.metrics.metrics import ServingMetrics, render
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.obs.journal import get_journal, reset_journal
+from instaslice_tpu.serving import ServingEngine
+from instaslice_tpu.serving.api_server import ApiServer
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+@pytest.fixture(autouse=True)
+def fresh_journal():
+    reset_journal()
+    yield
+    reset_journal()
+
+
+def greedy_reference(model, params, prompt, n_new):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = model.apply(params, jnp.asarray(toks, jnp.int32)[None])
+        t = int(jnp.argmax(logits[0, -1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def post(url, payload, timeout=120, headers=None):
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(
+        f"{url}/v1/completions", data=json.dumps(payload).encode(),
+        headers=h, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+class TestPreemptResumeEngine:
+    def test_roundtrip_token_identical(self, model):
+        """Park a mid-decode request, run someone else through its
+        slot, resume — the final chain must equal uninterrupted greedy
+        decode (the stripe write restored position-exact KV)."""
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 12)
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, kv_block_size=8)
+        rid = eng.add_request([5, 9, 2, 7])
+        for _ in range(4):
+            eng.step()
+        assert eng.preempt_slot(0) == rid
+        assert not eng.slots and rid in eng.parked
+        assert eng.preempted_total == 1
+        # the slot serves someone else meanwhile (dirties the stripe)
+        other = eng.add_request([11, 13, 17])
+        for _ in range(6):
+            eng.step()
+        eng.finish_slot(next(iter(eng.slots)))
+        assert eng.finished[-1].request_id == other
+        slot = eng.resume_request(rid)
+        assert slot == 0 and eng.resumed_total == 1
+        for _ in range(7):
+            eng.step()
+        req = eng.slots[0]
+        assert req.generated == oracle
+
+    def test_parked_blocks_held_then_freed(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=32,
+                            prefill_len=8, kv_block_size=8)
+        rid = eng.add_request(list(range(1, 9)))
+        used = eng.kv.used_blocks()
+        assert used >= 1
+        eng.preempt_slot(0)
+        # parked keeps its blocks (cheap resume)...
+        assert eng.kv.used_blocks() == used
+        assert eng.kv_stats()["parked"] == 1
+        # ...and dropping frees them on the spot
+        assert eng.drop_parked(rid)
+        assert eng.kv.used_blocks() == 0
+        assert not eng.drop_parked(rid)
+
+    def test_can_admit_gates_on_blocks_not_just_slots(self, model):
+        m, params = model
+        # pool: (2 * 32) / 8 = 8 blocks
+        eng = ServingEngine(m, params, max_batch=2, max_len=32,
+                            prefill_len=8, kv_block_size=8)
+        r1 = eng.add_request(list(range(1, 25)))     # 3-4 blocks
+        eng.preempt_slot(0)
+        r2 = eng.add_request(list(range(1, 25)))
+        eng.preempt_slot(0)
+        # both slots free, but parked state holds most of the pool
+        assert eng.free_slots() == 2
+        assert not eng.can_admit(24, 2)
+        eng.drop_parked(r1)
+        eng.drop_parked(r2)
+        assert eng.can_admit(24, 2)
+
+    def test_resume_requires_free_slot_and_parked_rid(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=32,
+                            prefill_len=8)
+        rid = eng.add_request([1, 2, 3])
+        with pytest.raises(ValueError, match="not parked"):
+            eng.resume_request(rid + 99)
+        eng.preempt_slot(0)
+        eng.add_request([4, 5, 6])
+        with pytest.raises(RuntimeError, match="free slot"):
+            eng.resume_request(rid)
+
+    def test_failed_resume_leaves_rid_droppable(self, model):
+        """A device failure mid-resume must not leak the block table:
+        the rid stays parked until the stripe writes land, so the
+        scheduler's cleanup (drop_parked) still finds and frees it."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=32,
+                            prefill_len=8, kv_block_size=8)
+        rid = eng.add_request([1, 2, 3, 4])
+        eng.preempt_slot(0)
+        calls = {"n": 0}
+        real = eng._write_stripe
+
+        def flaky(cache, stripe, slot):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+            return real(cache, stripe, slot)
+
+        eng._write_stripe = flaky
+        with pytest.raises(RuntimeError, match="injected"):
+            eng.resume_request(rid)
+        assert rid in eng.parked          # still findable
+        assert eng.drop_parked(rid)       # blocks come back
+        assert eng.kv.used_blocks() == 0
+
+    def test_recover_keeps_parked_stripes(self, model):
+        """Parked stripes are independent copies like prefixes: an
+        engine recovery (poisoned cache) must not lose them."""
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 8)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        rid = eng.add_request([5, 9, 2, 7])
+        for _ in range(3):
+            eng.step()
+        eng.preempt_slot(0)
+        victim = eng.add_request([9, 9, 9])
+        lost = eng.recover()
+        assert lost == [victim]
+        assert rid in eng.parked
+        eng.resume_request(rid)
+        for _ in range(4):
+            eng.step()
+        assert eng.slots[0].generated == oracle
+
+
+class TestSloSchedulerHttp:
+    def test_latency_class_preempts_best_effort(self, model):
+        """One slot; a best-effort request decoding 48 tokens; a
+        latency-class request arrives and must be served via
+        preemption LONG before the best-effort one finishes — and the
+        preempted request still completes with oracle-exact tokens."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, kv_block_size=8)
+        metrics = ServingMetrics()
+        tenants = "gold:4:latency:5.0,bronze:1:best-effort"
+        with ApiServer(eng, block_size=4, metrics=metrics,
+                       tenants=tenants, preempt_margin=0.02,
+                       request_timeout=60) as srv:
+            # warm the compiled programs so preemption timing below is
+            # about scheduling, not jit compiles
+            code, _ = post(srv.url, {"prompt": [1, 2, 3],
+                                     "max_tokens": 2})
+            assert code == 200
+            results = {}
+
+            def bronze():
+                results["bronze"] = post(
+                    srv.url, {"prompt": [5, 9, 2, 7],
+                              "max_tokens": 48},
+                    headers={"X-Tenant": "bronze"},
+                )
+
+            t = threading.Thread(target=bronze, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not eng.slots:
+                time.sleep(0.01)
+            assert eng.slots, "bronze never admitted"
+            t0 = time.monotonic()
+            code, out = post(srv.url, {"prompt": [9, 3, 1],
+                                       "max_tokens": 4},
+                             headers={"X-Tenant": "gold"})
+            gold_latency = time.monotonic() - t0
+            assert code == 200, out
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [9, 3, 1], 4
+            )
+            t.join(timeout=60)
+            assert not t.is_alive(), "preempted request hung"
+            code, out = results["bronze"]
+            assert code == 200, out
+            # the parked-and-resumed chain is exact
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [5, 9, 2, 7], 48
+            )
+            stats = srv.scheduler.stats()
+            assert stats["preempted"] >= 1
+            assert stats["resumed"] >= 1
+            assert srv.scheduler.preempted == eng.preempted_total
+            # journal ledger reconciles with the scheduler counters
+            jc = get_journal().counts()
+            assert jc.get(REASON_PREEMPTED, 0) == stats["preempted"]
+            assert jc.get(REASON_RESUMED, 0) == stats["resumed"]
+            # gold didn't wait out bronze's 48 tokens
+            assert gold_latency < 30
+            body = render(metrics)
+            if body:
+                assert "tpuslice_serve_preemptions_total" in body
+                assert ('tpuslice_serve_class_ttft_seconds_count'
+                        '{tenant_class="latency"}') in body
+
+    def test_slo_miss_journaled(self, model):
+        """An impossible TTFT target must produce an SLOMissed event
+        and count on the slo_missed ledger — attainment is measured,
+        not assumed."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        tenants = "instant:1:latency:0.000001"
+        with ApiServer(eng, block_size=4, tenants=tenants) as srv:
+            code, _ = post(srv.url, {"prompt": [5, 9, 2], "max_tokens": 4},
+                           headers={"X-Tenant": "instant"})
+            assert code == 200
+            assert srv.scheduler.slo_misses >= 1
+            evs = get_journal().events(reason=REASON_SLO_MISSED)
+            assert evs and "ttft" in evs[0].message
+
+    def test_fixed_mode_still_serves_oracle(self, model):
+        """The bench baseline: FIFO + full-block rounds — slower, but
+        byte-identical results and a visible mode in /v1/stats."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4, mode="fixed") as srv:
+            code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
+                                       "max_tokens": 6})
+            assert code == 200
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [5, 9, 2, 7], 6
+            )
+            with urllib.request.urlopen(f"{srv.url}/v1/stats",
+                                        timeout=10) as r:
+                stats = json.loads(r.read())
+            assert stats["mode"] == "fixed"
+            assert stats["preempted"] == 0
+
+    def test_stats_expose_kv_and_tenants(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, kv_block_size=16)
+        with ApiServer(eng, tenants="gold:2:latency:1.0") as srv:
+            code, _ = post(srv.url, {"prompt": [1, 2, 3, 4],
+                                     "max_tokens": 2})
+            assert code == 200
+            with urllib.request.urlopen(f"{srv.url}/v1/stats",
+                                        timeout=10) as r:
+                stats = json.loads(r.read())
+            assert stats["tenant_classes"] == {"gold": "latency"}
+            kv = stats["kv"]
+            assert kv["total"] == (2 * 64) // 16
+            assert {"free", "used", "cow", "utilization",
+                    "utilization_legacy"} <= set(kv)
+
+
+class TestKvUtilizationSplit:
+    def test_paged_beats_legacy_at_mixed_lengths(self, model):
+        """The satellite contract: the paged metric reports occupancy
+        of the blocks actually held, the legacy metric divides by the
+        whole rectangle — at mixed sequence lengths the paged one is
+        strictly higher (and the truthful one)."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, kv_block_size=8)
+        eng.add_request([1, 2, 3])                     # short
+        eng.add_request(list(range(1, 41)))            # long
+        paged = eng.kv_utilization()
+        legacy = eng.kv_utilization_legacy()
+        assert paged > legacy
+        assert paged >= 0.5
+        # the legacy metric charges the whole 4x64 rectangle
+        assert legacy == pytest.approx(
+            (4 + 41) / (4 * 64), rel=1e-6
+        )
+
+    def test_prefix_fork_shows_cow_blocks(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, kv_block_size=8)
+        prefix = list(range(1, 17))                    # two chunks
+        eng.register_prefix(prefix)
+        assert eng.kv.pinned_blocks() == 2
+        eng.add_request(prefix + [40, 41])
+        assert eng.prefix_hits == 1
+        stats = eng.kv_stats()
+        assert stats["cow"] >= 1                       # shared blocks
+
+
+class TestTenantLoadgen:
+    def test_report_has_per_tenant_slo_attainment(self, model):
+        from instaslice_tpu.serving.loadgen import run
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        spec = "gold:3:latency:30,bronze:1:best-effort:30"
+        with ApiServer(eng, block_size=4, tenants=spec) as srv:
+            out = run(srv.url, requests=10, concurrency=3,
+                      prompt_len=6, max_tokens=5, vocab=64,
+                      stream=True, timeout=120, seed=3, tenants=spec)
+            assert out["ok"] == 10 and out["errors"] == 0
+            tens = out["tenants"]
+            assert set(tens) == {"gold", "bronze"}
+            total = sum(t["requests"] for t in tens.values())
+            assert total == 10
+            for t in tens.values():
+                assert t["ok"] == t["requests"]
+                assert 0.0 <= t["slo_attainment"] <= 1.0
+                assert t["ttft_p95"] >= t["ttft_p50"] >= 0
+            # generous 30 s targets on a warm tiny model: attainment
+            # must be perfect, or the measurement itself is broken
+            assert tens["gold"]["slo_attainment"] == 1.0
+
+    def test_cli_flag_round_trip(self, model, capsys):
+        from instaslice_tpu.serving.loadgen import main as lg_main
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4) as srv:
+            rc = lg_main(["--url", srv.url, "--requests", "4",
+                          "--concurrency", "2", "--prompt-len", "6",
+                          "--max-tokens", "4", "--vocab", "64",
+                          "--tenants", "a:1:latency:30,b:1:standard"])
+        out = json.loads(capsys.readouterr().out.strip())
+        assert rc == 0
+        assert set(out["tenants"]) <= {"a", "b"}
+        bad = lg_main(["--url", "http://x", "--tenants", "a:z:latency"])
+        err = json.loads(capsys.readouterr().out.strip())
+        assert bad == 1 and "bad --tenants" in err["error"]
+
+
+class TestDistributedPreemptOps:
+    def test_follower_replays_preempt_resume_drop(self, model):
+        """preempt/resume/drop ride the op stream: after a preempt →
+        fill → resume sequence the follower's slot, parked, and block-
+        pool state converge to the driver's exactly (the SPMD
+        requirement — slot occupancy feeds the compiled decode)."""
+        from conftest import free_port
+        from instaslice_tpu.serving.distributed import (
+            DistributedEngine,
+            run_follower,
+        )
+
+        m, params = model
+        driver_eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                                   prefill_len=8, kv_block_size=8)
+        follower_eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                                     prefill_len=8, kv_block_size=8)
+        port = free_port()
+        t = threading.Thread(
+            target=run_follower,
+            args=(follower_eng, "127.0.0.1", port), daemon=True,
+        )
+        t.start()
+        deng = DistributedEngine(driver_eng, n_followers=1, port=port)
+        rid = deng.add_request([5, 9, 2, 7])
+        deng.decode_block(3)
+        assert deng.preempt_slot(0) == rid
+        other = deng.add_request([11, 13, 17])
+        deng.decode_block(2)
+        deng.evict_slot(0)
+        slot = deng.resume_request(rid)
+        deng.decode_block(2)
+        rid2 = deng.add_request([1, 2, 3])
+        assert deng.preempt_slot(
+            next(s for s, r in driver_eng.slots.items()
+                 if r.request_id == rid2)
+        ) == rid2
+        assert deng.drop_parked(rid2)
+        deng.shutdown()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        # replica convergence: same slots, same tokens, same parked
+        # set, same block-pool occupancy
+        assert set(follower_eng.slots) == set(driver_eng.slots) == {slot}
+        assert (follower_eng.slots[slot].generated
+                == driver_eng.slots[slot].generated)
+        assert set(follower_eng.parked) == set(driver_eng.parked) == set()
+        assert (follower_eng.kv.used_blocks()
+                == driver_eng.kv.used_blocks())
+        assert other not in follower_eng.slots
+
+
+class TestBlockPressureRelief:
+    def test_block_starved_latency_waiter_sheds_parked(self, model):
+        """The livelock guard: a parked best-effort request holds the
+        pool, a slot is FREE, and a latency-class waiter cannot admit
+        for lack of blocks — slot-preemption doesn't apply (nothing to
+        preempt) and resume refuses to hand the blocks' owner the
+        slot, so the scheduler must shed the parked state or the
+        waiter spins to its HTTP timeout."""
+        from instaslice_tpu.serving.scheduler import Pending, Scheduler
+
+        m, params = model
+        # pool: 1 * ceil(32/8) = 4 blocks
+        eng = ServingEngine(m, params, max_batch=1, max_len=32,
+                            prefill_len=8, kv_block_size=8)
+        sched = Scheduler(
+            eng, block_size=4, preempt_margin=0.0,
+            tenants="gold:1:latency:5.0,bronze:1:best-effort",
+        )
+        pb = Pending(list(range(1, 22)), 2, tenant="bronze")
+        sched.submit(pb)
+        sched._pump()
+        sched._admit()
+        assert len(eng.slots) == 1
+        # park bronze exactly as _maybe_preempt would: engine parks,
+        # scheduler tracks — it now holds 3 of 4 blocks, slot free
+        rid = next(iter(eng.slots.values())).request_id
+        rid_parked = eng.preempt_slot(next(iter(eng.slots)))
+        assert rid_parked == rid
+        sched._parked[rid] = pb
+        assert eng.free_slots() == 1
+        pg = Pending(list(range(1, 10)), 4, tenant="gold")
+        sched.submit(pg)
+        assert not eng.can_admit(len(pg.prompt), 1)   # block-starved
+        for _ in range(30):
+            sched._round()
+            if pg.done.is_set():
+                break
+        assert pg.done.is_set(), "latency waiter livelocked"
+        assert not pg.error and pg.results
+        # bronze was shed cleanly under block pressure, blocks freed
+        assert pb.done.is_set()
+        assert pb.shed == "evicted"
+        assert "block pressure" in pb.error
+        assert rid not in eng.parked
+        assert sched.parked_shed == 1
