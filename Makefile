@@ -40,13 +40,14 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke floors
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
 	$(MAKE) bench-smoke
 	$(MAKE) bench-defrag-smoke
 	$(MAKE) bench-serving-smoke
+	$(MAKE) bench-engine-smoke
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
@@ -67,6 +68,14 @@ bench-serving-smoke:  ## <60 s mixed-SLO serving run over the continuous schedul
 .PHONY: bench-serving
 bench-serving:  ## Full serving tier: continuous-batching scheduler vs the fixed-decode-round baseline on the mixed-SLO multi-tenant scenario (tok/s, per-class TTFT p95, SLO attainment, paged-vs-legacy kv utilization) — records BENCH_SERVING_r09.json (docs/SERVING.md)
 	JAX_PLATFORMS=cpu $(PY) bench.py --serving
+
+.PHONY: bench-engine-smoke
+bench-engine-smoke:  ## <60 s bursty-admission run of both engine arms: asserts hot-path (batched prefill + overlap) tok/s >= TPUSLICE_ENGINE_FLOOR x the per-slot baseline, zero hung requests, preempt/resume ledger reconciling
+	JAX_PLATFORMS=cpu $(PY) bench.py --engine-smoke
+
+.PHONY: bench-engine
+bench-engine:  ## Full engine hot-path tier: batched-prefill + overlap arm vs the per-slot PR 9 baseline, best-of-3 per arm (tok/s AND TTFT p95 must both win) — records BENCH_ENGINE_r10.json (docs/SERVING.md)
+	JAX_PLATFORMS=cpu $(PY) bench.py --engine
 
 .PHONY: bench-scale
 bench-scale:  ## Fleet-scale control-plane bench: 1k nodes / 2k pending pods, grants/sec + gate→ungate p95/p99, with the serial re-list baseline ratio (docs/SCALING.md)

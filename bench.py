@@ -565,12 +565,15 @@ def bench_serving(
     max_batch: int = 8,
     block_size: int = 16,
     d_model: int = 128,
+    prefill_len: int = 8,
+    engine_opts: dict = None,
+    overlap: bool = None,
 ) -> dict:
     """One serving-scheduler arm (docs/SERVING.md "Continuous batching
     & tenant SLOs"): a CPU-sized engine behind the real ApiServer, a
     mixed-SLO multi-tenant loadgen run at mixed sequence lengths, and
-    a sampler thread reading /v1/stats so the paged-vs-legacy
-    kv-utilization split is measured UNDER load, not at the idle end.
+    a sampler thread reading /v1/stats so paged kv utilization is
+    measured UNDER load, not at the idle end.
 
     ``mode="fixed"`` is the classic static-batching baseline the
     continuous scheduler is judged against (ROADMAP item 3's "fixed
@@ -604,14 +607,19 @@ def bench_serving(
     model = TpuLM(cfg)
     params = model.init(jax.random.key(0))
     eng = ServingEngine(model, params, max_batch=max_batch,
-                        max_len=128, prefill_len=8, kv_block_size=16)
+                        max_len=128, prefill_len=prefill_len,
+                        kv_block_size=16, **(engine_opts or {}))
+    # compile every prefill-batch bucket OUT of the measured window:
+    # the loadgen warm-up's burst widths are traffic-dependent, and one
+    # cold bucket compile mid-run swamps a seconds-long CPU measurement
+    eng.warm_prefill_buckets()
     metrics = ServingMetrics()
     samples: list = []
     stop = threading.Event()
     try:
         with ApiServer(eng, block_size=block_size, metrics=metrics,
                        tenants=SERVING_TENANTS, mode=mode,
-                       preempt_margin=0.3,
+                       preempt_margin=0.3, overlap=overlap,
                        request_timeout=180) as srv:
 
             def probe(path="/v1/stats"):
@@ -628,7 +636,6 @@ def bench_serving(
                         if s["live_slots"]:
                             samples.append((
                                 s["kv"]["utilization"],
-                                s["kv"]["utilization_legacy"],
                                 s["live_slots"],
                             ))
                     except Exception as e:  # pragma: no cover
@@ -668,11 +675,25 @@ def bench_serving(
             for key in ("preempted", "resumed", "parked_shed",
                         "slo_misses"):
                 stats[key] = end[key] - warm_stats[key]
+            # preempt/resume ledger reconciliation after quiesce: the
+            # scheduler's counters match the engine's, nothing is left
+            # parked or holding KV blocks once every client got its
+            # terminal response
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and (
+                eng.slots or eng.parked
+            ):
+                time.sleep(0.02)
+            ledger_ok = (
+                srv.scheduler.preempted == eng.preempted_total
+                and srv.scheduler.resumed == eng.resumed_total
+                and not eng.parked and not eng.slots
+                and eng.kv.used_blocks() == 0
+            )
     finally:
         stop.set()
         reset_journal()
     kv_util = [s[0] for s in samples]
-    kv_legacy = [s[1] for s in samples]
     gold = report["tenants"]["gold"]
     bronze = report["tenants"]["bronze"]
     return {
@@ -697,23 +718,112 @@ def bench_serving(
         "kv_util_mean": round(
             statistics.mean(kv_util), 4
         ) if kv_util else 0.0,
-        "kv_util_legacy_mean": round(
-            statistics.mean(kv_legacy), 4
-        ) if kv_legacy else 0.0,
         "kv_samples": len(samples),
+        # warm-up-subtracted like the preempt/SLO counters above: the
+        # arm reports ITS window, not the process totals
+        "prefill_batches": (stats["engine"]["prefill_batches"]
+                            - warm_stats["engine"]["prefill_batches"]),
+        "prefill_rows": (stats["engine"]["prefill_rows"]
+                         - warm_stats["engine"]["prefill_rows"]),
+        "fastpath_rounds": (stats["engine"]["fastpath_rounds"]
+                            - warm_stats["engine"]["fastpath_rounds"]),
         "preempted": stats["preempted"],
         "resumed": stats["resumed"],
         "parked_shed": stats["parked_shed"],
         "slo_misses": stats["slo_misses"],
+        "ledger_ok": ledger_ok,
     }
+
+
+#: the bursty-admission mixed-SLO scenario the engine tier runs: high
+#: loadgen concurrency (admission arrives in bursts) over prefill-heavy
+#: prompts — the regime batched prefill + host/device overlap target
+ENGINE_WORKLOAD = dict(
+    mode="continuous", concurrency=16, prompt_len=48, max_tokens=24,
+    jitter=0.6, prefill_len=8,
+)
+
+
+def bench_engine(optimized: bool = True, requests: int = 32,
+                 seed: int = 10) -> dict:
+    """One engine-tier arm (docs/SERVING.md "Engine hot path"): the
+    same bursty-admission mixed-SLO workload over either the r10 hot
+    path (batched prefill + single-adapter fast path + host/device
+    overlap) or the PR 9 per-slot baseline (every admission its own
+    dispatch chain, fully synchronous rounds) — same process, same
+    scheduler policy, so the ratio isolates the dispatch shape."""
+    out = bench_serving(
+        requests=requests, seed=seed,
+        engine_opts=(None if optimized else dict(
+            batched_prefill=False, adapter_fastpath=False,
+        )),
+        overlap=optimized,
+        **ENGINE_WORKLOAD,
+    )
+    out["arm"] = "optimized" if optimized else "per-slot"
+    return out
+
+
+def smoke_engine(floor: float = None) -> int:
+    """``make bench-engine-smoke``: a <60 s bursty-admission run of
+    BOTH engine arms in one process — asserts the hot-path arm
+    sustains at least ``TPUSLICE_ENGINE_FLOOR`` times the per-slot
+    baseline's tok/s, zero hung requests, and the preempt/resume
+    ledger still reconciling on both arms."""
+    if floor is None:
+        floor = float(os.environ.get("TPUSLICE_ENGINE_FLOOR", "0.9"))
+    reqs = int(os.environ.get("TPUSLICE_ENGINE_SMOKE_REQS", "20"))
+    # floor 0.9 + best-of-3: the smoke is a REGRESSION gate on a
+    # shared-core CI box where single runs of either arm swing ±30%
+    # on OS noise — it catches a broken hot path (the bucket-compile
+    # bug read 0.45x), not a 5% scheduling breeze. The recorded
+    # `--engine` tier keeps the strict must-beat-on-both-axes gate.
+    reps = max(1, int(os.environ.get(
+        "TPUSLICE_ENGINE_SMOKE_REPEATS", "3")))
+    # throwaway process-warming run: thread pools, sockets, allocator
+    # — the first serving run in a process is slow for reasons neither
+    # arm owns, and it must not land on either measured arm
+    bench_engine(optimized=False, requests=6)
+    bases, opts = [], []
+    for _ in range(reps):
+        bases.append(bench_engine(optimized=False, requests=reqs))
+        opts.append(bench_engine(optimized=True, requests=reqs))
+    base = max(bases, key=lambda r: r["client_tokens_per_sec"])
+    opt = max(opts, key=lambda r: r["client_tokens_per_sec"])
+    print(json.dumps({"optimized": opt, "per_slot_baseline": base}))
+    failures = []
+    for arm in (base, opt):
+        if arm["hung"]:
+            failures.append(f"{arm['arm']}: {arm['hung']} hung")
+        if arm["errors"]:
+            failures.append(
+                f"{arm['arm']}: {arm['errors']} loadgen error(s)"
+            )
+        if not arm["ledger_ok"]:
+            failures.append(
+                f"{arm['arm']}: preempt/resume ledger did not "
+                "reconcile"
+            )
+    if opt["client_tokens_per_sec"] < floor * base[
+            "client_tokens_per_sec"]:
+        failures.append(
+            f"hot path {opt['client_tokens_per_sec']} tok/s under "
+            f"{floor}x the per-slot baseline "
+            f"{base['client_tokens_per_sec']}"
+        )
+    if opt["prefill_batches"] == 0:
+        failures.append("hot-path arm never dispatched a batched "
+                        "prefill (knob wiring broken?)")
+    for f in failures:
+        print(f"bench-engine-smoke FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
 
 
 def smoke_serving(slo_floor: float = 0.75, kv_floor: float = 0.5) -> int:
     """``make bench-serving-smoke``: a <60 s mixed-SLO loadgen run over
     the continuous scheduler gating the fast tier — asserts every
     request terminates, latency-class SLO attainment holds a floor,
-    and paged kv utilization beats both its floor and the legacy
-    stripe metric."""
+    and paged kv utilization holds its floor."""
     out = bench_serving(
         mode="continuous",
         requests=int(os.environ.get("TPUSLICE_SERVING_SMOKE_REQS",
@@ -736,12 +846,6 @@ def smoke_serving(slo_floor: float = 0.75, kv_floor: float = 0.5) -> int:
         failures.append(
             f"kv utilization {out['kv_util_mean']} below floor "
             f"{kv_floor}"
-        )
-    if out["kv_util_mean"] <= out["kv_util_legacy_mean"]:
-        failures.append(
-            "paged kv utilization did not beat the legacy stripe "
-            f"metric ({out['kv_util_mean']} vs "
-            f"{out['kv_util_legacy_mean']})"
         )
     for f in failures:
         print(f"bench-serving-smoke FAIL: {f}", file=sys.stderr)
@@ -1271,6 +1375,27 @@ def main(argv=None) -> int:
                     default=int(os.environ.get(
                         "TPUSLICE_SERVING_SEED", "9")),
                     help="serving tier: loadgen scenario seed")
+    ap.add_argument("--engine", action="store_true",
+                    help="engine hot-path tier: bursty-admission "
+                    "mixed-SLO workload, batched-prefill + overlap "
+                    "arm vs the per-slot PR 9 baseline (tok/s, TTFT "
+                    "p95, prefill-batch occupancy)")
+    ap.add_argument("--engine-smoke", action="store_true",
+                    help="CI gate: <60 s run of both engine arms "
+                    "asserting hot-path tok/s >= TPUSLICE_ENGINE_FLOOR"
+                    " x the per-slot baseline, zero hung requests, "
+                    "and a reconciling preempt/resume ledger")
+    ap.add_argument("--engine-floor", type=float,
+                    default=float(os.environ.get(
+                        "TPUSLICE_ENGINE_FLOOR", "0.9")),
+                    help="engine-smoke: hot-path tok/s floor as a "
+                    "multiple of the per-slot baseline (0.9 absorbs "
+                    "shared-core CI noise; the full --engine tier "
+                    "gates a strict win)")
+    ap.add_argument("--engine-seed", type=int,
+                    default=int(os.environ.get(
+                        "TPUSLICE_ENGINE_SEED", "10")),
+                    help="engine tier: loadgen scenario seed")
     ap.add_argument("--interval", type=float, default=900.0,
                     help="watchdog: seconds between probes (default 900)")
     ap.add_argument("--max-hours", type=float, default=11.0,
@@ -1309,6 +1434,58 @@ def main(argv=None) -> int:
     if args.serving_smoke:
         return smoke_serving(slo_floor=args.serving_slo_floor,
                              kv_floor=args.serving_kv_floor)
+    if args.engine_smoke:
+        return smoke_engine(floor=args.engine_floor)
+    if args.engine:
+        result = {
+            "metric": "engine_tokens_per_sec",
+            "unit": "tokens/s",
+        }
+        # best-of-N per arm, interleaved (same rationale as --serving:
+        # single samples flip on OS noise on shared-core CI boxes)
+        reps = max(1, int(os.environ.get(
+            "TPUSLICE_ENGINE_REPEATS", "3")))
+        # throwaway process-warming run (see smoke_engine)
+        bench_engine(optimized=False, requests=6, seed=args.engine_seed)
+        opts, bases = [], []
+        for _ in range(reps):
+            opts.append(
+                bench_engine(optimized=True, seed=args.engine_seed)
+            )
+            bases.append(
+                bench_engine(optimized=False, seed=args.engine_seed)
+            )
+        opt = max(opts, key=lambda r: r["client_tokens_per_sec"])
+        base = max(bases, key=lambda r: r["client_tokens_per_sec"])
+        result["engine_optimized"] = opt
+        result["engine_per_slot_baseline"] = base
+        result["repeats"] = reps
+        result["tokens_per_sec_runs"] = {
+            "optimized": [r["client_tokens_per_sec"] for r in opts],
+            "per_slot": [r["client_tokens_per_sec"] for r in bases],
+        }
+        result["value"] = opt["client_tokens_per_sec"]
+        if base["client_tokens_per_sec"]:
+            result["vs_baseline"] = round(
+                opt["client_tokens_per_sec"]
+                / base["client_tokens_per_sec"], 2
+            )
+        # TTFT p95 compared at best-tok/s runs; the headline keys ride
+        # the shared BENCH_*.json shape for the perf trajectory
+        result["serve_toks_per_sec"] = opt["client_tokens_per_sec"]
+        result["serve_ttft_p95"] = opt["ttft_p95_s"]
+        result["ttft_p95_baseline_s"] = base["ttft_p95_s"]
+        print(json.dumps(result))
+        ok = (
+            opt["hung"] == 0 and base["hung"] == 0
+            and opt["errors"] == 0 and base["errors"] == 0
+            and opt["ledger_ok"] and base["ledger_ok"]
+            # the hot path must beat the per-slot arm on BOTH axes
+            and opt["client_tokens_per_sec"]
+            > base["client_tokens_per_sec"]
+            and opt["ttft_p95_s"] < base["ttft_p95_s"]
+        )
+        return 0 if ok else 1
     if args.serving:
         result = {
             "metric": "serving_tokens_per_sec",
@@ -1346,7 +1523,12 @@ def main(argv=None) -> int:
         result["gold_ttft_p95_s"] = cont["gold_ttft_p95_s"]
         result["gold_ttft_p95_baseline_s"] = fixed["gold_ttft_p95_s"]
         result["kv_util_mean"] = cont["kv_util_mean"]
-        result["kv_util_legacy_mean"] = cont["kv_util_legacy_mean"]
+        # headline keys in the shared BENCH_*.json shape: the perf
+        # trajectory tracker scans recorded files for these flat
+        # numerics, so r10 and later serving records register
+        # automatically
+        result["serve_toks_per_sec"] = cont["client_tokens_per_sec"]
+        result["serve_ttft_p95"] = cont["ttft_p95_s"]
         print(json.dumps(result))
         ok = (
             cont["hung"] == 0 and fixed["hung"] == 0
@@ -1355,13 +1537,9 @@ def main(argv=None) -> int:
             # useful tok/s at equal capacity...
             and cont["client_tokens_per_sec"]
             > fixed["client_tokens_per_sec"]
-            # ...keeps the latency class inside its TTFT SLO while
-            # best-effort degrades gracefully (still terminates)...
+            # ...and keeps the latency class inside its TTFT SLO while
+            # best-effort degrades gracefully (still terminates)
             and cont["gold_ttft_p95_s"] <= cont["gold_ttft_slo_s"]
-            # ...and the paged metric reports strictly higher (true)
-            # utilization than the legacy stripe metric at mixed
-            # sequence lengths
-            and cont["kv_util_mean"] > cont["kv_util_legacy_mean"]
         )
         return 0 if ok else 1
     if args.defrag:

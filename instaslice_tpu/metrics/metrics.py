@@ -211,7 +211,8 @@ class ServingMetrics:
             self.phase_seconds = _NoopMetric()
             self.batch_occupancy = _NoopMetric()
             self.kv_cache_utilization = _NoopMetric()
-            self.kv_cache_utilization_legacy = _NoopMetric()
+            self.prefill_batch_occupancy = _NoopMetric()
+            self.dispatch_gap_seconds = _NoopMetric()
             self.kv_blocks_free = _NoopMetric()
             self.kv_blocks_used = _NoopMetric()
             self.kv_blocks_cow = _NoopMetric()
@@ -305,12 +306,23 @@ class ServingMetrics:
             "Resident tokens / capacity of allocated KV blocks",
             registry=self.registry,
         )
-        # the pre-paging stripe metric, kept ONE release under _legacy
-        # so dashboards keyed on the old semantics don't silently shift
-        self.kv_cache_utilization_legacy = Gauge(
-            "tpuslice_serve_kv_cache_utilization_legacy",
-            "DEPRECATED pre-paging metric: live tokens / (max_batch x "
-            "max_len); replaced by tpuslice_serve_kv_cache_utilization",
+        # --- engine hot path (docs/SERVING.md "Engine hot path") ---
+        # batched prefill: real rows / bucket rows per multi-slot
+        # prefill dispatch (1.0 = the bucket was full; low values mean
+        # bursts arrive narrower than the padding spends)
+        self.prefill_batch_occupancy = Histogram(
+            "tpuslice_serve_prefill_batch_occupancy",
+            "Real rows / bucket rows per batched prefill dispatch",
+            buckets=(0.125, 0.25, 0.5, 0.625, 0.75, 0.875, 1.0),
+            registry=self.registry,
+        )
+        # host-side seam between consecutive engine dispatches — the
+        # device-idle time overlap + batched admission exist to shrink
+        self.dispatch_gap_seconds = Histogram(
+            "tpuslice_serve_dispatch_gap_seconds",
+            "Host planning time between engine dispatches (device idle)",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1, 0.25, 1),
             registry=self.registry,
         )
         self.kv_blocks_free = Gauge(

@@ -672,6 +672,7 @@ class TpuLM:
         lora: Optional[Params] = None,
         adapter_idx: Optional[jax.Array] = None,
         quant_kernel: bool = True,
+        single_adapter: bool = False,
     ) -> Tuple[jax.Array, Params]:
         """Incremental forward: run ``tokens`` (B, T) through the model
         with each row appended at its own cache offset ``lengths`` (B,).
@@ -696,6 +697,16 @@ class TpuLM:
         quantized weights at decode-sized row counts; the engine passes
         False under a multi-device mesh (pallas_call does not
         auto-partition — see ``quant.qdot``).
+
+        ``single_adapter`` (static): the whole batch flows through ONE
+        adapter — ``adapter_idx`` is then a (1,) traced id shared by
+        every row, and the delta indexes the stacked tree once
+        ((in, r) @ (r, out) per target) instead of one-hot-gathering a
+        per-row (B, in, r)/(B, r, out) pair over the full adapter
+        stack. Token-identical to the gathered path for rows whose
+        one-hot pick is this id (exact-zero terms drop out); the
+        serving engine selects it host-side when a decode round's live
+        slots all share an adapter (including 0 = base).
 
         ``attend_len`` (static) bounds the attended cache window:
         attention reads only positions [0, attend_len) instead of the
@@ -723,7 +734,26 @@ class TpuLM:
         # adapter (see serving.engine), making "no adapter" a zero
         # delta rather than a second compiled program.
         use_lora = lora is not None and adapter_idx is not None
-        if use_lora:
+        if use_lora and single_adapter:
+            # one shared adapter id for the whole batch: index the
+            # stack once per target instead of gathering per row. The
+            # scale multiplies A only (as below — the delta is linear
+            # in the product), and the dtype casts mirror the gathered
+            # path exactly so the two variants stay bit-identical.
+            aid = adapter_idx.reshape(-1)[0]
+            a_scale = lora["scales"].astype(cfg.dtype)[aid]
+
+            def lora_delta(h_in, ab):
+                """(B, T, out) delta, every row through adapter
+                ``aid``: (in, r) @ (r, out), no batch-indexed stack."""
+                a_s = ab["a"].astype(cfg.dtype)[aid] * a_scale
+                b_s = ab["b"].astype(cfg.dtype)[aid]
+                xa = jnp.einsum("bti,ir->btr", h_in, a_s,
+                                preferred_element_type=jnp.float32)
+                return jnp.einsum("btr,ro->bto", xa.astype(cfg.dtype),
+                                  b_s,
+                                  preferred_element_type=jnp.float32)
+        elif use_lora:
             n_adapters = lora["scales"].shape[0]
             pick = jax.nn.one_hot(adapter_idx, n_adapters,
                                   dtype=cfg.dtype)
@@ -731,17 +761,18 @@ class TpuLM:
             # in the product — scaling both gathers would square it)
             sel = pick * lora["scales"].astype(cfg.dtype)[None, :]
 
-        def lora_delta(h_in, ab):
-            """(B, T, out) delta for one target: row b uses adapter
-            ``adapter_idx[b]``'s (in, r) @ (r, out), scaled."""
-            a_b = jnp.einsum("bn,nir->bir", sel,
-                             ab["a"].astype(cfg.dtype))
-            b_b = jnp.einsum("bn,nro->bro", pick,
-                             ab["b"].astype(cfg.dtype))
-            xa = jnp.einsum("bti,bir->btr", h_in, a_b,
-                            preferred_element_type=jnp.float32)
-            return jnp.einsum("btr,bro->bto", xa.astype(cfg.dtype), b_b,
-                              preferred_element_type=jnp.float32)
+            def lora_delta(h_in, ab):
+                """(B, T, out) delta for one target: row b uses adapter
+                ``adapter_idx[b]``'s (in, r) @ (r, out), scaled."""
+                a_b = jnp.einsum("bn,nir->bir", sel,
+                                 ab["a"].astype(cfg.dtype))
+                b_b = jnp.einsum("bn,nro->bro", pick,
+                                 ab["b"].astype(cfg.dtype))
+                xa = jnp.einsum("bti,bir->btr", h_in, a_b,
+                                preferred_element_type=jnp.float32)
+                return jnp.einsum("btr,bro->bto", xa.astype(cfg.dtype),
+                                  b_b,
+                                  preferred_element_type=jnp.float32)
 
         # sliding-window models read only a (window + T - 1)-wide band
         # of the cache per row (vmapped dynamic_slice at each row's own

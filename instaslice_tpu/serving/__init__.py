@@ -7,7 +7,12 @@ metric (tokens/sec/chip) needs a measurable decode path on the granted
 mesh.
 """
 
-from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+from instaslice_tpu.serving.engine import (
+    AdmissionRequest,
+    GenerationResult,
+    ServingEngine,
+)
 from instaslice_tpu.serving.kvcache import KVBlockPool
 
-__all__ = ["ServingEngine", "GenerationResult", "KVBlockPool"]
+__all__ = ["AdmissionRequest", "ServingEngine", "GenerationResult",
+           "KVBlockPool"]

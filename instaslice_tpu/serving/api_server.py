@@ -609,7 +609,8 @@ class ApiServer:
                  drain_budget: Optional[float] = None,
                  fault_plan=None, tenants=None,
                  mode: Optional[str] = None,
-                 preempt_margin: Optional[float] = None):
+                 preempt_margin: Optional[float] = None,
+                 overlap: Optional[bool] = None):
         if request_timeout is None:
             request_timeout = _env_float("TPUSLICE_REQUEST_TIMEOUT", 300)
         if max_queue is None:
@@ -632,7 +633,8 @@ class ApiServer:
                                     drain_budget=drain_budget,
                                     fault_hook=sched_hook,
                                     tenants=tenants, mode=mode,
-                                    preempt_margin=preempt_margin)
+                                    preempt_margin=preempt_margin,
+                                    overlap=overlap)
         handler = type("BoundHandler", (_Handler,),
                        {"scheduler": self.scheduler,
                         "request_timeout": request_timeout})
@@ -719,6 +721,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="preempt a best-effort slot once a latency-"
                          "class request has waited this fraction of "
                          "its TTFT SLO (env: TPUSLICE_PREEMPT_MARGIN)")
+    ap.add_argument("--no-batched-prefill", action="store_true",
+                    help="disable the multi-slot batched prefill "
+                         "program (admission bursts prefill one slot "
+                         "at a time — the pre-r10 dispatch shape)")
+    ap.add_argument("--no-adapter-fastpath", action="store_true",
+                    help="disable the single-adapter decode variant "
+                         "(every round pays the per-row one-hot LoRA "
+                         "gather even when the batch shares one "
+                         "adapter)")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="fully synchronous decode dispatch (no "
+                         "host/device overlap; also "
+                         "TPUSLICE_ENGINE_OVERLAP=0)")
     ap.add_argument("--kv-block-size", type=int, default=16,
                     help="paged KV-cache block size in tokens "
                          "(serving/kvcache.py): admission, preemption "
@@ -916,10 +931,16 @@ def build_engine(args) -> ServingEngine:
         lora_alphas=alphas or None,
         lora_names=names or None,
         kv_block_size=getattr(args, "kv_block_size", 16),
+        batched_prefill=not getattr(args, "no_batched_prefill", False),
+        adapter_fastpath=not getattr(args, "no_adapter_fastpath",
+                                     False),
     )
     #: single-adapter merge: remember the name so a request naming it
     #: gets a helpful error (the adapter is always on; omit the field)
     eng.merged_adapter = merged_name
+    # pay every prefill-bucket compile at startup, not under the first
+    # admission burst (docs/SERVING.md "Engine hot path")
+    eng.warm_prefill_buckets()
     return eng
 
 
@@ -974,7 +995,8 @@ def main(argv=None) -> int:
                     drain_budget=args.drain_budget,
                     fault_plan=FaultPlan.from_env(),
                     tenants=args.tenants, mode=args.sched_mode,
-                    preempt_margin=args.preempt_margin).start()
+                    preempt_margin=args.preempt_margin,
+                    overlap=False if args.no_overlap else None).start()
     if args.metrics_port:
         from instaslice_tpu.metrics.metrics import start_metrics_server
 

@@ -21,6 +21,8 @@ Wire format (one JSON object per line)::
 
     {"op": "add_request", "prompt": [...], "stop": [[...]], "n": 1,
      "adapter": 0}
+    {"op": "add_requests", "reqs": [{"prompt": [...], "n": 1,
+     "stop": [[...]], "adapter": 0}, ...]}
     {"op": "step"} | {"op": "decode_block", "n": 8} | {"op": "spec_step"}
     {"op": "register_prefix", "tokens": [...]}
     {"op": "drop_prefix", "tokens": [...]}
@@ -57,7 +59,7 @@ import socket
 import time
 from typing import List, Optional
 
-from instaslice_tpu.serving.engine import ServingEngine
+from instaslice_tpu.serving.engine import AdmissionRequest, ServingEngine
 
 log = logging.getLogger("instaslice_tpu.serving.distributed")
 
@@ -177,6 +179,25 @@ class DistributedEngine:
         return self.engine.add_request_n(prompt, n, stop=stop,
                                          adapter=adapter)
 
+    def add_requests(self, reqs):
+        """Burst admission rides the op stream as ONE op: followers
+        replay the identical batched prefill dispatches (same bucketed
+        shapes), so the compiled-program sets stay aligned."""
+        reqs = [r if isinstance(r, AdmissionRequest)
+                else AdmissionRequest(**r) for r in reqs]
+        norm = []
+        for r in reqs:
+            stop = ServingEngine._normalize_stop(r.stop)
+            self.engine._check_prompt_fits(r.prompt)
+            norm.append(AdmissionRequest(list(r.prompt), r.n, stop,
+                                         r.adapter))
+        self.engine._check_capacity(sum(r.n for r in norm))
+        self._bcast({"op": "add_requests", "reqs": [
+            {"prompt": r.prompt, "n": r.n, "stop": r.stop,
+             "adapter": r.adapter} for r in norm
+        ]})
+        return self.engine.add_requests(norm)
+
     def step(self):
         self._bcast({"op": "step"})
         return self.engine.step()
@@ -184,6 +205,17 @@ class DistributedEngine:
     def decode_block(self, n_steps: int):
         self._bcast({"op": "decode_block", "n": n_steps})
         return self.engine.decode_block(n_steps)
+
+    def decode_block_start(self, n_steps: int):
+        """The overlap seam over the op stream: the BROADCAST happens
+        at start (followers dispatch their block concurrently with the
+        driver's — that is the point); finish is driver-local (the
+        followers' replayed decode_block does its own readback)."""
+        self._bcast({"op": "decode_block", "n": n_steps})
+        return self.engine.decode_block_start(n_steps)
+
+    def decode_block_finish(self):
+        return self.engine.decode_block_finish()
 
     def spec_step(self):
         self._bcast({"op": "spec_step"})
@@ -283,7 +315,8 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
             kind = op["op"]
             if kind == "shutdown":
                 return applied
-            if kind not in ("add_request", "step", "decode_block",
+            if kind not in ("add_request", "add_requests", "step",
+                            "decode_block",
                             "spec_step", "register_prefix",
                             "drop_prefix", "finish_slot", "evict_slot",
                             "preempt_slot", "resume_request",
@@ -296,6 +329,13 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                     engine.add_request_n(op["prompt"], op.get("n", 1),
                                          stop=op["stop"],
                                          adapter=op.get("adapter", 0))
+                elif kind == "add_requests":
+                    engine.add_requests([
+                        AdmissionRequest(r["prompt"], r.get("n", 1),
+                                         r.get("stop"),
+                                         r.get("adapter", 0))
+                        for r in op["reqs"]
+                    ])
                 elif kind == "step":
                     engine.step()
                 elif kind == "decode_block":

@@ -85,6 +85,18 @@ class GenerationResult:
 
 
 @dataclasses.dataclass
+class AdmissionRequest:
+    """One request of a burst admission (:meth:`ServingEngine.
+    add_requests`): the same fields ``add_request_n`` takes, as data so
+    a burst can ride one dispatch chain (and one op-stream broadcast)."""
+
+    prompt: List[int]
+    n: int = 1
+    stop: Optional[list] = None
+    adapter: int = 0
+
+
+@dataclasses.dataclass
 class _Slot:
     request_id: int
     prompt: List[int]
@@ -147,6 +159,8 @@ class ServingEngine:
         lora_adapters=None,
         lora_alphas=None,
         lora_names=None,
+        batched_prefill: bool = True,
+        adapter_fastpath: bool = True,
     ) -> None:
         """``kv_quant=True`` stores the KV cache as int8 with per-vector
         scales (``TpuLM.init_cache(quant=True)``): decode streams the
@@ -169,7 +183,17 @@ class ServingEngine:
         emitted — ≥1 and up to ``spec_k + 1`` tokens per target pass,
         token-identical to plain greedy decoding. Rollback is free: the
         per-slot offset cache never attends past ``lengths``, and a
-        rejected position is exactly the next write position."""
+        rejected position is exactly the next write position.
+
+        ``batched_prefill`` enables :meth:`add_requests`' multi-slot
+        prefill program (one ``(P, prefill_len)`` dispatch per chunk
+        round instead of one dispatch chain per admission, with P drawn
+        from a power-of-two bucket set so the compile cache stays
+        bounded); ``adapter_fastpath`` lets decode rounds whose live
+        slots all share one adapter id (including 0 = base) dispatch a
+        single-adapter program variant instead of the per-row one-hot
+        gather. Both default on; the bench's per-slot baseline arm and
+        A/B debugging turn them off."""
         if prefill_len > max_len:
             raise ValueError("prefill_len must be <= max_len")
         self.model = model
@@ -314,6 +338,44 @@ class ServingEngine:
         #: None (the default) costs one attribute read per dispatch
         self.fault_hook = None
 
+        # ---- engine hot path (docs/SERVING.md "Engine hot path") ----
+        #: multi-slot prefill: admission bursts share one dispatch
+        #: chain, P rows per chunk round bucketed to powers of two
+        self.batched_prefill = batched_prefill
+        #: power-of-two row buckets for the batched prefill program
+        #: (one compile each; a burst wider than the largest bucket
+        #: splits across dispatches). Bucket 1 is deliberately ABSENT:
+        #: a single-row chunk (a lone admission, or a burst whose chunk
+        #: rounds drained unevenly) rides the plain per-slot prefill
+        #: program — same shape family, already compiled, no extra
+        #: cache entry
+        self._prefill_buckets = [
+            1 << i for i in range(1, max_batch.bit_length())
+            if (1 << i) <= max_batch
+        ]
+        #: single-adapter decode fast path: skip the per-row one-hot
+        #: LoRA gather when every live slot shares one adapter id
+        self.adapter_fastpath = adapter_fastpath
+        #: memoized (1,) device ids for the fast path (bounded by
+        #: n_adapters + 1; avoids a host->device transfer per round)
+        self._single_aidx_cache: Dict[int, jax.Array] = {}
+        # hot-path observability (drained into ServingMetrics by the
+        # scheduler; also surfaced raw on /v1/stats)
+        self.prefill_batches = 0       # batched chunk dispatches
+        self.prefill_rows = 0          # real rows across them
+        self.prefill_pad_rows = 0      # bucket-padding rows across them
+        self.fastpath_rounds = 0       # decode rounds on the single-
+        self.gathered_rounds = 0       # adapter variant vs the gather
+        #: per-dispatch batched-prefill occupancy samples (real rows /
+        #: bucket rows), drained by Scheduler._drain_prefill_occupancy
+        self._prefill_occ: List[float] = []
+        #: an in-flight decode block (dispatched, tokens not yet read
+        #: back) — the host/device overlap seam (decode_block_start /
+        #: decode_block_finish); every other mutating entry point
+        #: drains it first so engine state can never be touched with a
+        #: block half-landed
+        self._pending_block: Optional[dict] = None
+
         self.draft_model = draft_model
         self.spec_k = spec_k
         if draft_model is not None:
@@ -365,6 +427,15 @@ class ServingEngine:
             donate_argnums=(1,),
             out_shardings=rep((None, self._replicated)),
         )
+        # multi-slot prefill: P chunks into P distinct slots' stripes
+        # in ONE dispatch (P = a power-of-two bucket; one compile per
+        # bucket). Logits replicate like _prefill's — admission samples
+        # from them host-side.
+        self._prefill_batch = jax.jit(
+            self._prefill_batch_impl,
+            donate_argnums=(1,),
+            out_shardings=rep((None, self._replicated)),
+        )
         # stripe length is a static shape: one compile per distinct
         # registered-prefix length (chunk multiples keep the set small)
         self._read_stripe = jax.jit(
@@ -373,15 +444,20 @@ class ServingEngine:
         self._write_stripe = jax.jit(
             self._write_stripe_impl, donate_argnums=(0,)
         )
+        # ``single`` (static) keys the single-adapter fast-path variant
+        # of each decode program — selected host-side per round, so the
+        # compiled set stays fixed: gathered + (with adapters) single
         self._decode = jax.jit(
             self._decode_impl,
+            static_argnames=("single",),
             donate_argnums=(1,),
             out_shardings=rep((None, self._replicated)),
         )
         self._decode_block = jax.jit(
             self._decode_block_impl,
             static_argnames=("n_steps", "greedy", "attend_len",
-                             "top_k", "top_p", "min_p", "penalize"),
+                             "top_k", "top_p", "min_p", "penalize",
+                             "single"),
             donate_argnums=(1,),
             out_shardings=rep(
                 (None, self._replicated, self._replicated,
@@ -505,6 +581,31 @@ class ServingEngine:
             self.model, params, cache, tokens, slot, offset, aidx=aidx
         )
 
+    def _prefill_batch_impl(self, params, cache, tokens, slots, offsets,
+                            aidx):
+        """Prefill P same-shaped chunks into P slots' cache stripes in
+        ONE dispatch: gather the P stripes, run the model once over the
+        (P, prefill_len) batch (each row masked to its own offset), and
+        scatter the stripes back. Rows are independent — per-row
+        results are exactly what P separate ``_prefill`` calls produce.
+        Padding rows (bucket alignment) duplicate a real row: the
+        scatter then writes identical values twice, which is idempotent
+        whatever order XLA picks. Returns (cache, (P, prefill_len,
+        vocab) logits)."""
+        stripes = jax.tree.map(lambda c: jnp.take(c, slots, axis=1),
+                               cache)
+        use_lora = self.lora is not None
+        logits, stripes = self.model.apply_with_cache(
+            params, tokens, stripes, offsets,
+            lora=self.lora if use_lora else None,
+            adapter_idx=aidx if use_lora else None,
+            quant_kernel=self._quant_kernel,
+        )
+        cache = jax.tree.map(
+            lambda c, s: c.at[:, slots].set(s), cache, stripes,
+        )
+        return cache, logits
+
     def _read_stripe_impl(self, cache, slot, *, length: int):
         """Copy out one slot's cache positions [0, length) — every leaf
         is (L, B, H, S[, hd]) with slot on axis 1 and position on
@@ -526,12 +627,14 @@ class ServingEngine:
 
         return jax.tree.map(wr, cache, stripe)
 
-    def _decode_impl(self, params, cache, last_token, lengths, aidx):
+    def _decode_impl(self, params, cache, last_token, lengths, aidx, *,
+                     single: bool = False):
         logits, cache = self.model.apply_with_cache(
             params, last_token[:, None], cache, lengths,
             lora=self.lora,
             adapter_idx=aidx if self.lora is not None else None,
             quant_kernel=self._quant_kernel,
+            single_adapter=single,
         )
         return cache, logits[:, 0]                  # (B, vocab)
 
@@ -540,7 +643,8 @@ class ServingEngine:
                            n_steps: int,
                            greedy: bool, attend_len: int = 0,
                            top_k: int = 0, top_p: float = 1.0,
-                           min_p: float = 0.0, penalize: bool = False):
+                           min_p: float = 0.0, penalize: bool = False,
+                           single: bool = False):
         """``n_steps`` decode steps as one ``lax.scan``: each sampled
         token feeds the next step on-device — no host round-trip inside
         the block. Returns the advanced state plus the (n_steps, B) token
@@ -563,6 +667,7 @@ class ServingEngine:
                 lora=self.lora,
                 adapter_idx=aidx if self.lora is not None else None,
                 quant_kernel=self._quant_kernel,
+                single_adapter=single,
             )
             logits = logits[:, 0]
             if penalize:
@@ -667,6 +772,33 @@ class ServingEngine:
             )
         return toks, token_logprob(logits, toks)
 
+    def _adapter_args(self):
+        """(aidx, single) for this round's decode dispatch. When every
+        live slot shares one adapter id (including 0 = base) and the
+        fast path is on, dispatch the single-adapter program variant:
+        ``aidx`` becomes a memoized (1,) id and the compiled program
+        indexes the stacked LoRA tree once instead of one-hot-gathering
+        per row. Selection is host-side (``_slot_adapter_host``), so
+        the compiled-program set stays fixed: gathered + single."""
+        if self.lora is None:
+            return self.slot_adapter, False
+        if self.adapter_fastpath:
+            ids = {self._slot_adapter_host.get(s, 0) for s in self.slots}
+            if len(ids) == 1:
+                self.fastpath_rounds += 1
+                return self._single_aidx(ids.pop()), True
+        self.gathered_rounds += 1
+        return self.slot_adapter, False
+
+    def _single_aidx(self, aid: int) -> jax.Array:
+        arr = self._single_aidx_cache.get(aid)
+        if arr is None:
+            arr = jnp.full((1,), aid, jnp.int32)
+            if self._replicated is not None:
+                arr = jax.device_put(arr, self._replicated)
+            self._single_aidx_cache[aid] = arr
+        return arr
+
     # -------------------------------------------------------------- public
 
     def free_slots(self) -> int:
@@ -692,24 +824,11 @@ class ServingEngine:
         fill, never a ``max_len`` stripe. Feeds
         ``tpuslice_serve_kv_cache_utilization``; MIG-serving
         reconfiguration papers key decisions off exactly this occupancy
-        signal. The pre-paging stripe metric survives as
-        :meth:`kv_utilization_legacy` (gauge ``..._legacy``) for one
-        release so dashboards don't silently shift."""
+        signal. (The pre-paging stripe metric — live tokens over the
+        whole max_batch × max_len rectangle — rode one release as
+        ``kv_utilization_legacy`` / gauge ``..._legacy`` after PR 9 and
+        is now retired.)"""
         return self.kv.utilization(self._resident_tokens())
-
-    def kv_utilization_legacy(self) -> float:
-        """The pre-paging metric: live tokens over the whole
-        (max_batch x max_len) rectangle — misleadingly low at mixed
-        sequence lengths (it charges every slot its full stripe) and
-        blind to parked state. Kept one release for dashboard
-        continuity; prefer :meth:`kv_utilization`."""
-        if not self.slots:
-            return 0.0
-        used = sum(
-            len(r.prompt) + len(r.generated)
-            for r in list(self.slots.values())
-        )
-        return min(1.0, used / float(self.max_batch * self.max_len))
 
     def kv_stats(self) -> dict:
         """Block-pool gauges (free/used/cow + parked count) for
@@ -719,8 +838,102 @@ class ServingEngine:
         out = self.kv.stats(dict(self._tables))
         out["parked"] = len(self.parked)
         out["utilization"] = self.kv_utilization()
-        out["utilization_legacy"] = self.kv_utilization_legacy()
         return out
+
+    def compiled_programs(self) -> Dict[str, int]:
+        """Per-jit compile-cache sizes — the observable behind the
+        "bounded compiled-program set" claim (asserted by the
+        compile-count regression test, surfaced on ``/v1/stats``).
+        Every entry is the number of distinct programs XLA compiled for
+        that dispatch form so far this process."""
+        out: Dict[str, int] = {}
+        for name in ("_prefill", "_prefill_batch", "_read_stripe",
+                     "_write_stripe", "_decode", "_decode_block",
+                     "_draft_prefill", "_draft_catchup", "_spec_draft",
+                     "_spec_verify"):
+            f = getattr(self, name, None)
+            if f is None:
+                continue
+            try:
+                out[name.lstrip("_")] = f._cache_size()
+            # observability only: a jax internals change must degrade
+            # to a missing entry, never break /v1/stats
+            except Exception:  # noqa: BLE001  # slicelint: disable=broad-except
+                pass
+        return out
+
+    def compile_budget(self, block_cap: int = 0) -> Dict[str, int]:
+        """The DOCUMENTED upper bound on compiled programs per dispatch
+        form for this engine's configuration (docs/SERVING.md "Engine
+        hot path") — what :meth:`compiled_programs` is asserted
+        against. ``block_cap`` is the largest decode-block length the
+        caller dispatches (the scheduler's ``block_size``; 0 = assume
+        up to ``max_len``).
+
+        - prefill: 1 (every chunk is the same padded shape; lone
+          burst rows reuse it too — bucket 1 does not exist)
+        - prefill_batch: one per power-of-two row bucket (2..max_batch)
+        - decode / decode_block: gathered + (with adapters) the
+          single-adapter variant, times the power-of-two step counts
+          and 256-position attend buckets for the block form
+        - read/write_stripe: one per distinct static stripe length —
+          chunk multiples (prefix/fork stripes) plus block multiples
+          (preemption roundings)
+        """
+        cap = block_cap or self.max_len
+        # power-of-two n_steps values in [1, cap]
+        n_steps = max(1, cap).bit_length()
+        # attend buckets: multiples of 256 below max_len, plus the
+        # full-cache (attend_len=0) variant
+        attend = max(1, -(-self.max_len // 256))
+        variants = 2 if self.lora is not None else 1
+        chunk_lens = self.max_len // self.prefill_len
+        block_lens = -(-self.max_len // self.kv_block_size)
+        stripe_lens = chunk_lens + block_lens
+        out = {
+            "prefill": 1,
+            "prefill_batch": len(self._prefill_buckets),
+            "decode": variants,
+            "decode_block": n_steps * attend * variants,
+            "read_stripe": stripe_lens,
+            "write_stripe": stripe_lens,
+        }
+        if self.draft_model is not None:
+            # catch-up consumes (B, 1) from step() and (B, n) from
+            # decode_block; spec k shrinks near the cache end, so each
+            # k in [0, spec_k] is a distinct draft/verify shape
+            out.update({
+                "draft_prefill": 1,
+                "draft_catchup": 1 + n_steps,
+                "spec_draft": self.spec_k + 1,
+                "spec_verify": self.spec_k + 1,
+            })
+        return out
+
+    def warm_prefill_buckets(self) -> None:
+        """Compile every batched-prefill bucket NOW, against the live
+        cache, with zero admissions — call once before taking traffic
+        (the serve CLI does; the bench does per arm) so no burst pays
+        a compile mid-measurement. The dummy rows write masked
+        positions of slot 0's stripe: harmless while no slot is live
+        (admission prefill overwrites everything it attends). No-op
+        with batched prefill off."""
+        if not self.batched_prefill or not self._prefill_buckets:
+            return
+        if self.slots:
+            raise RuntimeError(
+                "warm_prefill_buckets must run before any admission "
+                "(it scribbles on slot 0's masked stripe)"
+            )
+        P = self.prefill_len
+        for b in self._prefill_buckets:
+            self.cache, _ = self._prefill_batch(
+                self.params, self.cache,
+                jnp.zeros((b, P), jnp.int32),
+                jnp.zeros(b, jnp.int32),
+                jnp.zeros(b, jnp.int32),
+                jnp.zeros(b, jnp.int32),
+            )
 
     def _release_table(self, rid: int) -> None:
         t = self._tables.pop(rid, None)
@@ -730,14 +943,22 @@ class ServingEngine:
     def _sync_tables(self) -> None:
         """Grow every live slot's block table to its token count —
         called after each decode dispatch so freed/grown blocks are
-        visible to the very next admission decision. Never raises for
-        engine-only use: live tables cannot exceed the pool (each slot
-        is bounded by its row); only parked state can over-subscribe,
-        and the scheduler's headroom guard sheds it first."""
+        visible to the very next admission decision. INCREMENTAL: a
+        slot whose growth stays inside its current blocks (no new
+        block, no shared boundary to copy) just bumps the token count —
+        zero allocator work — so the post-readback host window stays
+        thin and scheduler planning overlaps device compute. Never
+        raises for engine-only use: live tables cannot exceed the pool
+        (each slot is bounded by its row); only parked state can
+        over-subscribe, and the scheduler's headroom guard sheds it
+        first."""
         for slot, req in self.slots.items():
             t = self._tables.get(req.request_id)
-            if t is not None:
-                self.kv.ensure(t, len(req.prompt) + len(req.generated))
+            if t is None:
+                continue
+            total = len(req.prompt) + len(req.generated)
+            if not self.kv.bump(t, total):
+                self.kv.ensure(t, total)
 
     def can_admit(self, prompt_len: int, n: int = 1) -> bool:
         """Step-level admission check: free slots AND free KV blocks.
@@ -766,6 +987,7 @@ class ServingEngine:
         stream (:mod:`instaslice_tpu.serving.distributed`); internal
         removals (eos/stop/max_len in ``_maybe_finish``) replay
         deterministically from the op stream and need no broadcast."""
+        self._drain_pending()
         req = self.slots.pop(slot)
         self._release_table(req.request_id)
         toks = req.generated if n_keep is None else req.generated[:n_keep]
@@ -779,6 +1001,7 @@ class ServingEngine:
         """Drop a live slot with NO result (abandoned request): the
         tokens were never delivered to anyone. Its blocks are free for
         the next admission immediately."""
+        self._drain_pending()
         req = self.slots.pop(slot)
         self._release_table(req.request_id)
 
@@ -791,6 +1014,7 @@ class ServingEngine:
         Part of the multi-host broadcast surface like finish_slot (slot
         occupancy feeds the compiled decode's attend window); returns
         the parked request id."""
+        self._drain_pending()
         if self.fault_hook is not None:
             self.fault_hook("prefill")
         req = self.slots[slot]
@@ -823,6 +1047,7 @@ class ServingEngine:
         so the stripe is row-position-exact), restore decode state, and
         return the slot. Raises when no slot is free or the rid is not
         parked (callers check, like add_request's capacity)."""
+        self._drain_pending()
         if rid not in self.parked:
             raise ValueError(f"request {rid} is not parked")
         slot = self._first_free_slot("no free slot to resume into")
@@ -901,6 +1126,8 @@ class ServingEngine:
         broadcast the reset through its op stream instead."""
         import jax.numpy as jnp
 
+        # an in-flight block's outputs died with the old cache's lineage
+        self._pending_block = None
         lost = [r.request_id for r in self.slots.values()]
         for rid in lost:
             self._release_table(rid)
@@ -1023,6 +1250,7 @@ class ServingEngine:
         key = tuple(prefix)
         if key in self.prefixes:
             return
+        self._drain_pending()
         self._validate_prefix(prefix)
         if self.fault_hook is not None:
             self.fault_hook("prefill")
@@ -1137,6 +1365,7 @@ class ServingEngine:
         # the span joins the caller's ambient trace (the API scheduler
         # binds the request's trace id around admission), so prefill
         # cost is attributable to the request that paid it
+        self._drain_pending()
         with get_tracer().span(
             "engine.prefill", tokens=len(prompt), n=n,
         ) as sp:
@@ -1282,9 +1511,226 @@ class ServingEngine:
             rids.append(rid)
         return rids
 
+    def add_requests(self, reqs: List[AdmissionRequest]) \
+            -> List[List[int]]:
+        """Admit a BURST of requests through ONE dispatch chain: every
+        chunk round prefills one ``(P, prefill_len)`` multi-slot batch
+        (P bucketed to powers of two, so a burst of B admissions costs
+        ``max(chunks)`` bucketed dispatches instead of ``sum(chunks)``
+        sequential ones). Token-identical to admitting the same
+        requests one by one in order — rows are independent, and
+        first-token sampling runs per request in burst order so even
+        the RNG stream matches the sequential path. Returns one rid
+        list per request, 1:1 with ``reqs``; all-or-nothing on
+        capacity like :meth:`add_request_n`.
+
+        Falls back to sequential admission when ``batched_prefill`` is
+        off, a draft model is attached (draft chunk prefills are not
+        batched), or the burst is a single request."""
+        reqs = [r if isinstance(r, AdmissionRequest)
+                else AdmissionRequest(**r) for r in reqs]
+        if (not self.batched_prefill or self.draft_model is not None
+                or len(reqs) <= 1):
+            return [self.add_request_n(r.prompt, r.n, stop=r.stop,
+                                       adapter=r.adapter) for r in reqs]
+        self._drain_pending()
+        with get_tracer().span(
+            "engine.prefill_batch", reqs=len(reqs),
+            tokens=sum(len(r.prompt) for r in reqs),
+        ) as sp:
+            return self._add_requests_inner(reqs, sp)
+
+    def _add_requests_inner(self, reqs: List[AdmissionRequest], sp) \
+            -> List[List[int]]:
+        # host-side validation for the WHOLE burst before any device op
+        # or table allocation (all-or-nothing: one bad request rejects
+        # the burst — callers pre-screen per request where that matters)
+        stops = [self._normalize_stop(r.stop) for r in reqs]
+        for r in reqs:
+            if not 0 <= r.adapter <= self.n_adapters:
+                raise ValueError(
+                    f"adapter {r.adapter} out of range (engine has "
+                    f"{self.n_adapters} adapter(s); 0 = base)"
+                )
+            self._check_prompt_fits(r.prompt)
+        self._check_capacity(sum(r.n for r in reqs))
+        prefs = [self._match_prefix(r.prompt) if r.adapter == 0
+                 else None for r in reqs]
+        all_tables: List[List[BlockTable]] = []
+        try:
+            for r, pref in zip(reqs, prefs):
+                all_tables.append(
+                    self._alloc_tables(len(r.prompt), r.n, pref)
+                )
+            return self._admit_burst(reqs, stops, prefs, all_tables, sp)
+        except BaseException:
+            # nothing admitted on failure: release every table the
+            # burst reserved (release is idempotent, so tables that
+            # made it into _tables before a late failure just free)
+            for tables in all_tables:
+                for t in tables:
+                    self.kv.release(t)
+            raise
+
+    def _admit_burst(self, reqs, stops, prefs, all_tables, sp) \
+            -> List[List[int]]:
+        if self.fault_hook is not None:
+            self.fault_hook("prefill")
+        P = self.prefill_len
+        free = self._free_slot_indices()
+        slots_per: List[List[int]] = []
+        i = 0
+        for r in reqs:
+            # contiguous low-first assignment == what sequential
+            # add_request_n calls would pick (slot-allocation policy
+            # must not drift between the two admission paths)
+            slots_per.append(free[i:i + r.n])
+            i += r.n
+        flat_slots = [s for ss in slots_per for s in ss]
+        flat_adapt = [r.adapter for r, ss in zip(reqs, slots_per)
+                      for _ in ss]
+        for s, a in zip(flat_slots, flat_adapt):
+            self._slot_adapter_host[s] = a
+        if self.lora is not None:
+            self.slot_adapter = self.slot_adapter.at[
+                jnp.asarray(flat_slots)
+            ].set(jnp.asarray(flat_adapt, jnp.int32))
+        # prefix stripes land before any chunk round touches the slot
+        start_chunks: List[int] = []
+        for r, pref, ss in zip(reqs, prefs, slots_per):
+            sc = 0
+            if pref is not None:
+                self.cache = self._write_stripe(
+                    self.cache, pref.stripe, ss[0]
+                )
+                sc = len(pref.tokens) // P
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += len(pref.tokens)
+            start_chunks.append(sc)
+        # chunk rounds: each request advances ONE chunk per round
+        # (chunk j+1 attends chunk j's KV), all participants in one
+        # bucketed dispatch — a burst of B same-length admissions is
+        # max-chunks dispatches, not B separate chains
+        cursors = list(start_chunks)
+        n_chunks = [-(-len(r.prompt) // P) for r in reqs]
+        last_logits: List[Optional[jax.Array]] = [None] * len(reqs)
+        rounds = 0
+        while True:
+            group = [gi for gi in range(len(reqs))
+                     if cursors[gi] < n_chunks[gi]]
+            if not group:
+                break
+            rounds += 1
+            max_rows = (self._prefill_buckets[-1]
+                        if self._prefill_buckets else 1)
+            for gstart in range(0, len(group), max_rows):
+                part = group[gstart:gstart + max_rows]
+                if len(part) == 1:
+                    # a lone row (uneven chunk drain): the per-slot
+                    # prefill program already compiled for this exact
+                    # shape — no bucket-1 program needed, ever
+                    ri = part[0]
+                    c = reqs[ri].prompt[cursors[ri] * P:
+                                        (cursors[ri] + 1) * P]
+                    padded = jnp.asarray(
+                        c + [0] * (P - len(c)), jnp.int32
+                    )[None]
+                    self.cache, logits1 = self._prefill(
+                        self.params, self.cache, padded,
+                        slots_per[ri][0], cursors[ri] * P,
+                        jnp.full((1,), reqs[ri].adapter, jnp.int32),
+                    )
+                    self.prefill_rows += 1
+                    if cursors[ri] == n_chunks[ri] - 1:
+                        last_logits[ri] = logits1
+                    continue
+                bucket = next(b for b in self._prefill_buckets
+                              if b >= len(part))
+                # padding rows duplicate the last real row — identical
+                # values scattered to the same slot, idempotent
+                rows = part + [part[-1]] * (bucket - len(part))
+                toks = []
+                for ri in rows:
+                    c = reqs[ri].prompt[cursors[ri] * P:
+                                        (cursors[ri] + 1) * P]
+                    toks.append(c + [0] * (P - len(c)))
+                self.cache, logits = self._prefill_batch(
+                    self.params, self.cache,
+                    jnp.asarray(toks, jnp.int32),
+                    jnp.asarray([slots_per[ri][0] for ri in rows],
+                                jnp.int32),
+                    jnp.asarray([cursors[ri] * P for ri in rows],
+                                jnp.int32),
+                    jnp.asarray([reqs[ri].adapter for ri in rows],
+                                jnp.int32),
+                )
+                self.prefill_batches += 1
+                self.prefill_rows += len(part)
+                self.prefill_pad_rows += bucket - len(part)
+                self._prefill_occ.append(len(part) / bucket)
+                for row_i, ri in enumerate(part):
+                    if cursors[ri] == n_chunks[ri] - 1:
+                        last_logits[ri] = logits[row_i]
+            for ri in group:
+                cursors[ri] += 1
+        sp.attrs["rounds"] = str(rounds)
+        # per-request device tail IN BURST ORDER: fork stripe copies,
+        # seen-set resets, first-token sampling — the exact sequence
+        # (and RNG stream) sequential admissions produce
+        toks_per: List[jax.Array] = []
+        lps_per: List[jax.Array] = []
+        for ri, r in enumerate(reqs):
+            ss = slots_per[ri]
+            if r.n > 1:
+                stripe = self._read_stripe(
+                    self.cache, ss[0], length=n_chunks[ri] * P
+                )
+                for s in ss[1:]:
+                    self.cache = self._write_stripe(self.cache, stripe,
+                                                    s)
+            if self.track_seen:
+                rows = jnp.asarray(ss)
+                pt = jnp.asarray(r.prompt, jnp.int32)
+                self.seen = self.seen.at[rows].set(False)
+                self.seen = self.seen.at[
+                    rows[:, None], pt[None, :]
+                ].set(True)
+            ll = last_logits[ri][(len(r.prompt) - 1) % P]
+            t_, l_ = self._sample(
+                jnp.broadcast_to(ll[None], (len(ss),) + ll.shape),
+                rows=ss,
+            )
+            if self.track_seen:
+                self.seen = self.seen.at[jnp.asarray(ss), t_].set(True)
+            toks_per.append(t_)
+            lps_per.append(l_)
+        # registration: pure host bookkeeping, after every device op
+        out: List[List[int]] = []
+        for ri, r in enumerate(reqs):
+            rids: List[int] = []
+            for k, s in enumerate(slots_per[ri]):
+                rid = self._next_id
+                self._next_id += 1
+                self.last_token = self.last_token.at[s].set(
+                    toks_per[ri][k]
+                )
+                self.lengths = self.lengths.at[s].set(len(r.prompt))
+                self.slots[s] = _Slot(
+                    rid, list(r.prompt), [int(toks_per[ri][k])],
+                    list(stops[ri]),
+                    logprobs=[float(lps_per[ri][k])],
+                )
+                self._tables[rid] = all_tables[ri][k]
+                self.tokens_generated += 1
+                self._maybe_finish(s)
+                rids.append(rid)
+            out.append(rids)
+        return out
+
     def step(self) -> Dict[int, int]:
         """One decode step for every live slot; returns request id → new
         token. Slots hitting eos/max_len move to ``finished``."""
+        self._drain_pending()
         if not self.slots:
             return {}
         with get_tracer().span(
@@ -1306,9 +1752,10 @@ class ServingEngine:
         # the sampled token for step t is appended at position lengths+1
         # (the prompt's last token sits at lengths-1; sampled continuation
         # enters the cache when it is fed back as input here)
+        aidx, single = self._adapter_args()
         self.cache, logits = self._decode(
             self.params, self.cache, self.last_token, self.lengths,
-            self.slot_adapter,
+            aidx, single=single,
         )
         toks, lps = self._sample(logits)
         if self.track_seen:
@@ -1344,16 +1791,39 @@ class ServingEngine:
         never attended by a later occupant: prefill resets the slot's
         length and the cache mask hides everything beyond it). Raises if
         any live slot would run past the cache, so block misuse is loud
-        instead of silently clamping writes."""
-        if not self.slots:
-            return {}
-        with get_tracer().span(
-            "engine.decode_block", n_steps=n_steps,
-            batch=len(self.slots),
-        ):
-            return self._decode_block_inner(n_steps)
+        instead of silently clamping writes.
 
-    def _decode_block_inner(self, n_steps: int) -> Dict[int, List[int]]:
+        Split form (the host/device overlap seam the continuous
+        scheduler uses): :meth:`decode_block_start` dispatches the
+        compiled scan and starts an async device→host copy of the token
+        block, :meth:`decode_block_finish` blocks on the copy and does
+        the host bookkeeping — between the two the device is computing
+        while the host plans the next round. This method is simply
+        start + finish."""
+        self.decode_block_start(n_steps)
+        return self.decode_block_finish()
+
+    def _drain_pending(self) -> None:
+        """Land an in-flight decode block before any other engine
+        mutation: slot occupancy, tables, and the carry must never be
+        touched with a dispatched block's tokens unread. Results go
+        through the normal bookkeeping (``finished`` etc.); the
+        scheduler never hits this (it always finishes explicitly) —
+        this keeps direct engine users safe by construction."""
+        if self._pending_block is not None:
+            self.decode_block_finish()
+
+    def decode_block_start(self, n_steps: int) -> bool:
+        """Dispatch ``n_steps`` decode steps WITHOUT blocking on the
+        tokens: the compiled scan is enqueued, the (n_steps, B) token
+        block's device→host copy starts asynchronously, and the call
+        returns while the device computes. Returns False (no dispatch)
+        on an empty batch. A second start before the finish lands the
+        first block first (one block in flight at a time — the carry
+        feeds forward on device, but host bookkeeping is per block)."""
+        self._drain_pending()
+        if not self.slots:
+            return False
         if self.fault_hook is not None:
             self.fault_hook("decode")
         worst = max(
@@ -1375,17 +1845,18 @@ class ServingEngine:
         attend = bucket if bucket < self.max_len else 0
         seen_in = (self.seen if self.track_seen
                    else jnp.zeros((self.max_batch, 1), jnp.bool_))
+        aidx, single = self._adapter_args()
         self.cache, self.last_token, self.lengths, seen_out, toks, lps = (
             self._decode_block(
                 self.params, self.cache, self.last_token, self.lengths,
                 sub, jnp.float32(max(self.temperature, 1e-6)),
                 seen_in,
                 jnp.float32(self.repetition_penalty),
-                self.slot_adapter,
+                aidx,
                 n_steps=n_steps, greedy=self.temperature <= 0.0,
                 attend_len=attend, top_k=self.top_k,
                 top_p=float(self.top_p), min_p=float(self.min_p),
-                penalize=self.track_seen,
+                penalize=self.track_seen, single=single,
             )
         )
         if self.track_seen:
@@ -1402,8 +1873,35 @@ class ServingEngine:
                 self.draft_params, self.draft_cache, consumed,
                 lengths_before,
             )
+        # kick the device→host copy off NOW: by the time the host comes
+        # back to finish(), the transfer rode along with the compute
+        for arr in (toks, lps):
+            start_async = getattr(arr, "copy_to_host_async", None)
+            if start_async is not None:
+                try:
+                    start_async()
+                # purely an overlap hint: any backend quirk degrades to
+                # the synchronous device_get in finish()
+                except Exception:  # noqa: BLE001  # slicelint: disable=broad-except
+                    pass
+        self._pending_block = {
+            "toks": toks, "lps": lps, "n_steps": n_steps,
+            "batch": len(self.slots), "t0": time.perf_counter(),
+        }
+        return True
+
+    def decode_block_finish(self) -> Dict[int, List[int]]:
+        """Block on the in-flight decode block's tokens and do the host
+        bookkeeping (extend per-slot chains, EOS/stop cuts, table
+        growth). Returns request id → new tokens ({} when no block is
+        in flight)."""
+        pending = self._pending_block
+        if pending is None:
+            return {}
+        self._pending_block = None
         # single host round-trip for the block's tokens AND logprobs
-        block, block_lp = jax.device_get((toks, lps))
+        block, block_lp = jax.device_get((pending["toks"],
+                                          pending["lps"]))
         out: Dict[int, List[int]] = {}
         for slot, req in list(self.slots.items()):
             seq = [int(t) for t in block[:, slot]]
@@ -1417,6 +1915,11 @@ class ServingEngine:
             out[req.request_id] = seq
             self._maybe_finish(slot)
         self._sync_tables()
+        get_tracer().record(
+            "engine.decode_block",
+            (time.perf_counter() - pending["t0"]) * 1e3,
+            n_steps=pending["n_steps"], batch=pending["batch"],
+        )
         return out
 
     def spec_step(self) -> Dict[int, List[int]]:
@@ -1437,6 +1940,7 @@ class ServingEngine:
             raise RuntimeError(
                 "spec_step needs an engine built with draft_model="
             )
+        self._drain_pending()
         if not self.slots:
             return {}
         with get_tracer().span(

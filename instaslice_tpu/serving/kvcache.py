@@ -228,6 +228,23 @@ class KVBlockPool:
         grow = max(0, self.blocks_for(tokens) - len(table.blocks))
         return grow + (1 if self._cow_boundary(table) >= 0 else 0)
 
+    def bump(self, table: BlockTable, tokens: int) -> bool:
+        """Token-count-only growth: True when covering ``tokens`` needs
+        NO allocator work — no new block and no shared boundary to
+        copy — in which case the table is updated in place for free.
+        THE incremental fast path of the engine's per-round
+        ``_sync_tables``: most decode rounds grow a slot within its
+        current tail block, and charging a full :meth:`ensure` walk
+        (exhaustion check, boundary scan, append loop) per slot per
+        round is exactly the post-readback host time the overlap seam
+        wants thin. Callers fall back to :meth:`ensure` on False."""
+        if tokens <= table.tokens:
+            return True
+        if self.growth_cost(table, tokens) != 0:
+            return False
+        table.tokens = tokens
+        return True
+
     def release(self, table: BlockTable) -> None:
         """Return every block reference; shared blocks survive while
         another table (or the pinned prefix) still holds them."""

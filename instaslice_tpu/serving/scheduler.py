@@ -69,7 +69,11 @@ from instaslice_tpu.api.constants import (
     REASON_SLO_MISSED,
 )
 from instaslice_tpu.obs.journal import get_journal
-from instaslice_tpu.serving.engine import GenerationResult, ServingEngine
+from instaslice_tpu.serving.engine import (
+    AdmissionRequest,
+    GenerationResult,
+    ServingEngine,
+)
 from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.trace import get_tracer, new_span_id
 
@@ -266,10 +270,38 @@ class Scheduler(threading.Thread):
                  metrics=None, max_queue: int = 0,
                  drain_budget: float = 30.0, fault_hook=None,
                  tenants=None, mode: Optional[str] = None,
-                 preempt_margin: float = 0.5):
+                 preempt_margin: float = 0.5,
+                 overlap: Optional[bool] = None,
+                 prefill_chunk_budget: Optional[int] = None):
         super().__init__(name="serve-scheduler", daemon=True)
         self.engine = engine
         self.block_size = block_size
+        #: host/device overlap: dispatch each decode block, do the
+        #: round's queue-pump/timeout-sweep host work while the device
+        #: computes, then block on the tokens (engine
+        #: decode_block_start/finish). Env TPUSLICE_ENGINE_OVERLAP=0
+        #: restores the fully synchronous dispatch (the bench baseline).
+        if overlap is None:
+            overlap = os.environ.get(
+                "TPUSLICE_ENGINE_OVERLAP", "1"
+            ).lower() not in ("0", "false", "no")
+        self.overlap = overlap
+        #: chunk-scheduling bound: while a latency-class request is
+        #: DECODING, an admission burst may add at most this many chunk
+        #: rounds of prefill per scheduler round (longer prompts wait,
+        #: shorter bursts ride along) — long prompts must not stall a
+        #: latency tenant's TPOT for their whole prefill. 0 disables
+        #: the bound. Env TPUSLICE_PREFILL_CHUNK_BUDGET.
+        if prefill_chunk_budget is None:
+            prefill_chunk_budget = int(os.environ.get(
+                "TPUSLICE_PREFILL_CHUNK_BUDGET",
+                str(max(2, block_size // 4)),
+            ))
+        self.prefill_chunk_budget = prefill_chunk_budget
+        #: wall time when the previous engine dispatch landed — the
+        #: engine.dispatch_gap observable (device-idle seam between
+        #: rounds); None while the batch is empty
+        self._last_dispatch_end: Optional[float] = None
         self.queue: "queue.Queue[Pending]" = queue.Queue()
         self.stop_flag = threading.Event()
         self._by_rid: Dict[int, Pending] = {}
@@ -553,6 +585,7 @@ class Scheduler(threading.Thread):
         self._deliver()
         self._export_kv_gauges()
         if not eng.slots:
+            self._last_dispatch_end = None   # no dispatch to gap against
             self.stop_flag.wait(0.005)
             return
         n = self._select_steps()
@@ -561,16 +594,32 @@ class Scheduler(threading.Thread):
         self._ensure_block_headroom(
             eng.spec_k + 1 if eng.draft_model is not None else max(1, n)
         )
+        use_overlap = (
+            self.overlap and eng.draft_model is None and n >= 1
+            and hasattr(eng, "decode_block_start")
+        )
         t_step = time.monotonic()
+        self._observe_dispatch_gap(t_step)
         try:
             if eng.draft_model is not None:
                 eng.spec_step()
             elif n >= 1:
-                eng.decode_block(n)
+                if use_overlap:
+                    # host/device overlap: the block computes (and its
+                    # token copy streams back) while the host does the
+                    # next round's queue-pump/timeout planning — then
+                    # block on the tokens
+                    eng.decode_block_start(n)
+                    self._overlap_host_work()
+                    eng.decode_block_finish()
+                else:
+                    eng.decode_block(n)
             else:
                 eng.step()
+            self._last_dispatch_end = time.monotonic()
         except Exception as e:  # noqa: BLE001 - recover, keep serving
             log.exception("decode failed: %s", e)
+            self._last_dispatch_end = None
             if eng.cache_poisoned():
                 # the failed call consumed its donated cache buffer:
                 # carrying on would raise "Array has been deleted"
@@ -582,6 +631,34 @@ class Scheduler(threading.Thread):
                 phase, time.monotonic() - t_step, n, round_rids
             )
         self._deliver()
+
+    def _observe_dispatch_gap(self, t_dispatch: float) -> None:
+        """Device-idle seam between consecutive engine dispatches: all
+        the host-side planning/delivery time the device spent waiting.
+        The number batched prefill + overlap exist to shrink."""
+        if self._last_dispatch_end is None:
+            return
+        gap = max(0.0, t_dispatch - self._last_dispatch_end)
+        self.metrics.dispatch_gap_seconds.observe(gap)
+        get_tracer().record("engine.dispatch_gap", gap * 1e3)
+
+    def _overlap_host_work(self) -> None:
+        """Host work safe to run while a decode block is in flight:
+        nothing here may mutate engine state (the block's readback
+        assumes the slot map it dispatched against), so it is queue
+        plumbing and metrics only."""
+        self._pump()
+        self._sweep_timeouts()
+        self._drain_prefill_occupancy()
+
+    def _drain_prefill_occupancy(self) -> None:
+        """Move the engine's per-dispatch batched-prefill occupancy
+        samples into the histogram (engine code stays metrics-free)."""
+        occ = getattr(self.engine, "_prefill_occ", None)
+        if occ:
+            for v in occ:
+                self.metrics.prefill_batch_occupancy.observe(v)
+            del occ[:]
 
     def _select_steps(self) -> int:
         """This round's decode-block length. Continuous: trimmed to the
@@ -776,6 +853,161 @@ class Scheduler(threading.Thread):
         self._vclock = v
 
     def _admit(self) -> None:
+        """Admission dispatcher: continuous mode on a batched-prefill
+        engine collects this round's admissible set and admits it as
+        ONE burst (one dispatch chain — engine.add_requests); fixed
+        mode and draft engines keep the sequential per-request path
+        (the FIFO baseline must not change shape)."""
+        eng = self.engine
+        if (self.mode != "continuous"
+                or not getattr(eng, "batched_prefill", False)
+                or eng.draft_model is not None):
+            self._admit_sequential()
+            return
+        batch: List[Pending] = []
+        slots_left = eng.free_slots()
+        blocks_left = eng.kv.free_blocks()
+        rounds_needed = 0
+        P = eng.prefill_len
+        latency_live = any(
+            vp is not None
+            and class_rank(vp.spec.tenant_class) == CLASS_RANK["latency"]
+            for r in eng.slots.values()
+            for vp in (self._by_rid.get(r.request_id),)
+        )
+        for p in self._admission_order():
+            if p.prefix_op:
+                if not eng.free_slots():
+                    continue
+                self._ready.remove(p)
+                self._do_prefix_op(p)
+                continue
+            # fail-fast a request the engine would REJECT (prompt too
+            # long, bad adapter) BEFORE it can join — one invalid
+            # request must 400 alone, not poison the all-or-nothing
+            # burst for its co-admitted neighbors
+            try:
+                eng._check_prompt_fits(p.prompt)
+                if not 0 <= p.adapter <= eng.n_adapters:
+                    raise ValueError("adapter out of range")
+            except ValueError:
+                self._ready.remove(p)
+                self._admit_one(p)      # its 400 path
+                continue
+            need = eng.kv.blocks_for(len(p.prompt) + 1) + (p.n - 1)
+            if p.n > slots_left or need > blocks_left:
+                continue
+            n_chunks = -(-len(p.prompt) // P)
+            if p.adapter == 0:
+                pref = eng._match_prefix(p.prompt)
+                if pref is not None:
+                    n_chunks -= len(pref.tokens) // P
+            if (latency_live and self.prefill_chunk_budget > 0
+                    and batch
+                    and n_chunks > max(self.prefill_chunk_budget,
+                                       rounds_needed)):
+                # chunk scheduling: a long prompt would extend this
+                # round's prefill stall past the budget while a
+                # latency-class request is decoding — it waits (and
+                # goes first once it heads the order with nothing
+                # admitted before it, so it cannot starve)
+                continue
+            rounds_needed = max(rounds_needed, n_chunks)
+            slots_left -= p.n
+            blocks_left -= need
+            batch.append(p)
+        if not batch:
+            return
+        for p in batch:
+            self._ready.remove(p)
+        if len(batch) == 1:
+            # a lone admission keeps the sequential path (and its
+            # trace shape: engine.prefill nested under serve.prefill)
+            self._admit_one(batch[0])
+        else:
+            self._admit_batch(batch)
+
+    def _do_prefix_op(self, p: Pending) -> None:
+        """Prefix-cache mutation (register/drop) — not batch work; the
+        engine call + error handling shared by both admission paths."""
+        eng = self.engine
+        try:
+            if p.prefix_op == "register":
+                eng.register_prefix(p.prompt)
+            elif not eng.drop_prefix(p.prompt):
+                p.error = "ValueError: no such prefix"
+        except Exception as e:
+            p.error = f"{type(e).__name__}: {e}"
+            # surfaced to the client via p.error, but the
+            # server log must show engine-side failures too
+            log.warning("prefix %s failed: %s", p.prefix_op, p.error)
+            # register_prefix prefills through donating jits
+            if eng.cache_poisoned():
+                p.server_fault = True
+                self._recover_engine(e)
+        p.done.set()
+
+    def _admit_batch(self, batch: List[Pending]) -> None:
+        """Admit a collected burst through engine.add_requests — one
+        dispatch chain, every request's first token sampled at its
+        end. Ledger treatment mirrors _admit_one per request."""
+        eng = self.engine
+        tracer = get_tracer()
+        t_admit = time.monotonic()
+        for p in batch:
+            if p.trace_id:
+                tracer.record(
+                    "serve.queue", (t_admit - p.t0) * 1e3,
+                    trace_id=p.trace_id, parent_id=p.span_id,
+                    start=p.t0_wall,
+                )
+        try:
+            rid_lists = eng.add_requests([
+                AdmissionRequest(p.prompt, p.n, p.stop, p.adapter)
+                for p in batch
+            ])
+        except Exception as e:  # noqa: BLE001 - keep serving
+            # the all-or-nothing burst failed (device error, injected
+            # fault): recover any poisoned cache, then retry each
+            # request ALONE so accounting is per request (a transient
+            # mid-burst must not 500 every co-admitted client; the
+            # requests re-record their queue spans — rare enough)
+            log.warning("batched admission failed (%s); retrying "
+                        "per-request", e)
+            if eng.cache_poisoned():
+                self._recover_engine(e)
+            for p in batch:
+                # re-check capacity per request: a recovery (or a
+                # transient) may have changed what fits, and a request
+                # that could simply wait a round must re-queue, not 500
+                if eng.can_admit(len(p.prompt), p.n):
+                    self._admit_one(p)
+                else:
+                    self._ready.append(p)
+            return
+        dt = time.monotonic() - t_admit
+        # admission prefill IS an engine dispatch: anchor the gap here
+        # or the whole burst's device compute would read as host idle
+        self._last_dispatch_end = time.monotonic()
+        self.metrics.step_seconds.labels(phase="prefill").observe(dt)
+        self.metrics.phase_seconds.labels(phase="prefill").inc(dt)
+        self._drain_prefill_occupancy()
+        now = time.monotonic()
+        for p, rids in zip(batch, rid_lists):
+            p.first_token_at = now
+            if p.trace_id:
+                tracer.record(
+                    "serve.prefill", dt * 1e3, trace_id=p.trace_id,
+                    parent_id=p.span_id, tokens=len(p.prompt), n=p.n,
+                    batched=len(batch),
+                )
+            self._charge(p)
+            for i, rid in enumerate(rids):
+                p.rid_index[rid] = i
+                self._by_rid[rid] = p
+                self._budget[rid] = p.max_tokens
+
+    def _admit_sequential(self) -> None:
         eng = self.engine
         for p in self._admission_order():
             if p.prefix_op:
@@ -789,22 +1021,7 @@ class Scheduler(threading.Thread):
                 # the max_queue bound counts exactly the waiting set
                 # (the pre-scheduler semantics the shed tests pin)
                 self._ready.remove(p)
-                try:
-                    if p.prefix_op == "register":
-                        eng.register_prefix(p.prompt)
-                    elif not eng.drop_prefix(p.prompt):
-                        p.error = "ValueError: no such prefix"
-                except Exception as e:
-                    p.error = f"{type(e).__name__}: {e}"
-                    # surfaced to the client via p.error, but the
-                    # server log must show engine-side failures too
-                    log.warning("prefix %s failed: %s",
-                                p.prefix_op, p.error)
-                    # register_prefix prefills through donating jits
-                    if eng.cache_poisoned():
-                        p.server_fault = True
-                        self._recover_engine(e)
-                p.done.set()
+                self._do_prefix_op(p)
                 continue
             if not eng.can_admit(len(p.prompt), p.n):
                 # a request the engine would REJECT (prompt too long
@@ -844,6 +1061,8 @@ class Scheduler(threading.Thread):
                                          adapter=p.adapter)
             dt_admit = time.monotonic() - t_admit
             p.first_token_at = time.monotonic()
+            # admission prefill is an engine dispatch (gap anchor)
+            self._last_dispatch_end = p.first_token_at
             self.metrics.step_seconds.labels(
                 phase="prefill"
             ).observe(dt_admit)
@@ -1170,9 +1389,7 @@ class Scheduler(threading.Thread):
         refreshed once per round, not in every _deliver call."""
         eng = self.engine
         self.metrics.kv_cache_utilization.set(eng.kv_utilization())
-        self.metrics.kv_cache_utilization_legacy.set(
-            eng.kv_utilization_legacy()
-        )
+        self._drain_prefill_occupancy()
         kv = eng.kv_stats()
         self.metrics.kv_blocks_free.set(kv["free"])
         self.metrics.kv_blocks_used.set(kv["used"])
@@ -1266,6 +1483,22 @@ class Scheduler(threading.Thread):
             "prefix_hits": eng.prefix_hits,
             "prefix_tokens_saved": eng.prefix_tokens_saved,
             "mode": self.mode,
+            "overlap": self.overlap,
+            "engine": {
+                "batched_prefill": getattr(eng, "batched_prefill",
+                                           False),
+                "adapter_fastpath": getattr(eng, "adapter_fastpath",
+                                            False),
+                "prefill_batches": getattr(eng, "prefill_batches", 0),
+                "prefill_rows": getattr(eng, "prefill_rows", 0),
+                "prefill_pad_rows": getattr(eng, "prefill_pad_rows", 0),
+                "fastpath_rounds": getattr(eng, "fastpath_rounds", 0),
+                "gathered_rounds": getattr(eng, "gathered_rounds", 0),
+                "compiled_programs": (
+                    eng.compiled_programs()
+                    if hasattr(eng, "compiled_programs") else {}
+                ),
+            },
             "parked": len(self._parked),
             "preempted": self.preempted,
             "resumed": self.resumed,

@@ -61,6 +61,7 @@ SLOW_MODULES = {
     "test_flash_decode",  # fused decode-attention kernel (interpret)
     "test_serving_chaos",  # fault-injected serving + drain under load
     "test_serving_sched",  # SLO scheduler + preempt/resume engine paths
+    "test_engine_hotpath",  # batched prefill / fast-path / overlap compiles
 }
 
 

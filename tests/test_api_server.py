@@ -877,7 +877,9 @@ class TestEngineRecovery:
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=64,
                             prefill_len=8)
-        real = eng.decode_block
+        # decode_block_start is THE dispatch point both the overlap
+        # and the sync path go through (decode_block = start + finish)
+        real = eng.decode_block_start
         calls = {"n": 0}
 
         def flaky(n):
@@ -891,7 +893,7 @@ class TestEngineRecovery:
                 raise RuntimeError("RESOURCE_EXHAUSTED: injected")
             return real(n)
 
-        eng.decode_block = flaky
+        eng.decode_block_start = flaky
         with ApiServer(eng) as srv:
             code, out = post(srv.url, {"prompt": [5, 9, 2], "max_tokens": 6})
             assert code == 500
@@ -911,7 +913,7 @@ class TestEngineRecovery:
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=64,
                             prefill_len=8)
-        real = eng.decode_block
+        real = eng.decode_block_start
         calls = {"n": 0}
 
         def flaky(n):
@@ -920,7 +922,7 @@ class TestEngineRecovery:
                 raise RuntimeError("host-side bug, cache untouched")
             return real(n)
 
-        eng.decode_block = flaky
+        eng.decode_block_start = flaky
         with ApiServer(eng) as srv:
             code, out = post(srv.url, {"prompt": [5, 9, 2, 7],
                                        "max_tokens": 6})
@@ -933,7 +935,9 @@ class TestEngineRecovery:
     def test_admission_poisoning_recovers(self, model):
         """A prefill failure that consumed the donated cache must also
         recover — admission, not just decode, goes through donating
-        jits."""
+        jits. (A lone request rides _admit_one, so the injected fault
+        is its 500; only multi-request bursts get the per-request
+        retry after recovery.)"""
         m, params = model
         eng = ServingEngine(m, params, max_batch=2, max_len=64,
                             prefill_len=8)

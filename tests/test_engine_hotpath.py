@@ -1,0 +1,556 @@
+"""Engine hot path (docs/SERVING.md "Engine hot path"): batched
+multi-slot prefill, the single-adapter decode fast path, and the
+host/device overlap seam — every variant TOKEN-IDENTICAL to the path
+it replaces (including across a preempt/resume cycle), the compiled-
+program set bounded by the documented budget, and the new dispatch
+forms replaying over the multi-host op stream."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.metrics.metrics import ServingMetrics, render
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.models.lora import LoraConfig, init_lora
+from instaslice_tpu.serving import AdmissionRequest, ServingEngine
+from instaslice_tpu.serving.api_server import ApiServer
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+def greedy_reference(model, params, prompt, n_new):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = model.apply(params, jnp.asarray(toks, jnp.int32)[None])
+        t = int(jnp.argmax(logits[0, -1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def _adapter(cfg, key, scale=0.4):
+    lcfg = LoraConfig(rank=4)
+    ad = init_lora(jax.random.key(key), cfg, lcfg)
+    for t in lcfg.targets:
+        ad["blocks"][t]["b"] = (
+            jax.random.normal(jax.random.key(key + 50),
+                              ad["blocks"][t]["b"].shape) * scale
+        )
+    return ad
+
+
+def _snapshot(eng):
+    """Comparable engine output state: per-slot chains + logprobs."""
+    return {
+        s: (r.request_id, r.prompt, r.generated, r.logprobs)
+        for s, r in sorted(eng.slots.items())
+    }
+
+
+class TestBatchedPrefillTokenIdentity:
+    PROMPTS = [[5, 9, 2, 7], list(range(1, 20)), [3] * 11, [7, 7]]
+
+    def _run(self, m, params, batched, temperature=0.0, fork=False,
+             prefix=None):
+        eng = ServingEngine(m, params, max_batch=8, max_len=64,
+                            prefill_len=8, kv_block_size=8, seed=3,
+                            temperature=temperature,
+                            batched_prefill=batched)
+        if prefix:
+            eng.register_prefix(prefix)
+        reqs = [AdmissionRequest(p) for p in self.PROMPTS]
+        if fork:
+            reqs.append(AdmissionRequest([9, 8, 7], n=2))
+        if batched:
+            eng.add_requests(reqs)
+        else:
+            for r in reqs:
+                eng.add_request_n(r.prompt, r.n, stop=r.stop,
+                                  adapter=r.adapter)
+        for _ in range(3):
+            eng.decode_block(4)
+        return _snapshot(eng), eng
+
+    def test_greedy_byte_equal(self, model):
+        """A burst admitted through ONE dispatch chain produces the
+        byte-identical chains (tokens AND logprobs) the per-slot
+        admission path produces — the oracle-exactness gate for
+        tentpole (a)."""
+        m, params = model
+        a, _ = self._run(m, params, batched=False)
+        b, eb = self._run(m, params, batched=True)
+        assert a == b
+        assert eb.prefill_batches >= 1
+        # the 19-token prompt runs 3 chunk rounds; a burst of 4 costs
+        # 2 bucketed dispatches + 1 lone-row per-slot call (the final
+        # round has one participant and rides the plain prefill
+        # program), not 7 sequential chunk calls
+        assert eb.prefill_batches == 2
+        assert eb.prefill_rows == 7
+
+    def test_sampled_and_forked_byte_equal(self, model):
+        """temperature > 0: first-token sampling runs per request in
+        burst order, so even the RNG stream matches the sequential
+        path — and n>1 forks ride the burst too."""
+        m, params = model
+        a, _ = self._run(m, params, batched=False, temperature=0.8,
+                         fork=True)
+        b, _ = self._run(m, params, batched=True, temperature=0.8,
+                         fork=True)
+        assert a == b
+
+    def test_prefix_hit_joins_burst_mid_chunk(self, model):
+        """A prefix-hit request enters the chunk rounds at its boundary
+        chunk (its stripe was written first) — same tokens, fewer
+        prefill rows."""
+        m, params = model
+        prefix = list(range(1, 9))                 # one chunk
+        ps = [prefix + [40, 41, 42], list(range(20, 1, -1))]
+        for batched in (False, True):
+            eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                                prefill_len=8, kv_block_size=8,
+                                batched_prefill=batched)
+            eng.register_prefix(prefix)
+            if batched:
+                eng.add_requests([AdmissionRequest(p) for p in ps])
+            else:
+                for p in ps:
+                    eng.add_request(p)
+            assert eng.prefix_hits == 1
+            eng.decode_block(4)
+            if batched:
+                got_b = _snapshot(eng)
+            else:
+                got_a = _snapshot(eng)
+        assert got_a == got_b
+
+    def test_across_preempt_resume_cycle(self, model):
+        """The satellite contract: batched and per-slot admission stay
+        byte-equal through park → foreign traffic → resume — the
+        stripe round-trip composes with the batched prefill."""
+        m, params = model
+
+        def run(batched):
+            eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                                prefill_len=8, kv_block_size=8,
+                                batched_prefill=batched)
+            reqs = [AdmissionRequest([5, 9, 2, 7]),
+                    AdmissionRequest([11, 13, 17])]
+            if batched:
+                rids = [r[0] for r in eng.add_requests(reqs)]
+            else:
+                rids = [eng.add_request(r.prompt) for r in reqs]
+            for _ in range(4):
+                eng.step()
+            slot0 = next(s for s, r in eng.slots.items()
+                         if r.request_id == rids[0])
+            eng.preempt_slot(slot0)
+            for _ in range(3):
+                eng.step()
+            # a second burst runs while rids[0] is parked
+            if batched:
+                eng.add_requests([AdmissionRequest([2, 4, 6])])
+            else:
+                eng.add_request([2, 4, 6])
+            eng.finish_slot(next(
+                s for s, r in eng.slots.items()
+                if r.request_id == rids[1]
+            ))
+            eng.resume_request(rids[0])
+            for _ in range(5):
+                eng.step()
+            return _snapshot(eng), eng.finished
+
+        a, fa = run(False)
+        b, fb = run(True)
+        assert a == b
+        assert [(f.request_id, f.tokens, f.logprobs) for f in fa] == \
+               [(f.request_id, f.tokens, f.logprobs) for f in fb]
+
+    def test_oracle_chain_through_batched_path(self, model):
+        """Absolute anchor, not just A/B: the batched path reproduces
+        the incremental-decode oracle."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, batched_prefill=True)
+        prompts = [[5, 9, 2, 7], list(range(1, 12))]
+        rid_lists = eng.add_requests(
+            [AdmissionRequest(p) for p in prompts]
+        )
+        for _ in range(2):
+            eng.decode_block(4)
+        for p, (rid,) in zip(prompts, rid_lists):
+            req = next(r for r in eng.slots.values()
+                       if r.request_id == rid)
+            assert req.generated == greedy_reference(m, params, p, 9)
+
+    def test_burst_all_or_nothing_on_capacity(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, batched_prefill=True)
+        with pytest.raises(RuntimeError, match="free slot"):
+            eng.add_requests([AdmissionRequest([1, 2])
+                              for _ in range(3)])
+        assert not eng.slots
+        assert eng.kv.used_blocks() == 0
+
+
+class TestSingleAdapterFastPath:
+    def _engine(self, m, params, cfg, fast):
+        return ServingEngine(
+            m, params, max_batch=4, max_len=64, prefill_len=8,
+            lora_adapters=[_adapter(cfg, 1), _adapter(cfg, 2)],
+            adapter_fastpath=fast, seed=2,
+        )
+
+    def test_uniform_adapter_byte_equal_and_selected(self, model):
+        """All live slots on one adapter: the single-adapter variant
+        dispatches (counter proves it) and the chains + logprobs are
+        byte-equal to the gathered path — for a real adapter AND for
+        base-only."""
+        m, params = model
+        cfg = m.cfg
+        for aid in (1, 0):
+            outs = []
+            for fast in (True, False):
+                eng = self._engine(m, params, cfg, fast)
+                for _ in range(3):
+                    eng.add_request([5, 9, 3, 7, 2], adapter=aid)
+                eng.step()
+                eng.decode_block(6)
+                outs.append((_snapshot(eng), eng.fastpath_rounds,
+                             eng.gathered_rounds))
+            (a, fast_rounds, g0), (b, f0, gathered_rounds) = outs
+            assert a == b
+            assert fast_rounds == 2 and g0 == 0
+            assert f0 == 0 and gathered_rounds == 2
+
+    def test_mixed_adapters_fall_back_to_gather(self, model):
+        m, params = model
+        eng = self._engine(m, params, m.cfg, fast=True)
+        for aid in (0, 1, 2):
+            eng.add_request([5, 9, 3], adapter=aid)
+        eng.decode_block(4)
+        assert eng.fastpath_rounds == 0
+        assert eng.gathered_rounds == 1
+        # and when the mixed-adapter slots drain to one, the next
+        # round re-selects the fast path (host-side, per round)
+        for s, r in list(eng.slots.items()):
+            if eng._slot_adapter_host[s] != 1:
+                eng.evict_slot(s)
+        eng.decode_block(4)
+        assert eng.fastpath_rounds == 1
+
+
+class TestOverlap:
+    def test_split_decode_equals_sync(self, model):
+        m, params = model
+
+        def run(split):
+            eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                                prefill_len=8, seed=1)
+            for p in ([5, 9, 2, 7], [1, 2, 3]):
+                eng.add_request(p)
+            for _ in range(3):
+                if split:
+                    eng.decode_block_start(4)
+                    eng.decode_block_finish()
+                else:
+                    eng.decode_block(4)
+            return _snapshot(eng)
+
+        assert run(True) == run(False)
+
+    def test_drain_pending_guards_mutations(self, model):
+        """Any mutating call with a block in flight lands the block
+        first — its tokens are never lost, new state never corrupts
+        the readback bookkeeping."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        rid = eng.add_request([5, 9, 2, 7])
+        eng.decode_block_start(4)
+        eng.add_request([1, 2, 3])          # drains the pending block
+        assert eng._pending_block is None
+        req = next(r for r in eng.slots.values()
+                   if r.request_id == rid)
+        # admission token + the drained block's 4: the full greedy chain
+        assert req.generated == greedy_reference(
+            m, params, [5, 9, 2, 7], 5
+        )
+
+    def test_http_oracle_exact_with_overlap(self, model):
+        """End to end over the real server with overlap ON (the
+        default): responses stay oracle-exact."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8)
+        with ApiServer(eng, block_size=4, overlap=True) as srv:
+            body = json.dumps({"prompt": [5, 9, 2, 7],
+                               "max_tokens": 6}).encode()
+            req = urllib.request.Request(
+                f"{srv.url}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+            assert out["choices"][0]["token_ids"] == greedy_reference(
+                m, params, [5, 9, 2, 7], 6
+            )
+            assert srv.scheduler.overlap is True
+
+    def test_recover_clears_pending_block(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8)
+        eng.add_request([1, 2, 3])
+        eng.decode_block_start(2)
+        eng.recover()
+        assert eng._pending_block is None
+        assert eng.decode_block_finish() == {}
+
+
+class TestCompileBudget:
+    def test_mixed_workload_stays_within_documented_bound(self, model):
+        """The "bounded compiled-program set" claim, asserted for the
+        first time: a workload mixing jittered prompt lengths, forks,
+        preempt/resume, prefix hits, and BOTH adapters compiles no
+        more programs per dispatch form than compile_budget()
+        documents."""
+        m, params = model
+        cfg = m.cfg
+        eng = ServingEngine(
+            m, params, max_batch=4, max_len=64, prefill_len=8,
+            kv_block_size=8,
+            lora_adapters=[_adapter(cfg, 1), _adapter(cfg, 2)],
+        )
+        eng.register_prefix(list(range(1, 9)))
+        # jittered burst over both adapters + base
+        eng.add_requests([
+            AdmissionRequest([5, 9, 2], adapter=1),
+            AdmissionRequest(list(range(1, 15)), adapter=2),
+            AdmissionRequest(list(range(1, 9)) + [40, 41]),  # prefix
+        ])
+        eng.decode_block(4)
+        eng.step()
+        # preempt / foreign fill / resume
+        slot = next(iter(eng.slots))
+        rid = eng.preempt_slot(slot)
+        eng.add_request_n([9, 8, 7], 2)       # fork
+        eng.decode_block(2)
+        for s, r in list(eng.slots.items()):
+            if len(r.prompt) == 3:
+                eng.evict_slot(s)
+                break
+        eng.resume_request(rid)
+        eng.decode_block(8)
+        budget = eng.compile_budget(block_cap=8)
+        got = eng.compiled_programs()
+        over = {k: (got[k], budget.get(k, 0)) for k in got
+                if got[k] > budget.get(k, 0)}
+        assert not over, (
+            f"compiled programs exceed the documented bound: {over} "
+            f"(all: {got} vs budget {budget})"
+        )
+        # and the workload really exercised the new forms
+        assert got["prefill_batch"] >= 1
+        assert got["decode_block"] >= 2     # gathered + single variants
+
+    def test_budget_math_matches_config(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=8, max_len=64,
+                            prefill_len=8, kv_block_size=16)
+        b = eng.compile_budget(block_cap=16)
+        assert b["prefill"] == 1
+        assert b["prefill_batch"] == 3      # buckets 2,4,8 (1 = plain)
+        assert b["decode"] == 1             # no adapters: no variant
+        # pow2 step counts (1..16 -> 5) x attend buckets
+        assert b["decode_block"] == 5 * 1
+        assert b["read_stripe"] == 64 // 8 + 64 // 16
+
+
+class TestDistributedBurst:
+    def test_follower_replays_add_requests(self, model):
+        """The new dispatch form rides the op stream: a burst admitted
+        on the driver replays as the identical burst on the follower
+        (same bucketed dispatches, convergent state) — and the
+        overlap split broadcasts at START."""
+        from conftest import free_port
+        from instaslice_tpu.serving.distributed import (
+            DistributedEngine,
+            run_follower,
+        )
+
+        m, params = model
+
+        def mk():
+            return ServingEngine(m, params, max_batch=4, max_len=64,
+                                 prefill_len=8, kv_block_size=8,
+                                 batched_prefill=True)
+
+        driver_eng, follower_eng = mk(), mk()
+        port = free_port()
+        t = threading.Thread(
+            target=run_follower,
+            args=(follower_eng, "127.0.0.1", port), daemon=True,
+        )
+        t.start()
+        deng = DistributedEngine(driver_eng, n_followers=1, port=port)
+        deng.add_requests([
+            AdmissionRequest([5, 9, 2, 7]),
+            AdmissionRequest(list(range(1, 12))),
+        ])
+        deng.decode_block_start(3)
+        deng.decode_block_finish()
+        deng.add_requests([AdmissionRequest([1, 2, 3])])
+        deng.decode_block(2)
+        deng.shutdown()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert set(follower_eng.slots) == set(driver_eng.slots)
+        for s in driver_eng.slots:
+            assert (follower_eng.slots[s].generated
+                    == driver_eng.slots[s].generated)
+        assert (follower_eng.prefill_batches
+                == driver_eng.prefill_batches >= 1)
+        assert (follower_eng.kv.used_blocks()
+                == driver_eng.kv.used_blocks())
+
+
+class TestHotPathObservability:
+    def test_stats_and_metrics_exports(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, batched_prefill=True)
+        metrics = ServingMetrics()
+        with ApiServer(eng, block_size=4, metrics=metrics) as srv:
+            body = json.dumps({"prompt": [5, 9, 2], "max_tokens": 4})
+            req = urllib.request.Request(
+                f"{srv.url}/v1/completions", data=body.encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(f"{srv.url}/v1/stats",
+                                        timeout=10) as r:
+                stats = json.loads(r.read())
+        engine = stats["engine"]
+        assert engine["batched_prefill"] is True
+        assert engine["adapter_fastpath"] is True
+        assert "prefill_batches" in engine
+        assert "compiled_programs" in engine
+        assert stats["overlap"] in (True, False)
+        assert "utilization_legacy" not in stats["kv"]
+        body = render(metrics)
+        if body:
+            assert "tpuslice_serve_dispatch_gap_seconds" in body
+            assert "tpuslice_serve_prefill_batch_occupancy" in body
+            assert "tpuslice_serve_kv_cache_utilization_legacy" \
+                not in body
+
+    def test_scheduler_burst_admits_in_one_engine_call(self, model):
+        """Submit a burst while the scheduler thread is paused at
+        admission: the round admits every fitting request through ONE
+        add_requests (prefill_batches grows, per-request bookkeeping
+        lands for all)."""
+        from instaslice_tpu.serving.scheduler import Pending, Scheduler
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, batched_prefill=True)
+        sched = Scheduler(eng, block_size=4)
+        ps = [Pending([5, 9, 2, 7], 4), Pending(list(range(1, 12)), 4),
+              Pending([3, 3, 3], 4)]
+        for p in ps:
+            sched.submit(p)
+        sched._pump()
+        sched._admit()
+        assert len(eng.slots) == 3
+        assert eng.prefill_batches >= 1
+        assert all(p.first_token_at is not None for p in ps)
+        # drive to completion so the ledger closes
+        deadline = time.monotonic() + 30
+        while any(not p.done.is_set() for p in ps):
+            sched._round()
+            assert time.monotonic() < deadline
+        assert all(p.results for p in ps)
+
+    def test_burst_failure_retries_per_request(self, model):
+        """A transient fault inside the all-or-nothing burst must not
+        500 every co-admitted client: the scheduler retries each
+        request alone and they all complete."""
+        from instaslice_tpu.serving.scheduler import Pending, Scheduler
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, batched_prefill=True)
+        calls = {"n": 0}
+        real = eng.add_requests
+
+        def flaky(reqs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+            return real(reqs)
+
+        eng.add_requests = flaky
+        sched = Scheduler(eng, block_size=4)
+        ps = [Pending([5, 9, 2, 7], 4), Pending([1, 2, 3], 4)]
+        for p in ps:
+            sched.submit(p)
+        deadline = time.monotonic() + 30
+        while any(not p.done.is_set() for p in ps):
+            sched._round()
+            assert time.monotonic() < deadline
+        assert calls["n"] == 1          # burst tried once, then singles
+        assert all(p.results and not p.error for p in ps)
+
+    def test_chunk_budget_defers_long_non_latency_prompts(self, model):
+        """Chunk scheduling: while a latency-class request is decoding,
+        a long best-effort prompt (chunks > budget) waits instead of
+        stalling the round — and admits once the batch drains."""
+        from instaslice_tpu.serving.scheduler import Pending, Scheduler
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, batched_prefill=True)
+        sched = Scheduler(
+            eng, block_size=4,
+            tenants="gold:1:latency:5.0,bronze:1:best-effort",
+            prefill_chunk_budget=1,
+        )
+        pg = Pending([5, 9, 2, 7], 8, tenant="gold")
+        sched.submit(pg)
+        sched._pump()
+        sched._admit()
+        assert len(eng.slots) == 1
+        # a short gold + a long bronze arrive together: gold rides the
+        # burst, the 3-chunk bronze waits (budget 1, latency live)
+        pg2 = Pending([1, 2, 3], 8, tenant="gold")
+        pb = Pending(list(range(1, 20)), 4, tenant="bronze")
+        sched.submit(pg2)
+        sched.submit(pb)
+        sched._pump()
+        sched._admit()
+        admitted = {r.request_id for r in eng.slots.values()}
+        assert pg2.rid_index and not pb.rid_index, admitted
+        # once nothing is admitted ahead of it, the long prompt goes
+        # (first in order, batch empty -> no starvation)
+        deadline = time.monotonic() + 30
+        while not pb.rid_index:
+            sched._round()
+            assert time.monotonic() < deadline

@@ -148,6 +148,30 @@ class TestBlockPool:
         with pytest.raises(ValueError):
             KVBlockPool(8, 0)
 
+    def test_bump_fast_path_semantics(self):
+        """bump() is the incremental _sync_tables fast path: free when
+        growth stays inside the current blocks, refused (False, table
+        untouched) the moment a new block or a shared-boundary copy
+        would be needed — ensure() then does the real work."""
+        pool = KVBlockPool(8, 4)
+        t = pool.allocate(2)                  # 1 block, 2 tokens
+        assert pool.bump(t, 3)                # within the tail block
+        assert t.tokens == 3 and len(t.blocks) == 1
+        assert pool.bump(t, 2)                # shrink/no-op: True
+        assert t.tokens == 3
+        assert not pool.bump(t, 5)            # needs a second block
+        assert t.tokens == 3 and pool.used_blocks() == 1
+        pool.ensure(t, 5)
+        assert len(t.blocks) == 2
+        # shared partial boundary: growth must COW, bump refuses
+        child = pool.fork(t, 5)
+        assert not pool.bump(child, 6)
+        before = pool.cow_copies
+        pool.ensure(child, 6)
+        assert pool.cow_copies == before + 1
+        pool.release(t)
+        pool.release(child)
+
 
 class TestTenantSpecs:
     def test_full_grammar(self):

@@ -518,9 +518,12 @@ class TestServingChaos:
                     req_mean, report["mean_latency"])
 
             # the per-round profiler populated alongside: step times
-            # in both phases, occupancy/KV gauges exported
+            # in both phases, occupancy/KV gauges exported. Prefill
+            # observations count DISPATCH CHAINS, and batched
+            # admission (r10) admits a whole burst through one — so
+            # the floor is bursts, not requests
             assert sample("tpuslice_serve_step_seconds_count",
-                          {"phase": "prefill"}) >= N
+                          {"phase": "prefill"}) >= 1
             assert sample("tpuslice_serve_step_seconds_count",
                           {"phase": "decode"}) >= 1
             assert sample("tpuslice_serve_phase_seconds_total",

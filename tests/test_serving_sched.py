@@ -302,29 +302,30 @@ class TestSloSchedulerHttp:
             assert stats["tenant_classes"] == {"gold": "latency"}
             kv = stats["kv"]
             assert kv["total"] == (2 * 64) // 16
-            assert {"free", "used", "cow", "utilization",
-                    "utilization_legacy"} <= set(kv)
+            assert {"free", "used", "cow", "utilization"} <= set(kv)
+            # the one-release migration window PR 9 promised is over
+            assert "utilization_legacy" not in kv
 
 
 class TestKvUtilizationSplit:
-    def test_paged_beats_legacy_at_mixed_lengths(self, model):
-        """The satellite contract: the paged metric reports occupancy
-        of the blocks actually held, the legacy metric divides by the
-        whole rectangle — at mixed sequence lengths the paged one is
-        strictly higher (and the truthful one)."""
+    def test_paged_metric_truthful_and_legacy_retired(self, model):
+        """The paged metric reports occupancy of the blocks actually
+        held (high at mixed sequence lengths); the pre-paging stripe
+        metric finished its one-release migration window and is GONE —
+        from the engine, the gauge set, and /v1/stats."""
         m, params = model
         eng = ServingEngine(m, params, max_batch=4, max_len=64,
                             prefill_len=8, kv_block_size=8)
         eng.add_request([1, 2, 3])                     # short
         eng.add_request(list(range(1, 41)))            # long
         paged = eng.kv_utilization()
-        legacy = eng.kv_utilization_legacy()
-        assert paged > legacy
         assert paged >= 0.5
-        # the legacy metric charges the whole 4x64 rectangle
-        assert legacy == pytest.approx(
-            (4 + 41) / (4 * 64), rel=1e-6
-        )
+        # would have read (4 + 41) / (4 * 64) ≈ 0.18 on the retired
+        # whole-rectangle metric — the paged one sees real occupancy
+        assert not hasattr(eng, "kv_utilization_legacy")
+        assert "utilization_legacy" not in eng.kv_stats()
+        from instaslice_tpu.metrics.metrics import ServingMetrics
+        assert not hasattr(ServingMetrics(), "kv_cache_utilization_legacy")
 
     def test_prefix_fork_shows_cow_blocks(self, model):
         m, params = model
