@@ -40,7 +40,7 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke floors
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
@@ -48,6 +48,7 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 	$(MAKE) bench-defrag-smoke
 	$(MAKE) bench-serving-smoke
 	$(MAKE) bench-engine-smoke
+	$(MAKE) bench-prefix-smoke
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
@@ -76,6 +77,14 @@ bench-engine-smoke:  ## <60 s bursty-admission run of both engine arms: asserts 
 .PHONY: bench-engine
 bench-engine:  ## Full engine hot-path tier: batched-prefill + overlap arm vs the per-slot PR 9 baseline, best-of-3 per arm (tok/s AND TTFT p95 must both win) — records BENCH_ENGINE_r10.json (docs/SERVING.md)
 	JAX_PLATFORMS=cpu $(PY) bench.py --engine
+
+.PHONY: bench-prefix-smoke
+bench-prefix-smoke:  ## <60 s shared-prefix run of both arms: asserts radix tok/s >= TPUSLICE_PREFIX_FLOOR (0.9, a regression floor — the recorded bench-prefix tier gates the strict win) x the exact-match baseline, prefix-hit token savings > 0, ledgers reconciling, zero leaked blocks after quiesce
+	JAX_PLATFORMS=cpu $(PY) bench.py --prefix-smoke
+
+.PHONY: bench-prefix
+bench-prefix:  ## Full radix prefix-cache tier: radix arm vs exact-match-only baseline on the seeded shared-prefix workload, best-of-3 per arm (tok/s AND TTFT p95 must both win) — records BENCH_PREFIX_r11.json (docs/SERVING.md)
+	JAX_PLATFORMS=cpu $(PY) bench.py --prefix
 
 .PHONY: bench-scale
 bench-scale:  ## Fleet-scale control-plane bench: 1k nodes / 2k pending pods, grants/sec + gate→ungate p95/p99, with the serial re-list baseline ratio (docs/SCALING.md)

@@ -688,7 +688,11 @@ def bench_serving(
                 srv.scheduler.preempted == eng.preempted_total
                 and srv.scheduler.resumed == eng.resumed_total
                 and not eng.parked and not eng.slots
-                and eng.kv.used_blocks() == 0
+                # post-quiesce every used block is the radix prefix
+                # cache's (completions legitimately cache their KV)
+                # and no dead rid pins a tree path
+                and eng.kv.used_blocks() == eng.radix.pool_blocks()
+                and not eng._radix_locks
             )
     finally:
         stop.set()
@@ -849,6 +853,191 @@ def smoke_serving(slo_floor: float = 0.75, kv_floor: float = 0.5) -> int:
         )
     for f in failures:
         print(f"bench-serving-smoke FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+#: the organic-prefix-sharing scenario the radix tier runs: prompts
+#: draw their head from a small pool of shared prefixes (think common
+#: system prompts across tenants), tails and budgets stay jittered —
+#: nothing is registered, so the exact-match baseline re-prefills
+#: every shared head while the radix arm stores it once and COW-forks
+PREFIX_WORKLOAD = dict(
+    concurrency=8, prompt_len=24, max_tokens=24, jitter=0.5,
+    prefix_pool="4:96",
+)
+
+
+def bench_prefix(radix: bool = True, requests: int = 64,
+                 seed: int = 11) -> dict:
+    """One radix-prefix-cache arm (docs/SERVING.md "Radix prefix
+    cache"): the shared-prefix loadgen workload over the real ApiServer
+    with the radix cache on, or off (``--no-radix-cache`` — the
+    exact-match-only PR 9 baseline, where organically shared prefixes
+    are re-prefilled every time because nobody registered them).
+
+    Both arms run the same warm-up burst first (compiles AND, for the
+    radix arm, the steady-state tree the measured window serves from —
+    a prefix cache is judged warm, like any cache tier), and both must
+    quiesce with a clean ledger: no live/parked state, every pool
+    block either free or held by the radix tree, no leaked path
+    locks."""
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.metrics.metrics import ServingMetrics
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.serving import ServingEngine
+    from instaslice_tpu.serving.api_server import ApiServer
+    from instaslice_tpu.serving.loadgen import run as loadgen_run
+
+    cfg = ModelConfig(
+        vocab_size=128, d_model=128, n_heads=4, n_layers=4,
+        d_ff=512, dtype=jnp.float32, remat=False,
+    )
+    model = TpuLM(cfg)
+    params = model.init(jax.random.key(0))
+    eng = ServingEngine(model, params, max_batch=8, max_len=256,
+                        prefill_len=16, kv_block_size=16,
+                        radix_cache=radix)
+    eng.warm_prefill_buckets()
+    # compile the whole power-of-two decode-block set too: each
+    # n_steps is its own program, the two arms' admission patterns
+    # reach different n values, and ONE cold compile mid-run swamps a
+    # seconds-long CPU measurement's TTFT tail (seen as a 1.2 s p95)
+    eng.add_request([1, 2, 3])
+    n = 1
+    while n <= 16:
+        eng.decode_block(n)
+        n <<= 1
+    for slot in list(eng.slots):
+        eng.evict_slot(slot)
+    metrics = ServingMetrics()
+    workload = dict(PREFIX_WORKLOAD)
+    pool = workload.pop("prefix_pool")
+    # the same mixed-SLO tenant scenario the serving/engine tiers run:
+    # shared prefixes ACROSS tenants is the motivating workload, and a
+    # latency-class tenant makes the scheduler's round-shortening (and
+    # so the TTFT axis) exercise the radix arm's faster admission
+    with ApiServer(eng, block_size=16, metrics=metrics,
+                   tenants=SERVING_TENANTS, preempt_margin=0.3,
+                   request_timeout=180) as srv:
+        # unmeasured warm burst: pays the jit compiles in both arms and
+        # brings the radix arm to its steady state (tree populated)
+        loadgen_run(
+            srv.url, requests=10, concurrency=4, vocab=128,
+            stream=True, timeout=180, seed=seed,
+            prefix_pool=pool, tenants=SERVING_TENANTS,
+            **{k: workload[k] for k in
+               ("prompt_len", "max_tokens", "jitter")},
+        )
+        warm = srv.scheduler.stats()
+        t0 = time.monotonic()
+        report = loadgen_run(
+            srv.url, requests=requests, vocab=128,
+            stream=True, timeout=180, seed=seed,
+            prefix_pool=pool, tenants=SERVING_TENANTS, **workload,
+        )
+        wall = time.monotonic() - t0
+        # quiesce, then reconcile the ledger: nothing live or parked,
+        # every used pool block is the radix tree's, zero leaked locks
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and (eng.slots or eng.parked):
+            time.sleep(0.02)
+        stats = srv.scheduler.stats()
+        budget = eng.compile_budget(block_cap=16)
+        compiled = eng.compiled_programs()
+        over = {k: (compiled[k], budget.get(k, 0)) for k in compiled
+                if compiled[k] > budget.get(k, 0)}
+        ledger_ok = (
+            not eng.slots and not eng.parked
+            and eng.kv.used_blocks() == eng.radix.pool_blocks()
+            and not eng._radix_locks
+            and not over
+        )
+    radix_stats = stats["radix"]
+    warm_radix = warm["radix"]
+    return {
+        "arm": "radix" if radix else "exact-match-baseline",
+        "seed": seed,
+        "requests": requests,
+        "prefix_pool": pool,
+        "ok": report["ok"],
+        "hung": report["outcomes"]["hung"],
+        "errors": report["errors"],
+        "wall_s": round(wall, 2),
+        "client_tokens_per_sec": report["client_tokens_per_sec"],
+        "ttft_p50_s": report["ttft_p50"],
+        "ttft_p95_s": report["ttft_p95"],
+        "client_reused_fraction":
+            report["prefix_pool"]["reused_fraction"],
+        # warm-up-subtracted: the arm reports ITS window only
+        "prefix_hits": radix_stats["hits"] - warm_radix["hits"],
+        "prefix_misses": radix_stats["misses"] - warm_radix["misses"],
+        "prefix_inserted": (radix_stats["inserted"]
+                            - warm_radix["inserted"]),
+        "prefix_evicted": (radix_stats["evicted"]
+                           - warm_radix["evicted"]),
+        "prefix_tokens_saved": (radix_stats["tokens_saved"]
+                                - warm_radix["tokens_saved"]),
+        "radix_nodes": radix_stats["nodes"],
+        "radix_blocks": radix_stats["blocks"],
+        "compiled_over_budget": over,
+        "ledger_ok": ledger_ok,
+    }
+
+
+def smoke_prefix(floor: float = None) -> int:
+    """``make bench-prefix-smoke``: a <60 s shared-prefix run of BOTH
+    arms — asserts the radix arm sustains at least
+    ``TPUSLICE_PREFIX_FLOOR`` (default 0.9 — a REGRESSION gate like
+    the engine smoke's, not a win gate: single short runs of either
+    arm swing ±30% on the shared-core CI box, and the recorded
+    ``--prefix`` tier keeps the strict must-beat-on-both-axes gate)
+    times the exact-match baseline's tok/s with real prefix-hit token
+    savings, zero hung requests, ledgers reconciling and zero leaked
+    blocks after quiesce, and the compiled-program set inside the
+    documented budget."""
+    if floor is None:
+        floor = float(os.environ.get("TPUSLICE_PREFIX_FLOOR", "0.9"))
+    reqs = int(os.environ.get("TPUSLICE_PREFIX_SMOKE_REQS", "24"))
+    reps = max(1, int(os.environ.get(
+        "TPUSLICE_PREFIX_SMOKE_REPEATS", "3")))
+    # throwaway process-warming run (see smoke_engine)
+    bench_prefix(radix=False, requests=6)
+    bases, opts = [], []
+    for _ in range(reps):
+        bases.append(bench_prefix(radix=False, requests=reqs))
+        opts.append(bench_prefix(radix=True, requests=reqs))
+    base = max(bases, key=lambda r: r["client_tokens_per_sec"])
+    opt = max(opts, key=lambda r: r["client_tokens_per_sec"])
+    print(json.dumps({"radix": opt, "exact_match_baseline": base}))
+    failures = []
+    for arm in (base, opt):
+        if arm["hung"]:
+            failures.append(f"{arm['arm']}: {arm['hung']} hung")
+        if arm["errors"]:
+            failures.append(
+                f"{arm['arm']}: {arm['errors']} loadgen error(s)"
+            )
+        if not arm["ledger_ok"]:
+            failures.append(
+                f"{arm['arm']}: ledger did not reconcile "
+                f"(compiled over budget: {arm['compiled_over_budget']})"
+            )
+    if opt["client_tokens_per_sec"] < floor * base[
+            "client_tokens_per_sec"]:
+        failures.append(
+            f"radix arm {opt['client_tokens_per_sec']} tok/s under "
+            f"{floor}x the exact-match baseline "
+            f"{base['client_tokens_per_sec']}"
+        )
+    if opt["prefix_tokens_saved"] <= 0:
+        failures.append("radix arm saved zero prefix tokens "
+                        "(cache wiring broken?)")
+    if opt["prefix_hits"] <= 0:
+        failures.append("radix arm never hit the cache")
+    for f in failures:
+        print(f"bench-prefix-smoke FAIL: {f}", file=sys.stderr)
     return 1 if failures else 0
 
 
@@ -1396,6 +1585,27 @@ def main(argv=None) -> int:
                     default=int(os.environ.get(
                         "TPUSLICE_ENGINE_SEED", "10")),
                     help="engine tier: loadgen scenario seed")
+    ap.add_argument("--prefix", action="store_true",
+                    help="radix prefix-cache tier: seeded shared-"
+                    "prefix loadgen workload, radix arm vs the "
+                    "exact-match-only baseline (tok/s, TTFT p95, "
+                    "prefix-hit token savings)")
+    ap.add_argument("--prefix-smoke", action="store_true",
+                    help="CI gate: <60 s shared-prefix run of both "
+                    "arms asserting radix tok/s >= "
+                    "TPUSLICE_PREFIX_FLOOR (0.9, a regression "
+                    "floor) x the exact-match "
+                    "baseline, prefix-hit savings > 0, reconciling "
+                    "ledgers and zero leaked blocks")
+    ap.add_argument("--prefix-floor", type=float,
+                    default=float(os.environ.get(
+                        "TPUSLICE_PREFIX_FLOOR", "0.9")),
+                    help="prefix-smoke: radix tok/s floor as a "
+                    "multiple of the exact-match baseline")
+    ap.add_argument("--prefix-seed", type=int,
+                    default=int(os.environ.get(
+                        "TPUSLICE_PREFIX_SEED", "11")),
+                    help="prefix tier: loadgen scenario seed")
     ap.add_argument("--interval", type=float, default=900.0,
                     help="watchdog: seconds between probes (default 900)")
     ap.add_argument("--max-hours", type=float, default=11.0,
@@ -1436,6 +1646,64 @@ def main(argv=None) -> int:
                              kv_floor=args.serving_kv_floor)
     if args.engine_smoke:
         return smoke_engine(floor=args.engine_floor)
+    if args.prefix_smoke:
+        return smoke_prefix(floor=args.prefix_floor)
+    if args.prefix:
+        result = {
+            "metric": "prefix_tokens_per_sec",
+            "unit": "tokens/s",
+        }
+        # best-of-N per arm, interleaved (same rationale as --engine);
+        # 4 reps, not 3: on the nproc=1 CI box single runs of either
+        # arm swing ~2x on OS noise, and the comparison is between the
+        # arms' CEILINGS — the radix ceiling is ~1.5x the baseline's,
+        # but 3 reps occasionally miss it while the baseline lands its
+        # golden run
+        reps = max(1, int(os.environ.get(
+            "TPUSLICE_PREFIX_REPEATS", "4")))
+        # throwaway process-warming run (see smoke_engine)
+        bench_prefix(radix=False, requests=6, seed=args.prefix_seed)
+        opts, bases = [], []
+        for _ in range(reps):
+            opts.append(
+                bench_prefix(radix=True, seed=args.prefix_seed)
+            )
+            bases.append(
+                bench_prefix(radix=False, seed=args.prefix_seed)
+            )
+        opt = max(opts, key=lambda r: r["client_tokens_per_sec"])
+        base = max(bases, key=lambda r: r["client_tokens_per_sec"])
+        result["prefix_radix"] = opt
+        result["prefix_exact_match_baseline"] = base
+        result["repeats"] = reps
+        result["tokens_per_sec_runs"] = {
+            "radix": [r["client_tokens_per_sec"] for r in opts],
+            "exact_match": [r["client_tokens_per_sec"]
+                            for r in bases],
+        }
+        result["value"] = opt["client_tokens_per_sec"]
+        if base["client_tokens_per_sec"]:
+            result["vs_baseline"] = round(
+                opt["client_tokens_per_sec"]
+                / base["client_tokens_per_sec"], 2
+            )
+        # headline keys in the shared BENCH_*.json shape (the perf
+        # trajectory tracker scans recorded files for these)
+        result["serve_toks_per_sec"] = opt["client_tokens_per_sec"]
+        result["serve_ttft_p95"] = opt["ttft_p95_s"]
+        result["ttft_p95_baseline_s"] = base["ttft_p95_s"]
+        print(json.dumps(result))
+        ok = (
+            opt["hung"] == 0 and base["hung"] == 0
+            and opt["errors"] == 0 and base["errors"] == 0
+            and opt["ledger_ok"] and base["ledger_ok"]
+            and opt["prefix_tokens_saved"] > 0
+            # the radix arm must beat exact-match on BOTH axes
+            and opt["client_tokens_per_sec"]
+            > base["client_tokens_per_sec"]
+            and opt["ttft_p95_s"] < base["ttft_p95_s"]
+        )
+        return 0 if ok else 1
     if args.engine:
         result = {
             "metric": "engine_tokens_per_sec",

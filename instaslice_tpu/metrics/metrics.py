@@ -216,6 +216,11 @@ class ServingMetrics:
             self.kv_blocks_free = _NoopMetric()
             self.kv_blocks_used = _NoopMetric()
             self.kv_blocks_cow = _NoopMetric()
+            self.kv_blocks_prefix = _NoopMetric()
+            self.prefix_hits = _NoopMetric()
+            self.prefix_misses = _NoopMetric()
+            self.prefix_inserted = _NoopMetric()
+            self.prefix_evicted = _NoopMetric()
             self.class_ttft_seconds = _NoopMetric()
             self.class_tpot_seconds = _NoopMetric()
             self.preemptions = _NoopMetric()
@@ -338,6 +343,35 @@ class ServingMetrics:
         self.kv_blocks_cow = Gauge(
             "tpuslice_kv_blocks_cow",
             "KV block pool: blocks copy-on-write shared by >1 holder",
+            registry=self.registry,
+        )
+        # --- radix prefix cache (docs/SERVING.md "Radix prefix
+        # cache") --- a hit skipped that prefix's prefill entirely; a
+        # miss prefilled cold; inserted/evicted is the cache churn the
+        # LRU keeps under block pressure
+        self.kv_blocks_prefix = Gauge(
+            "tpuslice_kv_blocks_prefix",
+            "KV block pool: blocks held by the radix prefix cache",
+            registry=self.registry,
+        )
+        self.prefix_hits = Counter(
+            "tpuslice_serve_prefix_hits_total",
+            "Admissions that reused a radix-cached prefix",
+            registry=self.registry,
+        )
+        self.prefix_misses = Counter(
+            "tpuslice_serve_prefix_misses_total",
+            "Base-model admissions with no cached prefix to reuse",
+            registry=self.registry,
+        )
+        self.prefix_inserted = Counter(
+            "tpuslice_serve_prefix_inserted_total",
+            "Radix tree nodes inserted by completed requests",
+            registry=self.registry,
+        )
+        self.prefix_evicted = Counter(
+            "tpuslice_serve_prefix_evicted_total",
+            "Radix tree nodes evicted (LRU reclaim or drop_prefix)",
             registry=self.registry,
         )
         # --- multi-tenant SLO scheduler (serving/scheduler.py) ---

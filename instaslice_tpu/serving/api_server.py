@@ -22,12 +22,17 @@ batched completions over HTTP.
   in both sync and streaming responses. ``"n": k`` returns k parallel
   samples (one prefill, KV-stripe forks; indexed choices; streaming
   chunks carry their choice index).
-- ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters.
-- ``POST /v1/prefixes`` with ``{"tokens": [token ids]}`` → prefill the
-  shared prefix once; later prompts starting with it skip that prefill
-  (engine prefix cache; length must be a multiple of the prefill chunk;
-  capped at the engine's ``max_prefixes`` — each stripe pins HBM).
-  ``DELETE /v1/prefixes`` with the same body frees the stripe.
+- ``GET /healthz`` → liveness; ``GET /v1/stats`` → engine counters
+  (including the ``radix`` prefix-cache block: hits/misses/inserted/
+  evicted, cached nodes/tokens/blocks).
+- Prefix reuse is AUTOMATIC (the radix prefix cache, docs/SERVING.md):
+  every completed prompt seeds the cache and later prompts sharing a
+  prefix skip that prefill. ``POST /v1/prefixes`` with ``{"tokens":
+  [token ids]}`` additionally PINS a prefix up front (pre-inserted,
+  eviction-exempt; length must be a multiple of the prefill chunk;
+  capped at the engine's ``max_prefixes``) — deprecated as an
+  optimization step, kept one release. ``DELETE /v1/prefixes`` with
+  the same body un-pins it.
 
 One scheduler thread owns the engine (the engine is not thread-safe by
 design — XLA dispatch is serialized anyway). The decision loop lives
@@ -91,6 +96,12 @@ def _env_float(name: str, default: float) -> float:
 
 def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, str(default)))
+
+
+def _env_flag(name: str, default: bool = True) -> bool:
+    return os.environ.get(
+        name, "1" if default else "0"
+    ).lower() not in ("0", "false", "no")
 
 
 #: the decision loop lives in serving/scheduler.py (continuous
@@ -739,6 +750,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "(serving/kvcache.py): admission, preemption "
                          "and the kv_blocks_* gauges account in these "
                          "units")
+    ap.add_argument("--no-radix-cache", action="store_true",
+                    default=not _env_flag("TPUSLICE_RADIX_CACHE"),
+                    help="disable the automatic radix prefix cache "
+                         "(completed prompts no longer seed prefix "
+                         "reuse; register_prefix/POST /v1/prefixes "
+                         "exact-match pinning still works — the PR 9 "
+                         "behavior; env: TPUSLICE_RADIX_CACHE=0)")
+    ap.add_argument("--no-radix-decoded", action="store_true",
+                    default=not _env_flag("TPUSLICE_RADIX_DECODED"),
+                    help="insert only each completion's PROMPT into "
+                         "the radix cache, not its decoded tokens "
+                         "(decoded insertion is what lets a multi-turn "
+                         "follow-up reuse the previous turn's whole "
+                         "history; env: TPUSLICE_RADIX_DECODED=0)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="Prometheus /metrics port (0 = off)")
     ap.add_argument("--max-batch", type=int, default=8)
@@ -931,6 +956,8 @@ def build_engine(args) -> ServingEngine:
         lora_alphas=alphas or None,
         lora_names=names or None,
         kv_block_size=getattr(args, "kv_block_size", 16),
+        radix_cache=not getattr(args, "no_radix_cache", False),
+        radix_decoded=not getattr(args, "no_radix_decoded", False),
         batched_prefill=not getattr(args, "no_batched_prefill", False),
         adapter_fastpath=not getattr(args, "no_adapter_fastpath",
                                      False),

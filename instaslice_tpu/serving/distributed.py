@@ -17,6 +17,15 @@ This is the TPU-native analog of vLLM's driver/worker RPC split, with
 the op-log as the entire protocol: newline-delimited JSON over one TCP
 connection per follower, ops applied strictly in order.
 
+The radix prefix cache needs NO ops of its own: every tree mutation is
+engine-internal and deterministic — matches/touches/locks happen
+inside the admission ops, insertion inside the decode/finish ops that
+complete a request, LRU eviction inside whichever op needed the blocks
+— and the LRU clock is logical (never wall time), so replaying the op
+stream converges every follower on the identical tree (structure,
+block accounting, eviction order). ``ServingEngine.radix_stats()`` is
+the convergence observable the tests compare.
+
 Wire format (one JSON object per line)::
 
     {"op": "add_request", "prompt": [...], "stop": [[...]], "n": 1,

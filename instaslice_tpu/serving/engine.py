@@ -33,12 +33,17 @@ built TPU-first instead of translated:
   collectives. Prefill and decode stay the same two compiled programs.
   This is how a multi-chip grant (e.g. the BASELINE 2x2 v5e slice for a
   7B-class model that cannot fit one chip) is consumed.
-- **Prefix caching**: :meth:`register_prefix` prefills a shared prompt
-  prefix once and stores its KV stripe; any prompt starting with it
-  copies the stripe in (one on-device write) instead of re-running
-  prefill. vLLM's automatic prefix caching made explicit and
-  static-shape: prefixes end on chunk boundaries, so admission reuses
-  the one compiled prefill program for the remainder.
+- **Radix prefix caching**: a radix tree over token sequences
+  (:mod:`instaslice_tpu.serving.kvcache`) caches every completed
+  prompt's KV at granule boundaries; any later prompt sharing a prefix
+  writes the cached stripes back (a few on-device writes) instead of
+  re-running that prefill — vLLM/SGLang-style AUTOMATIC prefix
+  caching, static-shape: node boundaries sit on prefill-chunk
+  granules, so the remainder reuses the one compiled prefill program
+  and stripe reads/writes stay one program per length. Cached nodes hold
+  refcounted pool blocks, LRU-evicted under block pressure;
+  :meth:`register_prefix` survives as a thin wrapper that pre-inserts
+  a pinned, eviction-exempt path (deprecated — see docs/SERVING.md).
 - **Parallel sampling**: :meth:`add_request_n` admits n samples of one
   prompt with ONE prefill — the KV stripe forks to the other slots
   (HBM copies), and independent per-row Gumbel noise diverges them at
@@ -56,6 +61,7 @@ built TPU-first instead of translated:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Dict, List, Optional
 
@@ -64,13 +70,26 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from instaslice_tpu.models.lm import Params, TpuLM, param_specs
-from instaslice_tpu.serving.kvcache import BlockTable, KVBlockPool
+from instaslice_tpu.serving.kvcache import (
+    BlockTable,
+    KVBlockPool,
+    RadixIndex,
+    RadixMatch,
+    RadixNode,
+    radix_granule,
+)
 from instaslice_tpu.serving.sampling import (
     apply_repetition_penalty,
     filter_logits,
     token_logprob,
 )
 from instaslice_tpu.utils.trace import get_tracer
+
+log = logging.getLogger("instaslice_tpu.serving.engine")
+
+#: sentinel for "no precomputed radix match passed" (None is a valid
+#: match result, so it cannot be the default)
+_MATCH_UNSET = object()
 
 
 @dataclasses.dataclass
@@ -112,15 +131,6 @@ class _Slot:
 
 
 @dataclasses.dataclass
-class _Prefix:
-    """A registered shared prompt prefix: its prefilled KV stripe(s),
-    ready to be copied into any slot instead of re-running prefill."""
-    tokens: tuple                      # the prefix token ids
-    stripe: Params                     # cache leaves (L, 1, H, T[, hd])
-    draft_stripe: Optional[Params]     # ditto for the speculative draft
-
-
-@dataclasses.dataclass
 class _Parked:
     """A preempted request: its host state plus its KV stripe(s), read
     out of the cache so the slot could go back to the batch. The block
@@ -156,6 +166,8 @@ class ServingEngine:
         repetition_penalty: float = 1.0,
         max_prefixes: int = 8,
         kv_block_size: int = 16,
+        radix_cache: bool = True,
+        radix_decoded: bool = True,
         lora_adapters=None,
         lora_alphas=None,
         lora_names=None,
@@ -313,23 +325,41 @@ class ServingEngine:
         )
         #: request id → block table (live slots AND parked requests)
         self._tables: Dict[int, BlockTable] = {}
-        #: registered prefix key → pinned read-only block table; slot
-        #: tables fork these copy-on-write at prefix-hit admission
-        self._prefix_tables: Dict[tuple, BlockTable] = {}
         #: preempted requests parked off-batch (request id → state)
         self.parked: Dict[int, _Parked] = {}
         #: host mirror of slot_adapter (preemption must not sync)
         self._slot_adapter_host: Dict[int, int] = {}
         self.preempted_total = 0
         self.resumed_total = 0
-        # prefix cache: registered prompt prefixes → stored KV stripes
-        # (:meth:`register_prefix`); admission auto-matches the longest.
-        # Each stripe pins HBM for the engine's lifetime, so the count is
-        # capped — registration past the cap raises (drop one first);
-        # explicit beats silent eviction for an operator-driven cache.
-        self.prefixes: Dict[tuple, _Prefix] = {}
+        # ---- radix prefix cache (docs/SERVING.md "Radix prefix
+        # cache") ----
+        # A radix tree over token sequences replaces the PR-9-era
+        # exact-match registered-prefix dict: every admitted prompt
+        # walks the tree and reuses the longest cached prefix (the
+        # node path's block tables fork copy-on-write at zero pool
+        # cost, the per-granule KV stripes write back into the slot);
+        # every completion INSERTS its prompt (and, with
+        # ``radix_decoded``, its decoded tokens) so the cache learns
+        # the workload with no registration step. Organic nodes hold
+        # ordinary pool blocks and are LRU-evicted under block
+        # pressure (leaf-first, never a node a live/parked table has
+        # locked); ``register_prefix`` survives as a thin wrapper that
+        # pre-inserts a PINNED, eviction-exempt path.
+        self.radix_granule = radix_granule(prefill_len, kv_block_size)
+        self.radix = RadixIndex(self.kv, self.radix_granule)
+        self.radix_cache = radix_cache
+        self.radix_decoded = radix_decoded
+        #: registered prefix key → its (registered, pinned) tree node;
+        #: the count is capped like the pre-radix stripe cache
+        self.prefixes: Dict[tuple, RadixNode] = {}
         self.max_prefixes = max_prefixes
+        #: rid → (deepest tree node its table forked, matched tokens):
+        #: lock bookkeeping plus the shared-position count the
+        #: utilization gauge must not double-count
+        self._radix_locks: Dict[int, tuple] = {}
         self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_inserted = 0
         self.prefix_tokens_saved = 0
         #: fault-injection seam (instaslice_tpu.faults.engine_fault_hook):
         #: called with the op name ("prefill"/"decode"/"spec") before
@@ -606,23 +636,32 @@ class ServingEngine:
         )
         return cache, logits
 
-    def _read_stripe_impl(self, cache, slot, *, length: int):
-        """Copy out one slot's cache positions [0, length) — every leaf
-        is (L, B, H, S[, hd]) with slot on axis 1 and position on
-        axis 3 (head-major — see ``TpuLM.init_cache``)."""
+    def _read_stripe_impl(self, cache, slot, start, *, length: int):
+        """Copy out one slot's cache positions [start, start+length) —
+        every leaf is (L, B, H, S[, hd]) with slot on axis 1 and
+        position on axis 3 (head-major — see ``TpuLM.init_cache``).
+        ``start`` is TRACED (radix granules read at arbitrary chunk
+        offsets without growing the compiled set); ``length`` stays the
+        compile-keyed static."""
 
         def rd(c):
             one = jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
-            return jax.lax.slice_in_dim(one, 0, length, axis=3)
+            return jax.lax.dynamic_slice_in_dim(one, start, length,
+                                                axis=3)
 
         return jax.tree.map(rd, cache)
 
-    def _write_stripe_impl(self, cache, stripe, slot):
-        """Write a stored stripe into a slot at position 0 (prefixes are
-        absolute-position entities: RoPE bakes positions into K)."""
+    def _write_stripe_impl(self, cache, stripe, slot, start):
+        """Write a stored stripe into a slot at position ``start``
+        (TRACED — radix path segments land at their own offsets through
+        the one compiled program per stripe length). Stripes are
+        absolute-position entities either way: RoPE bakes positions
+        into K, so a segment only ever writes back at the offset it was
+        read from."""
 
         def wr(c, s):
-            starts = (jnp.int32(0), slot) + (jnp.int32(0),) * (c.ndim - 2)
+            starts = (jnp.int32(0), slot, jnp.int32(0), start) + \
+                (jnp.int32(0),) * (c.ndim - 4)
             return jax.lax.dynamic_update_slice(c, s, starts)
 
         return jax.tree.map(wr, cache, stripe)
@@ -815,7 +854,17 @@ class ServingEngine:
             len(r.prompt) + len(r.generated)
             for r in list(self.slots.values())
         )
-        return live + sum(p.length for p in list(self.parked.values()))
+        # radix-cached tokens are resident too (their nodes hold the
+        # blocks in the denominator) — but positions a live/parked
+        # table SHARES with its matched path must count ONCE: the
+        # per-rid matched lengths subtract exactly the double-counted
+        # span, so steady prefix-hit traffic reads true occupancy
+        # instead of saturating the gauge at 1.0
+        shared = sum(length
+                     for _, length in list(self._radix_locks.values()))
+        return max(0, live
+                   + sum(p.length for p in list(self.parked.values()))
+                   + self.radix.tokens_cached() - shared)
 
     def kv_utilization(self) -> float:
         """True block-pool occupancy: resident tokens / capacity of the
@@ -838,7 +887,39 @@ class ServingEngine:
         out = self.kv.stats(dict(self._tables))
         out["parked"] = len(self.parked)
         out["utilization"] = self.kv_utilization()
+        #: pool blocks the radix prefix cache holds (the
+        #: tpuslice_kv_blocks_prefix gauge), and how many of those a
+        #: reclaim could free right now
+        out["prefix_blocks"] = self.radix.pool_blocks()
+        out["prefix_evictable"] = self.radix.evictable_blocks()
         return out
+
+    @property
+    def prefix_evicted(self) -> int:
+        """Radix nodes evicted since construction (LRU reclaim +
+        drop_prefix cascades) — the counter behind
+        ``tpuslice_serve_prefix_evicted_total``."""
+        return self.radix.evictions
+
+    def radix_stats(self) -> dict:
+        """The radix prefix cache's observability block (/v1/stats
+        ``radix``): structure gauges + the hit/miss/insert/evict
+        ledger. Tree walks list()-snapshot child maps, so HTTP stats
+        threads can read while the scheduler mutates."""
+        return {
+            "enabled": self.radix_cache,
+            "decoded": self.radix_decoded,
+            "granule": self.radix_granule,
+            "nodes": self.radix.node_count(),
+            "tokens": self.radix.tokens_cached(),
+            "blocks": self.radix.pool_blocks(),
+            "evictable_blocks": self.radix.evictable_blocks(),
+            "hits": self.prefix_hits,
+            "misses": self.prefix_misses,
+            "inserted": self.prefix_inserted,
+            "evicted": self.prefix_evicted,
+            "tokens_saved": self.prefix_tokens_saved,
+        }
 
     def compiled_programs(self) -> Dict[str, int]:
         """Per-jit compile-cache sizes — the observable behind the
@@ -877,8 +958,10 @@ class ServingEngine:
           single-adapter variant, times the power-of-two step counts
           and 256-position attend buckets for the block form
         - read/write_stripe: one per distinct static stripe length —
-          chunk multiples (prefix/fork stripes) plus block multiples
-          (preemption roundings)
+          chunk multiples (radix granules, fork stripes) plus block
+          multiples (preemption roundings). Radix stripe traffic adds
+          NO programs: the position offset is traced, and the granule
+          is itself a chunk multiple already in the set.
         """
         cap = block_cap or self.max_len
         # power-of-two n_steps values in [1, cap]
@@ -936,9 +1019,17 @@ class ServingEngine:
             )
 
     def _release_table(self, rid: int) -> None:
+        """THE per-rid teardown choke point: returns the block table's
+        references AND the radix-path lock the admission took — live
+        finishes, evictions, parked drops, and recovery all come
+        through here, so a tree node can never stay pinned by a dead
+        rid."""
         t = self._tables.pop(rid, None)
         if t is not None:
             self.kv.release(t)
+        held = self._radix_locks.pop(rid, None)
+        if held is not None:
+            self.radix.unlock(held[0])
 
     def _sync_tables(self) -> None:
         """Grow every live slot's block table to its token count —
@@ -958,23 +1049,42 @@ class ServingEngine:
                 continue
             total = len(req.prompt) + len(req.generated)
             if not self.kv.bump(t, total):
+                # cached-but-unreferenced radix blocks yield to live
+                # growth before ensure() can see exhaustion (the
+                # headroom guard counted them as free)
+                self._reclaim_for(self.kv.growth_cost(t, total))
                 self.kv.ensure(t, total)
 
-    def can_admit(self, prompt_len: int, n: int = 1) -> bool:
+    def can_admit(self, prompt, n: int = 1, adapter: int = 0,
+                  match=_MATCH_UNSET) -> bool:
         """Step-level admission check: free slots AND free KV blocks.
         The scheduler gates on this each step instead of slot count
         alone, so parked blocks correctly push back on admission.
 
-        The block count mirrors :meth:`_alloc_tables` exactly (forks
-        share the prompt's full blocks and pay one boundary block
-        each), so any HTTP-valid request fits an empty pool — a False
-        here always means "blocks will free", never "never". Prefix
-        sharing can only need fewer (conservative is safe: the caller
-        retries next step)."""
+        ``prompt`` may be the token list (the scheduler's form — the
+        block count then charges only the NON-SHARED suffix of a radix
+        hit, via :meth:`admit_block_cost`) or a bare length (the
+        conservative full-prompt charge). Either way the count mirrors
+        :meth:`_alloc_tables` exactly, and cached-but-unreferenced
+        radix blocks count as free (admission reclaims them
+        deterministically), so any HTTP-valid request fits an empty
+        pool — a False here always means "blocks will free", never
+        "never"."""
         if self.free_slots() < n:
             return False
-        need = self.kv.blocks_for(prompt_len + 1) + (n - 1)
-        return need <= self.kv.free_blocks()
+        if isinstance(prompt, int):
+            need = self.kv.blocks_for(prompt + 1) + (n - 1)
+        else:
+            if match is _MATCH_UNSET:
+                match = (self._match_prefix(prompt) if adapter == 0
+                         else None)
+            # the matched path's own evictable blocks leave the supply
+            # the moment admission locks it (match_reserve)
+            need = (self.admit_block_cost(prompt, n, adapter,
+                                          match=match)
+                    + self.match_reserve(match))
+        return need <= (self.kv.free_blocks()
+                        + self.radix.evictable_blocks())
 
     def finish_slot(self, slot: int, n_keep: Optional[int] = None,
                     reason: str = "max_new_tokens") -> None:
@@ -990,6 +1100,9 @@ class ServingEngine:
         self._drain_pending()
         req = self.slots.pop(slot)
         self._release_table(req.request_id)
+        # the prompt (and its decode chain) just proved it is real
+        # traffic: teach the radix cache before the slot is reused
+        self._radix_insert(slot, req)
         toks = req.generated if n_keep is None else req.generated[:n_keep]
         lps = req.logprobs if n_keep is None else req.logprobs[:n_keep]
         self.finished.append(
@@ -1027,11 +1140,11 @@ class ServingEngine:
             self.max_len,
             self.kv.blocks_for(max(1, length)) * self.kv_block_size,
         )
-        stripe = self._read_stripe(self.cache, slot, length=rounded)
+        stripe = self._read_stripe(self.cache, slot, 0, length=rounded)
         draft_stripe = None
         if self.draft_model is not None:
             draft_stripe = self._read_stripe(
-                self.draft_cache, slot, length=rounded
+                self.draft_cache, slot, 0, length=rounded
             )
         del self.slots[slot]
         self.parked[req.request_id] = _Parked(
@@ -1059,10 +1172,11 @@ class ServingEngine:
         # table would leak out of the pool forever
         parked = self.parked[rid]
         req = parked.req
-        self.cache = self._write_stripe(self.cache, parked.stripe, slot)
+        self.cache = self._write_stripe(self.cache, parked.stripe, slot,
+                                        0)
         if self.draft_model is not None and parked.draft_stripe is not None:
             self.draft_cache = self._write_stripe(
-                self.draft_cache, parked.draft_stripe, slot
+                self.draft_cache, parked.draft_stripe, slot, 0
             )
         del self.parked[rid]
         self.lengths = self.lengths.at[slot].set(parked.length)
@@ -1216,37 +1330,188 @@ class ServingEngine:
                 )
         return chunk_logits
 
-    def _match_prefix(self, prompt: List[int]) -> Optional[_Prefix]:
-        """Longest registered prefix that is a strict prefix of
-        ``prompt`` (strict so at least one chunk still runs — its logits
-        seed the first sampled token)."""
-        pt = tuple(prompt)
-        best = None
-        for pref in self.prefixes.values():
-            L = len(pref.tokens)
-            if L < len(prompt) and pt[:L] == pref.tokens and (
-                best is None or L > len(best.tokens)
-            ):
-                best = pref
-        return best
+    def _match_prefix(self, prompt: List[int]) -> Optional[RadixMatch]:
+        """Longest radix-cached strict prefix of ``prompt``, granule-
+        aligned and capped so at least one chunk still prefills (its
+        logits seed the first sampled token — the strict-prefix rule
+        the exact-match cache had). PURE: no LRU touch, so the
+        scheduler can call it while planning without diverging
+        op-stream followers (the admission op touches)."""
+        g = self.radix_granule
+        limit = ((len(prompt) - 1) // g) * g
+        if limit <= 0:
+            return None
+        m = self.radix.match(prompt, limit)
+        return m if m.length else None
+
+    def admit_block_cost(self, prompt: List[int], n: int = 1,
+                         adapter: int = 0,
+                         match=_MATCH_UNSET) -> int:
+        """Pool blocks admitting this request will charge — THE shared
+        admission cost model (``can_admit``, ``_alloc_tables``'s
+        reclaim, and the scheduler's burst planning and block-pressure
+        guards all use it, so headroom math charges only the NON-SHARED
+        suffix of a radix hit instead of the whole prompt). Matched
+        blocks fork at zero pool cost; a match ending inside a block
+        pays the one boundary copy-on-write ensure() will charge;
+        forks pay one boundary block each as before. Callers that
+        already walked the tree pass ``match=`` (the scheduler's
+        planner — one walk per request per round, not four)."""
+        if match is _MATCH_UNSET:
+            match = self._match_prefix(prompt) if adapter == 0 else None
+        shared = self.kv.blocks_for(match.length) if match else 0
+        cow = 1 if match and match.length % self.kv.block_size else 0
+        return (self.kv.blocks_for(len(prompt) + 1) - shared + cow
+                + (n - 1))
+
+    def match_reserve(self, match) -> int:
+        """Evictable-supply blocks admitting through ``match`` takes
+        OFF the table: _alloc_tables locks the matched path before
+        reclaiming, so its pool blocks — counted in
+        ``evictable_blocks()`` while unlocked — stop being
+        reclaimable the moment this admission starts. Every
+        supply-side check (can_admit, the scheduler's burst ledger and
+        block-pressure guards) must charge this reserve alongside
+        ``admit_block_cost``, or a prompt whose own matched path IS
+        most of the evictable supply would pass the check and then
+        hard-fail allocation (conservative when the path is already
+        locked by another table — the request just waits a round)."""
+        if match is None:
+            return 0
+        return sum(nd.pool_block_count() for nd in match.path)
+
+    def _reclaim_for(self, need_blocks: int) -> None:
+        """Free pool blocks by LRU-evicting unreferenced radix nodes —
+        the deterministic engine-side half of "cached blocks count as
+        free": callers that observed ``evictable_blocks`` in their
+        headroom math call this inside the admission/decode op, so
+        op-stream followers evict the identical nodes."""
+        deficit = need_blocks - self.kv.free_blocks()
+        if deficit > 0:
+            self.radix.reclaim(deficit)
+
+    def _write_match_stripes(self, path: List[RadixNode], length: int,
+                             slot: int) -> None:
+        """Write the matched path's per-granule KV stripes into a
+        slot's cache rows (target + draft) up to ``length`` — the
+        radix-hit replacement for re-running that prefix's prefill
+        chunks. One compiled write program per stripe length (the
+        granule); offsets are traced."""
+        g = self.radix_granule
+        for node in path:
+            for i, stripe in enumerate(node.stripes):
+                off = node.start + i * g
+                if off >= length:
+                    return
+                self.cache = self._write_stripe(self.cache, stripe,
+                                                slot, off)
+                if (self.draft_model is not None
+                        and node.draft_stripes is not None):
+                    self.draft_cache = self._write_stripe(
+                        self.draft_cache, node.draft_stripes[i], slot,
+                        off,
+                    )
+
+    def _read_granule_stripes(self, slot: int, start_g: int,
+                              end_g: int):
+        """(stripes, draft_stripes) for granules [start_g, end_g) of a
+        slot's cache rows — the read half of radix insertion and
+        registration."""
+        g = self.radix_granule
+        stripes = []
+        dstripes = [] if self.draft_model is not None else None
+        for gi in range(start_g, end_g):
+            stripes.append(
+                self._read_stripe(self.cache, slot, gi * g, length=g)
+            )
+            if dstripes is not None:
+                dstripes.append(
+                    self._read_stripe(self.draft_cache, slot, gi * g,
+                                      length=g)
+                )
+        return stripes, dstripes
+
+    def _radix_insert(self, slot: int, req: "_Slot") -> None:
+        """Insert a finishing request's prompt (and, with
+        ``radix_decoded``, its decoded tokens) into the radix tree so
+        the NEXT prompt sharing the prefix skips that prefill — the
+        no-registration half of the prefix cache. Called after the
+        request's own table released (its freed blocks are exactly the
+        room the new node wants). Best-effort: insertion never evicts
+        anything and never fails the completion path."""
+        if not self.radix_cache:
+            return
+        if self._slot_adapter_host.get(slot, 0) != 0:
+            # adapter KV must never pollute the base-model tree (the
+            # same rule that makes adapter requests skip prefix reuse)
+            return
+        g = self.radix_granule
+        toks = list(req.prompt)
+        if self.radix_decoded:
+            toks += req.generated
+            # generated[-1] is the pending last_token, not yet written
+            # to the cache (same bound preempt_slot rounds from)
+            limit = len(toks) - 1
+        else:
+            limit = len(req.prompt)
+        # a stored prefix only ever hits a strictly-longer prompt whose
+        # remainder chunk must still fit the cache (the registration
+        # bound, applied to organic inserts too)
+        limit = min(limit, self.max_len - self.prefill_len)
+        L = (limit // g) * g
+        if L < g:
+            return
+        granules = self.radix.granules_of(toks, L)
+        try:
+            parent, matched = self.radix.ensure_path(granules)
+            if matched == len(granules):
+                self.radix.touch(parent)
+                return
+            cost = (self.kv.blocks_for(L)
+                    - self.kv.blocks_for(matched * g)
+                    + (1 if (matched * g) % self.kv.block_size else 0))
+            if cost > self.kv.free_blocks():
+                return           # full pool: cache only what fits free
+            stripes, dstripes = self._read_granule_stripes(
+                slot, matched, len(granules)
+            )
+            node = self.radix.add_child(parent, granules[matched:])
+            node.stripes = stripes
+            node.draft_stripes = dstripes
+            self.prefix_inserted += 1
+        except Exception as e:  # noqa: BLE001 - cache fill is optional
+            # single-host: a failed stripe read (transient device
+            # error) aborts THIS insert, never the completion that
+            # triggered it — log so a persistently failing cache is
+            # visible, keep serving. Multi-host: swallowing would leave
+            # THIS replica's tree short one node while the others
+            # inserted — later matches/evictions would then dispatch
+            # different device ops per replica and deadlock the
+            # collectives; die loudly instead so the pod restarts
+            # (the follower's RuntimeError-subclass policy).
+            if self._multiproc:
+                raise
+            log.warning("radix insert skipped: %s", e)
 
     def register_prefix(self, prefix: List[int]) -> None:
-        """Prefill ``prefix`` once and store its KV stripe; later
-        :meth:`add_request` calls whose prompt starts with it copy the
-        stripe (one on-device write) instead of re-running prefill — the
-        shared-system-prompt optimization (vLLM's automatic prefix
-        caching, made explicit: registration is the natural grant-time
-        hook for a slice serving one application).
+        """Pre-insert ``prefix`` into the radix prefix cache as a
+        REGISTERED path: prefilled once (unless the tree already holds
+        it), pinned outside the allocatable pool, exempt from LRU
+        eviction until :meth:`drop_prefix`.
 
-        Constraints keeping every shape static: the length must be a
-        multiple of ``prefill_len`` (stripes start at position 0 —
-        RoPE bakes absolute positions into K — and end on a chunk
-        boundary so the remainder prefill reuses the one compiled
-        program) and short enough that a strictly-longer prompt still
-        fits the cache. Needs a free slot to prefill through (freed
-        immediately; the stripe is masked for the next occupant). Not
-        thread-safe against a running scheduler — register via the
-        serving API or before starting it."""
+        DEPRECATED as an optimization step — since the radix cache
+        (PR 11) every completion inserts its prompt automatically, so
+        organically shared prefixes are cached with no registration.
+        Kept one release as a thin wrapper for operators who want a
+        prefix pinned before the first request arrives (and for the
+        existing ``POST /v1/prefixes`` surface); see docs/SERVING.md
+        "Radix prefix cache".
+
+        Constraints unchanged: the length must be a multiple of
+        ``prefill_len`` (granule-floored internally when the radix
+        granule is coarser), short enough that a strictly-longer
+        prompt still fits the cache, and a free slot must exist to
+        prefill through when the path is not already cached."""
         key = tuple(prefix)
         if key in self.prefixes:
             return
@@ -1260,19 +1525,45 @@ class ServingEngine:
             self._register_prefix_inner(prefix, key)
 
     def _register_prefix_inner(self, prefix: List[int], key) -> None:
-        slot = self._first_free_slot("no free slots to prefill the prefix")
-        self._prefill_chunks(slot, list(prefix))
-        stripe = self._read_stripe(self.cache, slot, length=len(prefix))
-        draft_stripe = None
-        if self.draft_model is not None:
-            draft_stripe = self._read_stripe(
-                self.draft_cache, slot, length=len(prefix)
+        g = self.radix_granule
+        reg_len = (len(prefix) // g) * g
+        granules = self.radix.granules_of(prefix, reg_len)
+        parent, matched = self.radix.ensure_path(granules)
+        if matched == len(granules):
+            # the organic cache already learned this prefix: just pin
+            # it (no prefill, no new blocks)
+            node = parent
+        else:
+            slot = self._first_free_slot(
+                "no free slots to prefill the prefix"
             )
-        self.prefixes[key] = _Prefix(key, stripe, draft_stripe)
-        # pinned read-only blocks OUTSIDE the allocatable pool (the
-        # stripe is a separate HBM array, not a slot row); prefix-hit
-        # admissions fork this table copy-on-write
-        self._prefix_tables[key] = self.kv.pin(len(prefix))
+            if matched:
+                # cached head: write its stripes, prefill the rest
+                self._write_match_stripes(
+                    self.radix.path_of(parent), matched * g, slot
+                )
+            self._prefill_chunks(slot, list(prefix[:reg_len]),
+                                 start_chunk=matched * g
+                                 // self.prefill_len)
+            stripes, dstripes = self._read_granule_stripes(
+                slot, matched, len(granules)
+            )
+            # pinned: registered segments live OUTSIDE the allocatable
+            # pool (like the pre-radix stripe cache), so registration
+            # never shrinks the capacity admission reasons over
+            node = self.radix.add_child(parent, granules[matched:],
+                                        pinned=True)
+            node.stripes = stripes
+            node.draft_stripes = dstripes
+        node.registered = True
+        # the whole path is now structurally un-evictable: move its
+        # pool blocks outside the allocatable pool (adopting an
+        # organically-cached path must not silently shrink the
+        # capacity admission reasons over — the "registration never
+        # costs serving capacity" contract the pre-radix pin() kept)
+        self.radix.pin_path(node)
+        self.radix.touch(node)
+        self.prefixes[key] = node
 
     def _validate_prefix(self, prefix: List[int]) -> None:
         """Host-side registration checks, raised BEFORE any device op
@@ -1297,17 +1588,34 @@ class ServingEngine:
                 f"prefix cache full ({self.max_prefixes}); drop_prefix "
                 "one first (each stored stripe pins HBM)"
             )
-        self._first_free_slot("no free slots to prefill the prefix")
+        # a free slot is only needed when something must PREFILL: a
+        # path the organic cache fully holds just gets pinned
+        # (match() is pure, so pre-broadcast validation stays safe)
+        g = self.radix_granule
+        reg_len = (len(prefix) // g) * g
+        if self.radix.match(list(prefix), reg_len).length < reg_len:
+            self._first_free_slot("no free slots to prefill the prefix")
 
     def drop_prefix(self, prefix: List[int]) -> bool:
-        """Free a registered prefix's stored stripe (HBM). Its pinned
-        blocks unpin too; copies shared into live tables survive until
-        those tables release them."""
+        """Un-register a prefix: its tree path loses eviction
+        exemption, and whatever of it no live table references is
+        evicted NOW (pinned segment blocks unpin; copies shared into
+        live tables survive until those tables release them). Organic
+        descendants that grew under the registered path stay cached as
+        ordinary LRU-evictable nodes."""
         key = tuple(prefix)
-        table = self._prefix_tables.pop(key, None)
-        if table is not None:
-            self.kv.release(table)
-        return self.prefixes.pop(key, None) is not None
+        node = self.prefixes.pop(key, None)
+        if node is None:
+            return False
+        node.registered = False
+        cur = node
+        while (cur is not None and cur is not self.radix.root
+               and not cur.children and cur.locks == 0
+               and not cur.registered):
+            parent = cur.parent
+            self.radix.evict(cur)
+            cur = parent
+        return True
 
     @staticmethod
     def _normalize_stop(stop) -> List[List[int]]:
@@ -1372,19 +1680,58 @@ class ServingEngine:
             rids = self._add_request_n_inner(prompt, n, stop, adapter, sp)
         return rids
 
-    def _alloc_tables(self, prompt_len: int, n: int, pref):
+    def _adopt_radix_locks(self, pref: Optional[RadixMatch],
+                           rids: List[int]) -> None:
+        """Hand the path locks :meth:`_alloc_tables` took to the
+        admitted rids (released per rid in :meth:`_release_table`). A
+        rid that finished ON admission (max_len edge) already released
+        its table, so its lock unwinds here instead of leaking."""
+        if pref is None:
+            return
+        deepest = pref.path[-1]
+        for rid in rids:
+            if rid in self._tables:
+                self._radix_locks[rid] = (deepest, pref.length)
+            else:
+                self.radix.unlock(deepest)
+
+    def _alloc_tables(self, prompt_len: int, n: int,
+                      pref: Optional[RadixMatch],
+                      prelocked: bool = False):
         """Block tables for an n-way admission, all-or-nothing. The
-        first table forks the matched prefix's pinned table (its blocks
-        are copy-on-write shared — zero pool cost until divergence);
-        forks 2..n share the first table's blocks the same way."""
+        first table forks the matched radix path's segment tables (its
+        blocks are copy-on-write shared — zero pool cost until
+        divergence); forks 2..n share the first table's blocks the same
+        way. Locks the matched path n times first (one per fork, so
+        reclaim — here or in a later admission — can never evict a node
+        a table is about to reference); the rids adopt the locks at
+        registration, and every failure path unlocks.
+        ``prelocked=True`` means the caller took (and owns unwinding)
+        those locks already — the BURST path must lock EVERY
+        co-admitted request's path before ANY request's reclaim runs,
+        or request i's reclaim could evict the node request j>i
+        matched and j would fork a dead table."""
         from instaslice_tpu.serving.kvcache import BlockPoolExhausted
 
         tables: List[BlockTable] = []
+        locked = 0
+        node = pref.path[-1] if pref is not None else None
         try:
-            base = (self._prefix_tables.get(pref.tokens)
-                    if pref is not None else None)
-            t0 = (self.kv.fork(base, len(pref.tokens))
-                  if base is not None else self.kv.allocate(0))
+            if node is not None and not prelocked:
+                for _ in range(n):
+                    self.radix.lock(node)
+                locked = n
+            shared = self.kv.blocks_for(pref.length) if pref else 0
+            cow = (1 if pref and pref.length % self.kv.block_size
+                   else 0)
+            # cached-but-unreferenced radix blocks count as free in
+            # can_admit's math — make that true before allocating
+            self._reclaim_for(
+                self.kv.blocks_for(prompt_len + 1) - shared + cow
+                + (n - 1)
+            )
+            t0 = (self.kv.fork(pref.path[-1].table, pref.length)
+                  if pref is not None else self.kv.allocate(0))
             tables.append(t0)
             # +1: admission samples each request's first token
             self.kv.ensure(t0, prompt_len + 1)
@@ -1398,6 +1745,8 @@ class ServingEngine:
         except BlockPoolExhausted as e:
             for t in tables:
                 self.kv.release(t)
+            for _ in range(locked):
+                self.radix.unlock(node)
             raise RuntimeError(
                 f"kv block pool cannot admit this request: {e} "
                 "(shed parked state or wait for a release)"
@@ -1414,22 +1763,34 @@ class ServingEngine:
             )
         self._check_prompt_fits(prompt)
         self._check_capacity(n)
-        # registered-prefix stripes hold BASE-model KV: an adapter
-        # request must recompute its whole prompt through the adapter
-        # (reusing base KV would serve a silent base/adapter hybrid)
+        # radix-cached stripes hold BASE-model KV: an adapter request
+        # must recompute its whole prompt through the adapter (reusing
+        # base KV would serve a silent base/adapter hybrid)
+        t_match = time.perf_counter()
         pref = self._match_prefix(prompt) if adapter == 0 else None
+        get_tracer().record(
+            "engine.radix_match",
+            (time.perf_counter() - t_match) * 1e3,
+            matched=pref.length if pref else 0, tokens=len(prompt),
+        )
         tables = self._alloc_tables(len(prompt), n, pref)
         try:
-            return self._admit_with_tables(
+            rids = self._admit_with_tables(
                 prompt, n, stop, adapter, sp, pref, tables
             )
         except BaseException:
             # a failed admission (injected fault, device error) must
             # not leak the blocks it reserved — the caller's recovery
-            # path only releases REGISTERED tables
+            # path only releases REGISTERED tables (release is
+            # idempotent; the path locks _alloc_tables took unwind too)
             for t in tables:
                 self.kv.release(t)
+            if pref is not None:
+                for _ in range(n):
+                    self.radix.unlock(pref.path[-1])
             raise
+        self._adopt_radix_locks(pref, rids)
+        return rids
 
     def _admit_with_tables(self, prompt: List[int], n: int, stop,
                            adapter: int, sp, pref,
@@ -1446,16 +1807,14 @@ class ServingEngine:
             ].set(adapter)
         start_chunk = 0
         if pref is not None:
-            sp.attrs["prefix_hit"] = str(len(pref.tokens))
-            self.cache = self._write_stripe(self.cache, pref.stripe,
-                                            first)
-            if self.draft_model is not None:
-                self.draft_cache = self._write_stripe(
-                    self.draft_cache, pref.draft_stripe, first
-                )
-            start_chunk = len(pref.tokens) // self.prefill_len
+            sp.attrs["prefix_hit"] = str(pref.length)
+            self._write_match_stripes(pref.path, pref.length, first)
+            start_chunk = pref.length // self.prefill_len
+            self.radix.touch(pref.path[-1])
             self.prefix_hits += 1
-            self.prefix_tokens_saved += len(pref.tokens)
+            self.prefix_tokens_saved += pref.length
+        elif adapter == 0:
+            self.prefix_misses += 1
         chunk_logits = self._prefill_chunks(first, prompt, start_chunk,
                                             adapter=adapter)
         last_logits = chunk_logits[(len(prompt) - 1) % self.prefill_len]
@@ -1466,17 +1825,18 @@ class ServingEngine:
             stripe_len = (
                 -(-len(prompt) // self.prefill_len) * self.prefill_len
             )
-            stripe = self._read_stripe(self.cache, first,
+            stripe = self._read_stripe(self.cache, first, 0,
                                        length=stripe_len)
             d_stripe = None
             if self.draft_model is not None:
-                d_stripe = self._read_stripe(self.draft_cache, first,
+                d_stripe = self._read_stripe(self.draft_cache, first, 0,
                                              length=stripe_len)
             for s in slots[1:]:
-                self.cache = self._write_stripe(self.cache, stripe, s)
+                self.cache = self._write_stripe(self.cache, stripe, s,
+                                                0)
                 if d_stripe is not None:
                     self.draft_cache = self._write_stripe(
-                        self.draft_cache, d_stripe, s
+                        self.draft_cache, d_stripe, s, 0
                     )
         if self.track_seen:
             # fresh slots: clear whatever the previous occupant saw
@@ -1554,23 +1914,48 @@ class ServingEngine:
                 )
             self._check_prompt_fits(r.prompt)
         self._check_capacity(sum(r.n for r in reqs))
+        t_match = time.perf_counter()
         prefs = [self._match_prefix(r.prompt) if r.adapter == 0
                  else None for r in reqs]
+        get_tracer().record(
+            "engine.radix_match",
+            (time.perf_counter() - t_match) * 1e3,
+            matched=sum(p.length for p in prefs if p),
+            tokens=sum(len(r.prompt) for r in reqs), reqs=len(reqs),
+        )
         all_tables: List[List[BlockTable]] = []
+        # lock EVERY request's matched path BEFORE any allocation: a
+        # co-admitted request's reclaim must never LRU-evict a node a
+        # later request of the same burst is about to fork (it would
+        # inherit a released table and skip prefill with no stripes —
+        # silently wrong KV)
+        for r, pref in zip(reqs, prefs):
+            if pref is not None:
+                for _ in range(r.n):
+                    self.radix.lock(pref.path[-1])
         try:
             for r, pref in zip(reqs, prefs):
                 all_tables.append(
-                    self._alloc_tables(len(r.prompt), r.n, pref)
+                    self._alloc_tables(len(r.prompt), r.n, pref,
+                                       prelocked=True)
                 )
-            return self._admit_burst(reqs, stops, prefs, all_tables, sp)
+            out = self._admit_burst(reqs, stops, prefs, all_tables, sp)
         except BaseException:
             # nothing admitted on failure: release every table the
             # burst reserved (release is idempotent, so tables that
             # made it into _tables before a late failure just free)
+            # and unwind EVERY pre-taken path lock
             for tables in all_tables:
                 for t in tables:
                     self.kv.release(t)
+            for r, pref in zip(reqs, prefs):
+                if pref is not None:
+                    for _ in range(r.n):
+                        self.radix.unlock(pref.path[-1])
             raise
+        for pref, rids in zip(prefs, out):
+            self._adopt_radix_locks(pref, rids)
+        return out
 
     def _admit_burst(self, reqs, stops, prefs, all_tables, sp) \
             -> List[List[int]]:
@@ -1595,17 +1980,21 @@ class ServingEngine:
             self.slot_adapter = self.slot_adapter.at[
                 jnp.asarray(flat_slots)
             ].set(jnp.asarray(flat_adapt, jnp.int32))
-        # prefix stripes land before any chunk round touches the slot
+        # radix-matched stripes land before any chunk round touches the
+        # slot — a burst's requests join the chunk rounds mid-tree,
+        # each at its own matched depth
         start_chunks: List[int] = []
         for r, pref, ss in zip(reqs, prefs, slots_per):
             sc = 0
             if pref is not None:
-                self.cache = self._write_stripe(
-                    self.cache, pref.stripe, ss[0]
-                )
-                sc = len(pref.tokens) // P
+                self._write_match_stripes(pref.path, pref.length,
+                                          ss[0])
+                sc = pref.length // P
+                self.radix.touch(pref.path[-1])
                 self.prefix_hits += 1
-                self.prefix_tokens_saved += len(pref.tokens)
+                self.prefix_tokens_saved += pref.length
+            elif r.adapter == 0:
+                self.prefix_misses += 1
             start_chunks.append(sc)
         # chunk rounds: each request advances ONE chunk per round
         # (chunk j+1 attends chunk j's KV), all participants in one
@@ -1683,11 +2072,11 @@ class ServingEngine:
             ss = slots_per[ri]
             if r.n > 1:
                 stripe = self._read_stripe(
-                    self.cache, ss[0], length=n_chunks[ri] * P
+                    self.cache, ss[0], 0, length=n_chunks[ri] * P
                 )
                 for s in ss[1:]:
                     self.cache = self._write_stripe(self.cache, stripe,
-                                                    s)
+                                                    s, 0)
             if self.track_seen:
                 rows = jnp.asarray(ss)
                 pt = jnp.asarray(r.prompt, jnp.int32)
@@ -2043,6 +2432,9 @@ class ServingEngine:
             )
             del self.slots[slot]
             self._release_table(req.request_id)
+            # completion feeds the radix prefix cache (after the
+            # release: the freed blocks are the room the insert wants)
+            self._radix_insert(slot, req)
 
     def generate(
         self, prompts: List[List[int]], max_new_tokens: int,

@@ -156,10 +156,26 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
                 f"{type(e).__name__}: {e}", None)
 
 
+def parse_prefix_pool(spec: str):
+    """``N:L`` → (pool size, prefix length) for ``--prefix-pool``."""
+    try:
+        n_s, l_s = spec.split(":", 1)
+        n, length = int(n_s), int(l_s)
+    except ValueError:
+        raise ValueError(
+            f"prefix-pool spec {spec!r}: want N:L (e.g. '4:64')"
+        ) from None
+    if n < 1 or length < 1:
+        raise ValueError(f"prefix-pool spec {spec!r}: N and L must "
+                         "be >= 1")
+    return n, length
+
+
 def run(url: str, requests: int, concurrency: int, prompt_len: int,
         max_tokens: int, vocab: int, stream: bool, timeout: float,
         seed: int = 0, adapters: List[str] = (),
-        tenants=None, jitter: float = 0.0) -> dict:
+        tenants=None, jitter: float = 0.0,
+        prefix_pool: str = "") -> dict:
     """``adapters``: multi-LoRA names assigned round-robin across
     requests ("" rides the base model) — load-tests the batched
     per-request adapter path.
@@ -170,7 +186,16 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     and the report gains per-tenant TTFT/TPOT p50/p95/p99 plus an
     **SLO-attainment fraction** — ok requests whose TTFT met the
     tenant's target (streaming; sync runs use total latency, the
-    conservative stand-in)."""
+    conservative stand-in).
+
+    ``prefix_pool`` (``"N:L"``): organic prefix sharing — each prompt's
+    HEAD is drawn (seeded, uniform) from N shared L-token prefixes and
+    its TAIL is a fresh random draw of the usual ``prompt_len``/jitter
+    length, the traffic shape the server's radix prefix cache exists
+    for (common system prompts across tenants, nothing registered).
+    The report gains a ``prefix_pool`` block with the client-side
+    reuse fraction: requests whose prefix was already issued at least
+    once earlier in the run — the ceiling on the server's hit rate."""
     from instaslice_tpu.serving.scheduler import parse_tenant_specs
 
     rng = random.Random(seed)
@@ -206,6 +231,26 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
         [rng.randrange(1, vocab) for _ in range(plens[i])]
         for i in range(requests)
     ]
+    prefix_reused = 0
+    pool_spec = None
+    if prefix_pool:
+        pool_n, pool_len = parse_prefix_pool(prefix_pool)
+        pool = [
+            [rng.randrange(1, vocab) for _ in range(pool_len)]
+            for _ in range(pool_n)
+        ]
+        picks = [rng.randrange(pool_n) for _ in range(requests)]
+        # reuse fraction in ISSUE order: a request reuses when its
+        # prefix was issued by ANY earlier request — the organic-
+        # sharing ceiling the server-side hit counter reconciles under
+        seen_picks: set = set()
+        for pk in picks:
+            if pk in seen_picks:
+                prefix_reused += 1
+            seen_picks.add(pk)
+        prompts = [pool[picks[i]] + prompts[i]
+                   for i in range(requests)]
+        pool_spec = {"n": pool_n, "len": pool_len}
     lat: List[float] = []
     ttfts: List[float] = []
     tpots: List[float] = []
@@ -294,6 +339,13 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     }
     if adapters:
         out["adapters"] = list(adapters)
+    if pool_spec is not None:
+        out["prefix_pool"] = {
+            **pool_spec,
+            "reused": prefix_reused,
+            "reused_fraction": round(prefix_reused / requests, 4)
+            if requests else 0.0,
+        }
     if tenants:
         per_tenant = {}
         for name in sorted(tenants):
@@ -374,6 +426,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "assigned round-robin across requests (an "
                          "empty entry rides the base model, e.g. "
                          "',billing,support')")
+    ap.add_argument("--prefix-pool", default="",
+                    help="N:L — organic prefix sharing: each prompt's "
+                         "head is drawn (seeded) from N shared L-token "
+                         "prefixes, its tail is a fresh --prompt-len "
+                         "draw; the report gains the client-side "
+                         "prefix reuse fraction (the radix-cache "
+                         "workload shape)")
     ap.add_argument("--jitter", type=float, default=0.0,
                     help="mixed sequence lengths: each request draws "
                          "prompt-len and max-tokens from "
@@ -409,6 +468,13 @@ def main(argv=None) -> int:
             return 1
     else:
         tenants = None
+    if args.prefix_pool:
+        try:
+            parse_prefix_pool(args.prefix_pool)
+        except ValueError as e:
+            # scripted callers parse stdout JSON — never a traceback
+            print(json.dumps({"error": f"bad --prefix-pool: {e}"}))
+            return 1
     if args.sweep:
         try:
             levels = [int(x) for x in args.sweep.split(",")
@@ -424,7 +490,8 @@ def main(argv=None) -> int:
             r = run(args.url, args.requests, c, args.prompt_len,
                     args.max_tokens, args.vocab, args.stream,
                     args.timeout, seed=args.seed, adapters=adapters,
-                    tenants=tenants, jitter=args.jitter)
+                    tenants=tenants, jitter=args.jitter,
+                    prefix_pool=args.prefix_pool)
             curve.append(r)
         errors = sum(r["errors"] for r in curve)
         hung = sum(r["outcomes"]["hung"] for r in curve)
@@ -447,7 +514,8 @@ def main(argv=None) -> int:
     out = run(args.url, args.requests, args.concurrency,
               args.prompt_len, args.max_tokens, args.vocab,
               args.stream, args.timeout, seed=args.seed,
-              adapters=adapters, tenants=tenants, jitter=args.jitter)
+              adapters=adapters, tenants=tenants, jitter=args.jitter,
+              prefix_pool=args.prefix_pool)
     print(json.dumps(out))
     return 2 if out["outcomes"]["hung"] else (1 if out["errors"] else 0)
 

@@ -17,7 +17,11 @@ What it decides, every round:
   and the lowest virtual time goes first — a heavy tenant cannot starve
   a light one, a weighted tenant gets its share). Admission gates on
   free *KV blocks* as well as free slots (``ServingEngine.can_admit``),
-  so parked and pinned blocks push back on new work.
+  so parked and pinned blocks push back on new work — and the block
+  charge is radix-aware (``admit_block_cost``): a prompt whose prefix
+  the radix cache holds pays only its non-shared suffix, while
+  cached-but-unreferenced blocks count as free (the engine LRU-evicts
+  them deterministically inside the admission op).
 - **Decode rounds are right-sized**: bounded by the smallest remaining
   budget among live requests (a finished request's slot — and blocks —
   are reusable on the very next step) and shortened while requests
@@ -359,6 +363,10 @@ class Scheduler(threading.Thread):
 
             metrics = ServingMetrics()
         self.metrics = metrics
+        #: last-exported radix-cache counter snapshot: the engine keeps
+        #: cumulative ints, Prometheus counters take deltas
+        self._prefix_exported = {"hits": 0, "misses": 0,
+                                 "inserted": 0, "evicted": 0}
 
     @property
     def _head(self) -> Optional[Pending]:
@@ -728,7 +736,11 @@ class Scheduler(threading.Thread):
             # hand-copied condition here would drift and either shed
             # parked clients needlessly or let ensure() raise mid-round
             need += eng.kv.growth_cost(t, after)
-        if need <= eng.kv.free_blocks():
+        # evictable radix-cache blocks satisfy headroom before any
+        # parked client is shed: stale cache has the weakest claim of
+        # all (the engine reclaims it inside the decode op's
+        # _sync_tables, deterministically on every replica)
+        if need <= eng.kv.free_blocks() + eng.radix.evictable_blocks():
             return
         for rid, p in sorted(
             self._parked.items(),
@@ -866,7 +878,10 @@ class Scheduler(threading.Thread):
             return
         batch: List[Pending] = []
         slots_left = eng.free_slots()
-        blocks_left = eng.kv.free_blocks()
+        # cached-but-unreferenced radix blocks count as free: the
+        # engine reclaims them deterministically inside the admission
+        # op, so planning must not refuse work the pool can take
+        blocks_left = eng.kv.free_blocks() + eng.radix.evictable_blocks()
         rounds_needed = 0
         P = eng.prefill_len
         latency_live = any(
@@ -894,14 +909,24 @@ class Scheduler(threading.Thread):
                 self._ready.remove(p)
                 self._admit_one(p)      # its 400 path
                 continue
-            need = eng.kv.blocks_for(len(p.prompt) + 1) + (p.n - 1)
+            # THE shared admission cost model (engine.admit_block_cost):
+            # a radix hit charges only its non-shared suffix, so a
+            # burst of prompts sharing a cached prefix admits together
+            # where the full-prompt charge would refuse most of it.
+            # ONE tree walk per request per round: the match feeds the
+            # cost, the evictable-supply reserve (locking the path
+            # removes its blocks from what reclaim can free), and the
+            # chunk-budget math below
+            pref = (eng._match_prefix(p.prompt) if p.adapter == 0
+                    else None)
+            need = (eng.admit_block_cost(p.prompt, p.n, p.adapter,
+                                         match=pref)
+                    + eng.match_reserve(pref))
             if p.n > slots_left or need > blocks_left:
                 continue
             n_chunks = -(-len(p.prompt) // P)
-            if p.adapter == 0:
-                pref = eng._match_prefix(p.prompt)
-                if pref is not None:
-                    n_chunks -= len(pref.tokens) // P
+            if pref is not None:
+                n_chunks -= pref.length // P
             if (latency_live and self.prefill_chunk_budget > 0
                     and batch
                     and n_chunks > max(self.prefill_chunk_budget,
@@ -980,7 +1005,7 @@ class Scheduler(threading.Thread):
                 # re-check capacity per request: a recovery (or a
                 # transient) may have changed what fits, and a request
                 # that could simply wait a round must re-queue, not 500
-                if eng.can_admit(len(p.prompt), p.n):
+                if eng.can_admit(p.prompt, p.n, p.adapter):
                     self._admit_one(p)
                 else:
                     self._ready.append(p)
@@ -1023,7 +1048,9 @@ class Scheduler(threading.Thread):
                 self._ready.remove(p)
                 self._do_prefix_op(p)
                 continue
-            if not eng.can_admit(len(p.prompt), p.n):
+            pref = (eng._match_prefix(p.prompt) if p.adapter == 0
+                    else None)
+            if not eng.can_admit(p.prompt, p.n, p.adapter, match=pref):
                 # a request the engine would REJECT (prompt too long
                 # for the cache) must fail fast with its 400, not
                 # starve behind a block gate until the HTTP timeout
@@ -1175,8 +1202,12 @@ class Scheduler(threading.Thread):
             key=lambda p: (self._vtime.get(self._vtime_key(p), 0.0),
                            p.seq),
         )
-        need = eng.kv.blocks_for(len(waiter.prompt) + 1)
-        if eng.kv.free_blocks() >= need:
+        m = (eng._match_prefix(waiter.prompt) if waiter.adapter == 0
+             else None)
+        need = (eng.admit_block_cost(waiter.prompt, 1, waiter.adapter,
+                                     match=m)
+                + eng.match_reserve(m))
+        if eng.kv.free_blocks() + eng.radix.evictable_blocks() >= need:
             return
         for rid, p in sorted(
             self._parked.items(),
@@ -1228,9 +1259,12 @@ class Scheduler(threading.Thread):
         # preemption frees a SLOT, never blocks (the victim parks with
         # its table): when the waiter is still block-starved after
         # _relieve_block_pressure, parking someone cannot admit it
-        if eng.kv.free_blocks() < eng.kv.blocks_for(
-            len(waiter.prompt) + 1
-        ):
+        wm = (eng._match_prefix(waiter.prompt) if waiter.adapter == 0
+              else None)
+        if (eng.kv.free_blocks() + eng.radix.evictable_blocks()
+                < eng.admit_block_cost(waiter.prompt, 1,
+                                       waiter.adapter, match=wm)
+                + eng.match_reserve(wm)):
             return
         victims = [
             (slot, vp) for slot, req in eng.slots.items()
@@ -1394,6 +1428,20 @@ class Scheduler(threading.Thread):
         self.metrics.kv_blocks_free.set(kv["free"])
         self.metrics.kv_blocks_used.set(kv["used"])
         self.metrics.kv_blocks_cow.set(kv["cow"])
+        self.metrics.kv_blocks_prefix.set(kv.get("prefix_blocks", 0))
+        # radix-cache ledger: engine counters are cumulative, the
+        # Prometheus counters get the per-round delta
+        snap = {"hits": eng.prefix_hits, "misses": eng.prefix_misses,
+                "inserted": eng.prefix_inserted,
+                "evicted": eng.prefix_evicted}
+        for key, metric in (("hits", self.metrics.prefix_hits),
+                            ("misses", self.metrics.prefix_misses),
+                            ("inserted", self.metrics.prefix_inserted),
+                            ("evicted", self.metrics.prefix_evicted)):
+            delta = snap[key] - self._prefix_exported[key]
+            if delta > 0:
+                metric.inc(delta)
+        self._prefix_exported = snap
 
     def _deliver(self) -> None:
         eng = self.engine
@@ -1482,6 +1530,8 @@ class Scheduler(threading.Thread):
             "prefixes": len(eng.prefixes),
             "prefix_hits": eng.prefix_hits,
             "prefix_tokens_saved": eng.prefix_tokens_saved,
+            "radix": (eng.radix_stats()
+                      if hasattr(eng, "radix_stats") else {}),
             "mode": self.mode,
             "overlap": self.overlap,
             "engine": {

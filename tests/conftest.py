@@ -62,6 +62,7 @@ SLOW_MODULES = {
     "test_serving_chaos",  # fault-injected serving + drain under load
     "test_serving_sched",  # SLO scheduler + preempt/resume engine paths
     "test_engine_hotpath",  # batched prefill / fast-path / overlap compiles
+    "test_radix",         # radix prefix cache over the jax engine
 }
 
 

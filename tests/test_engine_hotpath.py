@@ -209,6 +209,126 @@ class TestBatchedPrefillTokenIdentity:
         assert eng.kv.used_blocks() == 0
 
 
+class TestRadixTokenIdentity:
+    """The PR 11 oracle gate: a prompt admitted through a RADIX hit
+    (organically cached by an earlier completion, no registration)
+    produces byte-identical chains — tokens, logprobs, RNG stream — to
+    a cold engine prefilling everything, on the sequential AND the
+    batched-prefill admission path."""
+
+    # wave 1 populates the tree (shared 16-token head, two depths);
+    # wave 2 shares prefixes at different depths and joins mid-tree
+    HEAD = list(range(1, 17))
+    WAVE1 = [HEAD + [40, 41, 42], HEAD + list(range(17, 25)) + [50]]
+    WAVE2 = [HEAD + [33, 34], HEAD + list(range(17, 25)) + [60, 61],
+             [9, 8, 7, 6]]
+
+    def _run(self, m, params, radix, batched, temperature=0.0):
+        eng = ServingEngine(m, params, max_batch=8, max_len=64,
+                            prefill_len=8, kv_block_size=8, seed=5,
+                            temperature=temperature,
+                            radix_cache=radix,
+                            batched_prefill=batched)
+
+        def admit(prompts):
+            reqs = [AdmissionRequest(p) for p in prompts]
+            if batched:
+                eng.add_requests(reqs)
+            else:
+                for r in reqs:
+                    eng.add_request_n(r.prompt, r.n)
+
+        admit(self.WAVE1)
+        eng.decode_block(4)
+        for slot in list(eng.slots):
+            eng.finish_slot(slot)          # completions feed the tree
+        admit(self.WAVE2)
+        eng.decode_block(4)
+        chains = _snapshot(eng)
+        finished = [(f.request_id, f.tokens, f.logprobs)
+                    for f in eng.finished]
+        return chains, finished, eng
+
+    @pytest.mark.parametrize("batched", [False, True])
+    def test_radix_hits_byte_equal_to_cold(self, model, batched):
+        m, params = model
+        cold, cold_fin, ec = self._run(m, params, radix=False,
+                                       batched=batched)
+        hot, hot_fin, eh = self._run(m, params, radix=True,
+                                     batched=batched)
+        assert hot == cold
+        assert hot_fin == cold_fin
+        assert ec.prefix_hits == 0
+        # wave 2's two HEAD-sharers hit the organically-learned tree
+        assert eh.prefix_hits == 2
+        assert eh.prefix_inserted >= 1
+        assert eh.prefix_tokens_saved > 0
+
+    @pytest.mark.parametrize("batched", [False, True])
+    def test_sampled_radix_hits_keep_the_rng_stream(self, model,
+                                                    batched):
+        """temperature > 0: a radix hit must not shift the RNG stream —
+        the sampled chains stay byte-equal to the cold engine's."""
+        m, params = model
+        cold, cold_fin, _ = self._run(m, params, radix=False,
+                                      batched=batched, temperature=0.8)
+        hot, hot_fin, eh = self._run(m, params, radix=True,
+                                     batched=batched, temperature=0.8)
+        assert hot == cold
+        assert hot_fin == cold_fin
+        assert eh.prefix_hits == 2
+
+    def test_burst_joins_mid_tree_at_distinct_depths(self, model):
+        """One burst whose requests match cached prefixes at DIFFERENT
+        depths (8 and 24 tokens) plus a cold row: each joins the chunk
+        rounds at its own boundary, chains oracle-exact."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=8, max_len=64,
+                            prefill_len=8, kv_block_size=8,
+                            radix_cache=True, batched_prefill=True)
+        seeds = [[5, 9, 2, 7] + [11] * 5,
+                 list(range(1, 25)) + [40]]
+        eng.add_requests([AdmissionRequest(p) for p in seeds])
+        for slot in list(eng.slots):
+            eng.finish_slot(slot)
+        burst = [[5, 9, 2, 7] + [11] * 4 + [12, 13],  # 8-token hit
+                 list(range(1, 25)) + [50, 51],    # 24-token hit
+                 [60, 61, 62]]                     # cold
+        rid_lists = eng.add_requests([AdmissionRequest(p)
+                                      for p in burst])
+        assert eng.prefix_hits == 2
+        eng.decode_block(4)
+        for p, (rid,) in zip(burst, rid_lists):
+            req = next(r for r in eng.slots.values()
+                       if r.request_id == rid)
+            assert req.generated == greedy_reference(m, params, p, 5)
+
+    def test_decoded_insertion_serves_multi_turn(self, model):
+        """radix_decoded: turn 2's prompt = turn 1's prompt + its
+        completion + new text — the whole history is a cache hit and
+        the chain stays oracle-exact."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, kv_block_size=8,
+                            radix_cache=True, radix_decoded=True)
+        turn1 = list(range(1, 13))                 # 12 tokens
+        rid = eng.add_request(turn1)
+        eng.decode_block(8)
+        req = next(r for r in eng.slots.values() if r.request_id == rid)
+        answer = list(req.generated)
+        slot = next(s for s, r in eng.slots.items()
+                    if r.request_id == rid)
+        eng.finish_slot(slot)
+        # 12 + 9 generated - 1 pending = 20 resident → 16 cached
+        turn2 = turn1 + answer + [30, 31]
+        eng.add_request(turn2)
+        assert eng.prefix_hits == 1
+        assert eng.prefix_tokens_saved >= 16
+        eng.decode_block(4)
+        req2 = next(iter(eng.slots.values()))
+        assert req2.generated == greedy_reference(m, params, turn2, 5)
+
+
 class TestSingleAdapterFastPath:
     def _engine(self, m, params, cfg, fast):
         return ServingEngine(

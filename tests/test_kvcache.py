@@ -11,6 +11,8 @@ import pytest
 from instaslice_tpu.serving.kvcache import (
     BlockPoolExhausted,
     KVBlockPool,
+    RadixIndex,
+    radix_granule,
 )
 from instaslice_tpu.serving.scheduler import (
     CLASS_RANK,
@@ -171,6 +173,166 @@ class TestBlockPool:
         assert pool.cow_copies == before + 1
         pool.release(t)
         pool.release(child)
+
+
+class TestRadixIndex:
+    """The radix prefix cache's pure accounting half: granule-keyed
+    trie, disjoint segment tables in the pool, exact evictable math,
+    leaf-first LRU, lock/registered pinning. Device stripes are the
+    engine's business (tests/test_radix.py)."""
+
+    def _index(self, blocks=32, bs=8, granule=8):
+        pool = KVBlockPool(blocks, bs)
+        return pool, RadixIndex(pool, granule)
+
+    def _insert(self, r, tokens, matched_hint=None):
+        """Insert tokens (granule-floored) the way the engine does."""
+        granules = r.granules_of(tokens, len(tokens))
+        parent, matched = r.ensure_path(granules)
+        if matched == len(granules):
+            return parent
+        return r.add_child(parent, granules[matched:])
+
+    def test_granule_is_the_prefill_chunk(self):
+        # block alignment is NOT required (full-prefix node tables
+        # fork position-exactly; a mid-block match just boundary-COWs)
+        assert radix_granule(8, 16) == 8
+        assert radix_granule(16, 8) == 16
+        assert radix_granule(128, 16) == 128
+
+    def test_match_is_granule_exact_and_pure(self):
+        pool, r = self._index()
+        self._insert(r, list(range(24)))
+        clock0 = r.clock
+        m = r.match(list(range(30)), 24)
+        assert m.length == 24 and len(m.path) == 1
+        # partial granule never matches; divergent granule never matches
+        assert r.match(list(range(20)), 16).length == 16
+        div = list(range(8)) + [99] * 8
+        assert r.match(div, 16).length == 8
+        # match() is PURE (scheduler planning must not tick the LRU
+        # clock, or op-stream followers diverge)
+        assert r.clock == clock0
+
+    def test_split_on_divergence_shares_the_head(self):
+        pool, r = self._index()
+        a = self._insert(r, list(range(24)))          # 3 granules
+        used0 = pool.used_blocks()
+        b = self._insert(r, list(range(16)) + [7] * 8)
+        # head (2 granules) stored ONCE: the second insert only paid
+        # its divergent tail granule
+        assert pool.used_blocks() == used0 + 1
+        assert r.node_count() == 3                    # upper + 2 tails
+        assert a.start == 16 and b.start == 16        # both are tails
+        m = r.match(list(range(16)) + [7] * 8 + [1], 24)
+        assert m.length == 24
+
+    def test_split_preserves_locks_and_stripes(self):
+        pool, r = self._index()
+        node = self._insert(r, list(range(24)))
+        node.stripes = ["s0", "s1", "s2"]
+        r.lock(node)
+        self._insert(r, list(range(8)) + [5] * 8)     # splits at 1
+        upper = node.parent
+        assert upper.stripes == ["s0"] and node.stripes == ["s1", "s2"]
+        assert upper.locks == 1 and node.locks == 1
+        # unlock through the original node walks the new ancestor too
+        r.unlock(node)
+        assert upper.locks == 0 and node.locks == 0
+
+    def test_evictable_exact_and_lock_aware(self):
+        pool, r = self._index()
+        node = self._insert(r, list(range(24)))       # 3 blocks
+        self._insert(r, list(range(16)) + [7] * 8)    # +1 block
+        assert r.pool_blocks() == 4
+        assert r.evictable_blocks() == 4
+        r.lock(node)
+        # node's path (upper + node) is pinned; the sibling tail is not
+        assert r.evictable_blocks() == 1
+        free0 = pool.free_blocks()
+        assert r.reclaim(10) == 1                     # only the sibling
+        assert pool.free_blocks() == free0 + 1
+        r.unlock(node)
+        assert r.reclaim(10) == 3                     # leaf then parent
+        assert r.node_count() == 0
+        assert pool.used_blocks() == 0
+
+    def test_lru_leaf_first_deterministic(self):
+        pool, r = self._index()
+        a = self._insert(r, [1] * 8)
+        b = self._insert(r, [2] * 8)
+        r.touch(a)                                    # b is now LRU
+        assert r._lru_evictable_leaf() is b
+        r.touch(b)
+        assert r._lru_evictable_leaf() is a
+        # an interior node only evicts after its children: deep chain
+        tail = r.add_child(a, [(9,) * 8])
+        r.touch(a)                                    # a older than... tick
+        got = []
+        while True:
+            leaf = r._lru_evictable_leaf()
+            if leaf is None:
+                break
+            got.append(leaf)
+            r.evict(leaf)
+        assert got[0] is b or got[0] is tail          # never `a` first
+        assert a in got and got.index(a) > got.index(tail)
+
+    def test_registered_pinned_outside_pool_and_exempt(self):
+        pool, r = self._index()
+        node = r.add_child(r.root, r.granules_of([3] * 16, 16),
+                           pinned=True)
+        node.registered = True
+        assert pool.used_blocks() == 0                # pinned: no pool
+        assert pool.pinned_blocks() == 2
+        assert r.pool_blocks() == 0
+        assert r.evictable_blocks() == 0
+        assert r.reclaim(10) == 0                     # exempt
+        # organic child under a registered parent IS evictable
+        child = r.add_child(node, [(4,) * 8])
+        assert r.evictable_blocks() == 1
+        assert r.reclaim(10) == 1
+        assert child.parent is None                   # gone
+        # un-register → the pinned segment evicts (frees pinned refs)
+        node.registered = False
+        r.evict(node)
+        assert pool.pinned_blocks() == 0
+
+    def test_hit_forks_the_deepest_table_at_zero_cost(self):
+        pool, r = self._index(bs=8, granule=8)
+        upper = self._insert(r, list(range(16)))
+        tail = r.add_child(upper, r.granules_of([9] * 8, 8))
+        used0 = pool.used_blocks()
+        # a hit forks the deepest matched node's FULL-PREFIX table
+        t = pool.fork(tail.table, 24)
+        assert pool.used_blocks() == used0            # zero pool cost
+        assert len(t.blocks) == 3 and t.tokens == 24
+        # growth past a block-aligned share appends, never COWs
+        before = pool.cow_copies
+        pool.ensure(t, 25)
+        assert pool.cow_copies == before
+        assert pool.used_blocks() == used0 + 1
+        pool.release(t)
+        assert pool.used_blocks() == used0
+
+    def test_sub_block_granule_boundary_cows(self):
+        """granule 8 under block size 16: a one-granule match ends
+        mid-block, so the hit's growth copies the boundary — the cost
+        the engine's admit model charges for exactly this case."""
+        pool, r = self._index(blocks=32, bs=16, granule=8)
+        node = self._insert(r, list(range(8)))        # 1 block, half
+        t = pool.fork(node.table, 8)
+        before = pool.cow_copies
+        pool.ensure(t, 9)                             # into the share
+        assert pool.cow_copies == before + 1
+        pool.release(t)
+        assert r.evictable_blocks() == 1
+        assert r.reclaim(10) == 1
+
+    def test_bad_granule_rejected(self):
+        pool = KVBlockPool(8, 16)
+        with pytest.raises(ValueError, match="granule"):
+            RadixIndex(pool, 0)
 
 
 class TestTenantSpecs:

@@ -1,0 +1,436 @@
+"""Radix prefix cache over the serving engine (PR 11) (docs/SERVING.md "Radix
+prefix cache"): admission math charges only the non-shared suffix, an
+HTTP-valid request always fits an empty pool (cached blocks are
+reclaimable, never capacity), parked requests pin their tree path,
+register_prefix survives as a pinned pre-insert wrapper, op-stream
+followers converge on identical tree state, and the observability
+surface (/v1/stats radix block, tpuslice_serve_prefix_* metrics,
+loadgen --prefix-pool) reports it all. Token identity of radix hits is
+pinned in tests/test_engine_hotpath.py::TestRadixTokenIdentity; the
+pure tree accounting in tests/test_kvcache.py::TestRadixIndex."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.metrics.metrics import ServingMetrics, render
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.serving import AdmissionRequest, ServingEngine
+from instaslice_tpu.serving.api_server import ApiServer
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq_len=64, dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+def greedy_reference(model, params, prompt, n_new):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = model.apply(params, jnp.asarray(toks, jnp.int32)[None])
+        t = int(jnp.argmax(logits[0, -1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def _engine(model, **kw):
+    m, params = model
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prefill_len", 8)
+    kw.setdefault("kv_block_size", 8)
+    return ServingEngine(m, params, **kw)
+
+
+def _complete(eng, prompt, steps=4):
+    """Admit, decode, finish — the completion that feeds the tree."""
+    rid = eng.add_request(prompt)
+    eng.decode_block(steps)
+    for slot, req in list(eng.slots.items()):
+        if req.request_id == rid:
+            eng.finish_slot(slot)
+    return rid
+
+
+class TestAdmissionMath:
+    HEAD = list(range(1, 17))                    # two granules
+
+    def test_cost_charges_only_the_non_shared_suffix(self, model):
+        """The satellite-4 regression gate (the PR 9 over-charge class
+        of bug): with the head cached, admission charges blocks for
+        the suffix alone — and the scheduler-facing cost model agrees
+        with what _alloc_tables actually pulls from the pool."""
+        eng = _engine(model)
+        _complete(eng, self.HEAD + [40, 41])
+        prompt = self.HEAD + [50, 51, 52]
+        cold = eng.kv.blocks_for(len(prompt) + 1)
+        assert cold == 3
+        assert eng.admit_block_cost(prompt, 1) == 1   # suffix only
+        free0 = eng.kv.free_blocks()
+        eng.add_request(prompt)
+        assert free0 - eng.kv.free_blocks() == 1      # the model held
+        # adapterless fork math unchanged: +1 boundary block per fork
+        assert eng.admit_block_cost(prompt, 3) == 3
+
+    def test_adapter_requests_pay_full_price(self, model):
+        m, params = model
+        from instaslice_tpu.models.lora import LoraConfig, init_lora
+
+        ad = init_lora(jax.random.key(1), m.cfg, LoraConfig(rank=4))
+        eng = _engine(model, lora_adapters=[ad])
+        _complete(eng, self.HEAD + [40])
+        prompt = self.HEAD + [50]
+        assert eng.admit_block_cost(prompt, 1, adapter=1) == \
+            eng.kv.blocks_for(len(prompt) + 1)
+
+    def test_http_valid_request_always_fits_an_empty_pool(self, model):
+        """Fill the pool with cached (unreferenced) tree state, then
+        admit a maximum-length prompt: can_admit says yes and the
+        admission op reclaims deterministically instead of failing."""
+        eng = _engine(model, max_batch=2)
+        # churn distinct prompts until the tree owns most of the pool
+        # (each 20-token completion caches 2 granule blocks)
+        for i in range(6):
+            _complete(eng, [i + 1] * 20, steps=2)
+        assert eng.radix.pool_blocks() >= 10
+        assert not eng.slots and not eng.parked
+        big = [63] * (eng.max_len - 1)                # HTTP-valid max
+        assert eng.can_admit(big, 1)
+        evicted0 = eng.prefix_evicted
+        rid = eng.add_request(big)                    # must not raise
+        # a max_len-1 prompt finishes ON admission (cache edge) — the
+        # admission itself is what must have succeeded
+        assert rid in {r.request_id for r in eng.finished} | \
+            {r.request_id for r in eng.slots.values()}
+        # the admission reclaimed cached blocks to make room
+        assert eng.prefix_evicted > evicted0
+
+    def test_can_admit_charges_the_matched_paths_own_supply(self,
+                                                            model):
+        """Locking the matched path removes ITS blocks from the
+        evictable supply — can_admit must charge that reserve, or a
+        prompt whose own cached prefix is most of what reclaim could
+        free passes the check and then hard-fails allocation (the
+        review-pass double-count bug). And the contract stands: a True
+        can_admit always admits."""
+        eng = _engine(model, max_batch=2, radix_decoded=False)
+        _complete(eng, [1] * 48, steps=2)             # 6-block path
+        assert eng.radix.pool_blocks() == 6
+        rid = eng.add_request([5] * 61)               # 8 blocks
+        slot = next(s for s, r in eng.slots.items()
+                    if r.request_id == rid)
+        eng.preempt_slot(slot)                        # parked: 8 held
+        assert eng.kv.free_blocks() == 2
+        prompt = [1] * 48 + [3] * 13                  # matches 48
+        # n=2 needs 3 fresh blocks; only 2 exist once the path locks
+        # (its 6 evictable blocks are the match itself) — must refuse
+        assert not eng.can_admit(prompt, 2)
+        # n=1 needs 2: genuinely fits, and admission must succeed
+        assert eng.can_admit(prompt, 1)
+        eng.add_request(prompt)                       # must not raise
+        assert len(eng.slots) == 1
+
+    def test_burst_reclaim_never_evicts_a_coadmitted_match(self,
+                                                           model):
+        """Review-pass repro: in one burst, request 1's reclaim (under
+        block pressure) must not LRU-evict the node request 2 matched
+        — every path is locked BEFORE any allocation, so request 2
+        forks live blocks and its hit stays oracle-exact instead of
+        serving a dead node's KV."""
+        eng = _engine(model, max_batch=6, radix_decoded=False)
+        # [1]*24 is the LRU path; 9 more churns crowd the pool
+        for i in range(10):
+            _complete(eng, [i + 1] * 24, steps=2)
+        for f in (41, 42, 43):                        # live fillers
+            eng.add_request([f] * 30)
+        assert eng.kv.free_blocks() < 7               # r1 must reclaim
+        r2_prompt = [1] * 24 + [3] * 8
+        oracle = greedy_reference(*model, r2_prompt, 4)
+        rid_lists = eng.add_requests([
+            AdmissionRequest([50] * 55),              # no match: 7 blk
+            AdmissionRequest(r2_prompt),              # matches [1]*24
+        ])
+        assert eng.prefix_hits == 1
+        # the matched path survived the co-admitted reclaim
+        assert eng.radix.match([1] * 24, 24).length == 24
+        eng.decode_block(3)
+        (rid2,) = rid_lists[1]
+        req = next(r for r in eng.slots.values()
+                   if r.request_id == rid2)
+        assert req.generated == oracle
+
+    def test_utilization_counts_shared_positions_once(self, model):
+        """A hit's prefix positions live in blocks charged once — the
+        gauge must not add them for the live table AND the tree (the
+        old double count saturated at 1.0 for any prefix traffic)."""
+        eng = _engine(model, radix_decoded=False)
+        _complete(eng, [1] * 24, steps=2)             # tree: 24 tok/3 blk
+        eng.add_request([1] * 24 + [3] * 8)           # hit: +2 blocks
+        # resident = 33 live (24 shared counted once in the tree's 24)
+        # over 5 blocks * 8 = 40 capacity
+        assert eng.kv_utilization() == pytest.approx(33 / 40)
+
+    def test_decode_growth_reclaims_cache_not_parked(self, model):
+        """_sync_tables growth yields cached blocks before ensure()
+        could ever see exhaustion."""
+        eng = _engine(model, max_batch=2)
+        for i in range(6):
+            _complete(eng, [i + 1] * 12, steps=2)
+        eng.add_request([50] * 30)
+        eng.add_request([51] * 30)
+        evicted0 = eng.prefix_evicted
+        for _ in range(6):
+            eng.decode_block(4)                       # grows past free
+        assert eng.prefix_evicted >= evicted0         # never raised
+        assert len(eng.slots) <= 2
+
+
+class TestParkedPinsTree:
+    def test_parked_table_locks_its_path(self, model):
+        eng = _engine(model)
+        head = list(range(1, 17))
+        _complete(eng, head + [40, 41])
+        rid = eng.add_request(head + [50, 51])        # radix hit
+        assert eng.prefix_hits == 1
+        slot = next(s for s, r in eng.slots.items()
+                    if r.request_id == rid)
+        eng.preempt_slot(slot)
+        # the parked table's matched path is locked: a full reclaim
+        # cannot evict the head it references
+        blocks0 = eng.radix.pool_blocks()
+        eng.radix.reclaim(10 ** 6)
+        assert eng.radix.pool_blocks() > 0
+        assert eng.radix.pool_blocks() <= blocks0
+        # dropping the parked request unlocks; the path evicts
+        eng.drop_parked(rid)
+        assert not eng._radix_locks
+        eng.radix.reclaim(10 ** 6)
+        assert eng.radix.pool_blocks() == 0
+        assert eng.kv.used_blocks() == 0
+
+    def test_resume_after_park_keeps_lock_balanced(self, model):
+        eng = _engine(model)
+        head = list(range(1, 17))
+        _complete(eng, head + [40, 41])
+        rid = eng.add_request(head + [50, 51])
+        slot = next(s for s, r in eng.slots.items()
+                    if r.request_id == rid)
+        eng.preempt_slot(slot)
+        eng.resume_request(rid)
+        eng.decode_block(2)
+        s2 = next(s for s, r in eng.slots.items()
+                  if r.request_id == rid)
+        eng.finish_slot(s2)
+        assert not eng._radix_locks
+        deepest = eng.radix.match(head + [50, 51], 16)
+        assert all(n.locks == 0 for n in deepest.path)
+
+
+class TestRegisteredWrapper:
+    PREFIX = list(range(1, 17))
+
+    def test_registered_is_pinned_and_reclaim_exempt(self, model):
+        eng = _engine(model)
+        pinned0 = eng.kv.pinned_blocks()
+        eng.register_prefix(self.PREFIX)
+        assert eng.kv.pinned_blocks() == pinned0 + 2  # outside pool
+        assert eng.kv.used_blocks() == 0
+        assert eng.radix.reclaim(10 ** 6) == 0        # exempt
+        eng.add_request(self.PREFIX + [40])
+        assert eng.prefix_hits == 1
+        assert eng.prefix_tokens_saved == len(self.PREFIX)
+
+    def test_register_adopts_an_organic_path_without_prefill(self,
+                                                             model):
+        """When the organic cache already learned the prefix,
+        registration pins it in place — no slot, no prefill, and the
+        path's pool blocks MOVE outside the allocatable pool (an
+        eviction-exempt path counted as allocatable capacity would
+        silently break the 'registration never shrinks capacity'
+        contract)."""
+        eng = _engine(model)
+        _complete(eng, self.PREFIX + [40, 41])
+        used0 = eng.kv.used_blocks()
+        # occupy EVERY slot: registration would raise if it needed one
+        for i in range(eng.max_batch):
+            eng.add_request([i + 30] * 4)
+        live = eng.kv.used_blocks() - used0
+        eng.register_prefix(self.PREFIX)
+        # the 2 path blocks left the pool ledger for the pinned one
+        assert eng.kv.pinned_blocks() == 2
+        assert eng.kv.used_blocks() == used0 + live - 2
+        assert eng.radix.pool_blocks() == used0 - 2   # rest stays pool
+        assert tuple(self.PREFIX) in eng.prefixes
+        assert eng.radix.reclaim(10 ** 6) == 0        # now exempt
+
+    def test_drop_prefix_evicts_and_misses(self, model):
+        eng = _engine(model)
+        eng.register_prefix(self.PREFIX)
+        assert eng.drop_prefix(self.PREFIX)
+        assert not eng.drop_prefix(self.PREFIX)
+        assert eng.kv.pinned_blocks() == 0
+        eng.add_request(self.PREFIX + [7])
+        assert eng.prefix_hits == 0
+
+    def test_radix_off_keeps_exact_match_semantics(self, model):
+        """--no-radix-cache: completions teach nothing, registered
+        prefixes still hit — the PR 9 behavior for one release."""
+        eng = _engine(model, radix_cache=False)
+        _complete(eng, self.PREFIX + [40, 41])
+        assert eng.prefix_inserted == 0
+        assert eng.radix.node_count() == 0
+        eng.add_request(self.PREFIX + [50])
+        assert eng.prefix_hits == 0                   # organic: no
+        eng.register_prefix(self.PREFIX)
+        eng.add_request(self.PREFIX + [51])
+        assert eng.prefix_hits == 1                   # registered: yes
+
+
+class TestFollowerConvergence:
+    def test_tree_state_converges_over_the_op_stream(self, model):
+        """No radix ops exist on the wire: insertions ride the decode/
+        finish ops, hits ride admissions, evictions ride whichever op
+        needed blocks — replay must land both replicas on the identical
+        tree (structure, blocks, ledger)."""
+        from conftest import free_port
+        from instaslice_tpu.serving.distributed import (
+            DistributedEngine,
+            run_follower,
+        )
+
+        def mk():
+            return _engine(model, max_batch=4)
+
+        driver_eng, follower_eng = mk(), mk()
+        port = free_port()
+        t = threading.Thread(
+            target=run_follower,
+            args=(follower_eng, "127.0.0.1", port), daemon=True,
+        )
+        t.start()
+        deng = DistributedEngine(driver_eng, n_followers=1, port=port)
+        head = list(range(1, 17))
+        deng.add_requests([AdmissionRequest(head + [40, 41]),
+                           AdmissionRequest([9, 8, 7])])
+        deng.decode_block(4)
+        for slot in list(driver_eng.slots):
+            deng.finish_slot(slot)                    # inserts on both
+        deng.add_request(head + [50, 51])             # hit on both
+        deng.decode_block(2)
+        deng.register_prefix([21] * 8)
+        deng.shutdown()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        ds, fs = driver_eng.radix_stats(), follower_eng.radix_stats()
+        assert ds == fs
+        assert ds["hits"] == 1 and ds["inserted"] >= 1
+        assert (driver_eng.kv.used_blocks()
+                == follower_eng.kv.used_blocks())
+
+        def shape(idx):
+            out = []
+            for n in sorted(idx._walk(), key=lambda n: (n.start,
+                                                        n.granules[0])):
+                out.append((n.start, n.end, tuple(n.granules),
+                            n.locks, n.registered, n.last_used))
+            return out
+
+        assert shape(driver_eng.radix) == shape(follower_eng.radix)
+
+
+class TestObservability:
+    def test_stats_and_metrics_surface(self, model):
+        eng = _engine(model)
+        metrics = ServingMetrics()
+        with ApiServer(eng, block_size=4, metrics=metrics) as srv:
+            head = list(range(1, 17))
+            for tail in ([40, 41], [50, 51]):
+                body = json.dumps({"prompt": head + tail,
+                                   "max_tokens": 4}).encode()
+                req = urllib.request.Request(
+                    f"{srv.url}/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    assert r.status == 200
+            with urllib.request.urlopen(f"{srv.url}/v1/stats",
+                                        timeout=10) as r:
+                stats = json.loads(r.read())
+        radix = stats["radix"]
+        assert radix["enabled"] is True
+        assert radix["hits"] == 1                     # second request
+        assert radix["inserted"] >= 1
+        assert radix["tokens_saved"] >= 16
+        assert stats["kv"]["prefix_blocks"] == radix["blocks"] > 0
+        body = render(metrics)
+        if body:
+            for name in ("tpuslice_serve_prefix_hits_total",
+                         "tpuslice_serve_prefix_misses_total",
+                         "tpuslice_serve_prefix_inserted_total",
+                         "tpuslice_serve_prefix_evicted_total",
+                         "tpuslice_kv_blocks_prefix"):
+                assert name in body
+
+    def test_headroom_guard_counts_evictable(self, model):
+        """_ensure_block_headroom must not shed parked clients while
+        the radix cache holds reclaimable blocks."""
+        from instaslice_tpu.serving.scheduler import Pending, Scheduler
+
+        eng = _engine(model, max_batch=2)
+        for i in range(6):
+            _complete(eng, [i + 1] * 12, steps=2)
+        sched = Scheduler(eng, block_size=4)
+        rid = eng.add_request([50] * 20)
+        slot = next(iter(eng.slots))
+        eng.preempt_slot(slot)
+        parked = Pending([50] * 20, 8)
+        sched._parked[rid] = parked
+        sched._by_rid[rid] = parked
+        eng.add_request([51] * 20)
+        sched._ensure_block_headroom(8)
+        assert sched.parked_shed == 0                 # cache yields 1st
+
+    def test_loadgen_prefix_pool_report(self, model):
+        from instaslice_tpu.serving.loadgen import (
+            parse_prefix_pool,
+            run as loadgen_run,
+        )
+
+        assert parse_prefix_pool("4:64") == (4, 64)
+        with pytest.raises(ValueError, match="N:L"):
+            parse_prefix_pool("4x64")
+        with pytest.raises(ValueError, match=">= 1"):
+            parse_prefix_pool("0:64")
+        eng = _engine(model, max_len=64)
+        with ApiServer(eng, block_size=4) as srv:
+            report = loadgen_run(
+                srv.url, requests=8, concurrency=2, prompt_len=4,
+                max_tokens=4, vocab=64, stream=False, timeout=60,
+                seed=3, prefix_pool="2:16",
+            )
+        pool = report["prefix_pool"]
+        assert pool["n"] == 2 and pool["len"] == 16
+        # 8 draws from 2 prefixes: at least 6 re-draws of a seen one
+        assert pool["reused"] >= 6
+        assert pool["reused_fraction"] == round(pool["reused"] / 8, 4)
+        assert report["ok"] == 8
+        assert eng.prefix_hits > 0                    # server-side too
+
+    def test_loadgen_cli_flag(self, model, capsys):
+        from instaslice_tpu.serving.loadgen import main as lg_main
+
+        assert lg_main(["--url", "http://x", "--prefix-pool",
+                        "nope"]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert "bad --prefix-pool" in out["error"]

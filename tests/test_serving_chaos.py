@@ -635,13 +635,21 @@ class TestPreemptLedgerChaos:
                 stats["resumed"] + stats["parked_shed"]
                 + stats["parked"]
             )
-            # the kv block pool is fully reconciled after the run: no
-            # leaked blocks once everything terminal
+            # the kv block pool is fully reconciled after the run:
+            # once everything is terminal, every used block belongs
+            # to the radix prefix cache (completions legitimately
+            # cache their KV — PR 11) and no request pins a tree path
             deadline = time.monotonic() + 10
             while time.monotonic() < deadline and (
                 eng.slots or eng.parked
             ):
                 time.sleep(0.05)
+            assert eng.kv.used_blocks() == eng.radix.pool_blocks(), \
+                eng.kv.stats()
+            assert not eng._radix_locks
+            # and the cache is fully reclaimable — dropping it leaves
+            # a truly empty pool (the pre-radix invariant, restorable)
+            eng.radix.reclaim(10 ** 9)
             assert eng.kv.used_blocks() == 0, eng.kv.stats()
 
             # recovery: faults off, the same server serves 200s

@@ -152,11 +152,11 @@ class TestPreemptResumeEngine:
         calls = {"n": 0}
         real = eng._write_stripe
 
-        def flaky(cache, stripe, slot):
+        def flaky(cache, stripe, slot, start):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise RuntimeError("RESOURCE_EXHAUSTED: injected")
-            return real(cache, stripe, slot)
+            return real(cache, stripe, slot, start)
 
         eng._write_stripe = flaky
         with pytest.raises(RuntimeError, match="injected"):
