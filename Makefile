@@ -40,7 +40,7 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke floors
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
@@ -49,6 +49,7 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 	$(MAKE) bench-serving-smoke
 	$(MAKE) bench-engine-smoke
 	$(MAKE) bench-prefix-smoke
+	$(MAKE) bench-spec-smoke
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
@@ -85,6 +86,14 @@ bench-prefix-smoke:  ## <60 s shared-prefix run of both arms: asserts radix tok/
 .PHONY: bench-prefix
 bench-prefix:  ## Full radix prefix-cache tier: radix arm vs exact-match-only baseline on the seeded shared-prefix workload, best-of-3 per arm (tok/s AND TTFT p95 must both win) — records BENCH_PREFIX_r11.json (docs/SERVING.md)
 	JAX_PLATFORMS=cpu $(PY) bench.py --prefix
+
+.PHONY: bench-spec-smoke
+bench-spec-smoke:  ## <60 s speculative-decoding run of both arms at temperature>0: asserts spec tok/s >= TPUSLICE_SPEC_FLOOR (0.9, a regression floor — the recorded bench-spec tier gates the strict win) x the no-spec baseline, real draft acceptance, ledgers reconciling with zero leaked blocks/locks after quiesce, compiled programs <= budget
+	JAX_PLATFORMS=cpu $(PY) bench.py --spec-smoke
+
+.PHONY: bench-spec
+bench-spec:  ## Full speculative-decoding tier: spec arm (rejection sampling + adaptive k + overlapped rounds) vs the no-spec baseline at temperature 0 AND >0, best-of-4 interleaved (tok/s AND TTFT p95 must both win at both temperatures) — records BENCH_SPEC_r12.json (docs/SERVING.md)
+	JAX_PLATFORMS=cpu $(PY) bench.py --spec
 
 .PHONY: bench-scale
 bench-scale:  ## Fleet-scale control-plane bench: 1k nodes / 2k pending pods, grants/sec + gate→ungate p95/p99, with the serial re-list baseline ratio (docs/SCALING.md)

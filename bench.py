@@ -568,6 +568,7 @@ def bench_serving(
     prefill_len: int = 8,
     engine_opts: dict = None,
     overlap: bool = None,
+    engine_factory=None,
 ) -> dict:
     """One serving-scheduler arm (docs/SERVING.md "Continuous batching
     & tenant SLOs"): a CPU-sized engine behind the real ApiServer, a
@@ -600,19 +601,28 @@ def bench_serving(
     # dispatch — the regime real serving lives in (decode is HBM/FLOP
     # bound at batch); a micro-model would make wasted slot-steps look
     # free and reward exactly the wrong scheduler
-    cfg = ModelConfig(
-        vocab_size=128, d_model=d_model, n_heads=4, n_layers=4,
-        d_ff=4 * d_model, dtype=jnp.float32, remat=False,
-    )
-    model = TpuLM(cfg)
-    params = model.init(jax.random.key(0))
-    eng = ServingEngine(model, params, max_batch=max_batch,
-                        max_len=128, prefill_len=prefill_len,
-                        kv_block_size=16, **(engine_opts or {}))
-    # compile every prefill-batch bucket OUT of the measured window:
-    # the loadgen warm-up's burst widths are traffic-dependent, and one
-    # cold bucket compile mid-run swamps a seconds-long CPU measurement
+    if engine_factory is not None:
+        # the spec tier supplies its own draft/target pair (and
+        # temperature) — everything downstream (server, loadgen,
+        # ledgers) is shared
+        eng = engine_factory(max_batch=max_batch, max_len=128,
+                             prefill_len=prefill_len, kv_block_size=16)
+    else:
+        cfg = ModelConfig(
+            vocab_size=128, d_model=d_model, n_heads=4, n_layers=4,
+            d_ff=4 * d_model, dtype=jnp.float32, remat=False,
+        )
+        model = TpuLM(cfg)
+        params = model.init(jax.random.key(0))
+        eng = ServingEngine(model, params, max_batch=max_batch,
+                            max_len=128, prefill_len=prefill_len,
+                            kv_block_size=16, **(engine_opts or {}))
+    # compile every prefill-batch bucket (and, with a draft, the full
+    # spec draft/verify shape set) OUT of the measured window: the
+    # loadgen warm-up's burst widths are traffic-dependent, and one
+    # cold compile mid-run swamps a seconds-long CPU measurement
     eng.warm_prefill_buckets()
+    eng.warm_spec_programs()
     metrics = ServingMetrics()
     samples: list = []
     stop = threading.Event()
@@ -700,7 +710,29 @@ def bench_serving(
     kv_util = [s[0] for s in samples]
     gold = report["tenants"]["gold"]
     bronze = report["tenants"]["bronze"]
+    # compiled-program regression check rides every arm (the spec tier
+    # gates on it: adaptive k must stay inside the documented shape set)
+    budget = eng.compile_budget(block_cap=block_size)
+    compiled = eng.compiled_programs()
+    over = {k: (compiled[k], budget.get(k, 0)) for k in compiled
+            if compiled[k] > budget.get(k, 0)}
+    spec_block = {}
+    if eng.draft_model is not None:
+        w = warm_stats.get("spec", {})
+        s = stats.get("spec", {})
+        proposed = s.get("proposed", 0) - w.get("proposed", 0)
+        accepted = s.get("accepted", 0) - w.get("accepted", 0)
+        spec_block = {
+            "spec_rounds": s.get("rounds", 0) - w.get("rounds", 0),
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
+            "spec_acceptance_rate": round(accepted / proposed, 4)
+            if proposed else 0.0,
+            "spec_k": s.get("k", 0),
+        }
     return {
+        **spec_block,
+        "compiled_over_budget": over,
         "mode": mode,
         "seed": seed,
         "requests": requests,
@@ -1038,6 +1070,159 @@ def smoke_prefix(floor: float = None) -> int:
         failures.append("radix arm never hit the cache")
     for f in failures:
         print(f"bench-prefix-smoke FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+#: the spec tier's workload: the same bursty mixed-SLO tenant traffic
+#: as the engine tier, decode-heavy budgets (speculation pays on the
+#: decode stream; prefill is untouched), run at temperature 0 AND >0 —
+#: losslessness must not cost the sampled path its win
+SPEC_WORKLOAD = dict(
+    concurrency=8, prompt_len=24, max_tokens=32, jitter=0.6,
+    prefill_len=8,
+)
+
+
+def _spec_model_pair(seed: int = 12, d_model: int = 128,
+                     n_layers: int = 4, vocab: int = 128):
+    """(target model, params, draft model, draft params) for the spec
+    tier: the target's blocks past the first contribute EXACTLY zero
+    to the residual stream (their attention/FF output projections are
+    zeroed), and the draft IS the target's first block + shared
+    embed/final-norm — so the draft agrees with the target almost
+    everywhere at a quarter of the per-token cost. This reproduces the
+    deployment regime speculative decoding targets (a distilled
+    high-agreement draft) with constructed weights: the bench measures
+    the ENGINE's round mechanics at a realistic acceptance rate, and
+    the no-spec baseline serves the identical target at identical
+    cost (zeroed einsums are not cheaper)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+
+    cfg = ModelConfig(
+        vocab_size=vocab, d_model=d_model, n_heads=4,
+        n_layers=n_layers, d_ff=4 * d_model, dtype=jnp.float32,
+        remat=False,
+    )
+    model = TpuLM(cfg)
+    params = model.init(jax.random.key(seed))
+    blocks = dict(params["blocks"])
+    blocks["wo"] = blocks["wo"].at[1:].set(0.0)
+    blocks["w_out"] = blocks["w_out"].at[1:].set(0.0)
+    params = dict(params, blocks=blocks)
+    draft = TpuLM(_dc.replace(cfg, n_layers=1))
+    draft_params = {
+        "embed": params["embed"],
+        "blocks": jax.tree.map(lambda x: x[:1], blocks),
+        "ln_f": params["ln_f"],
+    }
+    return model, params, draft, draft_params
+
+
+def bench_spec(spec: bool = True, temperature: float = 0.0,
+               requests: int = 64, seed: int = 12) -> dict:
+    """One spec-tier arm (docs/SERVING.md "Speculative decoding"): the
+    bursty mixed-SLO workload over either the speculative hot path
+    (draft-propose / target-verify rounds, rejection-sampled at
+    temperature > 0, adaptive k, overlapped dispatch) or the plain
+    decode baseline serving the IDENTICAL target model. Both arms warm
+    their full compiled sets up front and must quiesce with clean
+    ledgers and the compiled-program count inside the documented
+    budget."""
+    from instaslice_tpu.serving import ServingEngine
+
+    model, params, dm, dp = _spec_model_pair()
+
+    def factory(max_batch, max_len, prefill_len, kv_block_size):
+        # max_len 512, not the serving tier's 128: decode is HBM-bound
+        # on the cache stream, and a serving-realistic cache is where
+        # that bound lives — the verify forward streams the cache ONCE
+        # per k+1 tokens while plain decode streams it every step, so
+        # a toy-short cache would understate exactly the cost
+        # speculation removes. Both arms get the identical cache.
+        return ServingEngine(
+            model, params, max_batch=max_batch, max_len=512,
+            prefill_len=prefill_len, kv_block_size=kv_block_size,
+            temperature=temperature,
+            draft_model=dm if spec else None,
+            draft_params=dp if spec else None,
+            spec_k=8,
+        )
+
+    out = bench_serving(requests=requests, seed=seed,
+                        engine_factory=factory, **SPEC_WORKLOAD)
+    out["arm"] = "spec" if spec else "no-spec"
+    out["temperature"] = temperature
+    return out
+
+
+def smoke_spec(floor: float = None) -> int:
+    """``make bench-spec-smoke``: a <60 s run of BOTH arms at
+    temperature > 0 (the rejection-sampling path — greedy is its
+    special case and the slow tier pins it bit-exactly) — asserts the
+    spec arm sustains at least ``TPUSLICE_SPEC_FLOOR`` (default 0.9, a
+    REGRESSION floor like the engine/prefix smokes — the recorded
+    ``--spec`` tier gates the strict win on both axes) times the
+    no-spec baseline's tok/s with real acceptance, zero hung requests,
+    ledgers reconciling with zero leaked blocks/locks after quiesce,
+    and the compiled-program set inside the documented budget."""
+    if floor is None:
+        floor = float(os.environ.get("TPUSLICE_SPEC_FLOOR", "0.9"))
+    # one rep of a LONGER measured window per arm, not best-of-short:
+    # each arm pays ~10 s of engine build + compile warm-up around a
+    # ~1 s measurement, so repeats blow the <60 s budget while a 32-
+    # request window already averages the OS-noise bursts a short one
+    # flips on (the recorded --spec tier keeps best-of-4)
+    reqs = int(os.environ.get("TPUSLICE_SPEC_SMOKE_REQS", "32"))
+    reps = max(1, int(os.environ.get(
+        "TPUSLICE_SPEC_SMOKE_REPEATS", "1")))
+    # throwaway process-warming run (see smoke_engine)
+    bench_spec(spec=False, temperature=0.7, requests=6)
+    bases, opts = [], []
+    for _ in range(reps):
+        bases.append(bench_spec(spec=False, temperature=0.7,
+                                requests=reqs))
+        opts.append(bench_spec(spec=True, temperature=0.7,
+                               requests=reqs))
+    base = max(bases, key=lambda r: r["client_tokens_per_sec"])
+    opt = max(opts, key=lambda r: r["client_tokens_per_sec"])
+    print(json.dumps({"spec": opt, "no_spec_baseline": base}))
+    failures = []
+    for arm in (base, opt):
+        if arm["hung"]:
+            failures.append(f"{arm['arm']}: {arm['hung']} hung")
+        if arm["errors"]:
+            failures.append(
+                f"{arm['arm']}: {arm['errors']} loadgen error(s)"
+            )
+        if not arm["ledger_ok"]:
+            failures.append(
+                f"{arm['arm']}: ledger did not reconcile"
+            )
+        if arm["compiled_over_budget"]:
+            failures.append(
+                f"{arm['arm']}: compiled programs over budget: "
+                f"{arm['compiled_over_budget']}"
+            )
+    if opt["client_tokens_per_sec"] < floor * base[
+            "client_tokens_per_sec"]:
+        failures.append(
+            f"spec arm {opt['client_tokens_per_sec']} tok/s under "
+            f"{floor}x the no-spec baseline "
+            f"{base['client_tokens_per_sec']}"
+        )
+    if opt.get("spec_rounds", 0) <= 0:
+        failures.append("spec arm never ran a speculative round "
+                        "(knob wiring broken?)")
+    if opt.get("spec_accepted", 0) <= 0:
+        failures.append("spec arm accepted zero draft tokens "
+                        "(draft/verify wiring broken?)")
+    for f in failures:
+        print(f"bench-spec-smoke FAIL: {f}", file=sys.stderr)
     return 1 if failures else 0
 
 
@@ -1602,6 +1787,26 @@ def main(argv=None) -> int:
                         "TPUSLICE_PREFIX_FLOOR", "0.9")),
                     help="prefix-smoke: radix tok/s floor as a "
                     "multiple of the exact-match baseline")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decoding tier: spec arm vs the "
+                         "no-spec baseline on the bursty mixed-SLO "
+                         "workload at temperature 0 AND >0, best-of-4 "
+                         "interleaved per arm (tok/s AND TTFT p95 must "
+                         "both win at both temperatures) — records "
+                         "BENCH_SPEC_r12.json")
+    ap.add_argument("--spec-smoke", action="store_true",
+                    help="<60 s spec regression gate for make test "
+                         "(TPUSLICE_SPEC_FLOOR x no-spec tok/s, "
+                         "ledgers, compile budget)")
+    ap.add_argument("--spec-floor", type=float,
+                    default=float(os.environ.get(
+                        "TPUSLICE_SPEC_FLOOR", "0.9")),
+                    help="spec-smoke: spec tok/s floor as a fraction "
+                         "of the no-spec baseline")
+    ap.add_argument("--spec-seed", type=int,
+                    default=int(os.environ.get(
+                        "TPUSLICE_SPEC_SEED", "12")),
+                    help="spec tier loadgen seed")
     ap.add_argument("--prefix-seed", type=int,
                     default=int(os.environ.get(
                         "TPUSLICE_PREFIX_SEED", "11")),
@@ -1648,6 +1853,67 @@ def main(argv=None) -> int:
         return smoke_engine(floor=args.engine_floor)
     if args.prefix_smoke:
         return smoke_prefix(floor=args.prefix_floor)
+    if args.spec_smoke:
+        return smoke_spec(floor=args.spec_floor)
+    if args.spec:
+        result = {
+            "metric": "spec_tokens_per_sec",
+            "unit": "tokens/s",
+        }
+        # best-of-N per arm, interleaved, at BOTH temperatures: the
+        # lossless claim is only worth shipping if the sampled path
+        # wins too, and the prefix-tier precedent (4 reps, ceilings
+        # compared) holds on the nproc=1 CI box
+        reps = max(1, int(os.environ.get(
+            "TPUSLICE_SPEC_REPEATS", "4")))
+        # throwaway process-warming run (see smoke_engine)
+        bench_spec(spec=False, temperature=0.0, requests=6,
+                   seed=args.spec_seed)
+        ok = True
+        for label, temp in (("greedy", 0.0), ("sampled", 0.7)):
+            opts, bases = [], []
+            for _ in range(reps):
+                opts.append(bench_spec(spec=True, temperature=temp,
+                                       seed=args.spec_seed))
+                bases.append(bench_spec(spec=False, temperature=temp,
+                                        seed=args.spec_seed))
+            opt = max(opts, key=lambda r: r["client_tokens_per_sec"])
+            base = max(bases, key=lambda r: r["client_tokens_per_sec"])
+            result[f"spec_{label}"] = opt
+            result[f"nospec_{label}_baseline"] = base
+            result[f"tokens_per_sec_runs_{label}"] = {
+                "spec": [r["client_tokens_per_sec"] for r in opts],
+                "no_spec": [r["client_tokens_per_sec"] for r in bases],
+            }
+            if base["client_tokens_per_sec"]:
+                result[f"vs_baseline_{label}"] = round(
+                    opt["client_tokens_per_sec"]
+                    / base["client_tokens_per_sec"], 2
+                )
+            ok = ok and (
+                opt["hung"] == 0 and base["hung"] == 0
+                and opt["errors"] == 0 and base["errors"] == 0
+                and opt["ledger_ok"] and base["ledger_ok"]
+                and not opt["compiled_over_budget"]
+                and not base["compiled_over_budget"]
+                and opt.get("spec_accepted", 0) > 0
+                # the spec arm must beat no-spec on BOTH axes at
+                # BOTH temperatures
+                and opt["client_tokens_per_sec"]
+                > base["client_tokens_per_sec"]
+                and opt["ttft_p95_s"] < base["ttft_p95_s"]
+            )
+        result["repeats"] = reps
+        # headline keys in the shared BENCH_*.json shape (the perf
+        # trajectory tracker scans recorded files for these)
+        result["value"] = result["spec_greedy"]["client_tokens_per_sec"]
+        result["serve_toks_per_sec"] = result["value"]
+        result["serve_ttft_p95"] = result["spec_greedy"]["ttft_p95_s"]
+        result["ttft_p95_baseline_s"] = (
+            result["nospec_greedy_baseline"]["ttft_p95_s"]
+        )
+        print(json.dumps(result))
+        return 0 if ok else 1
     if args.prefix:
         result = {
             "metric": "prefix_tokens_per_sec",

@@ -226,6 +226,10 @@ class ServingMetrics:
             self.preemptions = _NoopMetric()
             self.resumes = _NoopMetric()
             self.slo_missed = _NoopMetric()
+            self.spec_rounds = _NoopMetric()
+            self.spec_proposed = _NoopMetric()
+            self.spec_accepted = _NoopMetric()
+            self.spec_acceptance = _NoopMetric()
             self.registry = None
             return
         self.registry = registry or CollectorRegistry()
@@ -408,6 +412,33 @@ class ServingMetrics:
             "tpuslice_serve_slo_missed_total",
             "Completed requests that exceeded their class SLO target",
             ["tenant_class", "slo"],
+            registry=self.registry,
+        )
+        # --- speculative decoding (docs/SERVING.md "Speculative
+        # decoding") --- rounds is draft+verify dispatch chains;
+        # proposed/accepted is the draft-token ledger behind the
+        # acceptance rate the adaptive-k ladder follows (bonus tokens
+        # are not counted — they are free either way)
+        self.spec_rounds = Counter(
+            "tpuslice_serve_spec_rounds_total",
+            "Speculative rounds dispatched (draft + verify chains)",
+            registry=self.registry,
+        )
+        self.spec_proposed = Counter(
+            "tpuslice_serve_spec_proposed_total",
+            "Draft tokens proposed across speculative rounds",
+            registry=self.registry,
+        )
+        self.spec_accepted = Counter(
+            "tpuslice_serve_spec_accepted_total",
+            "Draft tokens accepted by target verification",
+            registry=self.registry,
+        )
+        self.spec_acceptance = Histogram(
+            "tpuslice_serve_spec_acceptance_rate",
+            "Per-round draft acceptance rate (accepted / proposed)",
+            buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                     1.0),
             registry=self.registry,
         )
 

@@ -750,6 +750,34 @@ def build_parser() -> argparse.ArgumentParser:
                          "(serving/kvcache.py): admission, preemption "
                          "and the kv_blocks_* gauges account in these "
                          "units")
+    ap.add_argument("--spec-k", type=int,
+                    default=_env_int("TPUSLICE_SPEC_K", 4),
+                    help="speculative decoding: max draft tokens per "
+                         "round (the adaptive-k ladder's top rung; "
+                         "needs a draft model — see --draft-n-layers). "
+                         "Lossless at any temperature: greedy stays "
+                         "bit-identical, sampling is rejection-sampled "
+                         "to the target distribution (env: "
+                         "TPUSLICE_SPEC_K)")
+    ap.add_argument("--no-spec", action="store_true",
+                    help="ignore any configured draft model and serve "
+                         "plain decode rounds (the no-spec baseline "
+                         "arm of make bench-spec)")
+    ap.add_argument("--draft-checkpoint", default="",
+                    help="orbax checkpoint dir for the speculative "
+                         "DRAFT model's params (shape set by the "
+                         "--draft-* dims); omitted with "
+                         "--draft-n-layers set = random-init draft "
+                         "(testing only — acceptance will be noise)")
+    ap.add_argument("--draft-n-layers", type=int, default=0,
+                    help="draft model depth; 0 (default) = no draft, "
+                         "speculative decoding off")
+    ap.add_argument("--draft-d-model", type=int, default=0,
+                    help="draft model width (0 = same as --d-model)")
+    ap.add_argument("--draft-n-heads", type=int, default=0,
+                    help="draft attention heads (0 = same as --n-heads)")
+    ap.add_argument("--draft-d-ff", type=int, default=0,
+                    help="draft FF width (0 = same as --d-ff)")
     ap.add_argument("--no-radix-cache", action="store_true",
                     default=not _env_flag("TPUSLICE_RADIX_CACHE"),
                     help="disable the automatic radix prefix cache "
@@ -947,6 +975,24 @@ def build_engine(args) -> ServingEngine:
 
         params = quantize_params(params, bits=args.quantize_bits or 8)
         kv_quant = True
+    draft_model = draft_params = None
+    if getattr(args, "draft_n_layers", 0) and not getattr(
+            args, "no_spec", False):
+        import dataclasses as _dc
+
+        dcfg = _dc.replace(
+            cfg,
+            n_layers=args.draft_n_layers,
+            d_model=args.draft_d_model or cfg.d_model,
+            n_heads=args.draft_n_heads or cfg.n_heads,
+            d_ff=args.draft_d_ff or cfg.d_ff,
+        )
+        draft_model = TpuLM(dcfg)
+        draft_params = (
+            _restore_params_half(args.draft_checkpoint)
+            if getattr(args, "draft_checkpoint", "")
+            else draft_model.init(jax.random.key(1))
+        )
     eng = ServingEngine(
         model, params, max_batch=args.max_batch, max_len=args.max_len,
         prefill_len=args.prefill_len, mesh=mesh, kv_quant=kv_quant,
@@ -961,13 +1007,19 @@ def build_engine(args) -> ServingEngine:
         batched_prefill=not getattr(args, "no_batched_prefill", False),
         adapter_fastpath=not getattr(args, "no_adapter_fastpath",
                                      False),
+        draft_model=draft_model,
+        draft_params=draft_params,
+        spec_k=getattr(args, "spec_k", 4),
     )
     #: single-adapter merge: remember the name so a request naming it
     #: gets a helpful error (the adapter is always on; omit the field)
     eng.merged_adapter = merged_name
-    # pay every prefill-bucket compile at startup, not under the first
-    # admission burst (docs/SERVING.md "Engine hot path")
+    # pay every prefill-bucket (and, with a draft, the full spec
+    # draft/verify shape set) compile at startup, not under the first
+    # admission burst or mid-run round (docs/SERVING.md "Engine hot
+    # path" / "Speculative decoding")
     eng.warm_prefill_buckets()
+    eng.warm_spec_programs()
     return eng
 
 

@@ -32,7 +32,8 @@ Wire format (one JSON object per line)::
      "adapter": 0}
     {"op": "add_requests", "reqs": [{"prompt": [...], "n": 1,
      "stop": [[...]], "adapter": 0}, ...]}
-    {"op": "step"} | {"op": "decode_block", "n": 8} | {"op": "spec_step"}
+    {"op": "step"} | {"op": "decode_block", "n": 8}
+    {"op": "spec_step", "k": 4}
     {"op": "register_prefix", "tokens": [...]}
     {"op": "drop_prefix", "tokens": [...]}
     {"op": "finish_slot", "slot": 0, "n_keep": 5, "reason": "..."}
@@ -226,9 +227,26 @@ class DistributedEngine:
     def decode_block_finish(self):
         return self.engine.decode_block_finish()
 
-    def spec_step(self):
-        self._bcast({"op": "spec_step"})
-        return self.engine.spec_step()
+    def spec_step(self, k=None):
+        if k is None:
+            k = self.engine.spec_plan_k()
+        self._bcast({"op": "spec_step", "k": k})
+        return self.engine.spec_step(k=k)
+
+    def spec_step_start(self, k=None):
+        """The spec overlap seam over the op stream, exactly like
+        decode_block_start: the broadcast happens at START — with the
+        driver's PLANNED k pinned into the op, so followers dispatch
+        the identical draft/verify shapes even if their adaptive-EMA
+        state ever drifted — and followers compute concurrently with
+        the driver; finish is driver-local."""
+        if k is None:
+            k = self.engine.spec_plan_k()
+        self._bcast({"op": "spec_step", "k": k})
+        return self.engine.spec_step_start(k=k)
+
+    def spec_step_finish(self):
+        return self.engine.spec_step_finish()
 
     def register_prefix(self, prefix: List[int]) -> None:
         if tuple(prefix) not in self.engine.prefixes:
@@ -350,7 +368,14 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                 elif kind == "decode_block":
                     engine.decode_block(op["n"])
                 elif kind == "spec_step":
-                    engine.spec_step()
+                    # the driver's planned k rides the op. A missing k
+                    # (a pre-r12 driver) makes the follower plan its
+                    # own — best effort only: a mixed-version mesh is
+                    # NOT a supported deployment (driver and followers
+                    # ship in one pod template and restart together),
+                    # and an old driver's un-floored k need not match
+                    # the new shape set
+                    engine.spec_step(k=op.get("k"))
                 elif kind == "register_prefix":
                     engine.register_prefix(op["tokens"])
                 elif kind == "drop_prefix":

@@ -81,6 +81,7 @@ from instaslice_tpu.serving.kvcache import (
 from instaslice_tpu.serving.sampling import (
     apply_repetition_penalty,
     filter_logits,
+    speculative_accept,
     token_logprob,
 )
 from instaslice_tpu.utils.trace import get_tracer
@@ -173,6 +174,7 @@ class ServingEngine:
         lora_names=None,
         batched_prefill: bool = True,
         adapter_fastpath: bool = True,
+        spec_adaptive: bool = True,
     ) -> None:
         """``kv_quant=True`` stores the KV cache as int8 with per-vector
         scales (``TpuLM.init_cache(quant=True)``): decode streams the
@@ -188,14 +190,20 @@ class ServingEngine:
         rank and target set (one static stack); ``lora_alphas`` gives
         each its training alpha (default 16).
 
-        ``draft_model`` (+ ``draft_params``) enables greedy speculative
-        decoding (:meth:`spec_step`): the draft proposes ``spec_k``
-        tokens per round, the target verifies them in ONE forward, and
-        the longest agreeing prefix plus the target's own next token are
-        emitted — ≥1 and up to ``spec_k + 1`` tokens per target pass,
-        token-identical to plain greedy decoding. Rollback is free: the
-        per-slot offset cache never attends past ``lengths``, and a
-        rejected position is exactly the next write position.
+        ``draft_model`` (+ ``draft_params``) enables LOSSLESS
+        speculative decoding (:meth:`spec_step`): the draft proposes up
+        to ``spec_k`` tokens per round, the target verifies them in ONE
+        forward, and the accepted prefix plus one bonus/resampled token
+        is emitted — ≥1 and up to ``spec_k + 1`` tokens per target
+        pass. Greedy engines emit the bit-identical plain greedy chain;
+        at temperature > 0 the acceptance rule is standard rejection
+        sampling, so output is distribution-identical to plain sampling
+        at any temperature. ``spec_adaptive`` (default on) picks each
+        round's k from a bounded power-of-two-style shape set by an
+        acceptance-rate EMA, degrading toward plain decode (k=0) on
+        low-acceptance traffic. Rollback is free: the per-slot offset
+        cache never attends past ``lengths``, and a rejected position
+        is exactly the next write position.
 
         ``batched_prefill`` enables :meth:`add_requests`' multi-slot
         prefill program (one ``(P, prefill_len)`` dispatch per chunk
@@ -408,12 +416,14 @@ class ServingEngine:
 
         self.draft_model = draft_model
         self.spec_k = spec_k
+        #: adaptive k: per-round proposal depth chosen from the bounded
+        #: power-of-two-style shape set below by an acceptance-rate EMA
+        #: (docs/SERVING.md "Speculative decoding"); False pins every
+        #: round at ``spec_k`` (the pre-adaptive behavior)
+        self.spec_adaptive = spec_adaptive
         if draft_model is not None:
-            if temperature > 0.0:
-                raise ValueError(
-                    "speculative decoding is greedy-only (acceptance "
-                    "compares argmax chains); temperature must be 0"
-                )
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
             self.draft_params = (
                 draft_params if draft_params is not None
                 else draft_model.init(jax.random.key(1))
@@ -426,6 +436,43 @@ class ServingEngine:
                         self.draft_cache,
                     )
                 )
+        # ---- speculative decoding (docs/SERVING.md "Speculative
+        # decoding") ----
+        #: the bounded k shape set: 0 (a plain, draft-cache-maintaining
+        #: step — the graceful-degradation floor), the powers of two
+        #: below spec_k, and spec_k itself. Every dispatched k is a
+        #: member, so the compiled draft/verify set stays
+        #: O(log spec_k) however k adapts or shrinks near the cache end
+        kset = {0}
+        if draft_model is not None:
+            b = 1
+            while b < spec_k:
+                kset.add(b)
+                b <<= 1
+            kset.add(spec_k)
+        self._spec_kset = sorted(kset)
+        #: ladder position into ``_spec_kset`` — starts optimistic (at
+        #: spec_k); the acceptance EMA walks it up/down per round
+        self._spec_idx = len(self._spec_kset) - 1
+        #: acceptance-rate EMA (accepted draft tokens / proposed);
+        #: optimistic start so the first rounds propose at full depth
+        self.spec_accept_ema = 1.0
+        #: consecutive k=0 rounds (drives the periodic k=1 probe that
+        #: lets a recovered workload climb back out of plain decode)
+        self._spec_zero_rounds = 0
+        # spec observability (drained into ServingMetrics by the
+        # scheduler; surfaced raw on /v1/stats "spec")
+        self.spec_rounds = 0
+        self.spec_proposed = 0         # draft tokens proposed (k*batch)
+        self.spec_accepted = 0         # draft tokens accepted
+        #: per-round acceptance-rate samples, drained by the scheduler
+        #: into the tpuslice_serve_spec_acceptance_rate histogram
+        self._spec_rate_samples: List[float] = []
+        #: an in-flight spec round (dispatched, outputs not yet read
+        #: back) — the host/device overlap seam for spec rounds
+        #: (spec_step_start / spec_step_finish), drained by
+        #: _drain_pending exactly like _pending_block
+        self._pending_spec: Optional[dict] = None
 
         # multi-process (multi-host) mesh: every process executes the
         # same jitted calls (the driver/follower op-stream,
@@ -502,15 +549,21 @@ class ServingEngine:
                 self._draft_catchup_impl, donate_argnums=(1,)
             )
             self._spec_draft = jax.jit(
-                self._spec_draft_impl, static_argnames=("k",),
-                donate_argnums=(1,),
-                out_shardings=rep((None, self._replicated)),
-            )
-            self._spec_verify = jax.jit(
-                self._spec_verify_impl,
+                self._spec_draft_impl,
+                static_argnames=("k", "greedy", "top_k", "top_p",
+                                 "min_p"),
                 donate_argnums=(1,),
                 out_shardings=rep(
                     (None, self._replicated, self._replicated)
+                ),
+            )
+            self._spec_verify = jax.jit(
+                self._spec_verify_impl,
+                static_argnames=("greedy", "top_k", "top_p", "min_p"),
+                donate_argnums=(1,),
+                out_shardings=rep(
+                    (None, self._replicated, self._replicated,
+                     self._replicated, self._replicated)
                 ),
             )
 
@@ -757,33 +810,92 @@ class ServingEngine:
         )
         return cache
 
-    def _spec_draft_impl(self, params, cache, last, lens, *, k: int):
-        """k greedy draft steps as one scan → (B, k) proposals."""
+    def _spec_draft_impl(self, params, cache, last, lens, rng,
+                         temperature, *, k: int, greedy: bool,
+                         top_k: int = 0, top_p: float = 1.0,
+                         min_p: float = 0.0):
+        """k draft steps as one scan → (B, k) proposals. Greedy
+        (temperature -> 0): argmax chains, the bit-identical legacy
+        path. Sampling: each step draws from the FILTERED, tempered
+        draft distribution q (same temperature/top-k/top-p/min-p the
+        target applies), and the per-step q distributions (B, k, V)
+        ride along — rejection sampling needs them for the
+        accept-or-resample math."""
 
-        def step(carry, _):
+        def step(carry, i):
             cache, last, lens = carry
             logits, cache = self.draft_model.apply_with_cache(
                 params, last[:, None], cache, lens,
                 quant_kernel=self._quant_kernel,
             )
-            toks = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-            return (cache, toks, lens + 1), toks
+            logits = logits[:, 0]
+            if greedy:
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (cache, toks, lens + 1), toks
+            logits = filter_logits(logits / temperature, top_k, top_p,
+                                   min_p)
+            toks = jax.random.categorical(
+                jax.random.fold_in(rng, i), logits, axis=-1,
+            ).astype(jnp.int32)
+            return (cache, toks, lens + 1), (
+                toks, jax.nn.softmax(logits, axis=-1)
+            )
 
-        (cache, _, _), toks = jax.lax.scan(
-            step, (cache, last, lens), None, length=k
+        (cache, _, _), out = jax.lax.scan(
+            step, (cache, last, lens), jnp.arange(k, dtype=jnp.int32)
         )
-        return cache, jnp.swapaxes(toks, 0, 1)
+        if greedy:
+            # uniform output structure across the greedy/sampling
+            # statics (one out_shardings spec serves both): greedy has
+            # no proposal distributions, so q is a scalar placeholder
+            return (cache, jnp.swapaxes(out, 0, 1),
+                    jnp.zeros((1,), jnp.float32))
+        toks, q = out
+        return (cache, jnp.swapaxes(toks, 0, 1),
+                jnp.swapaxes(q, 0, 1))
 
-    def _spec_verify_impl(self, params, cache, inputs, lens):
-        """One target forward over (B, k+1) inputs → (B, k+1) greedy
-        next-token predictions (position j predicts the token after
-        input j) plus their logprobs."""
+    def _spec_verify_impl(self, params, cache, inputs, lens, d, q, rng,
+                          temperature, *, greedy: bool, top_k: int,
+                          top_p: float, min_p: float):
+        """One target forward over (B, k+1) inputs, fused with the
+        acceptance rule. Greedy: accept the longest draft prefix
+        agreeing with the target's argmax chain (bit-identical to plain
+        greedy decode). Sampling: standard rejection sampling
+        (:func:`instaslice_tpu.serving.sampling.speculative_accept`) —
+        output distribution-identical to plain sampling from the
+        filtered, tempered target distribution at ANY temperature.
+
+        Returns ``(cache, accepted (B,), out (B, k+1), logprobs
+        (B, k+1), final (B,))``: ``out[:, :accepted]`` is the emitted
+        draft prefix, ``out[:, accepted]`` the bonus/resampled token
+        (``final``), positions past that are garbage the host slices
+        off. ``lengths`` advance by ``accepted + 1`` — all computed
+        on-device so the overlap seam never forces a readback."""
         logits, cache = self.model.apply_with_cache(
             params, inputs, cache, lens,
             quant_kernel=self._quant_kernel,
         )
-        toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return cache, toks, token_logprob(logits, toks)
+        B, k1 = inputs.shape
+        k = k1 - 1
+        rows = jnp.arange(B)
+        if greedy:
+            t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            matches = (d == t[:, :k]).astype(jnp.int32)
+            accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+            final = t[rows, accepted]
+            out = jnp.concatenate(
+                [d, jnp.zeros((B, 1), jnp.int32)], axis=1
+            ).at[rows, accepted].set(final)
+            # emitted tokens ARE the target's greedy chain t[:n+1]
+            # (accepted draft tokens equal it), so their logprobs are
+            # the verify pass's logprobs at those positions
+            return cache, accepted, out, token_logprob(logits, t), final
+        p = jax.nn.softmax(
+            filter_logits(logits / temperature, top_k, top_p, min_p),
+            axis=-1,
+        )
+        accepted, out, lps, final = speculative_accept(d, q, p, rng)
+        return cache, accepted, out, lps, final
 
     def _sample(self, logits: jax.Array, rows=None):
         """(tokens, logprobs) for a (B, vocab) logits batch; logprob is
@@ -983,13 +1095,15 @@ class ServingEngine:
         }
         if self.draft_model is not None:
             # catch-up consumes (B, 1) from step() and (B, n) from
-            # decode_block; spec k shrinks near the cache end, so each
-            # k in [0, spec_k] is a distinct draft/verify shape
+            # decode_block; every dispatched spec k is a member of the
+            # bounded shape set (adaptive ladder, cache-end shrink and
+            # budget caps all floor onto it), times the greedy/sampled
+            # variants (temperature is mutable between calls)
             out.update({
                 "draft_prefill": 1,
                 "draft_catchup": 1 + n_steps,
-                "spec_draft": self.spec_k + 1,
-                "spec_verify": self.spec_k + 1,
+                "spec_draft": 2 * len(self._spec_kset),
+                "spec_verify": 2 * len(self._spec_kset),
             })
         return out
 
@@ -1242,6 +1356,7 @@ class ServingEngine:
 
         # an in-flight block's outputs died with the old cache's lineage
         self._pending_block = None
+        self._pending_spec = None
         lost = [r.request_id for r in self.slots.values()]
         for rid in lost:
             self._release_table(rid)
@@ -1884,13 +1999,16 @@ class ServingEngine:
         list per request, 1:1 with ``reqs``; all-or-nothing on
         capacity like :meth:`add_request_n`.
 
-        Falls back to sequential admission when ``batched_prefill`` is
-        off, a draft model is attached (draft chunk prefills are not
-        batched), or the burst is a single request."""
+        On a draft-carrying engine the TARGET chunks still batch; the
+        draft's chunk prefills dispatch per-row inside each round (the
+        draft is the cheap model — its dispatch count is not the
+        bottleneck the batched program exists to cut), leaving the
+        draft cache byte-identical to sequential admission. Falls back
+        to sequential admission when ``batched_prefill`` is off or the
+        burst is a single request."""
         reqs = [r if isinstance(r, AdmissionRequest)
                 else AdmissionRequest(**r) for r in reqs]
-        if (not self.batched_prefill or self.draft_model is not None
-                or len(reqs) <= 1):
+        if not self.batched_prefill or len(reqs) <= 1:
             return [self.add_request_n(r.prompt, r.n, stop=r.stop,
                                        adapter=r.adapter) for r in reqs]
         self._drain_pending()
@@ -2029,6 +2147,11 @@ class ServingEngine:
                         slots_per[ri][0], cursors[ri] * P,
                         jnp.full((1,), reqs[ri].adapter, jnp.int32),
                     )
+                    if self.draft_model is not None:
+                        self.draft_cache = self._draft_prefill(
+                            self.draft_params, self.draft_cache,
+                            padded, slots_per[ri][0], cursors[ri] * P,
+                        )
                     self.prefill_rows += 1
                     if cursors[ri] == n_chunks[ri] - 1:
                         last_logits[ri] = logits1
@@ -2057,6 +2180,21 @@ class ServingEngine:
                 self.prefill_rows += len(part)
                 self.prefill_pad_rows += bucket - len(part)
                 self._prefill_occ.append(len(part) / bucket)
+                if self.draft_model is not None:
+                    # the draft cache must hold every prompt too: one
+                    # per-row dispatch each (the draft is cheap; its
+                    # content is byte-identical to sequential
+                    # admission's _prefill_chunks ordering)
+                    for ri in part:
+                        c = reqs[ri].prompt[cursors[ri] * P:
+                                            (cursors[ri] + 1) * P]
+                        self.draft_cache = self._draft_prefill(
+                            self.draft_params, self.draft_cache,
+                            jnp.asarray(
+                                c + [0] * (P - len(c)), jnp.int32
+                            )[None],
+                            slots_per[ri][0], cursors[ri] * P,
+                        )
                 for row_i, ri in enumerate(part):
                     if cursors[ri] == n_chunks[ri] - 1:
                         last_logits[ri] = logits[row_i]
@@ -2074,9 +2212,19 @@ class ServingEngine:
                 stripe = self._read_stripe(
                     self.cache, ss[0], 0, length=n_chunks[ri] * P
                 )
+                d_stripe = None
+                if self.draft_model is not None:
+                    d_stripe = self._read_stripe(
+                        self.draft_cache, ss[0], 0,
+                        length=n_chunks[ri] * P,
+                    )
                 for s in ss[1:]:
                     self.cache = self._write_stripe(self.cache, stripe,
                                                     s, 0)
+                    if d_stripe is not None:
+                        self.draft_cache = self._write_stripe(
+                            self.draft_cache, d_stripe, s, 0
+                        )
             if self.track_seen:
                 rows = jnp.asarray(ss)
                 pt = jnp.asarray(r.prompt, jnp.int32)
@@ -2201,6 +2349,8 @@ class ServingEngine:
         this keeps direct engine users safe by construction."""
         if self._pending_block is not None:
             self.decode_block_finish()
+        if self._pending_spec is not None:
+            self.spec_step_finish()
 
     def decode_block_start(self, n_steps: int) -> bool:
         """Dispatch ``n_steps`` decode steps WITHOUT blocking on the
@@ -2311,68 +2461,180 @@ class ServingEngine:
         )
         return out
 
-    def spec_step(self) -> Dict[int, List[int]]:
-        """One speculative round for every live slot: draft ``spec_k``
+    # ---- adaptive-k tuning (docs/SERVING.md "Speculative decoding"):
+    # the EMA walks the shape-set ladder one rung per crossing, with a
+    # hysteresis band so k doesn't thrash on round-to-round noise, and
+    # a periodic k=1 probe so a workload that recovered its
+    # predictability can climb back out of the k=0 (plain-decode) floor
+    SPEC_EMA_BETA = 0.25
+    SPEC_EMA_HI = 0.7
+    SPEC_EMA_LO = 0.35
+    SPEC_PROBE_EVERY = 8
+
+    def _kset_floor(self, k: int) -> int:
+        """Largest shape-set member <= k (the set contains 0, so this
+        never fails) — every dispatched k must be a compiled shape."""
+        out = 0
+        for v in self._spec_kset:
+            if v <= k:
+                out = v
+        return out
+
+    def _spec_clamp(self, k: int) -> int:
+        """THE k clamp (shared by :meth:`spec_plan_k` and an explicit
+        ``spec_step_start(k=...)`` so planner, broadcast, and dispatch
+        cannot drift): shrink near the cache end instead of refusing —
+        k=0 degrades to a plain (draft-cache-maintaining) step, so a
+        slot can always be drained to max_len through this path — then
+        floor onto the compiled shape set."""
+        worst = max(
+            len(r.prompt) + len(r.generated)
+            for r in self.slots.values()
+        )
+        return self._kset_floor(
+            max(0, min(k, self.max_len - 2 - worst))
+        )
+
+    def spec_plan_k(self, budget_cap: Optional[int] = None) -> int:
+        """The k the NEXT spec round will dispatch: the adaptive
+        ladder's current rung (acceptance-EMA driven; ``spec_k`` flat
+        when ``spec_adaptive`` is off), clamped to the cache headroom
+        of the deepest live slot and to the caller's emitted-token cap
+        (``budget_cap`` tokens may be emitted at most, so k <=
+        budget_cap - 1), floored onto the compiled shape set.
+
+        PURE — no state changes, so scheduler planning (headroom
+        charges), the distributed driver's START broadcast, and the
+        dispatch itself all see the same k."""
+        if self.draft_model is None or not self.slots:
+            return 0
+        if self.spec_adaptive:
+            k = self._spec_kset[self._spec_idx]
+            if (k == 0 and len(self._spec_kset) > 1
+                    and self._spec_zero_rounds % self.SPEC_PROBE_EVERY
+                    == self.SPEC_PROBE_EVERY - 1):
+                k = self._spec_kset[1]     # periodic re-measure probe
+        else:
+            k = self.spec_k
+        if budget_cap is not None:
+            k = max(0, min(k, budget_cap - 1))
+        return self._spec_clamp(k)
+
+    def spec_step(self, k: Optional[int] = None) -> Dict[int, List[int]]:
+        """One speculative round for every live slot: draft ``k``
         proposals (one cheap scan), verify with ONE target forward,
-        emit the longest agreeing prefix plus the target's own next
-        token — between 1 and ``spec_k + 1`` tokens per slot per target
-        pass, token-identical to plain greedy decode.
+        emit the accepted prefix plus one bonus/resampled token —
+        between 1 and ``k + 1`` tokens per slot per target pass.
+        Greedy engines emit exactly the plain greedy chain
+        (bit-identical); at temperature > 0 the acceptance rule is
+        standard rejection sampling, so output is
+        distribution-identical to plain sampling (losslessness is
+        independent of draft quality — only throughput depends on it).
 
         Rollback costs nothing: rejected positions sit at/beyond each
         slot's new write offset, so the mask never admits them and the
         next round overwrites them — in BOTH caches (the draft's wrong
-        entry is exactly its next write position). Near the cache end
-        ``k`` shrinks automatically (down to a plain greedy step at
-        ``k = 0``), so slots drain to ``max_len`` through this path
-        instead of raising."""
+        entry is exactly its next write position).
+
+        ``k=None`` plans this round's k (:meth:`spec_plan_k` — the
+        adaptive ladder). Split form for host/device overlap:
+        :meth:`spec_step_start` dispatches draft + verify and starts
+        the async readback, :meth:`spec_step_finish` lands the tokens
+        and does the host bookkeeping. This method is start + finish."""
+        self.spec_step_start(k)
+        return self.spec_step_finish()
+
+    def spec_step_start(self, k: Optional[int] = None) -> bool:
+        """Dispatch one speculative round WITHOUT blocking on its
+        outputs: the draft scan, the fused verify+accept forward, and
+        the on-device decode-state advance (``last_token`` /
+        ``lengths``) are all enqueued, the accepted-count/token-block
+        readback starts asynchronously, and the call returns while the
+        device computes. Returns False (no dispatch) on an empty
+        batch."""
         if self.draft_model is None:
             raise RuntimeError(
                 "spec_step needs an engine built with draft_model="
             )
         self._drain_pending()
         if not self.slots:
-            return {}
-        with get_tracer().span(
-            "engine.spec_round", batch=len(self.slots), k=self.spec_k,
-        ):
-            return self._spec_step_inner()
-
-    def _spec_step_inner(self) -> Dict[int, List[int]]:
+            return False
         if self.fault_hook is not None:
             self.fault_hook("spec")
-        worst = max(
-            len(r.prompt) + len(r.generated) for r in self.slots.values()
-        )
-        # shrink k near the cache end instead of refusing: k=0 degrades
-        # to a plain (draft-cache-maintaining) greedy step, so a slot can
-        # always be drained to max_len through this path
-        k = max(0, min(self.spec_k, self.max_len - 2 - worst))
+        k = self.spec_plan_k() if k is None else self._spec_clamp(k)
+        greedy = self.temperature <= 0.0
+        if greedy:
+            # greedy consumes no randomness — the RNG stream stays
+            # byte-identical to the pre-rejection-sampling engine
+            sub = self._rng
+        else:
+            # ONE split per round, derived keys per consumer: op-stream
+            # followers replay the identical split sequence, so the
+            # uniform draws (and therefore the accepted counts)
+            # converge across replicas
+            self._rng, sub = jax.random.split(self._rng)
+        draft_rng = jax.random.fold_in(sub, 0)
+        verify_rng = jax.random.fold_in(sub, 1)
+        temp = jnp.float32(max(self.temperature, 1e-6))
         # the draft scans k+1 steps: step j consumes [last, d0..d_{k-1}]
         # so on FULL acceptance (new write position = lens+k+1) every
-        # admitted draft-cache position is really written — a k-step scan
-        # would leave d_{k-1}'s position as a permanent zero-hole
-        self.draft_cache, d_all = self._spec_draft(
+        # admitted draft-cache position is really written — a k-step
+        # scan would leave d_{k-1}'s position as a permanent zero-hole
+        self.draft_cache, d_all, q_all = self._spec_draft(
             self.draft_params, self.draft_cache, self.last_token,
-            self.lengths, k=k + 1,
+            self.lengths, draft_rng, temp, k=k + 1, greedy=greedy,
+            top_k=self.top_k, top_p=float(self.top_p),
+            min_p=float(self.min_p),
         )
         d = d_all[:, :k]
+        q = q_all if greedy else q_all[:, :k]
         inputs = jnp.concatenate([self.last_token[:, None], d], axis=1)
-        self.cache, t, t_lp = self._spec_verify(
-            self.params, self.cache, inputs, self.lengths
+        self.cache, accepted, out, lps, final = self._spec_verify(
+            self.params, self.cache, inputs, self.lengths, d, q,
+            verify_rng, temp, greedy=greedy, top_k=self.top_k,
+            top_p=float(self.top_p), min_p=float(self.min_p),
         )
-        matches = (d == t[:, :k]).astype(jnp.int32)
-        accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
-        bonus = jnp.take_along_axis(t, accepted[:, None], axis=1)[:, 0]
-        d_h, t_h, a_h, lp_h = jax.device_get((d, t, accepted, t_lp))
-        self.last_token = bonus
+        # decode state advances ON DEVICE — the host sees nothing until
+        # finish(), so scheduler host work overlaps the whole chain
+        self.last_token = final
         self.lengths = self.lengths + accepted + 1
+        # kick the device→host copy off NOW: by the time the host comes
+        # back to finish(), the transfer rode along with the compute
+        for arr in (accepted, out, lps):
+            start_async = getattr(arr, "copy_to_host_async", None)
+            if start_async is not None:
+                try:
+                    start_async()
+                # purely an overlap hint: any backend quirk degrades to
+                # the synchronous device_get in finish()
+                except Exception:  # noqa: BLE001  # slicelint: disable=broad-except
+                    pass
+        self._pending_spec = {
+            "accepted": accepted, "out": out, "lps": lps, "k": k,
+            "batch": len(self.slots), "t0": time.perf_counter(),
+        }
+        return True
+
+    def spec_step_finish(self) -> Dict[int, List[int]]:
+        """Block on the in-flight spec round's outputs and do the host
+        bookkeeping: extend per-slot chains (EOS/stop cuts included),
+        update the acceptance EMA + adaptive-k ladder, grow block
+        tables. Returns request id → new tokens ({} when no round is
+        in flight)."""
+        pending = self._pending_spec
+        if pending is None:
+            return {}
+        self._pending_spec = None
+        a_h, out_h, lp_h = jax.device_get(
+            (pending["accepted"], pending["out"], pending["lps"])
+        )
+        k = pending["k"]
         out: Dict[int, List[int]] = {}
+        accepted_sum = 0
         for slot, req in list(self.slots.items()):
             n = int(a_h[slot])
-            # emitted tokens ARE the target's greedy chain t[:n+1]
-            # (accepted draft tokens equal it), so their logprobs are
-            # the verify pass's logprobs at those positions
-            seq = [int(x) for x in d_h[slot, :n]] + [int(t_h[slot, n])]
+            accepted_sum += n
+            seq = [int(x) for x in out_h[slot, : n + 1]]
             if self.eos_id is not None and self.eos_id in seq:
                 seq = seq[: seq.index(self.eos_id) + 1]
             req.generated.extend(seq)
@@ -2382,8 +2644,101 @@ class ServingEngine:
             self.tokens_generated += len(seq)
             out[req.request_id] = seq
             self._maybe_finish(slot)
+        self.spec_rounds += 1
+        if k > 0:
+            proposed = k * pending["batch"]
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted_sum
+            rate = accepted_sum / proposed
+            self._spec_rate_samples.append(rate)
+            self._spec_zero_rounds = 0
+            if self.spec_adaptive:
+                self.spec_accept_ema = (
+                    (1.0 - self.SPEC_EMA_BETA) * self.spec_accept_ema
+                    + self.SPEC_EMA_BETA * rate
+                )
+                if (self.spec_accept_ema >= self.SPEC_EMA_HI
+                        and self._spec_idx < len(self._spec_kset) - 1):
+                    self._spec_idx += 1
+                elif (self.spec_accept_ema <= self.SPEC_EMA_LO
+                        and self._spec_idx > 0):
+                    self._spec_idx -= 1
+        else:
+            self._spec_zero_rounds += 1
         self._sync_tables()
+        get_tracer().record(
+            "engine.spec_round",
+            (time.perf_counter() - pending["t0"]) * 1e3,
+            k=k, batch=pending["batch"], accepted=accepted_sum,
+        )
         return out
+
+    def spec_stats(self) -> dict:
+        """The speculative-decoding observability block (/v1/stats
+        ``spec``): shape-set/ladder gauges plus the rounds/proposed/
+        accepted ledger the scheduler delta-exports."""
+        if self.draft_model is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "k": self.spec_plan_k() if self.slots
+            else (self._spec_kset[self._spec_idx] if self.spec_adaptive
+                  else self.spec_k),
+            "k_max": self.spec_k,
+            "k_set": list(self._spec_kset),
+            "adaptive": self.spec_adaptive,
+            "acceptance_ema": round(self.spec_accept_ema, 4),
+            "rounds": self.spec_rounds,
+            "proposed": self.spec_proposed,
+            "accepted": self.spec_accepted,
+        }
+
+    def warm_spec_programs(self) -> None:
+        """Compile the FULL draft/verify shape set NOW — every k the
+        adaptive ladder (or the cache-end clamp) can dispatch, for the
+        engine's current sampling mode — plus the draft prefill
+        program, against the live caches with zero admissions. Call
+        once before taking traffic (the serve CLI does, right next to
+        :meth:`warm_prefill_buckets`; the bench does per arm) so no
+        round pays a compile mid-measurement: PR 11 measured a single
+        cold mid-run compile polluting a seconds-long TTFT p95 tail.
+        The dummy dispatches scribble masked positions of empty slots'
+        stripes — harmless while nothing is live (admission prefill
+        overwrites everything it attends). No-op without a draft."""
+        if self.draft_model is None:
+            return
+        if self.slots:
+            raise RuntimeError(
+                "warm_spec_programs must run before any admission "
+                "(it scribbles on empty slots' masked stripes)"
+            )
+        greedy = self.temperature <= 0.0
+        rng = jax.random.fold_in(jax.random.key(0), 0)
+        if self._replicated is not None:
+            rng = jax.device_put(rng, self._replicated)
+        temp = jnp.float32(max(self.temperature, 1e-6))
+        P = self.prefill_len
+        self.draft_cache = self._draft_prefill(
+            self.draft_params, self.draft_cache,
+            jnp.zeros((1, P), jnp.int32), 0, 0,
+        )
+        for k in self._spec_kset:
+            self.draft_cache, d_all, q_all = self._spec_draft(
+                self.draft_params, self.draft_cache, self.last_token,
+                self.lengths, rng, temp, k=k + 1, greedy=greedy,
+                top_k=self.top_k, top_p=float(self.top_p),
+                min_p=float(self.min_p),
+            )
+            d = d_all[:, :k]
+            q = q_all if greedy else q_all[:, :k]
+            inputs = jnp.concatenate(
+                [self.last_token[:, None], d], axis=1
+            )
+            self.cache, *_ = self._spec_verify(
+                self.params, self.cache, inputs, self.lengths, d, q,
+                rng, temp, greedy=greedy, top_k=self.top_k,
+                top_p=float(self.top_p), min_p=float(self.min_p),
+            )
 
     @staticmethod
     def _find_stop(generated: List[int], stops: List[List[int]],

@@ -82,6 +82,74 @@ def filter_logits(
     return logits
 
 
+def speculative_accept(
+    draft: jax.Array, q_probs: jax.Array, p_probs: jax.Array,
+    rng: jax.Array,
+):
+    """Standard speculative-sampling acceptance (the
+    draft-propose/target-verify accept-or-resample scheme): token i of
+    each row's draft is accepted with probability ``min(1, p_i(x_i) /
+    q_i(x_i))``; at the first rejection the replacement token is drawn
+    from the normalized residual ``max(p_i - q_i, 0)``, and a fully
+    accepted row draws its bonus token from ``p_k``. The emitted
+    sequence is distributed EXACTLY as k+1 ancestral samples from
+    ``p`` — losslessness does not depend on how good ``q`` is, only
+    the acceptance rate does.
+
+    ``draft`` (B, k) int tokens sampled from ``q_probs`` (B, k, V);
+    ``p_probs`` (B, k+1, V) is the target distribution at every
+    position (post temperature/top-k/top-p/min-p filtering — the
+    distribution plain sampling draws from). Returns ``(accepted,
+    out, logprobs, final)``: ``accepted`` (B,) in [0, k];
+    ``out`` (B, k+1) holds the accepted draft prefix with the
+    resampled/bonus token at index ``accepted`` (positions past it are
+    unspecified — callers slice to ``accepted + 1``); ``logprobs`` is
+    ``log p`` at each emitted position (the distribution the lossless
+    output is distributed as); ``final`` (B,) = ``out[b, accepted]``.
+
+    Pure and jit-friendly: all randomness derives from ``rng`` via
+    ``fold_in``, so op-stream replicas replaying the same key converge
+    on identical accepted counts.
+    """
+    B, k = draft.shape
+    rows = jnp.arange(B)
+    u = jax.random.uniform(jax.random.fold_in(rng, 0), (B, k))
+    p_at = jnp.take_along_axis(
+        p_probs[:, :k], draft[..., None], axis=-1
+    )[..., 0]
+    q_at = jnp.take_along_axis(q_probs, draft[..., None], axis=-1)[..., 0]
+    # u * q < p  <=>  u < p/q where q > 0 (always: the draft sampled x
+    # from q), without the divide-by-zero
+    acc = (u * q_at < p_at).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)       # (B,)
+    # the token at index ``accepted``: residual distribution at a
+    # rejection, plain p at full acceptance (q past the draft is 0)
+    q_pad = jnp.concatenate(
+        [q_probs, jnp.zeros_like(p_probs[:, :1])], axis=1
+    )
+    p_pos = p_probs[rows, accepted]                            # (B, V)
+    q_pos = q_pad[rows, accepted]
+    res = jnp.maximum(p_pos - q_pos, 0.0)
+    norm = jnp.sum(res, axis=-1, keepdims=True)
+    # p == q to machine precision leaves an all-zero residual; the
+    # correct limit of norm(max(p - q, 0)) as q -> p is p itself
+    res = jnp.where(norm > 0, res / jnp.where(norm > 0, norm, 1.0),
+                    p_pos)
+    final = jax.random.categorical(
+        jax.random.fold_in(rng, 1),
+        jnp.log(jnp.maximum(res, 1e-38)), axis=-1,
+    ).astype(jnp.int32)
+    out = jnp.concatenate(
+        [draft.astype(jnp.int32), jnp.zeros((B, 1), jnp.int32)], axis=1
+    )
+    out = out.at[rows, accepted].set(final)
+    logprobs = jnp.log(jnp.maximum(
+        jnp.take_along_axis(p_probs, out[..., None], axis=-1)[..., 0],
+        1e-38,
+    ))
+    return accepted, out, logprobs, final
+
+
 def token_logprob(logits: jax.Array, toks: jax.Array) -> jax.Array:
     """log p(tok) under softmax(logits): logits (…, V), toks (…) int —
     returns (…) fp32. Callers pass the FILTERED/tempered logits so the

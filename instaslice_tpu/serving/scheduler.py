@@ -367,6 +367,9 @@ class Scheduler(threading.Thread):
         #: cumulative ints, Prometheus counters take deltas
         self._prefix_exported = {"hits": 0, "misses": 0,
                                  "inserted": 0, "evicted": 0}
+        #: same delta discipline for the speculative-decoding ledger
+        self._spec_exported = {"rounds": 0, "proposed": 0,
+                               "accepted": 0}
 
     @property
     def _head(self) -> Optional[Pending]:
@@ -597,20 +600,34 @@ class Scheduler(threading.Thread):
             self.stop_flag.wait(0.005)
             return
         n = self._select_steps()
-        phase = "spec" if eng.draft_model is not None else "decode"
+        spec = eng.draft_model is not None
+        phase = "spec" if spec else "decode"
         round_rids = [r.request_id for r in eng.slots.values()]
-        self._ensure_block_headroom(
-            eng.spec_k + 1 if eng.draft_model is not None else max(1, n)
-        )
-        use_overlap = (
-            self.overlap and eng.draft_model is None and n >= 1
-            and hasattr(eng, "decode_block_start")
+        # spec rounds: plan this round's k ONCE (adaptive ladder +
+        # budget/latency caps) so the headroom charge, the dispatch,
+        # and a distributed driver's START broadcast all see the same
+        # value; headroom charges up to k+1 tokens per slot per round
+        # through KVBlockPool.blocks_for (growth_cost's shared math)
+        spec_k = (eng.spec_plan_k(self._spec_budget_cap())
+                  if spec else 0)
+        self._ensure_block_headroom(spec_k + 1 if spec else max(1, n))
+        use_overlap = self.overlap and (
+            hasattr(eng, "spec_step_start") if spec
+            else (n >= 1 and hasattr(eng, "decode_block_start"))
         )
         t_step = time.monotonic()
         self._observe_dispatch_gap(t_step)
         try:
-            if eng.draft_model is not None:
-                eng.spec_step()
+            if spec:
+                if use_overlap:
+                    # same seam as decode_block_start/finish: the
+                    # draft+verify chain computes (and its outputs
+                    # stream back) while the host pumps the queue
+                    eng.spec_step_start(k=spec_k)
+                    self._overlap_host_work()
+                    eng.spec_step_finish()
+                else:
+                    eng.spec_step(k=spec_k)
             elif n >= 1:
                 if use_overlap:
                     # host/device overlap: the block computes (and its
@@ -636,7 +653,8 @@ class Scheduler(threading.Thread):
                 self._recover_engine(e)
         finally:
             self._observe_round(
-                phase, time.monotonic() - t_step, n, round_rids
+                phase, time.monotonic() - t_step,
+                spec_k + 1 if spec else n, round_rids,
             )
         self._deliver()
 
@@ -668,6 +686,37 @@ class Scheduler(threading.Thread):
                 self.metrics.prefill_batch_occupancy.observe(v)
             del occ[:]
 
+    def _min_remaining_budget(self) -> Optional[int]:
+        """Smallest remaining token budget among live requests this
+        scheduler owns (None when it owns none) — at-budget slots were
+        already removed this round, so the value is >= 1. THE shared
+        round-trimming input for decode blocks AND spec rounds."""
+        eng = self.engine
+        owned = [
+            r for r in eng.slots.values()
+            if r.request_id in self._budget
+        ]
+        if not owned:
+            return None
+        return min(
+            self._budget[r.request_id] - len(r.generated)
+            for r in owned
+        )
+
+    def _latency_pressure(self) -> bool:
+        """Someone LATENCY-sensitive is waiting — a queued
+        latency-class request or a parked preemptee — so rounds
+        shorten (their TTFT is bounded by the round length). A
+        best-effort backlog keeps full rounds: shrinking for it would
+        trade fleet throughput for latency nobody asked for. THE
+        shared predicate for decode blocks AND spec rounds."""
+        return bool(self._parked) or any(
+            not p.prefix_op
+            and class_rank(p.spec.tenant_class)
+            == CLASS_RANK["latency"]
+            for p in self._ready
+        )
+
     def _select_steps(self) -> int:
         """This round's decode-block length. Continuous: trimmed to the
         smallest remaining budget (the freed slot readmits at the very
@@ -679,28 +728,10 @@ class Scheduler(threading.Thread):
         eng = self.engine
         n = self.block_size
         if self.mode == "continuous":
-            owned = [
-                r for r in eng.slots.values()
-                if r.request_id in self._budget
-            ]
-            if owned:
-                # at-budget slots were just removed: remaining >= 1
-                n = min(n, min(
-                    self._budget[r.request_id] - len(r.generated)
-                    for r in owned
-                ))
-            # shorten rounds only when someone LATENCY-sensitive is
-            # waiting (a queued latency-class request or a parked
-            # preemptee): their TTFT is bounded by the round length.
-            # A best-effort backlog keeps full blocks — shrinking for
-            # it would trade fleet throughput for latency nobody asked
-            # for.
-            if self._parked or any(
-                not p.prefix_op
-                and class_rank(p.spec.tenant_class)
-                == CLASS_RANK["latency"]
-                for p in self._ready
-            ):
+            budget = self._min_remaining_budget()
+            if budget is not None:
+                n = min(n, budget)
+            if self._latency_pressure():
                 n = min(n, max(1, self.block_size // 4))
         worst = max(
             len(r.prompt) + len(r.generated)
@@ -716,6 +747,25 @@ class Scheduler(threading.Thread):
         if self.mode == "continuous" and n > 1:
             n = 1 << (n.bit_length() - 1)
         return n
+
+    def _spec_budget_cap(self) -> Optional[int]:
+        """Emitted-token cap for the next spec round (None = no cap):
+        the spec counterpart of :meth:`_select_steps`' trimming. A
+        round emits up to k+1 tokens per slot, so the cap binds k at
+        cap-1: the smallest remaining budget among live requests (the
+        freed slot readmits at the next round boundary; spec overshoot
+        past a budget is no longer structural), shortened while a
+        latency-class request or a parked preemptee waits — their TTFT
+        is bounded by the round length, exactly the decode path's
+        rule. Fixed mode keeps full-depth rounds (the baseline must
+        not change shape)."""
+        if self.mode != "continuous":
+            return None
+        cap = self._min_remaining_budget()
+        if self._latency_pressure():
+            short = max(1, self.block_size // 4)
+            cap = short if cap is None else min(cap, short)
+        return cap
 
     def _ensure_block_headroom(self, n_steps: int) -> None:
         """Guarantee the pool covers this round's table growth: shed
@@ -867,13 +917,14 @@ class Scheduler(threading.Thread):
     def _admit(self) -> None:
         """Admission dispatcher: continuous mode on a batched-prefill
         engine collects this round's admissible set and admits it as
-        ONE burst (one dispatch chain — engine.add_requests); fixed
-        mode and draft engines keep the sequential per-request path
-        (the FIFO baseline must not change shape)."""
+        ONE burst (one dispatch chain — engine.add_requests; on a
+        draft-carrying engine the target chunks batch and the draft
+        rides per-row inside each round); fixed mode keeps the
+        sequential per-request path (the FIFO baseline must not change
+        shape)."""
         eng = self.engine
         if (self.mode != "continuous"
-                or not getattr(eng, "batched_prefill", False)
-                or eng.draft_model is not None):
+                or not getattr(eng, "batched_prefill", False)):
             self._admit_sequential()
             return
         batch: List[Pending] = []
@@ -1442,6 +1493,26 @@ class Scheduler(threading.Thread):
             if delta > 0:
                 metric.inc(delta)
         self._prefix_exported = snap
+        if eng.draft_model is not None:
+            sp = {"rounds": eng.spec_rounds,
+                  "proposed": eng.spec_proposed,
+                  "accepted": eng.spec_accepted}
+            for key, metric in (
+                ("rounds", self.metrics.spec_rounds),
+                ("proposed", self.metrics.spec_proposed),
+                ("accepted", self.metrics.spec_accepted),
+            ):
+                delta = sp[key] - self._spec_exported[key]
+                if delta > 0:
+                    metric.inc(delta)
+            self._spec_exported = sp
+            # per-round acceptance-rate samples (engine code stays
+            # metrics-free, like the prefill-occupancy drain)
+            samples = getattr(eng, "_spec_rate_samples", None)
+            if samples:
+                for v in samples:
+                    self.metrics.spec_acceptance.observe(v)
+                del samples[:]
 
     def _deliver(self) -> None:
         eng = self.engine
@@ -1526,6 +1597,9 @@ class Scheduler(threading.Thread):
             "max_batch": eng.max_batch,
             "max_len": eng.max_len,
             "speculative": eng.draft_model is not None,
+            "spec": (eng.spec_stats()
+                     if hasattr(eng, "spec_stats")
+                     else {"enabled": False}),
             "mesh": dict(eng.mesh.shape) if eng.mesh is not None else None,
             "prefixes": len(eng.prefixes),
             "prefix_hits": eng.prefix_hits,

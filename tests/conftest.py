@@ -63,6 +63,7 @@ SLOW_MODULES = {
     "test_serving_sched",  # SLO scheduler + preempt/resume engine paths
     "test_engine_hotpath",  # batched prefill / fast-path / overlap compiles
     "test_radix",         # radix prefix cache over the jax engine
+    "test_spec_decode",   # rejection-sampling spec decode compiles
 }
 
 

@@ -344,15 +344,18 @@ class TestSpeculativeDecoding:
         assert got[sa][:10] == ref[ra]
         assert got[sb][:10] == ref[rb]
 
-    def test_requires_draft_and_greedy(self, model):
+    def test_requires_draft(self, model):
         m, params = model
         eng = ServingEngine(m, params, max_batch=1, max_len=32,
                             prefill_len=8)
         with pytest.raises(RuntimeError, match="draft_model"):
             eng.spec_step()
-        with pytest.raises(ValueError, match="greedy"):
-            ServingEngine(m, params, temperature=0.7, draft_model=m,
-                          draft_params=params)
+        # temperature > 0 + draft is now ALLOWED (rejection sampling —
+        # tests/test_spec_decode.py pins distribution identity); what
+        # stays rejected is a nonsensical spec_k
+        with pytest.raises(ValueError, match="spec_k"):
+            ServingEngine(m, params, draft_model=m,
+                          draft_params=params, spec_k=0)
 
     def test_k_shrinks_near_cache_end_and_drains(self, model):
         """Near max_len, k shrinks (down to a plain greedy step) so the

@@ -1,0 +1,753 @@
+"""Lossless speculative decoding (docs/SERVING.md "Speculative
+decoding"): rejection-sampling distribution identity (seeded,
+tolerance-bounded), greedy bit-identity with the pre-rejection path,
+adaptive-k ladder behavior, overlapped spec rounds, preempt/resume and
+radix-hit token identity under spec, op-stream follower convergence of
+accepted counts, and the compile budget over the adaptive-k shape
+set."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.serving import ServingEngine
+from instaslice_tpu.serving.sampling import speculative_accept
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def tiny_vocab_model():
+    """Small vocab so empirical marginals converge in a few hundred
+    trials (the statistical distribution-identity tests)."""
+    cfg = ModelConfig(
+        vocab_size=16, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(3))
+
+
+def tv_distance(a, b) -> float:
+    return 0.5 * float(np.abs(np.asarray(a) - np.asarray(b)).sum())
+
+
+class TestRejectionSampler:
+    """The mathematical core: speculative_accept's output must be
+    distributed exactly as ancestral samples from p, for ANY proposal
+    distribution q."""
+
+    V, K, N = 8, 3, 40000
+
+    def _dists(self):
+        kq, kp = jax.random.split(jax.random.key(42))
+        q = jax.nn.softmax(jax.random.normal(kq, (self.K, self.V)) * 1.5)
+        p = jax.nn.softmax(
+            jax.random.normal(kp, (self.K + 1, self.V)) * 1.5
+        )
+        return q, p
+
+    def test_position0_marginal_is_p0(self):
+        """Monte Carlo over N keys: the marginal of the first emitted
+        token (accepted draft token OR the rejection resample) must be
+        p_0 — THE lossless property, independent of q."""
+        q, p = self._dists()
+
+        def one(key):
+            kd, kr = jax.random.split(key)
+            d = jax.random.categorical(
+                kd, jnp.log(q), axis=-1
+            ).astype(jnp.int32)[None]
+            acc, out, lps, final = speculative_accept(
+                d, q[None], p[None], kr
+            )
+            return out[0, 0], acc[0]
+
+        toks, accs = jax.vmap(one)(
+            jax.random.split(jax.random.key(7), self.N)
+        )
+        emp = np.bincount(np.asarray(toks), minlength=self.V) / self.N
+        tv = tv_distance(emp, p[0])
+        # expected TV at N=40k, V=8 is ~0.006; a biased sampler (e.g.
+        # always keeping the draft token) lands far beyond 0.02
+        assert tv < 0.02, f"TV(emitted marginal, p0) = {tv}"
+        # the draft deliberately disagrees with the target: both
+        # branches of the accept-or-resample rule must really fire
+        assert 0.0 < float(accs.mean()) < self.K
+
+    def test_identical_p_q_accepts_everything(self):
+        _, p = self._dists()
+        q = p[: self.K][None]
+        d = jnp.argmax(p[: self.K], axis=-1).astype(jnp.int32)[None]
+        acc, out, lps, final = speculative_accept(
+            d, q, p[None], jax.random.key(0)
+        )
+        # p == q: accept probability is exactly 1 at every position
+        assert int(acc[0]) == self.K
+        assert [int(x) for x in out[0, : self.K]] == [
+            int(x) for x in d[0]
+        ]
+
+    def test_k0_samples_plain_p(self):
+        """k=0 (the adaptive floor): no proposals, the single emitted
+        token must simply be a sample from p_0 — graceful degradation
+        IS plain sampling."""
+        _, p = self._dists()
+
+        def one(key):
+            acc, out, lps, final = speculative_accept(
+                jnp.zeros((1, 0), jnp.int32),
+                jnp.zeros((1, 0, self.V)), p[:1][None], key,
+            )
+            return out[0, 0]
+
+        toks = jax.vmap(one)(jax.random.split(jax.random.key(9), 20000))
+        emp = np.bincount(np.asarray(toks), minlength=self.V) / 20000
+        assert tv_distance(emp, p[0]) < 0.03
+
+    def test_logprobs_are_log_p_at_emitted(self):
+        q, p = self._dists()
+        d = jnp.argmax(q, axis=-1).astype(jnp.int32)[None]
+        acc, out, lps, final = speculative_accept(
+            d, q[None], p[None], jax.random.key(1)
+        )
+        n = int(acc[0])
+        for i in range(n + 1):
+            want = float(jnp.log(p[i, int(out[0, i])]))
+            assert lps[0, i] == pytest.approx(want, abs=1e-5)
+
+
+class TestEngineDistributionIdentity:
+    """Engine-level statistical identity: a spec engine with a
+    DISAGREEING draft, at temperature > 0, must emit tokens whose
+    marginal matches the exact tempered target distribution."""
+
+    TRIALS = 600
+    PROMPT = [5, 9, 2, 7]
+    TEMP = 0.9
+
+    def test_first_spec_token_marginal(self, tiny_vocab_model):
+        m, params = tiny_vocab_model
+        V = m.cfg.vocab_size
+        # exact marginal of generated[1]: sum over g0 of
+        # p(g0 | prompt) * p(g1 | prompt + g0), both tempered —
+        # admission samples g0, the first spec round emits g1
+        logits0 = m.apply(
+            params, jnp.asarray(self.PROMPT, jnp.int32)[None]
+        )[0, -1]
+        p0 = np.asarray(jax.nn.softmax(logits0 / self.TEMP))
+        exact = np.zeros(V)
+        for g0 in range(V):
+            lg = m.apply(
+                params,
+                jnp.asarray(self.PROMPT + [g0], jnp.int32)[None],
+            )[0, -1]
+            exact += p0[g0] * np.asarray(jax.nn.softmax(lg / self.TEMP))
+        # draft = a DIFFERENT random init: real disagreement, so both
+        # acceptance and rejection-resampling paths fire constantly
+        draft_params = m.init(jax.random.key(99))
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, temperature=self.TEMP,
+                            draft_model=m, draft_params=draft_params,
+                            spec_k=3, seed=11)
+        counts = np.zeros(V)
+        accepted_any = False
+        for _ in range(self.TRIALS):
+            rid = eng.add_request(list(self.PROMPT))
+            eng.spec_step()
+            req = (next(iter(eng.slots.values()))
+                   if eng.slots else None)
+            assert req is not None and req.request_id == rid
+            counts[req.generated[1]] += 1
+            accepted_any = accepted_any or eng.spec_accepted > 0
+            eng.evict_slot(next(iter(eng.slots)))
+        emp = counts / self.TRIALS
+        tv = tv_distance(emp, exact)
+        # expected TV at 600 trials over V=16 is ~0.09; a broken
+        # acceptance rule (greedy acceptance on sampled chains reads
+        # ~0.5 here) is far outside 0.2
+        assert tv < 0.2, f"TV(spec marginal, exact tempered p) = {tv}"
+        assert accepted_any, "draft never accepted — q wiring broken?"
+        # partial acceptance: the rejection path really ran
+        assert eng.spec_accepted < eng.spec_proposed
+
+    def test_plain_engine_same_marginal_sanity(self, tiny_vocab_model):
+        """Anchor: the plain sampled engine's generated[1] marginal
+        matches the same exact distribution — so a spec-side failure
+        in the test above cannot hide behind oracle error."""
+        m, params = tiny_vocab_model
+        V = m.cfg.vocab_size
+        logits0 = m.apply(
+            params, jnp.asarray(self.PROMPT, jnp.int32)[None]
+        )[0, -1]
+        p0 = np.asarray(jax.nn.softmax(logits0 / self.TEMP))
+        exact = np.zeros(V)
+        for g0 in range(V):
+            lg = m.apply(
+                params,
+                jnp.asarray(self.PROMPT + [g0], jnp.int32)[None],
+            )[0, -1]
+            exact += p0[g0] * np.asarray(jax.nn.softmax(lg / self.TEMP))
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, temperature=self.TEMP,
+                            seed=23)
+        counts = np.zeros(V)
+        for _ in range(self.TRIALS):
+            eng.add_request(list(self.PROMPT))
+            eng.step()
+            req = next(iter(eng.slots.values()))
+            counts[req.generated[1]] += 1
+            eng.evict_slot(next(iter(eng.slots)))
+        assert tv_distance(counts / self.TRIALS, exact) < 0.2
+
+
+class TestGreedyBitIdentity:
+    """temperature -> 0 is a special case of the same code path: the
+    chains (and the RNG stream) must stay byte-identical to both the
+    plain engine and the pre-rejection greedy spec path."""
+
+    def test_spec_chain_equals_plain_greedy(self, model):
+        m, params = model
+        plain = ServingEngine(m, params, max_batch=2, max_len=64,
+                              prefill_len=8)
+        rref = plain.add_request([5, 9, 2, 7])
+        ref = plain.decode_block(12)[rref]
+        spec = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=8, draft_model=m,
+                             draft_params=params, spec_k=4)
+        rid = spec.add_request([5, 9, 2, 7])
+        got = []
+        while len(got) < 12:
+            got.extend(spec.spec_step()[rid])
+        assert got[:12] == ref
+
+    def test_greedy_spec_consumes_no_rng(self, model):
+        """Greedy rounds must not split the engine RNG: the stream —
+        and so every later sampled op — stays identical to the
+        pre-rejection-sampling engine."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        eng.add_request([5, 9, 2, 7])
+        before = np.asarray(jax.random.key_data(eng._rng)).copy()
+        eng.spec_step()
+        eng.spec_step()
+        after = np.asarray(jax.random.key_data(eng._rng))
+        assert (before == after).all()
+
+    def test_sampled_spec_consumes_one_split_per_round(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=64,
+                            prefill_len=8, temperature=0.7,
+                            draft_model=m, draft_params=params,
+                            spec_k=3)
+        eng.add_request([5, 9, 2, 7])
+        before = np.asarray(jax.random.key_data(eng._rng)).copy()
+        eng.spec_step()
+        after = np.asarray(jax.random.key_data(eng._rng))
+        assert not (before == after).all()
+
+
+class TestAdaptiveK:
+    def test_ladder_starts_at_spec_k_and_holds_on_acceptance(
+            self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=128,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=4)
+        assert eng._spec_kset == [0, 1, 2, 4]
+        rid = eng.add_request([5, 9, 2, 7])
+        assert len(eng.spec_step()[rid]) == 5      # k=4 first round
+        for _ in range(4):
+            eng.spec_step()
+        # self-draft: full acceptance keeps the ladder at the top
+        assert eng.spec_plan_k() == 4
+        assert eng.spec_accept_ema == pytest.approx(1.0)
+
+    def test_ladder_descends_on_garbage_draft_then_probes(self, model):
+        """A draft that never agrees (its embedding table is rolled, so
+        it proposes a shifted token stream the target puts no mass on)
+        must walk k down to 0 — plain decode, no draft dispatches
+        wasted — and then probe k=1 every SPEC_PROBE_EVERY rounds so
+        recovery is possible."""
+        m, params = model
+        # a uniform-logits draft (zeroed final norm) against the
+        # sharp copy-machine target: acceptance ~ 1/vocab — the tied
+        # embedding makes any permuted/scaled draft cancel back to
+        # agreement, so "garbage" must break the OUTPUT head
+        garbage = dict(params, ln_f={
+            "scale": jnp.zeros_like(params["ln_f"]["scale"])
+        })
+        eng = ServingEngine(m, params, max_batch=1, max_len=256,
+                            prefill_len=8, temperature=1.0,
+                            draft_model=m, draft_params=garbage,
+                            spec_k=4, seed=5)
+        eng.add_request([5, 9, 2, 7])
+        ks = []
+        for _ in range(40):
+            if not eng.slots:
+                break
+            k = eng.spec_plan_k()
+            ks.append(k)
+            eng.spec_step(k=k)
+        assert 0 in ks, f"ladder never reached the k=0 floor: {ks}"
+        zero_runs = [k for k in ks[ks.index(0):]]
+        # probes appear among the zero rounds (every 8th), and k
+        # never exceeds the ladder's descent path
+        assert any(k > 0 for k in zero_runs), \
+            f"no probe rounds after hitting the floor: {ks}"
+        assert eng.spec_accept_ema < 0.4
+
+    def test_adaptive_off_pins_spec_k(self, model):
+        m, params = model
+        # a uniform-logits draft (zeroed final norm) against the
+        # sharp copy-machine target: acceptance ~ 1/vocab — the tied
+        # embedding makes any permuted/scaled draft cancel back to
+        # agreement, so "garbage" must break the OUTPUT head
+        garbage = dict(params, ln_f={
+            "scale": jnp.zeros_like(params["ln_f"]["scale"])
+        })
+        eng = ServingEngine(m, params, max_batch=1, max_len=256,
+                            prefill_len=8, temperature=1.0,
+                            draft_model=m, draft_params=garbage,
+                            spec_k=4, spec_adaptive=False, seed=5)
+        eng.add_request([5, 9, 2, 7])
+        for _ in range(10):
+            assert eng.spec_plan_k() == 4
+            eng.spec_step()
+
+    def test_budget_cap_floors_onto_shape_set(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=1, max_len=128,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=4)
+        eng.add_request([5, 9, 2, 7])
+        # cap is in emitted tokens: k <= cap - 1, floored to the set
+        assert eng.spec_plan_k(budget_cap=1) == 0
+        assert eng.spec_plan_k(budget_cap=2) == 1
+        assert eng.spec_plan_k(budget_cap=4) == 2
+        assert eng.spec_plan_k(budget_cap=5) == 4
+        assert eng.spec_plan_k(budget_cap=100) == 4
+
+    def test_k_shrinks_near_cache_end_and_drains(self, model):
+        """The cache-end clamp composes with the shape set: a slot
+        near max_len still drains through spec rounds alone, on the
+        plain greedy chain."""
+        m, params = model
+        prompt = list(range(1, 11))
+        plain = ServingEngine(m, params, max_batch=1, max_len=16,
+                              prefill_len=8)
+        plain.add_request(prompt)
+        ref = [plain.slots[0].generated[0]]
+        while plain.slots:
+            ref.extend(plain.step().values())
+        spec = ServingEngine(m, params, max_batch=1, max_len=16,
+                             prefill_len=8, draft_model=m,
+                             draft_params=params, spec_k=8)
+        spec.add_request(prompt)
+        got = [spec.slots[0].generated[0]]
+        for _ in range(32):
+            if not spec.slots:
+                break
+            for seq in spec.spec_step().values():
+                got.extend(seq)
+        assert not spec.slots
+        assert spec.finished[-1].finished_reason == "max_len"
+        assert got == ref
+
+
+class TestOverlappedSpecRounds:
+    def test_split_form_matches_unsplit(self, model):
+        m, params = model
+        one = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        r1 = one.add_request([5, 9, 2, 7])
+        want = []
+        for _ in range(3):
+            want.extend(one.spec_step().get(r1, []))
+        two = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        r2 = two.add_request([5, 9, 2, 7])
+        got = []
+        for _ in range(3):
+            assert two.spec_step_start()
+            got.extend(two.spec_step_finish().get(r2, []))
+        assert got == want
+
+    def test_drain_pending_lands_inflight_round(self, model):
+        """A mutating entry point between start and finish must land
+        the in-flight round first — engine state can never be touched
+        with a dispatched round's tokens unread."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        eng.add_request([5, 9, 2, 7])
+        eng.spec_step_start()
+        assert eng._pending_spec is not None
+        eng.add_request([11, 4])          # drains the pending round
+        assert eng._pending_spec is None
+        req = next(iter(eng.slots.values()))
+        assert len(req.generated) >= 2    # round's tokens landed
+
+    def test_empty_batch_start_is_noop(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        assert eng.spec_step_start() is False
+        assert eng.spec_step_finish() == {}
+
+
+class TestTokenIdentityUnderSpec:
+    def test_preempt_resume_token_identity(self, model):
+        """Park + resume mid-spec must keep the chain on the exact
+        greedy oracle — the draft stripe round-trips beside the
+        target's."""
+        m, params = model
+        solo = ServingEngine(m, params, max_batch=1, max_len=64,
+                             prefill_len=8)
+        [want] = solo.generate([[5, 9, 2, 7]], max_new_tokens=14)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        eng.add_request([5, 9, 2, 7])
+        eng.spec_step()
+        slot = next(iter(eng.slots))
+        rid = eng.preempt_slot(slot)
+        # a foreign request churns the cache while ours is parked
+        eng.add_request([11, 4])
+        eng.spec_step()
+        eng.resume_request(rid)
+        for _ in range(4):
+            eng.spec_step()
+        req = next(
+            r for r in eng.slots.values() if r.request_id == rid
+        )
+        n = min(len(req.generated), 14)
+        assert req.generated[:n] == want.tokens[:n]
+
+    def test_preempt_resume_sampled_keeps_serving(self, model):
+        """At temperature > 0 the rng stream shifts with round
+        structure (no bit-oracle exists), but parked draft stripes
+        must still restore a position-exact cache: the resumed chain
+        keeps decoding with 1:1 logprobs and clean counters."""
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, temperature=0.8,
+                            draft_model=m, draft_params=params,
+                            spec_k=3, seed=13)
+        eng.add_request([5, 9, 2, 7])
+        eng.spec_step()
+        rid = eng.preempt_slot(next(iter(eng.slots)))
+        eng.spec_step()
+        eng.resume_request(rid)
+        for _ in range(3):
+            eng.spec_step()
+        req = next(
+            r for r in eng.slots.values() if r.request_id == rid
+        )
+        assert len(req.logprobs) == len(req.generated)
+        assert all(np.isfinite(x) for x in req.logprobs)
+
+    def test_radix_hit_token_identity_under_spec(self, model):
+        """An organic radix hit (a completed prompt re-used by a
+        longer one) must leave the spec chain byte-equal to a cold
+        spec engine — target AND draft stripes write back."""
+        m, params = model
+        shared = list(range(1, 17))
+        prompt = shared + [40, 41]
+
+        def run(eng):
+            rid = eng.add_request(list(prompt))
+            got = []
+            for _ in range(4):
+                got.extend(eng.spec_step().get(rid, []))
+            return got
+
+        cold = ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=8, draft_model=m,
+                             draft_params=params, spec_k=3)
+        want = run(cold)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        # teach the cache organically: run the shared head to finish
+        r0 = eng.add_request(list(shared))
+        slot = next(iter(eng.slots))
+        eng.finish_slot(slot, n_keep=1)
+        assert eng.prefix_inserted >= 1
+        got = run(eng)
+        assert eng.prefix_hits == 1
+        assert got == want
+
+
+class TestFollowerConvergence:
+    def test_sampled_spec_accepted_counts_converge(self, model):
+        """The RNG-stream discipline end to end: a follower replaying
+        the op stream (with the driver's planned k pinned into each
+        op) must land identical accepted counts, chains, and
+        adaptive-EMA state at temperature > 0."""
+        from conftest import free_port
+        from instaslice_tpu.serving.distributed import (
+            DistributedEngine,
+            run_follower,
+        )
+
+        m, params = model
+        draft_params = m.init(jax.random.key(55))
+
+        def mk():
+            return ServingEngine(m, params, max_batch=2, max_len=64,
+                                 prefill_len=8, temperature=0.7,
+                                 draft_model=m,
+                                 draft_params=draft_params,
+                                 spec_k=4, seed=9)
+
+        driver_eng, follower_eng = mk(), mk()
+        port = free_port()
+        t = threading.Thread(
+            target=run_follower,
+            args=(follower_eng, "127.0.0.1", port), daemon=True,
+        )
+        t.start()
+        deng = DistributedEngine(driver_eng, n_followers=1, port=port)
+        deng.add_request([5, 9, 2, 7])
+        deng.add_request([11, 4])
+        for _ in range(3):
+            deng.spec_step()
+        # the overlap split broadcasts at START like decode_block
+        deng.spec_step_start()
+        deng.spec_step_finish()
+        deng.shutdown()
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert follower_eng.spec_rounds == driver_eng.spec_rounds == 4
+        assert follower_eng.spec_accepted == driver_eng.spec_accepted
+        assert follower_eng.spec_proposed == driver_eng.spec_proposed
+        assert (follower_eng.spec_accept_ema
+                == driver_eng.spec_accept_ema)
+        assert set(follower_eng.slots) == set(driver_eng.slots)
+        for s in driver_eng.slots:
+            assert (follower_eng.slots[s].generated
+                    == driver_eng.slots[s].generated)
+
+
+class TestCompileBudgetAdaptiveK:
+    def test_adaptive_sweep_stays_within_budget(self, model):
+        """The adaptive-k shape set exercised for real — a
+        low-acceptance sampled workload walks the whole ladder, then
+        the same engine flips to greedy (temperature is mutable) — and
+        the compiled draft/verify programs stay inside
+        compile_budget()'s documented bound."""
+        m, params = model
+        # a uniform-logits draft (zeroed final norm) against the
+        # sharp copy-machine target: acceptance ~ 1/vocab — the tied
+        # embedding makes any permuted/scaled draft cancel back to
+        # agreement, so "garbage" must break the OUTPUT head
+        garbage = dict(params, ln_f={
+            "scale": jnp.zeros_like(params["ln_f"]["scale"])
+        })
+        eng = ServingEngine(m, params, max_batch=2, max_len=256,
+                            prefill_len=8, temperature=1.0,
+                            draft_model=m, draft_params=garbage,
+                            spec_k=4, seed=5)
+        eng.warm_spec_programs()
+        eng.add_request([5, 9, 2, 7])
+        for _ in range(30):
+            if not eng.slots:
+                eng.add_request([5, 9, 2, 7])
+            eng.spec_step()
+        assert eng._spec_idx == 0          # the ladder really walked
+        # greedy variants of the same shape set (mutable temperature)
+        eng.temperature = 0.0
+        for _ in range(4):
+            if not eng.slots:
+                eng.add_request([9, 3, 1])
+            eng.spec_step()
+        budget = eng.compile_budget(block_cap=16)
+        got = eng.compiled_programs()
+        over = {k: (got[k], budget.get(k, 0)) for k in got
+                if got[k] > budget.get(k, 0)}
+        assert not over, (
+            f"compiled programs exceed the documented bound: {over} "
+            f"(all: {got} vs budget {budget})"
+        )
+        assert budget["spec_draft"] == 2 * len(eng._spec_kset)
+
+    def test_warm_compiles_the_full_current_variant_set(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=128,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=4)
+        eng.warm_spec_programs()
+        c0 = eng.compiled_programs()
+        assert c0["spec_draft"] == len(eng._spec_kset)
+        assert c0["spec_verify"] == len(eng._spec_kset)
+        eng.add_request([5, 9, 2, 7])
+        for _ in range(6):
+            eng.spec_step()
+        c1 = eng.compiled_programs()
+        # traffic added NOTHING: every dispatched shape was pre-warmed
+        assert c1["spec_draft"] == c0["spec_draft"]
+        assert c1["spec_verify"] == c0["spec_verify"]
+
+    def test_warm_refuses_live_slots(self, model):
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=2)
+        eng.add_request([1, 2, 3])
+        with pytest.raises(RuntimeError, match="before any admission"):
+            eng.warm_spec_programs()
+
+
+class TestServingPlaneIntegration:
+    def test_stats_spec_block_and_metric_export(self, model):
+        import json
+        import urllib.request
+
+        from instaslice_tpu.metrics.metrics import ServingMetrics
+        from instaslice_tpu.serving.api_server import ApiServer
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3)
+        eng.warm_prefill_buckets()
+        eng.warm_spec_programs()
+        metrics = ServingMetrics()
+        with ApiServer(eng, block_size=8, metrics=metrics) as srv:
+            req = urllib.request.Request(
+                srv.url + "/v1/completions",
+                data=json.dumps({"prompt": [9, 3, 1],
+                                 "max_tokens": 8}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+            assert len(out["choices"][0]["token_ids"]) == 8
+            with urllib.request.urlopen(srv.url + "/v1/stats",
+                                        timeout=10) as r:
+                stats = json.loads(r.read())
+        spec = stats["spec"]
+        assert spec["enabled"] and spec["rounds"] >= 1
+        assert spec["k_set"] == [0, 1, 2, 3]
+        assert spec["proposed"] >= spec["accepted"] > 0
+        assert 0.0 <= spec["acceptance_ema"] <= 1.0
+        # delta export really ran (counters are cumulative; the
+        # scheduler snapshots like the prefix counters)
+        assert srv.scheduler._spec_exported["rounds"] == spec["rounds"]
+        if metrics.registry is not None:
+            from prometheus_client import generate_latest
+
+            text = generate_latest(metrics.registry).decode()
+            for name in ("tpuslice_serve_spec_rounds_total",
+                         "tpuslice_serve_spec_proposed_total",
+                         "tpuslice_serve_spec_accepted_total",
+                         "tpuslice_serve_spec_acceptance_rate"):
+                assert name in text
+
+    def test_sampled_http_completion_over_spec_engine(self, model):
+        """The removed temperature guard end to end: a sampled spec
+        engine behind the real server delivers budget-exact tokens
+        with 1:1 logprobs."""
+        import json
+        import urllib.request
+
+        from instaslice_tpu.serving.api_server import ApiServer
+
+        m, params = model
+        eng = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, temperature=0.8,
+                            draft_model=m, draft_params=params,
+                            spec_k=3, seed=2)
+        with ApiServer(eng, block_size=8) as srv:
+            req = urllib.request.Request(
+                srv.url + "/v1/completions",
+                data=json.dumps({"prompt": [5, 9, 2, 7],
+                                 "max_tokens": 9,
+                                 "logprobs": True}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                out = json.loads(r.read())
+        choice = out["choices"][0]
+        assert len(choice["token_ids"]) == 9
+        assert len(choice["logprobs"]) == 9
+
+    def test_burst_admission_with_draft_matches_sequential(self, model):
+        """Batched prefill now covers draft engines: a burst must be
+        token-identical to sequential admission (target AND draft
+        caches), spec rounds included."""
+        from instaslice_tpu.serving.engine import AdmissionRequest
+
+        m, params = model
+        prompts = [[5, 9, 2, 7], list(range(1, 12)), [6, 6, 1]]
+        seq = ServingEngine(m, params, max_batch=4, max_len=64,
+                            prefill_len=8, draft_model=m,
+                            draft_params=params, spec_k=3,
+                            batched_prefill=False)
+        for p in prompts:
+            seq.add_request(list(p))
+        for _ in range(3):
+            seq.spec_step()
+        burst = ServingEngine(m, params, max_batch=4, max_len=64,
+                              prefill_len=8, draft_model=m,
+                              draft_params=params, spec_k=3)
+        burst.add_requests([
+            AdmissionRequest(list(p)) for p in prompts
+        ])
+        assert burst.prefill_batches >= 1   # the batched program ran
+        for _ in range(3):
+            burst.spec_step()
+        for (s_slot, s_req), (b_slot, b_req) in zip(
+            sorted(seq.slots.items()), sorted(burst.slots.items())
+        ):
+            assert s_slot == b_slot
+            assert s_req.generated == b_req.generated
+
+    def test_cli_flags_build_spec_engine(self):
+        from instaslice_tpu.serving.api_server import (
+            build_engine,
+            build_parser,
+        )
+
+        argv = ["--vocab-size", "64", "--d-model", "16", "--n-heads",
+                "2", "--n-layers", "2", "--d-ff", "32", "--max-len",
+                "64", "--prefill-len", "8", "--max-batch", "2",
+                "--draft-n-layers", "1", "--spec-k", "3"]
+        args = build_parser().parse_args(argv)
+        eng = build_engine(args)
+        assert eng.draft_model is not None
+        assert eng.spec_k == 3
+        assert eng.draft_model.cfg.n_layers == 1
+        # the shape set compiled at startup (warm_spec_programs wired
+        # next to warm_prefill_buckets)
+        assert eng.compiled_programs()["spec_draft"] == \
+            len(eng._spec_kset)
+        args2 = build_parser().parse_args(argv + ["--no-spec"])
+        eng2 = build_engine(args2)
+        assert eng2.draft_model is None
+
+    def test_spec_k_env_default(self, monkeypatch):
+        from instaslice_tpu.serving.api_server import build_parser
+
+        monkeypatch.setenv("TPUSLICE_SPEC_K", "6")
+        args = build_parser().parse_args([])
+        assert args.spec_k == 6
