@@ -40,7 +40,7 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke floors
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke + bench-router-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
@@ -50,6 +50,7 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 	$(MAKE) bench-engine-smoke
 	$(MAKE) bench-prefix-smoke
 	$(MAKE) bench-spec-smoke
+	$(MAKE) bench-router-smoke
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
@@ -94,6 +95,14 @@ bench-spec-smoke:  ## <60 s speculative-decoding run of both arms at temperature
 .PHONY: bench-spec
 bench-spec:  ## Full speculative-decoding tier: spec arm (rejection sampling + adaptive k + overlapped rounds) vs the no-spec baseline at temperature 0 AND >0, best-of-4 interleaved (tok/s AND TTFT p95 must both win at both temperatures) — records BENCH_SPEC_r12.json (docs/SERVING.md)
 	JAX_PLATFORMS=cpu $(PY) bench.py --spec
+
+.PHONY: bench-router-smoke
+bench-router-smoke:  ## <60 s 2-replica fleet gate: router aggregate tok/s >= TPUSLICE_ROUTER_FLOOR (0.5, a meltdown floor; the deterministic gates are prefix routing firing, the migration probe, and clean ledgers — the recorded tier gates the capacity win) x the single replica on the identical recorded->replayed stream, one live KV session migration token-identical, zero hung, ledgers reconcile on both replicas
+	JAX_PLATFORMS=cpu $(PY) bench.py --router-smoke
+
+.PHONY: bench-router
+bench-router:  ## Full fleet-router tier: 3-replica router vs best single replica on the identical recorded->replayed stream (fleet wins tok/s by TPUSLICE_ROUTER_RECORD_FLOOR with TTFT p95 no worse; the one-core CI box measures the prefix-capacity mechanism — see docs/SERVING.md) + churn arm (replica kill/re-add mid-run, migrated sessions oracle-exact, ledgers clean) — records BENCH_ROUTER_r13.json
+	JAX_PLATFORMS=cpu $(PY) bench.py --router
 
 .PHONY: bench-scale
 bench-scale:  ## Fleet-scale control-plane bench: 1k nodes / 2k pending pods, grants/sec + gate→ungate p95/p99, with the serial re-list baseline ratio (docs/SCALING.md)

@@ -1226,6 +1226,500 @@ def smoke_spec(floor: float = None) -> int:
     return 1 if failures else 0
 
 
+#: the fleet-router tier's workload (docs/SERVING.md "Fleet router &
+#: session migration"): prefill-heavy shared-prefix traffic whose
+#: prefix WORKING SET overflows one replica's KV pool but fits the
+#: fleet's aggregate — the honest single-box shape of the fleet claim.
+#: On this one-core CI box N engines CANNOT multiply raw compute (the
+#: arms share one core and one GIL, so the near-linear tok/s multiplier
+#: the router delivers on real hardware — where each replica owns its
+#: slice's chips — is structurally unmeasurable here); what the fleet
+#: DOES multiply on one core is KV capacity: prefix-affine routing
+#: partitions the working set so each replica's share stays resident,
+#: while the single replica thrashes its radix cache and re-pays the
+#: 480-token prefill — REAL compute the fleet skips, visible as the
+#: measured hit-rate gap (~75-80% vs ~40%) and the tok/s ratio.
+ROUTER_WORKLOAD = dict(
+    concurrency=12, prompt_len=16, max_tokens=4, jitter=0.0,
+    prefix_pool="24:480",
+)
+#: per-replica engine shape: pool = 6 slots x 896/16 = 336 blocks =
+#: 5376 tokens. The 24 x 480-token prefix pool (11520 tokens) overflows
+#: one replica's cache headroom severalfold but partitions to ~8
+#: prefixes (3840 tokens) per replica of a 3-fleet — which fit.
+ROUTER_ENGINE = dict(max_batch=6, max_len=896, prefill_len=32,
+                     kv_block_size=16)
+
+
+def _router_model(d_model: int = 64):
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+
+    cfg = ModelConfig(
+        vocab_size=128, d_model=d_model, n_heads=4, n_layers=2,
+        d_ff=4 * d_model, dtype=jnp.float32, remat=False,
+    )
+    model = TpuLM(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+def _router_replica(model, params, engine_opts=None):
+    """One live replica: fresh engine (fresh radix cache — cache state
+    IS the experiment), prefill buckets pre-compiled."""
+    from instaslice_tpu.serving import ServingEngine
+    from instaslice_tpu.serving.api_server import ApiServer
+
+    opts = dict(ROUTER_ENGINE)
+    opts.update(engine_opts or {})
+    eng = ServingEngine(model, params, **opts)
+    eng.warm_prefill_buckets()
+    return ApiServer(eng, block_size=16, request_timeout=180).start()
+
+
+def _replica_ledger_ok(srv) -> bool:
+    """Post-quiesce invariants on one replica: nothing live/parked, no
+    orphaned imports, every used pool block the radix tree's, zero
+    leaked path locks."""
+    eng = srv.scheduler.engine
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and (eng.slots or eng.parked):
+        time.sleep(0.02)
+    return (
+        not eng.slots and not eng.parked
+        and not srv.scheduler._imports
+        and eng.kv.used_blocks() == eng.radix.pool_blocks()
+        and not eng._radix_locks
+    )
+
+
+def _stream_probe(url: str, prompt, max_tokens: int, result: dict):
+    """One long streaming completion whose tokens are collected for
+    oracle comparison — the churn arm's migrated-session witness."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps({"prompt": list(prompt),
+                         "max_tokens": max_tokens,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    toks = []
+    try:
+        with urllib.request.urlopen(req, timeout=180) as resp:
+            buf = b""
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    result["error"] = "stream ended without [DONE]"
+                    return
+                buf += chunk
+                while b"\n\n" in buf:
+                    ev, buf = buf.split(b"\n\n", 1)
+                    line = ev.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    data = line[len("data: "):]
+                    if data == "[DONE]":
+                        result["tokens"] = toks
+                        return
+                    payload = json.loads(data)
+                    if "error" in payload:
+                        result["error"] = payload["error"]
+                        return
+                    for c in payload.get("choices", []):
+                        toks.extend(c.get("token_ids") or [])
+    except Exception as e:  # slicelint: disable=broad-except
+        # the probe must ACCOUNT for any failure; the churn gate reads
+        # result["error"] — a silent probe death would pass as hung
+        result["error"] = f"{type(e).__name__}: {e}"
+
+
+def _oracle_chains(model, params, engine_opts, prompts, n):
+    """Uninterrupted-run oracles for the migration probes: a FRESH
+    engine decodes every probe prompt to ``n`` tokens with no churn,
+    no migration, no preemption — the chain a migrated session must
+    reproduce byte-for-byte. (Engine-vs-model.apply token identity is
+    pinned by the test suite; an unjitted apply loop here would cost
+    ~1 s/token on CPU and blow the smoke budget.)"""
+    from instaslice_tpu.serving import ServingEngine
+
+    opts = dict(ROUTER_ENGINE)
+    opts.update(engine_opts or {})
+    eng = ServingEngine(model, params, **opts)
+    rids = [eng.add_request(list(p)) for p in prompts]
+    # add_request sampled token 1; n-1 more steps completes n
+    eng.decode_block(n - 1)
+    by_rid = {r.request_id: list(r.generated)
+              for r in eng.slots.values()}
+    return [by_rid[rid][:n] for rid in rids]
+
+
+def bench_router(replicas: int = 3, requests: int = 48,
+                 seed: int = 13, workload: dict = None,
+                 engine_opts: dict = None, d_model: int = 192,
+                 record_trace: str = "", replay_trace: str = "",
+                 warm_requests: int = 20,
+                 migration_probe: bool = False) -> dict:
+    """One fleet-tier arm: ``replicas`` engine replicas behind the
+    prefix/SLO-aware router (``replicas=1`` = the best-single-replica
+    baseline, loadgen pointed DIRECTLY at the server — no router hop,
+    the tougher comparison). Both arms run an unmeasured warm burst
+    first (compiles + steady-state radix caches — cache tiers are
+    judged warm), then the measured window; record/replay a loadgen
+    trace so every arm sees the IDENTICAL request stream (the
+    record/replay satellite doing its job)."""
+    from instaslice_tpu.serving.loadgen import run as loadgen_run
+    from instaslice_tpu.serving.router import Router
+
+    workload = dict(workload or ROUTER_WORKLOAD)
+    model, params = _router_model(d_model)
+    servers = [_router_replica(model, params, engine_opts)
+               for _ in range(replicas)]
+    router = None
+    try:
+        if replicas > 1:
+            router = Router([s.url for s in servers],
+                            poll_interval=0.1).start()
+            url = router.url
+        else:
+            url = servers[0].url
+        # unmeasured warm burst: jit compiles + the radix steady state
+        # (the fleet arm's warm traffic also seeds the router's shadow
+        # prefix index through the poll loop)
+        # SAME seed as the measured run: the warm burst must warm the
+        # measured run's prefix pool (trace-id reuse is already
+        # impossible — loadgen salts ids with a per-run nonce)
+        loadgen_run(
+            url, requests=warm_requests, vocab=128,
+            stream=True, timeout=180, seed=seed,
+            **dict(workload, concurrency=6),
+        )
+        if router is not None:
+            router.poll_now()      # adopt the warmed digests NOW
+        warm = [s.scheduler.stats() for s in servers]
+        t0 = time.monotonic()
+        report = loadgen_run(
+            url, requests=requests, vocab=128, stream=True,
+            timeout=180, seed=seed, record_trace=record_trace,
+            replay_trace=replay_trace, **workload,
+        )
+        wall = time.monotonic() - t0
+        probe_block = {}
+        if migration_probe and router is not None:
+            # one live migration through the running fleet: a long
+            # streaming probe, exported off its replica mid-decode,
+            # must finish token-identical to the uninterrupted oracle
+            import urllib.request
+
+            probe: dict = {}
+            pt = threading.Thread(
+                target=_stream_probe,
+                args=(router.url, [3, 1, 4, 1, 5], 64, probe),
+                daemon=True,
+            )
+            pt.start()
+            victim = None
+            deadline = time.monotonic() + 10
+            while victim is None and time.monotonic() < deadline:
+                for s in servers:
+                    if s.scheduler.stats()["live_slots"]:
+                        victim = s
+                        break
+                time.sleep(0.01)
+            if victim is not None:
+                req = urllib.request.Request(
+                    victim.url + "/v1/sessions/export", data=b"{}",
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    json.loads(r.read())
+            pt.join(timeout=120)
+            [want] = _oracle_chains(model, params, engine_opts,
+                                    [[3, 1, 4, 1, 5]], 64)
+            probe_block = {
+                "probe_ok": probe.get("tokens") == want
+                and "error" not in probe,
+                "probe_error": probe.get("error"),
+                "probe_migrated":
+                    router.stats()["migrations"].get("resumed", 0),
+            }
+        ledgers = [_replica_ledger_ok(s) for s in servers]
+        stats = [s.scheduler.stats() for s in servers]
+        hits = sum(s["radix"]["hits"] - w["radix"]["hits"]
+                   for s, w in zip(stats, warm))
+        misses = sum(s["radix"]["misses"] - w["radix"]["misses"]
+                     for s, w in zip(stats, warm))
+        saved = sum(
+            s["radix"]["tokens_saved"] - w["radix"]["tokens_saved"]
+            for s, w in zip(stats, warm)
+        )
+        out = {
+            "arm": f"{replicas}-replica"
+                   + ("-router" if router else "-direct"),
+            "replicas": replicas,
+            "seed": seed,
+            "requests": report["requests"],
+            "ok": report["ok"],
+            "hung": report["outcomes"]["hung"],
+            "errors": report["errors"],
+            "wall_s": round(wall, 2),
+            "client_tokens_per_sec": report["client_tokens_per_sec"],
+            "ttft_p50_s": report["ttft_p50"],
+            "ttft_p95_s": report["ttft_p95"],
+            "client_reused_fraction":
+                report["prefix_pool"]["reused_fraction"],
+            "prefix_hits": hits,
+            "prefix_misses": misses,
+            "prefix_tokens_saved": saved,
+            "ledger_ok": all(ledgers),
+            "trace": report.get("trace", {}),
+        }
+        out.update(probe_block)
+        if router is not None:
+            rstats = router.stats()
+            out["routed"] = rstats["routed"]
+            out["router_requests"] = rstats["requests"]
+            out["migrations"] = rstats["migrations"]
+        return out
+    finally:
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.stop()
+
+
+def bench_router_churn(replicas: int = 3, requests: int = 32,
+                       seed: int = 13, probe_tokens: int = 96,
+                       d_model: int = 192, workload: dict = None,
+                       engine_opts: dict = None) -> dict:
+    """The churn arm: kill (drain-remove, sessions migrating out live)
+    and re-add a replica MID-RUN under load. Two long streaming probe
+    sessions ride the fleet; the one(s) on the removed replica migrate
+    mid-stream and must land token-identical to the uninterrupted
+    greedy oracle. Gates: zero hung, zero probe errors, every probe
+    oracle-exact, ≥1 live migration resumed (not re-prefilled), and
+    clean ledgers on every surviving replica."""
+    from instaslice_tpu.serving.loadgen import run as loadgen_run
+    from instaslice_tpu.serving.router import Router
+
+    workload = dict(workload or ROUTER_WORKLOAD)
+    model, params = _router_model(d_model)
+    servers = [_router_replica(model, params, engine_opts)
+               for _ in range(replicas)]
+    replacement = None
+    router = Router([s.url for s in servers],
+                    poll_interval=0.1).start()
+    try:
+        # warm burst (compiles; also gives the probes peers to land on)
+        loadgen_run(
+            router.url, requests=12, vocab=128,
+            stream=True, timeout=180, seed=seed,
+            **dict(workload, concurrency=6),
+        )
+        router.poll_now()
+        # the probes: long greedy streams whose full token chains we
+        # compare against the uninterrupted-run oracles afterwards
+        probes = [{"prompt": [3, 1, 4, 1, 5], "result": {}},
+                  {"prompt": [2, 7, 1, 8], "result": {}}]
+        threads = []
+        for p in probes:
+            t = threading.Thread(
+                target=_stream_probe,
+                args=(router.url, p["prompt"], probe_tokens,
+                      p["result"]),
+                daemon=True,
+            )
+            t.start()
+            threads.append(t)
+        # wait until at least one probe holds a live slot somewhere
+        victim = None
+        deadline = time.monotonic() + 10
+        while victim is None and time.monotonic() < deadline:
+            for s in servers:
+                if s.scheduler.stats()["live_slots"]:
+                    victim = s
+                    break
+            time.sleep(0.01)
+        if victim is None:
+            raise RuntimeError("no probe ever went live")
+        # background load DURING the churn
+        lg: dict = {}
+
+        def load():
+            lg.update(loadgen_run(
+                router.url, requests=requests,
+                vocab=128, stream=True, timeout=180, seed=seed,
+                **dict(workload, concurrency=8),
+            ))
+
+        lt = threading.Thread(target=load, daemon=True)
+        lt.start()
+        time.sleep(0.2)     # churn lands mid-run, not at its edge
+        removed = router.remove_replica(victim.url)   # drain+migrate
+        victim.stop()                                 # actually kill it
+        # ...and re-add capacity: a FRESH replica (cold cache) joins
+        replacement = _router_replica(model, params, engine_opts)
+        router.add_replica(replacement.url)
+        lt.join(timeout=180)
+        for t in threads:
+            t.join(timeout=180)
+        survivors = [s for s in servers if s is not victim]
+        if replacement is not None:
+            survivors.append(replacement)
+        probe_results = []
+        probes_ok = True
+        oracles = _oracle_chains(model, params, engine_opts,
+                                 [p["prompt"] for p in probes],
+                                 probe_tokens)
+        for p, want in zip(probes, oracles):
+            got = p["result"].get("tokens")
+            ok = got == want
+            probes_ok = probes_ok and ok and (
+                "error" not in p["result"]
+            )
+            probe_results.append({
+                "prompt": p["prompt"],
+                "tokens": len(got or []),
+                "oracle_exact": ok,
+                "error": p["result"].get("error"),
+            })
+        rstats = router.stats()
+        return {
+            "arm": "churn",
+            "seed": seed,
+            "requests": lg.get("requests", 0),
+            "ok": lg.get("ok", 0),
+            "hung": lg.get("outcomes", {}).get("hung", 1),
+            "errors": lg.get("errors", 0),
+            "client_tokens_per_sec": lg.get("client_tokens_per_sec"),
+            "removed": removed,
+            "replaced": replacement.url,
+            "probes": probe_results,
+            "probes_ok": probes_ok,
+            "migrations": rstats["migrations"],
+            "migrated_resumed": rstats["migrations"].get("resumed", 0),
+            "migrated_fallback": rstats["migrations"].get(
+                "fallback", 0),
+            "ledger_ok": all(_replica_ledger_ok(s) for s in survivors),
+            "surviving_replicas": len(router.replicas()),
+        }
+    finally:
+        router.stop()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:  # slicelint: disable=broad-except
+                pass           # the victim is already stopped
+        if replacement is not None:
+            replacement.stop()
+
+
+def smoke_router(floor: float = None) -> int:
+    """``make bench-router-smoke``: a <60 s 2-replica fleet run gating
+    the fast tier — asserts aggregate tok/s ≥ ``TPUSLICE_ROUTER_FLOOR``
+    (default 0.5 — a MELTDOWN floor only: all arms time-share this
+    box's single core and one GIL, 2-replica fleets hit process-wide
+    GIL convoys that halve short windows arm-wide, and the single
+    arm's working-set overflow is structurally ≤ 2x at 2 replicas —
+    so the REGRESSION burden rides the deterministic gates instead:
+    prefix-affine routing actually firing (a broken shadow index reads
+    ~0-3 prefix routes), one live migration completing
+    token-identically, zero hung requests, and ledgers reconciling on
+    both replicas; the recorded ``--router`` tier gates the strict
+    capacity win at 3 replicas) × the single-replica baseline on the
+    IDENTICAL (recorded→replayed) request stream."""
+    import tempfile
+
+    if floor is None:
+        floor = float(os.environ.get("TPUSLICE_ROUTER_FLOOR", "0.5"))
+    reqs = int(os.environ.get("TPUSLICE_ROUTER_SMOKE_REQS", "24"))
+    # shrunken shapes: the same overflow-one-fit-two capacity story at
+    # smoke scale (2-replica fleet: per-replica ~10 x 320 = 200 blocks
+    # of a 252-block pool; one replica: 20 x 320 overflows ~2x)
+    workload = dict(ROUTER_WORKLOAD, prefix_pool="20:320")
+    engine = dict(ROUTER_ENGINE, max_len=672)
+    dm = int(os.environ.get("TPUSLICE_ROUTER_SMOKE_DMODEL", "128"))
+    # throwaway process-warming run (see smoke_engine): thread pools,
+    # sockets, allocator — the first serving run in a process is slow
+    # for reasons neither arm owns, and the fleet arm runs first
+    bench_router(replicas=1, requests=6, workload=workload,
+                 engine_opts=engine, warm_requests=4, d_model=dm)
+    reps = max(1, int(os.environ.get(
+        "TPUSLICE_ROUTER_SMOKE_REPEATS", "2")))
+    fleets, singles = [], []
+    with tempfile.NamedTemporaryFile(suffix=".jsonl") as f:
+        # the one live migration rides the first fleet rep
+        # (migration_probe) — the full kill/re-add churn arm is the
+        # recorded tier's, a smoke must fit the <60 s budget. Best-of
+        # per arm, interleaved: single ~5 s windows on the shared-core
+        # CI box swing ±40% on OS noise alone (engine-smoke precedent)
+        fleets.append(bench_router(
+            replicas=2, requests=reqs, workload=workload,
+            engine_opts=engine, record_trace=f.name,
+            warm_requests=12, d_model=dm, migration_probe=True))
+        singles.append(bench_router(
+            replicas=1, requests=reqs, workload=workload,
+            engine_opts=engine, replay_trace=f.name,
+            warm_requests=12, d_model=dm))
+        for _ in range(reps - 1):
+            fleets.append(bench_router(
+                replicas=2, requests=reqs, workload=workload,
+                engine_opts=engine, replay_trace=f.name,
+                warm_requests=12, d_model=dm))
+            singles.append(bench_router(
+                replicas=1, requests=reqs, workload=workload,
+                engine_opts=engine, replay_trace=f.name,
+                warm_requests=12, d_model=dm))
+    probe_rep = fleets[0]
+    fleet = max(fleets, key=lambda r: r["client_tokens_per_sec"])
+    single = max(singles, key=lambda r: r["client_tokens_per_sec"])
+    print(json.dumps({"fleet": fleet, "single": single,
+                      "probe_rep": probe_rep,
+                      "tokens_per_sec_runs": {
+                          "fleet": [r["client_tokens_per_sec"]
+                                    for r in fleets],
+                          "single": [r["client_tokens_per_sec"]
+                                     for r in singles],
+                      }}))
+    failures = []
+    for arm in (fleet, single, probe_rep):
+        if arm["hung"]:
+            failures.append(f"{arm['arm']}: {arm['hung']} hung")
+        if arm["errors"]:
+            failures.append(
+                f"{arm['arm']}: {arm['errors']} loadgen error(s)")
+        if not arm["ledger_ok"]:
+            failures.append(f"{arm['arm']}: ledger did not reconcile")
+    if fleet["client_tokens_per_sec"] < floor * single[
+            "client_tokens_per_sec"]:
+        failures.append(
+            f"fleet {fleet['client_tokens_per_sec']} tok/s under "
+            f"{floor}x the single replica "
+            f"{single['client_tokens_per_sec']}"
+        )
+    # the DETERMINISTIC wiring gate: prefix-affine routing must
+    # actually fire (the broken-shadow-index failure mode measured
+    # ~0-3 prefix routes and still cleared a pure tok/s floor)
+    if fleet.get("routed", {}).get("prefix", 0) < 5:
+        failures.append(
+            "prefix-affine routing barely fired "
+            f"({fleet.get('routed')}) — shadow index broken?"
+        )
+    if not probe_rep.get("probe_ok"):
+        failures.append(
+            "migration probe not token-identical: "
+            f"{probe_rep.get('probe_error')}"
+        )
+    if probe_rep.get("probe_migrated", 0) < 1:
+        failures.append("no session completed a live migration "
+                        "(resume path never ran)")
+    for f in failures:
+        print(f"bench-router-smoke FAIL: {f}", file=sys.stderr)
+    return 1 if failures else 0
+
+
 def _run_tpu_phase(phase: str, timeout: float, env: dict,
                    pass_fds=()) -> dict:
     """One phase in its own subprocess; returns its JSON fragment or a
@@ -1811,6 +2305,27 @@ def main(argv=None) -> int:
                     default=int(os.environ.get(
                         "TPUSLICE_PREFIX_SEED", "11")),
                     help="prefix tier: loadgen scenario seed")
+    ap.add_argument("--router", action="store_true",
+                    help="full fleet-router tier: loadgen at a "
+                         "3-replica router vs the best single replica "
+                         "on the identical recorded→replayed stream "
+                         "(fleet must win tok/s by "
+                         "TPUSLICE_ROUTER_RECORD_FLOOR with TTFT p95 "
+                         "no worse — the single-core CI box measures "
+                         "the prefix-capacity mechanism, not the "
+                         "hardware replica multiplier) plus the churn "
+                         "arm (replica kill + re-add mid-run, "
+                         "migrated sessions oracle-exact, ledgers "
+                         "clean) — records BENCH_ROUTER_r13.json")
+    ap.add_argument("--router-smoke", action="store_true",
+                    help="<60 s 2-replica fleet gate for make test "
+                         "(aggregate >= TPUSLICE_ROUTER_FLOOR x "
+                         "single, one live migration token-identical, "
+                         "zero hung, ledgers reconcile)")
+    ap.add_argument("--router-seed", type=int,
+                    default=int(os.environ.get(
+                        "TPUSLICE_ROUTER_SEED", "13")),
+                    help="router tier: loadgen scenario seed")
     ap.add_argument("--interval", type=float, default=900.0,
                     help="watchdog: seconds between probes (default 900)")
     ap.add_argument("--max-hours", type=float, default=11.0,
@@ -1855,6 +2370,110 @@ def main(argv=None) -> int:
         return smoke_prefix(floor=args.prefix_floor)
     if args.spec_smoke:
         return smoke_spec(floor=args.spec_floor)
+    if args.router_smoke:
+        return smoke_router()
+    if args.router:
+        import tempfile
+
+        result = {
+            "metric": "router_tokens_per_sec",
+            "unit": "tokens/s",
+        }
+        # best-of-N per arm on the IDENTICAL request stream: the first
+        # fleet run RECORDS the loadgen trace (closed-loop arrivals at
+        # the fleet's own pace), every later run — fleet and single —
+        # REPLAYS it, so the comparison is one stream against two
+        # topologies, not two draws from one distribution. The single
+        # replica gets the same offered arrival times; what it cannot
+        # absorb it queues, which is exactly what "adding a slice adds
+        # zero capacity" looks like from the client.
+        reps = max(1, int(os.environ.get(
+            "TPUSLICE_ROUTER_REPEATS", "2")))
+        # throwaway process-warming run (see smoke_engine)
+        bench_router(replicas=1, requests=6, warm_requests=4,
+                     seed=args.router_seed)
+        fleets, singles = [], []
+        with tempfile.NamedTemporaryFile(suffix=".jsonl") as f:
+            fleets.append(bench_router(
+                replicas=3, seed=args.router_seed,
+                record_trace=f.name,
+            ))
+            for _ in range(reps - 1):
+                fleets.append(bench_router(
+                    replicas=3, seed=args.router_seed,
+                    replay_trace=f.name,
+                ))
+            for _ in range(reps):
+                singles.append(bench_router(
+                    replicas=1, seed=args.router_seed,
+                    replay_trace=f.name,
+                ))
+        fleet = max(fleets, key=lambda r: r["client_tokens_per_sec"])
+        single = max(singles,
+                     key=lambda r: r["client_tokens_per_sec"])
+        churn = bench_router_churn(replicas=3, seed=args.router_seed)
+        result["router_fleet"] = fleet
+        result["single_replica_baseline"] = single
+        result["churn"] = churn
+        result["repeats"] = reps
+        result["tokens_per_sec_runs"] = {
+            "fleet": [r["client_tokens_per_sec"] for r in fleets],
+            "single": [r["client_tokens_per_sec"] for r in singles],
+        }
+        result["value"] = fleet["client_tokens_per_sec"]
+        if single["client_tokens_per_sec"]:
+            result["vs_baseline"] = round(
+                fleet["client_tokens_per_sec"]
+                / single["client_tokens_per_sec"], 2
+            )
+        # headline keys in the shared BENCH_*.json shape (the perf
+        # trajectory tracker scans recorded files for these)
+        result["serve_toks_per_sec"] = fleet["client_tokens_per_sec"]
+        result["serve_ttft_p95"] = fleet["ttft_p95_s"]
+        result["ttft_p95_baseline_s"] = single["ttft_p95_s"]
+        # the fleet's hardware claim is near-linear tok/s with
+        # replica count (>= 2.5x at 3 replicas) — structurally
+        # unmeasurable on this box, where every replica time-shares
+        # ONE core and one GIL with the client and the router (see
+        # ROUTER_WORKLOAD). What IS measurable here is the capacity
+        # mechanism itself: the recorded floor gates the prefix-
+        # working-set win (aggregate KV + prefix-affine routing saving
+        # the single replica's re-prefill compute), and the recorded
+        # JSON carries the hit-rate gap that explains it.
+        record_floor = float(os.environ.get(
+            "TPUSLICE_ROUTER_RECORD_FLOOR", "1.25"))
+        result["record_floor"] = record_floor
+        result["single_core_note"] = (
+            "all replicas time-share one CPU core + one GIL; the "
+            "fleet's tok/s edge here is the prefix-capacity "
+            "mechanism only — on hardware where each replica owns "
+            "its slice, the compute multiplier stacks on top"
+        )
+        print(json.dumps(result))
+        ok = (
+            fleet["hung"] == 0 and single["hung"] == 0
+            and churn["hung"] == 0
+            and fleet["errors"] == 0 and single["errors"] == 0
+            and churn["errors"] == 0
+            and fleet["ledger_ok"] and single["ledger_ok"]
+            and churn["ledger_ok"]
+            # the recorded gate: the fleet must beat the single
+            # replica by the documented floor with TTFT p95 no worse
+            # (1.1x tolerance: best-of-rep selection is by tok/s, and
+            # p95 of a ~50-request window moves ~10% on one stray OS
+            # preemption)
+            and fleet["client_tokens_per_sec"]
+            >= record_floor * single["client_tokens_per_sec"]
+            and fleet["ttft_p95_s"] <= 1.1 * single["ttft_p95_s"]
+            # the fleet must actually be USING its cache edge, not
+            # winning on noise: strictly more prefix hits than the
+            # thrashing single replica
+            and fleet["prefix_hits"] > single["prefix_hits"]
+            # churn: sessions MIGRATED (resume path), oracle-exact
+            and churn["probes_ok"]
+            and churn["migrated_resumed"] >= 1
+        )
+        return 0 if ok else 1
     if args.spec:
         result = {
             "metric": "spec_tokens_per_sec",

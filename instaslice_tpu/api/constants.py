@@ -139,6 +139,13 @@ REASON_PREEMPTED = "RequestPreempted"
 REASON_RESUMED = "RequestResumed"
 REASON_SLO_MISSED = "SLOMissed"
 
+# fleet serving tier (serving/router.py + live KV session migration):
+# a session exported off a replica (drain/rebalance) and the matching
+# import+resume on its destination — both under the request's trace id
+# so one trace shows the whole hop.
+REASON_SESSION_EXPORTED = "SessionExported"
+REASON_SESSION_IMPORTED = "SessionImported"
+
 #: AllocationStatus value → the journal reason its transition records.
 TRANSITION_REASONS = {
     "creating": REASON_SLICE_CREATING,
@@ -163,6 +170,7 @@ EVENT_REASONS = frozenset({
     REASON_BREAKER_OPEN, REASON_BACKOFF, REASON_WATCH_RECONNECT,
     REASON_DRAIN_BEGIN, REASON_DRAIN_END, REASON_SHED, REASON_DRAINED,
     REASON_PREEMPTED, REASON_RESUMED, REASON_SLO_MISSED,
+    REASON_SESSION_EXPORTED, REASON_SESSION_IMPORTED,
 })
 
 # ------------------------------------------------------- labels / leases

@@ -78,6 +78,62 @@ class CircuitOpen(ApiError):
     code = 503
 
 
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a half-open probe —
+    the fail-fast half of this module's resilience story, factored out
+    so the fleet serving router (serving/router.py) breaks per REPLICA
+    with the same semantics the kube transport uses per API server.
+
+    ``threshold`` consecutive :meth:`fail` calls open the circuit for
+    ``cooldown`` seconds; while open, :meth:`check` raises
+    :class:`CircuitOpen`. Past the cooldown one caller becomes the
+    half-open probe (the failure count sits one short of the
+    threshold, so a failed probe re-opens immediately and a
+    successful :meth:`ok` resets). Thread-safe."""
+
+    def __init__(self, threshold: int = 5, cooldown: float = 10.0,
+                 name: str = "") -> None:
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.name = name
+        self._lock = named_lock("kube.breaker")
+        self._failures = 0
+        self._open_until = 0.0
+
+    def check(self) -> None:
+        """Fail fast while open."""
+        with self._lock:
+            remaining = self._open_until - time.monotonic()
+            if remaining > 0:
+                raise CircuitOpen(
+                    f"circuit open for another {remaining:.1f}s "
+                    f"({self.threshold} consecutive failures "
+                    f"against {self.name})"
+                )
+
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._open_until - time.monotonic() > 0
+
+    def fail(self) -> bool:
+        """Record one failure; True exactly when THIS call opened the
+        circuit (callers log/journal outside the lock)."""
+        with self._lock:
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._open_until = time.monotonic() + self.cooldown
+                # leave the count one short of the threshold: a failed
+                # half-open probe re-opens immediately, a success resets
+                self._failures = self.threshold - 1
+                return True
+            return False
+
+    def ok(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._open_until = 0.0
+
+
 def build_client(kubeconfig: str = "") -> "RealKubeClient":
     """Standard client resolution: explicit kubeconfig → in-cluster
     service account → default kubeconfig path."""
@@ -198,10 +254,14 @@ class RealKubeClient(KubeClient):
         #: private-key material; deleted on close() (atexit-registered by
         #: from_kubeconfig)
         self._temp_files: List[str] = []
-        # circuit breaker: shared across this client's threads
-        self._breaker_lock = named_lock("kube.breaker")
-        self._consecutive_failures = 0
-        self._breaker_open_until = 0.0
+        # circuit breaker: shared across this client's threads (the
+        # policy numbers stay client attributes — tests and embedders
+        # tune them post-construction — and sync onto the breaker at
+        # each use)
+        self._breaker = CircuitBreaker(
+            self.breaker_threshold, self.breaker_cooldown,
+            name=self.base_url,
+        )
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_file)
             if insecure_skip_verify:
@@ -402,31 +462,22 @@ class RealKubeClient(KubeClient):
 
     # ----------------------------------------------------------- breaker
 
+    def _sync_breaker(self) -> "CircuitBreaker":
+        """The policy numbers live on the client (instance-tunable);
+        copy them onto the shared breaker before each use."""
+        b = self._breaker
+        b.threshold = self.breaker_threshold
+        b.cooldown = self.breaker_cooldown
+        return b
+
     def _breaker_check(self) -> None:
         """Fail fast while the breaker is open (threshold consecutive
         transient failures); past the cooldown the caller becomes the
         half-open probe."""
-        with self._breaker_lock:
-            remaining = self._breaker_open_until - time.monotonic()
-            if remaining > 0:
-                raise CircuitOpen(
-                    f"circuit open for another {remaining:.1f}s "
-                    f"({self.breaker_threshold} consecutive failures "
-                    f"against {self.base_url})"
-                )
+        self._sync_breaker().check()
 
     def _breaker_fail(self) -> None:
-        opened = False
-        with self._breaker_lock:
-            self._consecutive_failures += 1
-            if self._consecutive_failures >= self.breaker_threshold:
-                self._breaker_open_until = (
-                    time.monotonic() + self.breaker_cooldown
-                )
-                # leave the count one short of the threshold: a failed
-                # half-open probe re-opens immediately, a success resets
-                self._consecutive_failures = self.breaker_threshold - 1
-                opened = True
+        opened = self._sync_breaker().fail()
         if opened:
             # report outside the breaker lock: the span ring and the
             # journal ring must not order-couple to it
@@ -448,9 +499,7 @@ class RealKubeClient(KubeClient):
             )
 
     def _breaker_ok(self) -> None:
-        with self._breaker_lock:
-            self._consecutive_failures = 0
-            self._breaker_open_until = 0.0
+        self._breaker.ok()
 
     @staticmethod
     def _retry_after_seconds(headers) -> Optional[float]:

@@ -234,9 +234,11 @@ class ServingMetrics:
             return
         self.registry = registry or CollectorRegistry()
         # outcome ∈ ok | error | timeout | rejected | shed (queue-full
-        # 429) | drained (drain-time 503). Every HTTP request lands in
-        # EXACTLY one outcome — tests/test_serving_chaos.py reconciles
-        # the sum against delivered responses under fault injection.
+        # 429) | drained (drain-time 503) | migrated (session exported
+        # to a peer replica — the fleet router finishes it elsewhere).
+        # Every HTTP request lands in EXACTLY one outcome —
+        # tests/test_serving_chaos.py reconciles the sum against
+        # delivered responses under fault injection.
         self.requests = Counter(
             "tpuslice_serve_requests_total",
             "Completion requests by outcome",
@@ -439,6 +441,60 @@ class ServingMetrics:
             "Per-round draft acceptance rate (accepted / proposed)",
             buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
                      1.0),
+            registry=self.registry,
+        )
+
+
+class RouterMetrics:
+    """Metrics for the fleet serving router (serving/router.py) — the
+    operator-side view of N replicas serving as one endpoint."""
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None):
+        if not _PROM:
+            _warn_no_prom()
+            self.requests = _NoopMetric()
+            self.routed = _NoopMetric()
+            self.migrations = _NoopMetric()
+            self.replicas = _NoopMetric()
+            self.breaker_opens = _NoopMetric()
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        # outcome ∈ ok | ok-migrated (survived ≥1 live migration) |
+        # shed | unavailable | upstream-error | transport-error |
+        # no-replica | client-gone
+        self.requests = Counter(
+            "tpuslice_router_requests_total",
+            "Proxied completion requests by outcome",
+            ["outcome"],
+            registry=self.registry,
+        )
+        # policy ∈ session | prefix | least-loaded — which routing rule
+        # picked the replica (docs/SERVING.md "Fleet router & session
+        # migration"); a healthy prefix-heavy workload routes mostly
+        # "prefix", which is exactly the TTFT win
+        self.routed = Counter(
+            "tpuslice_router_routed_total",
+            "Routing decisions by policy rule",
+            ["policy"],
+            registry=self.registry,
+        )
+        # outcome ∈ resumed (imported + resumed, zero re-prefill) |
+        # fallback (re-prefilled on a peer) | lost (terminal 502)
+        self.migrations = Counter(
+            "tpuslice_router_migrations_total",
+            "Live KV session migrations by outcome",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.replicas = Gauge(
+            "tpuslice_router_replicas",
+            "Engine replicas registered with the router",
+            registry=self.registry,
+        )
+        self.breaker_opens = Counter(
+            "tpuslice_router_breaker_open_total",
+            "Per-replica circuit breaker open events",
             registry=self.registry,
         )
 

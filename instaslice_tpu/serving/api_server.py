@@ -265,20 +265,42 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path.startswith("/v1/prefixes"):
             self._prefix_request("register")
             return
+        if self.path.startswith("/v1/sessions/export"):
+            self._sessions_export()
+            return
+        if self.path.startswith("/v1/sessions/import"):
+            self._sessions_import()
+            return
         if self.path.startswith("/v1/drain"):
             try:
                 body = self._read_body()
                 budget = body.get("budget")
                 budget = None if budget is None else float(budget)
+                migrate = bool(body.get("migrate", False))
             except (ValueError, TypeError, json.JSONDecodeError) as e:
                 self._send(400, {"error": str(e)})
                 return
             sched = type(self).scheduler
             sched.drain(budget)
+            migrated = 0
+            if migrate:
+                # drain-without-503: in-flight sessions leave through
+                # their own responses as migration terminals (the
+                # router imports them elsewhere); queued requests shed
+                # with the usual drain 503 the router retries
+                try:
+                    migrated = sched.control(sched.migrate_out)
+                except Exception as e:  # noqa: BLE001
+                    # the drain itself stands; report the partial state
+                    log.warning("drain-migrate failed: %s", e)
+                    self._send(500, {"error": f"migrate failed: {e}",
+                                     "draining": True})
+                    return
             self._send(200, {
                 "draining": True,
                 "budget": (sched.drain_budget if budget is None
                            else budget),
+                "migrated": migrated,
             })
             return
         if not self.path.startswith("/v1/completions"):
@@ -290,6 +312,14 @@ class _Handler(BaseHTTPRequestHandler):
         tid = _mint_trace_id(self.headers.get("X-Trace-Id"))
         try:
             req = self._read_body()
+            if req.get("resume") is not None:
+                # continuation of an imported session (fleet live
+                # migration): no prompt, no sampling config — the
+                # session blob carried all of that; the scheduler binds
+                # this pending to the parked engine state and resumes
+                # the decode with zero re-prefill
+                self._resume_completion(req, tid)
+                return
             try:
                 prompt = self._token_list(req, "prompt")
             except ValueError:
@@ -363,7 +393,35 @@ class _Handler(BaseHTTPRequestHandler):
                            stop=stop,
                            want_logprobs=bool(req.get("logprobs", False)),
                            n=n, adapter=adapter, trace_id=tid,
-                           tenant=tenant)
+                           tenant=tenant,
+                           session_key=self._session_key())
+        self._run_completion(pending)
+
+    def _session_key(self) -> str:
+        """The fleet router's per-request handle (``X-Session-Key``):
+        a targeted session export selects by it, and the export blob
+        echoes it back so the router matches blobs to streams. Opaque
+        here; bounded so a hostile client can't bloat pending state."""
+        key = self.headers.get("X-Session-Key") or ""
+        return key if len(key) <= 128 else ""
+
+    def _resume_completion(self, req: dict, tid: str) -> None:
+        try:
+            rid = int(req["resume"])
+        except (ValueError, TypeError):
+            self._send(400, {"error": "resume must be an imported "
+                                      "session rid (int)"},
+                       trace_id=tid)
+            return
+        pending = _Pending([], 0, stream=bool(req.get("stream", False)),
+                           trace_id=tid, resume_rid=rid,
+                           session_key=self._session_key())
+        self._run_completion(pending)
+
+    def _run_completion(self, pending: "_Pending") -> None:
+        """Submit → await → terminal response; shared by fresh
+        admissions and migrated-session resumes."""
+        tid = pending.trace_id
         if not self._submit_or_shed(pending):
             return
         if pending.stream_q is not None:
@@ -372,6 +430,15 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._await_or_timeout(pending):
             self._send(503, {"error": "request timed out in queue"},
                        trace_id=tid)
+            return
+        if pending.migrated is not None:
+            # the session left this replica mid-decode: the terminal
+            # response IS the handoff — the router imports the blob
+            # into another replica and finishes the completion there
+            self._send(200, {
+                "object": "text_completion.migration",
+                "session": pending.migrated,
+            }, trace_id=tid)
             return
         if pending.error:
             # shed/drained requests get a clean 503 (retry elsewhere);
@@ -404,7 +471,9 @@ class _Handler(BaseHTTPRequestHandler):
             "object": "text_completion",
             "choices": choices,
             "usage": {
-                "prompt_tokens": len(prompt),
+                # pending.prompt, not a handler local: a resumed
+                # migration binds its prompt from the imported session
+                "prompt_tokens": len(pending.prompt),
                 "completion_tokens": sum(
                     len(r.tokens) for r in pending.results.values()
                 ),
@@ -495,6 +564,16 @@ class _Handler(BaseHTTPRequestHandler):
                     continue
                 if isinstance(item, str):          # pre-admission error
                     write({"error": item})
+                    write("[DONE]")
+                    return
+                if item["kind"] == "migrated":
+                    # mid-stream handoff: the terminal event carries
+                    # the exported session blob; the router (the only
+                    # intended consumer) imports it elsewhere and
+                    # splices the resumed stream — a plain client would
+                    # see a clean stream end
+                    write({"object": "text_completion.migration",
+                           "session": item["session"]})
                     write("[DONE]")
                     return
                 if item["kind"] == "final":
@@ -601,6 +680,64 @@ class _Handler(BaseHTTPRequestHandler):
             return
         key = "registered" if op == "register" else "dropped"
         self._send(200, {key: len(tokens)})
+
+    # --------------------------------------------- session migration
+
+    def _sessions_export(self) -> None:
+        """``POST /v1/sessions/export`` — trigger live migration of
+        in-flight sessions OFF this replica (drain-without-503 replica
+        removal, hot-replica rebalancing). Body: ``{"session_key":
+        "sk-..."}`` targets one proxied request, ``{"limit": N}``
+        bounds the count, ``{}`` exports everything eligible. The
+        blobs themselves ride each session's own in-flight response as
+        ``text_completion.migration`` terminals; this returns only the
+        count."""
+        try:
+            body = self._read_body()
+            key = body.get("session_key")
+            if key is not None and not isinstance(key, str):
+                raise ValueError("session_key must be a string")
+            limit = int(body.get("limit", 0))
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        sched = type(self).scheduler
+        try:
+            moved = sched.control(
+                lambda: sched.migrate_out(session_key=key, limit=limit)
+            )
+        except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
+            log.warning("session export failed: %s", e)
+            self._send(500, {"error": f"export failed: {e}"})
+            return
+        self._send(200, {"migrated": moved})
+
+    def _sessions_import(self) -> None:
+        """``POST /v1/sessions/import`` with ``{"session": <blob>}`` —
+        materialize an exported session as parked state on this
+        replica; the follow-up ``{"resume": rid}`` completion continues
+        the decode with zero re-prefill. 400 on wire-version / model-
+        signature mismatch (the versioned-format rejection contract)."""
+        try:
+            body = self._read_body()
+            blob = body.get("session")
+            if not isinstance(blob, dict):
+                raise ValueError('body must carry {"session": {...}}')
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send(400, {"error": str(e)})
+            return
+        sched = type(self).scheduler
+        try:
+            rid = sched.import_session(blob)
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - surfaced as HTTP 500
+            log.warning("session import failed: %s", e)
+            self._send(500, {"error": f"import failed: {e}"})
+            return
+        self._send(200, {"rid": rid,
+                         "tokens": len(blob.get("generated", []))})
 
 
 class ApiServer:

@@ -41,6 +41,7 @@ Wire format (one JSON object per line)::
     {"op": "preempt_slot", "slot": 0}
     {"op": "resume_request", "rid": 7}
     {"op": "drop_parked", "rid": 7}
+    {"op": "import_session", "blob": {...session wire format...}}
     {"op": "shutdown"}
 
 Usage — driver (worker 0)::
@@ -285,6 +286,18 @@ class DistributedEngine:
         self._bcast({"op": "drop_parked", "rid": rid})
         return self.engine.drop_parked(rid)
 
+    def import_session(self, blob: dict) -> int:
+        """Inbound live migration rides the op stream: every replica
+        materializes the identical parked state (and adopts the blob's
+        RNG key), so the later resume_request replays aligned. The blob
+        is validated BEFORE the broadcast — a rejected session must
+        never enter the op stream. export_session needs no op: it is a
+        pure read of parked state (and is refused on multi-process
+        meshes — see the engine)."""
+        self.engine._validate_session_blob(blob)
+        self._bcast({"op": "import_session", "blob": blob})
+        return self.engine.import_session(blob)
+
     def generate(self, prompts, max_new_tokens, block_size: int = 32,
                  stop=None):
         # ServingEngine.generate drives everything through the public
@@ -347,7 +360,7 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                             "spec_step", "register_prefix",
                             "drop_prefix", "finish_slot", "evict_slot",
                             "preempt_slot", "resume_request",
-                            "drop_parked"):
+                            "drop_parked", "import_session"):
                 # a protocol mismatch is NOT deterministic-skip
                 # territory: replicas are about to diverge — die loudly
                 raise RuntimeError(f"unknown op {kind!r} in op stream")
@@ -391,6 +404,8 @@ def run_follower(engine: ServingEngine, driver_host: str, port: int,
                     engine.resume_request(op["rid"])
                 elif kind == "drop_parked":
                     engine.drop_parked(op["rid"])
+                elif kind == "import_session":
+                    engine.import_session(op["blob"])
             except (ValueError, KeyError, RuntimeError) as e:
                 # deterministic host-side validation failure: the
                 # driver hit (or pre-screened) the exact same error, so

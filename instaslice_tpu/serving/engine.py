@@ -71,12 +71,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from instaslice_tpu.models.lm import Params, TpuLM, param_specs
 from instaslice_tpu.serving.kvcache import (
+    SESSION_WIRE_VERSION,
     BlockTable,
     KVBlockPool,
     RadixIndex,
     RadixMatch,
     RadixNode,
+    array_to_wire,
     radix_granule,
+    tree_to_wire,
+    wire_to_array,
+    wire_to_tree,
 )
 from instaslice_tpu.serving.sampling import (
     apply_repetition_penalty,
@@ -339,6 +344,11 @@ class ServingEngine:
         self._slot_adapter_host: Dict[int, int] = {}
         self.preempted_total = 0
         self.resumed_total = 0
+        # live-migration ledger (docs/SERVING.md "Fleet router &
+        # session migration"): parked sessions serialized off this
+        # engine / deserialized onto it
+        self.exported_total = 0
+        self.imported_total = 0
         # ---- radix prefix cache (docs/SERVING.md "Radix prefix
         # cache") ----
         # A radix tree over token sequences replaces the PR-9-era
@@ -1321,6 +1331,215 @@ class ServingEngine:
             return False
         self._release_table(rid)
         return True
+
+    # ------------------------------------------------- session migration
+
+    def model_signature(self) -> dict:
+        """What two engines must agree on for a KV session to move
+        between them (docs/SERVING.md "Fleet router & session
+        migration") — checked at :meth:`import_session` so a blob from
+        a differently-shaped replica is REJECTED instead of silently
+        resuming garbage attention state."""
+        cfg = self.model.cfg
+        return {
+            "d_model": cfg.d_model, "n_layers": cfg.n_layers,
+            "n_heads": cfg.n_heads, "kv_heads": cfg.kv_heads,
+            "d_ff": cfg.d_ff, "vocab_size": cfg.vocab_size,
+            "window": cfg.window,
+            "max_len": self.max_len, "prefill_len": self.prefill_len,
+            "kv_block_size": self.kv_block_size,
+            "kv_quant": bool(self.kv_quant),
+            "n_adapters": self.n_adapters,
+            "draft": self.draft_model is not None,
+        }
+
+    def _sampling_signature(self) -> dict:
+        """Sampling config is engine-level; a migrated continuation
+        must sample from the same distribution it started under."""
+        return {
+            "temperature": float(self.temperature),
+            "top_k": int(self.top_k), "top_p": float(self.top_p),
+            "min_p": float(self.min_p),
+            "repetition_penalty": float(self.repetition_penalty),
+        }
+
+    def export_session(self, rid: int) -> dict:
+        """Serialize a PARKED request into the versioned session wire
+        format (``SESSION_WIRE_VERSION``, serving/kvcache.py): the
+        block-rounded KV stripe :meth:`preempt_slot` read out (plus the
+        draft stripe, host decode state, adapter id, and the engine RNG
+        key) as a JSON-safe dict a peer replica's
+        :meth:`import_session` can feed to :meth:`resume_request` with
+        ZERO re-prefill.
+
+        Pure read: the rid STAYS parked here — the migration's safety
+        rule is copy-then-delete, so the caller drops the source copy
+        (:meth:`drop_parked`, broadcast surface) only after the blob is
+        safely on the wire. Callers preempt live slots first
+        (``preempt_slot`` is the broadcast-surface half that changes
+        slot occupancy).
+
+        The RNG key rides the blob so a sampled (temperature > 0)
+        continuation resumed on an RNG-fresh destination replays the
+        source's exact stream — import ADOPTS it, which is
+        distribution-preserving for any co-resident sessions (one
+        uniformly-random key replaces another) and deterministic across
+        op-stream followers."""
+        if self._multiproc:
+            raise RuntimeError(
+                "session export over a multi-process mesh is not "
+                "supported: the KV stripe is sharded across processes "
+                "and no single host fully addresses it (migrate "
+                "between slices, not out of one)"
+            )
+        parked = self.parked.get(rid)
+        if parked is None:
+            raise ValueError(
+                f"request {rid} is not parked (export serializes "
+                "parked state; preempt_slot the live slot first)"
+            )
+        req = parked.req
+        blob = {
+            "version": SESSION_WIRE_VERSION,
+            "model": self.model_signature(),
+            "sampling": self._sampling_signature(),
+            "prompt": [int(t) for t in req.prompt],
+            "generated": [int(t) for t in req.generated],
+            "logprobs": [float(x) for x in req.logprobs],
+            "stop": [[int(x) for x in s] for s in req.stop],
+            "stop_scanned": int(req.stop_scanned),
+            "length": int(parked.length),
+            "adapter": int(parked.adapter),
+            "stripe": tree_to_wire(jax.device_get(parked.stripe)),
+            "draft_stripe": (
+                tree_to_wire(jax.device_get(parked.draft_stripe))
+                if parked.draft_stripe is not None else None
+            ),
+            "rng": array_to_wire(
+                jax.device_get(jax.random.key_data(self._rng))
+            ),
+        }
+        self.exported_total += 1
+        return blob
+
+    def _validate_session_blob(self, blob) -> None:
+        """Reject a blob this engine cannot resume — wire version,
+        model/sampling signature, adapter range. Split out so the
+        multi-host driver can pre-screen BEFORE broadcasting (a
+        rejected blob must never enter the op stream)."""
+        ver = blob.get("version") if isinstance(blob, dict) else None
+        if ver != SESSION_WIRE_VERSION:
+            raise ValueError(
+                f"unsupported session wire version {ver!r} (this "
+                f"engine speaks v{SESSION_WIRE_VERSION}; re-export "
+                "from a matching release)"
+            )
+        sig = self.model_signature()
+        if blob.get("model") != sig:
+            raise ValueError(
+                "session blob was exported by an incompatible engine: "
+                f"theirs {blob.get('model')!r} vs ours {sig!r}"
+            )
+        if blob.get("sampling") != self._sampling_signature():
+            raise ValueError(
+                "session blob sampling config mismatch: resuming "
+                f"{blob.get('sampling')!r} under "
+                f"{self._sampling_signature()!r} would silently change "
+                "the output distribution"
+            )
+        if not 0 <= int(blob.get("adapter", 0)) <= self.n_adapters:
+            raise ValueError(
+                f"session blob adapter {blob.get('adapter')} out of "
+                f"range (engine has {self.n_adapters})"
+            )
+
+    def import_session(self, blob: dict) -> int:
+        """Deserialize an exported session into a PARKED request on
+        this engine: allocate its block table, re-materialize the KV
+        stripe(s) on device, and register the parked state so
+        :meth:`resume_request` continues the decode with zero
+        re-prefill. Returns the fresh LOCAL request id (rids are
+        per-engine; the wire format deliberately carries none).
+
+        Raises ``ValueError`` on wire-version / model-signature /
+        sampling mismatch (the blob is untouched state from another
+        process — reject, never guess) and ``RuntimeError`` when the
+        pool cannot hold the stripe even after reclaiming evictable
+        radix cache."""
+        self._drain_pending()
+        self._validate_session_blob(blob)
+        length = int(blob["length"])
+        if not 0 < length < self.max_len:
+            raise ValueError(
+                f"session length {length} outside (0, {self.max_len})"
+            )
+        need = length + 1
+        # cached-but-unreferenced radix blocks yield to an inbound
+        # session exactly like they yield to admission
+        self._reclaim_for(self.kv.blocks_for(need))
+        try:
+            table = self.kv.allocate(need)
+        except Exception as e:
+            raise RuntimeError(
+                f"kv block pool cannot hold the inbound session: {e}"
+            ) from None
+        try:
+            stripe = jax.tree.map(jnp.asarray,
+                                  wire_to_tree(blob["stripe"]))
+            draft_stripe = None
+            if blob.get("draft_stripe") is not None:
+                draft_stripe = jax.tree.map(
+                    jnp.asarray, wire_to_tree(blob["draft_stripe"])
+                )
+            if self._replicated is not None:
+                stripe = jax.device_put(stripe, self._replicated)
+                if draft_stripe is not None:
+                    draft_stripe = jax.device_put(draft_stripe,
+                                                  self._replicated)
+            req = _Slot(
+                0,  # rid assigned below, after nothing can fail
+                [int(t) for t in blob["prompt"]],
+                [int(t) for t in blob["generated"]],
+                stop=[[int(x) for x in s] for s in blob["stop"]],
+                stop_scanned=int(blob["stop_scanned"]),
+                logprobs=[float(x) for x in blob["logprobs"]],
+            )
+        except Exception as e:  # noqa: BLE001 - re-raised as ValueError
+            # the blob passed the signature checks but its payload is
+            # missing/corrupt (truncated base64, absent key): the
+            # allocated table was never registered, so release it HERE
+            # — repeated malformed imports must not shrink the pool
+            self.kv.release(table)
+            raise ValueError(
+                f"malformed session blob payload: {e!r}"
+            ) from None
+        rid = self._next_id
+        self._next_id += 1
+        req.request_id = rid
+        self._tables[rid] = table
+        self.parked[rid] = _Parked(req, stripe, draft_stripe, length,
+                                   adapter=int(blob["adapter"]))
+        # adopt the source's RNG stream (see export_session): bit-exact
+        # sampled continuations on an RNG-fresh replica, distribution-
+        # preserving otherwise, and identical on op-stream followers
+        if blob.get("rng") is not None:
+            self._rng = jax.random.wrap_key_data(
+                jnp.asarray(wire_to_array(blob["rng"]))
+            )
+        self.imported_total += 1
+        return rid
+
+    def radix_digest(self, max_paths: int = 32) -> dict:
+        """Hashed hot-prefix summary for the fleet router (rides
+        ``/v1/stats`` under ``radix.digest``): the granule size plus
+        the most-recently-used cached paths as stable granule-hash
+        chains. The router shadow-indexes these per replica and routes
+        a prompt to the replica already holding its longest prefix —
+        without raw tokens ever leaving the replica."""
+        return {
+            "granule": self.radix_granule,
+            "paths": self.radix.hot_paths(max_paths),
+        }
 
     def cache_poisoned(self) -> bool:
         """True when a donated cache buffer was consumed by a FAILED

@@ -32,7 +32,9 @@ the driver and every op-stream follower.
 
 from __future__ import annotations
 
+import base64
 import dataclasses
+import hashlib
 from typing import Dict, List, Optional, Tuple
 
 
@@ -667,6 +669,25 @@ class RadixIndex:
                 best = n
         return best
 
+    def hot_paths(self, max_paths: int = 32) -> List[List[str]]:
+        """The most-recently-used root-to-leaf paths as granule-hash
+        chains (:func:`granule_hash`) — the "advertised prefixes" half
+        of the fleet router's shadow index. Hashes, not tokens: a
+        ``/v1/stats`` poll must not ship prompt content across the
+        fleet, and the router only needs equality at granule
+        boundaries. list()-snapshotted like every stats walk."""
+        leaves = [n for n in self._walk()
+                  if not list(n.children.values())]
+        leaves.sort(key=lambda n: n.last_used, reverse=True)
+        out: List[List[str]] = []
+        for leaf in leaves[:max_paths]:
+            chain: List[str] = []
+            for node in self.path_of(leaf):
+                chain.extend(granule_hash(g) for g in node.granules)
+            if chain:
+                out.append(chain)
+        return out
+
     def reclaim(self, need_blocks: int) -> int:
         """Evict LRU leaves (leaf-first — an interior node becomes a
         leaf once its children go) until ``need_blocks`` pool blocks
@@ -680,3 +701,89 @@ class RadixIndex:
                 break
             freed += self.evict(leaf)
         return freed
+
+
+# ------------------------------------------------------ session wire format
+#
+# The live-migration primitive's serialization half (docs/SERVING.md
+# "Fleet router & session migration"): a preempted request's parked KV
+# stripe (plus host decode state) crosses the DCN path between replicas
+# as JSON — versioned, model-signature-checked at import, arrays carried
+# as base64 rows. Pure host-side like everything else in this module:
+# the codec speaks numpy buffers (the engine device_get/device_puts at
+# its own seam), so op-stream followers replay imports byte-identically.
+
+#: bump on ANY change to the blob layout the engine emits — import
+#: REJECTS other versions outright (a half-understood session resumed
+#: from a stale field set would silently corrupt the decode chain)
+SESSION_WIRE_VERSION = 1
+
+
+def granule_hash(granule) -> str:
+    """Stable cross-process hash of one radix granule (a tuple of token
+    ids) — the unit of the router's shadow prefix index. blake2b-8:
+    Python's builtin ``hash`` is per-process salted and would make every
+    replica advertise unmatchable chains."""
+    raw = ",".join(str(int(t)) for t in granule).encode()
+    return hashlib.blake2b(raw, digest_size=8).hexdigest()
+
+
+def array_to_wire(arr) -> dict:
+    """One numpy-like array → a JSON-safe dict (dtype/shape/b64 data)."""
+    import numpy as np
+
+    a = np.ascontiguousarray(arr)
+    return {
+        "__nd__": True,
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "data": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _wire_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 etc. live in ml_dtypes (always present beside jax)
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def wire_to_array(obj: dict):
+    import numpy as np
+
+    raw = base64.b64decode(obj["data"])
+    a = np.frombuffer(raw, dtype=_wire_dtype(obj["dtype"]))
+    return a.reshape(obj["shape"]).copy()
+
+
+def tree_to_wire(tree):
+    """A pytree of arrays (dict / list / tuple nesting) → JSON-safe
+    nesting. Tuples are tagged so the reconstruction round-trips the
+    exact tree STRUCTURE — ``jax.tree.map`` over a cache and a stripe
+    with list-vs-tuple drift would refuse to zip them."""
+    if hasattr(tree, "dtype") and hasattr(tree, "shape"):
+        return array_to_wire(tree)
+    if isinstance(tree, dict):
+        return {k: tree_to_wire(v) for k, v in tree.items()}
+    if isinstance(tree, tuple):
+        return {"__tuple__": [tree_to_wire(v) for v in tree]}
+    if isinstance(tree, list):
+        return [tree_to_wire(v) for v in tree]
+    return tree
+
+
+def wire_to_tree(obj):
+    if isinstance(obj, dict):
+        if obj.get("__nd__"):
+            return wire_to_array(obj)
+        if "__tuple__" in obj and len(obj) == 1:
+            return tuple(wire_to_tree(v) for v in obj["__tuple__"])
+        return {k: wire_to_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [wire_to_tree(v) for v in obj]
+    return obj

@@ -171,11 +171,54 @@ def parse_prefix_pool(spec: str):
     return n, length
 
 
+#: bump on ANY change to the trace JSONL layout — replay REJECTS other
+#: versions (a half-understood trace would silently change the replayed
+#: request stream, which defeats the point of replaying one)
+TRACE_VERSION = 1
+
+
+def _write_trace(path: str, vocab: int, pool_entries, records) -> None:
+    """One header line (version, vocab, shared prefix pool entries),
+    then one line per request sorted by arrival time."""
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "trace_version": TRACE_VERSION,
+            "vocab": vocab,
+            "pool": pool_entries,
+        }) + "\n")
+        for rec in sorted(records, key=lambda r: r["t"]):
+            f.write(json.dumps(rec) + "\n")
+
+
+def _read_trace(path: str):
+    """Returns (vocab, pool entries or None, request records)."""
+    with open(path) as f:
+        header = json.loads(f.readline())
+        ver = header.get("trace_version")
+        if ver != TRACE_VERSION:
+            raise ValueError(
+                f"trace {path!r} has version {ver!r}; this loadgen "
+                f"replays v{TRACE_VERSION} — re-record it"
+            )
+        records = [json.loads(line) for line in f if line.strip()]
+    if not records:
+        raise ValueError(f"trace {path!r} holds zero requests")
+    return int(header["vocab"]), header.get("pool"), records
+
+
+def _prompt_from(pseed: int, plen: int, vocab: int) -> List[int]:
+    """The per-request prompt tail, regenerable from its recorded seed
+    (the trace carries seeds, not token streams)."""
+    prng = random.Random(pseed)
+    return [prng.randrange(1, vocab) for _ in range(plen)]
+
+
 def run(url: str, requests: int, concurrency: int, prompt_len: int,
         max_tokens: int, vocab: int, stream: bool, timeout: float,
         seed: int = 0, adapters: List[str] = (),
         tenants=None, jitter: float = 0.0,
-        prefix_pool: str = "") -> dict:
+        prefix_pool: str = "", record_trace: str = "",
+        replay_trace: str = "") -> dict:
     """``adapters``: multi-LoRA names assigned round-robin across
     requests ("" rides the base model) — load-tests the batched
     per-request adapter path.
@@ -195,17 +238,23 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     for (common system prompts across tenants, nothing registered).
     The report gains a ``prefix_pool`` block with the client-side
     reuse fraction: requests whose prefix was already issued at least
-    once earlier in the run — the ceiling on the server's hit rate."""
+    once earlier in the run — the ceiling on the server's hit rate.
+
+    ``record_trace`` / ``replay_trace`` (paths, mutually exclusive):
+    the bench-reproducibility satellite. Recording writes one JSONL
+    line per request — arrival offset, tenant, prompt seed + length,
+    budget, pool pick — under a versioned header carrying the shared
+    prefix-pool entries; replaying reconstructs the IDENTICAL request
+    stream (prompts regenerated from their seeds) and paces each
+    request at its recorded arrival offset, so two bench arms see the
+    same traffic instead of merely the same distribution."""
     from instaslice_tpu.serving.scheduler import parse_tenant_specs
 
+    if record_trace and replay_trace:
+        raise ValueError("record_trace and replay_trace are exclusive")
     rng = random.Random(seed)
     if isinstance(tenants, str):
         tenants = parse_tenant_specs(tenants) if tenants else None
-    tenant_of: List[str] = [""] * requests
-    if tenants:
-        names = sorted(tenants)
-        weights = [tenants[n].weight for n in names]
-        tenant_of = rng.choices(names, weights=weights, k=requests)
     # per-run nonce in every trace id: two runs with the same seed
     # against one long-lived server must not reuse ids, or the
     # documented `--trace` drill-down would merge unrelated requests'
@@ -213,44 +262,83 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     run_id = uuid.uuid4().hex[:6]
     if not 0.0 <= jitter < 1.0:
         raise ValueError(f"jitter must be in [0, 1), got {jitter}")
-    # mixed sequence lengths (seeded): each request draws its prompt
-    # length and budget from [ceil(x*(1-jitter)), x] — the scenario
-    # paged KV accounting and budget-trimmed rounds exist for. 0 keeps
-    # the historical fixed-shape behavior.
-    plens = [
-        rng.randint(max(1, int(prompt_len * (1 - jitter))), prompt_len)
-        if jitter else prompt_len
-        for _ in range(requests)
-    ]
-    budgets = [
-        rng.randint(max(1, int(max_tokens * (1 - jitter))), max_tokens)
-        if jitter else max_tokens
-        for _ in range(requests)
-    ]
+    pool = None
+    pool_spec = None
+    picks: List[Optional[int]] = [None] * requests
+    arrivals: List[Optional[float]] = []
+    if replay_trace:
+        vocab, pool_entries, records = _read_trace(replay_trace)
+        requests = len(records)
+        tenant_of = [str(r.get("tenant", "")) for r in records]
+        plens = [int(r["prompt_len"]) for r in records]
+        budgets = [int(r["max_tokens"]) for r in records]
+        pseeds = [int(r["pseed"]) for r in records]
+        picks = [r.get("pick") for r in records]
+        arrivals = [float(r["t"]) for r in records]
+        if pool_entries:
+            pool = [[int(t) for t in e] for e in pool_entries]
+            pool_spec = {"n": len(pool),
+                         "len": len(pool[0]) if pool else 0}
+    else:
+        tenant_of = [""] * requests
+        if tenants:
+            names = sorted(tenants)
+            weights = [tenants[n].weight for n in names]
+            tenant_of = rng.choices(names, weights=weights, k=requests)
+        # mixed sequence lengths (seeded): each request draws its
+        # prompt length and budget from [ceil(x*(1-jitter)), x] — the
+        # scenario paged KV accounting and budget-trimmed rounds exist
+        # for. 0 keeps the historical fixed-shape behavior.
+        plens = [
+            rng.randint(max(1, int(prompt_len * (1 - jitter))),
+                        prompt_len)
+            if jitter else prompt_len
+            for _ in range(requests)
+        ]
+        budgets = [
+            rng.randint(max(1, int(max_tokens * (1 - jitter))),
+                        max_tokens)
+            if jitter else max_tokens
+            for _ in range(requests)
+        ]
+        # per-request prompt SEEDS (not token streams) so a recorded
+        # trace stays compact and replay regenerates identical prompts
+        pseeds = [rng.randrange(2 ** 31) for _ in range(requests)]
+        if prefix_pool:
+            pool_n, pool_len = parse_prefix_pool(prefix_pool)
+            # the pool rides its OWN derived seed, independent of the
+            # request count: a warm-up run and a measured run with the
+            # same seed must share the same prefixes, or "warming the
+            # prefix cache" warms the wrong cache (found the hard way
+            # — the master rng's state at this point depends on every
+            # per-request draw above)
+            pool_rng = random.Random(
+                f"{seed}:prefix-pool:{pool_n}:{pool_len}:{vocab}"
+            )
+            pool = [
+                [pool_rng.randrange(1, vocab)
+                 for _ in range(pool_len)]
+                for _ in range(pool_n)
+            ]
+            picks = [rng.randrange(pool_n) for _ in range(requests)]
+            pool_spec = {"n": pool_n, "len": pool_len}
     prompts = [
-        [rng.randrange(1, vocab) for _ in range(plens[i])]
+        ((pool[picks[i]] if pool is not None and picks[i] is not None
+          else []) + _prompt_from(pseeds[i], plens[i], vocab))
         for i in range(requests)
     ]
     prefix_reused = 0
-    pool_spec = None
-    if prefix_pool:
-        pool_n, pool_len = parse_prefix_pool(prefix_pool)
-        pool = [
-            [rng.randrange(1, vocab) for _ in range(pool_len)]
-            for _ in range(pool_n)
-        ]
-        picks = [rng.randrange(pool_n) for _ in range(requests)]
+    if pool_spec is not None:
         # reuse fraction in ISSUE order: a request reuses when its
         # prefix was issued by ANY earlier request — the organic-
         # sharing ceiling the server-side hit counter reconciles under
         seen_picks: set = set()
         for pk in picks:
+            if pk is None:
+                continue
             if pk in seen_picks:
                 prefix_reused += 1
             seen_picks.add(pk)
-        prompts = [pool[picks[i]] + prompts[i]
-                   for i in range(requests)]
-        pool_spec = {"n": pool_n, "len": pool_len}
     lat: List[float] = []
     ttfts: List[float] = []
     tpots: List[float] = []
@@ -266,6 +354,9 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     t_outcomes: dict = {}
     lock = named_lock("loadgen.results")
     it = iter(range(requests))
+    #: fire-time offset per request (what a recorded trace's ``t`` is);
+    #: replay paces on the RECORDED offsets instead
+    fired: List[float] = [0.0] * requests
 
     def worker():
         while True:
@@ -273,6 +364,18 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
                 i = next(it, None)
             if i is None:
                 return
+            if arrivals:
+                # replay: hold the request until its recorded arrival
+                # offset (workers pull in t-sorted order, so this never
+                # reorders the stream)
+                delay = arrivals[i] - (time.monotonic() - t0)
+                if delay > 0:
+                    # replay pacing, not a poll: the nap is the
+                    # recorded inter-arrival gap itself, and loadgen
+                    # has no shutdown path to interrupt (the process
+                    # IS the run)
+                    time.sleep(delay)  # slicelint: disable=sleep-in-loop
+            fired[i] = round(time.monotonic() - t0, 4)
             dt, ttft, toks, err, code = _one_request(
                 url, prompts[i], budgets[i], stream, timeout,
                 adapter=adapters[i % len(adapters)] if adapters else "",
@@ -339,6 +442,18 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     }
     if adapters:
         out["adapters"] = list(adapters)
+    if record_trace:
+        _write_trace(record_trace, vocab,
+                     pool if pool is not None else None, [
+                         {"i": i, "t": fired[i],
+                          "tenant": tenant_of[i], "pseed": pseeds[i],
+                          "prompt_len": plens[i],
+                          "max_tokens": budgets[i], "pick": picks[i]}
+                         for i in range(requests)
+                     ])
+        out["trace"] = {"recorded": record_trace, "requests": requests}
+    if replay_trace:
+        out["trace"] = {"replayed": replay_trace, "requests": requests}
     if pool_spec is not None:
         out["prefix_pool"] = {
             **pool_spec,
@@ -445,6 +560,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "(seeded) and send it via X-Tenant; the "
                          "report gains per-tenant TTFT/TPOT p50/p95/"
                          "p99 and an SLO-attainment fraction")
+    ap.add_argument("--record-trace", default="", metavar="FILE",
+                    help="write the request stream (JSONL: arrival "
+                         "offset, tenant, prompt seed + length, "
+                         "budget, pool pick under a versioned header) "
+                         "so a later --replay-trace run fires the "
+                         "IDENTICAL stream")
+    ap.add_argument("--replay-trace", default="", metavar="FILE",
+                    help="replay a recorded trace: prompts regenerated "
+                         "from their recorded seeds, each request "
+                         "paced at its recorded arrival offset "
+                         "(--requests/--prompt-len/--max-tokens/"
+                         "--jitter/--prefix-pool come from the trace "
+                         "and are ignored)")
     ap.add_argument("--sweep", default="",
                     help="comma-separated concurrency levels (e.g. "
                          "'1,2,4,8'): run --requests at EACH level and "
@@ -475,6 +603,16 @@ def main(argv=None) -> int:
             # scripted callers parse stdout JSON — never a traceback
             print(json.dumps({"error": f"bad --prefix-pool: {e}"}))
             return 1
+    if args.record_trace and args.replay_trace:
+        # scripted callers parse stdout JSON — never a traceback
+        print(json.dumps({"error": "--record-trace and --replay-trace "
+                                   "are exclusive"}))
+        return 1
+    if args.replay_trace and args.sweep:
+        print(json.dumps({"error": "--replay-trace replays ONE "
+                                   "recorded stream; --sweep draws "
+                                   "fresh ones per level"}))
+        return 1
     if args.sweep:
         try:
             levels = [int(x) for x in args.sweep.split(",")
@@ -511,11 +649,19 @@ def main(argv=None) -> int:
         # that never got a terminal response (server robustness bug, as
         # opposed to explicit shed/timeout errors, which are exit 1)
         return 2 if hung else (1 if errors else 0)
-    out = run(args.url, args.requests, args.concurrency,
-              args.prompt_len, args.max_tokens, args.vocab,
-              args.stream, args.timeout, seed=args.seed,
-              adapters=adapters, tenants=tenants, jitter=args.jitter,
-              prefix_pool=args.prefix_pool)
+    try:
+        out = run(args.url, args.requests, args.concurrency,
+                  args.prompt_len, args.max_tokens, args.vocab,
+                  args.stream, args.timeout, seed=args.seed,
+                  adapters=adapters, tenants=tenants,
+                  jitter=args.jitter, prefix_pool=args.prefix_pool,
+                  record_trace=args.record_trace,
+                  replay_trace=args.replay_trace)
+    except (ValueError, OSError) as e:
+        # bad/missing/mismatched trace file: scripted callers parse
+        # stdout JSON — never a traceback
+        print(json.dumps({"error": f"trace: {e}"}))
+        return 1
     print(json.dumps(out))
     return 2 if out["outcomes"]["hung"] else (1 if out["errors"] else 0)
 

@@ -61,6 +61,7 @@ import os
 import queue
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional
 
 from instaslice_tpu.api.constants import (
@@ -69,6 +70,8 @@ from instaslice_tpu.api.constants import (
     REASON_DRAINED,
     REASON_PREEMPTED,
     REASON_RESUMED,
+    REASON_SESSION_EXPORTED,
+    REASON_SESSION_IMPORTED,
     REASON_SHED,
     REASON_SLO_MISSED,
 )
@@ -86,6 +89,12 @@ log = logging.getLogger("instaslice_tpu.serving.scheduler")
 #: priority classes, best first. Admission and preemption order by
 #: rank; unknown class names rank as "standard".
 CLASS_RANK = {"latency": 0, "standard": 1, "best-effort": 2}
+
+#: stable per-PROCESS nonce, surfaced on ``/v1/stats`` as
+#: ``replica_id``: the fleet router keys replica identity on it (plus
+#: the monotonic ``uptime_seconds``) so a restarted replica — same URL,
+#: empty radix cache, dead sessions — is detected instead of trusted
+REPLICA_ID = uuid.uuid4().hex[:12]
 
 
 def class_rank(name: str) -> int:
@@ -172,9 +181,23 @@ class Pending:
                  stop: Optional[List[List[int]]] = None,
                  want_logprobs: bool = False, n: int = 1,
                  adapter: int = 0, trace_id: str = "",
-                 tenant: str = ""):
+                 tenant: str = "", session_key: str = "",
+                 resume_rid: Optional[int] = None):
         self.prompt = prompt
         self.max_tokens = max_tokens
+        #: opaque caller-supplied key (``X-Session-Key``, minted by the
+        #: fleet router per proxied request): a targeted
+        #: ``/v1/sessions/export`` selects by it, and the export blob
+        #: echoes it so the router matches blobs to in-flight streams
+        self.session_key = session_key
+        #: continuation of an imported session (``"resume": rid``):
+        #: instead of admission prefill, the scheduler binds this
+        #: pending to the already-parked engine state and resumes it
+        self.resume_rid = resume_rid
+        #: set when this request's session was exported off this
+        #: replica: the terminal response carries the blob instead of
+        #: tokens (outcome "migrated", never a 503)
+        self.migrated: Optional[dict] = None
         #: the request's trace id (minted/accepted at HTTP admission);
         #: every span of this request's lifecycle carries it, and the
         #: root ``serve.request`` span uses ``span_id`` so children
@@ -339,6 +362,28 @@ class Scheduler(threading.Thread):
         self.resumed = 0              # metrics reconcile against these)
         self.parked_shed = 0
         self.slo_misses = 0
+        # ---- fleet tier: live session migration (docs/SERVING.md
+        # "Fleet router & session migration") ----
+        #: monotonic birth — /v1/stats uptime_seconds (the router's
+        #: restart detector, alongside REPLICA_ID)
+        self.started_at = time.monotonic()
+        #: control ops (session export/import) run ON the scheduler
+        #: thread — it owns the engine — handed over via this queue and
+        #: drained at the top of every round, drain rounds included
+        #: (drain-with-migrate is exactly when exports must still run)
+        self._control: "queue.Queue" = queue.Queue()
+        #: imported-but-not-yet-resumed sessions: engine rid → binding
+        #: metadata (remaining budget, streamed-token watermark, tenant)
+        #: from the blob; a ``resume`` completion claims it. Swept
+        #: after ``import_ttl`` so an orphaned import cannot hold KV
+        #: blocks forever.
+        self._imports: Dict[int, dict] = {}
+        self.import_ttl = 60.0
+        self.migrated_out = 0         # sessions exported off this
+        self.migrated_in = 0          # replica / resumed onto it
+        self.migrate_preempts = 0     # exports that parked a LIVE slot
+        #                               (ledger: engine.preempted_total
+        #                               == preempted + migrate_preempts)
         #: admission bound (0 = unbounded): past it, submit() sheds with
         #: 429 instead of queueing a request that would 503 at timeout.
         #: The lock makes bound-check + enqueue atomic across the HTTP
@@ -475,6 +520,222 @@ class Scheduler(threading.Thread):
                 )
         self.metrics.draining.set(0)
 
+    # -------------------------------------------- session migration ops
+
+    def control(self, fn, timeout: float = 30.0):
+        """Run ``fn`` ON the scheduler thread (the engine owner) and
+        return its result to the calling (HTTP) thread. The migration
+        endpoints come through here: export/import mutate engine state,
+        and the engine is single-threaded by design."""
+        res: dict = {"done": threading.Event()}
+        self._control.put((fn, res))
+        if not res["done"].wait(timeout):
+            raise TimeoutError(
+                "scheduler did not service the control op in "
+                f"{timeout:.0f}s"
+            )
+        if "error" in res:
+            raise res["error"]
+        return res.get("value")
+
+    def _run_control(self) -> None:
+        """Drain pending control ops (top of every round — drain
+        rounds included: drain-with-migrate exports exactly then)."""
+        while True:
+            try:
+                fn, res = self._control.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                res["value"] = fn()
+            except Exception as e:  # noqa: BLE001 - relayed to caller
+                log.warning("control op failed: %s", e)
+                res["error"] = e
+            res["done"].set()
+
+    def migrate_out(self, session_key: Optional[str] = None,
+                    limit: int = 0) -> int:
+        """Export in-flight sessions off this replica (the drain-
+        without-503 / rebalance primitive): preempt live slots, ship
+        each session's parked stripe through its OWN in-flight HTTP
+        response as a ``text_completion.migration`` terminal (the
+        response IS the handoff — the router thread already holding
+        both connections imports it into the destination and stitches
+        the streams), then drop the source copy.
+
+        Safety rules (docs/SERVING.md): only single-choice (n == 1)
+        completions with ≥1 token of budget left migrate — n>1 forks
+        share stripes and a spent request should just finish here;
+        timed-out requests are already dead. ``session_key`` targets
+        one session; ``limit`` bounds the count (rebalance moves one);
+        0 = everything eligible. Returns sessions exported. Callers go
+        through :meth:`control`."""
+        eng = self.engine
+        if getattr(eng, "_multiproc", False) or getattr(
+                getattr(eng, "engine", None), "_multiproc", False):
+            # check BEFORE preempting anything: export_session refuses
+            # multi-process meshes, and preempt-then-fail would strand
+            # every live request in parked state
+            log.warning("migrate_out refused: sessions cannot be "
+                        "exported off a multi-process mesh")
+            return 0
+        moved = 0
+        candidates = [
+            ("live", slot, req.request_id)
+            for slot, req in sorted(eng.slots.items())
+        ] + [("parked", None, rid) for rid in list(self._parked)]
+        for kind, slot, rid in candidates:
+            if limit and moved >= limit:
+                break
+            p = self._by_rid.get(rid)
+            if p is None or p.prefix_op or p.n != 1 or p.timed_out:
+                continue
+            if session_key is not None and p.session_key != session_key:
+                continue
+            gen = (eng.slots[slot].generated if kind == "live"
+                   else eng.parked[rid].req.generated)
+            remaining = self._budget.get(rid, 0) - len(gen)
+            if remaining < 1:
+                continue        # about to finish: cheaper to let it
+            try:
+                if kind == "live":
+                    eng.preempt_slot(slot)
+            except Exception as e:  # noqa: BLE001 - keep serving
+                log.warning("pre-export preempt of rid %d failed: %s",
+                            rid, e)
+                if eng.cache_poisoned():
+                    self._recover_engine(e)
+                continue
+            if kind == "live":
+                self.migrate_preempts += 1
+            try:
+                blob = eng.export_session(rid)
+            except Exception as e:  # noqa: BLE001 - keep serving
+                # the preempt LANDED: register the rid as ordinary
+                # parked state so _resume_parked resumes it on this
+                # replica — an export failure must degrade to "didn't
+                # migrate", never to a stranded client (the engine
+                # holds the stripe, the scheduler must keep the claim)
+                log.warning("session export of rid %d failed: %s "
+                            "(parking for normal resume)", rid, e)
+                if eng.cache_poisoned():
+                    self._recover_engine(e)
+                if kind == "live" and rid in eng.parked:
+                    self._parked[rid] = p
+                continue
+            blob["session_key"] = p.session_key
+            blob["remaining_budget"] = remaining
+            blob["sent"] = p.sent.get(rid, 0)
+            blob["tenant"] = p.tenant
+            blob["want_logprobs"] = p.want_logprobs
+            blob["trace_id"] = p.trace_id
+            # copy-then-delete: the blob exists (and is about to ride
+            # the terminal response) before the source copy drops
+            eng.drop_parked(rid)
+            self._parked.pop(rid, None)
+            self._by_rid.pop(rid, None)
+            self._budget.pop(rid, None)
+            self.migrated_out += 1
+            get_journal().emit(
+                "serving", reason=REASON_SESSION_EXPORTED,
+                message=(f"session exported mid-stream "
+                         f"({len(blob['generated'])} tokens in, "
+                         f"{remaining} budget left, tenant "
+                         f"{p.tenant or 'default'!r})"),
+                trace_id=p.trace_id,
+            )
+            if p.trace_id:
+                get_tracer().record(
+                    "serve.migrate", 0.0, trace_id=p.trace_id,
+                    parent_id=p.span_id, direction="out",
+                )
+            p.migrated = blob
+            if p.stream_q is not None:
+                p.stream_q.put({"kind": "migrated", "session": blob})
+            self._maybe_complete(p)
+            moved += 1
+        return moved
+
+    def import_session(self, blob: dict) -> int:
+        """Control-op wrapper for the import endpoint: materialize the
+        inbound session as parked engine state and remember the
+        binding metadata until a ``resume`` completion claims it."""
+        def op() -> int:
+            rid = self.engine.import_session(blob)
+            self._imports[rid] = {
+                "budget": max(0, int(blob.get("remaining_budget", 0))),
+                "sent": max(0, int(blob.get("sent", 0))),
+                "tenant": str(blob.get("tenant", "") or ""),
+                "want_logprobs": bool(blob.get("want_logprobs", False)),
+                "trace_id": str(blob.get("trace_id", "") or ""),
+                "ts": time.monotonic(),
+            }
+            get_journal().emit(
+                "serving", reason=REASON_SESSION_IMPORTED,
+                message=(f"session imported as rid {rid} "
+                         f"({len(blob.get('generated', []))} tokens "
+                         "in, awaiting resume)"),
+                trace_id=str(blob.get("trace_id", "") or ""),
+            )
+            return rid
+
+        return self.control(op)
+
+    def _bind_resumes(self) -> None:
+        """Bind ``resume`` completions to their imported sessions: the
+        pending adopts the parked rid (budget, streamed-token
+        watermark, tenant from the import metadata) and joins
+        ``_parked`` — ``_resume_parked`` takes it from there with zero
+        re-prefill."""
+        for p in [p for p in self._ready if p.resume_rid is not None]:
+            self._ready.remove(p)
+            rid = p.resume_rid
+            meta = self._imports.pop(rid, None)
+            parked = self.engine.parked.get(rid)
+            if meta is None or parked is None:
+                p.error = (f"ValueError: no imported session {rid} "
+                           "awaiting resume on this replica")
+                if p.stream_q is not None:
+                    p.stream_q.put(p.error)
+                self.metrics.requests.labels(outcome="rejected").inc()
+                self._record_request_span(p, "rejected")
+                p.done.set()
+                continue
+            p.tenant = meta["tenant"]
+            self._bind_tenant(p)
+            p.want_logprobs = meta["want_logprobs"]
+            p.prompt = list(parked.req.prompt)
+            p.max_tokens = len(parked.req.generated) + meta["budget"]
+            p.rid_index[rid] = 0
+            p.sent[rid] = meta["sent"]
+            # the first token was sampled on the SOURCE replica: TTFT
+            # here is the migration gap, not a prefill wait
+            p.first_token_at = time.monotonic()
+            self._by_rid[rid] = p
+            self._budget[rid] = p.max_tokens
+            self._parked[rid] = p
+            self.migrated_in += 1
+            if p.trace_id:
+                get_tracer().record(
+                    "serve.migrate", 0.0, trace_id=p.trace_id,
+                    parent_id=p.span_id, direction="in",
+                )
+
+    def _sweep_stale_imports(self) -> None:
+        """An imported session nobody resumed holds KV blocks — drop
+        it after ``import_ttl`` (the router retries the import or falls
+        back to re-prefill; an orphan must not shrink the pool)."""
+        if not self._imports:
+            return
+        now = time.monotonic()
+        for rid, meta in list(self._imports.items()):
+            if now - meta["ts"] > self.import_ttl:
+                log.warning("dropping imported session %d: never "
+                            "resumed within %.0fs", rid,
+                            self.import_ttl)
+                self.engine.drop_parked(rid)
+                self._imports.pop(rid, None)
+
     def _fail_shed(self, p: Pending, shed: str, msg: str,
                    retry_after: Optional[float] = None) -> None:
         p.shed = shed
@@ -551,6 +812,10 @@ class Scheduler(threading.Thread):
         eng = self.engine
         if self.fault_hook is not None:
             self.fault_hook()   # may raise (injected); run() recovers
+        # migration control ops first, drain rounds included: a
+        # drain-with-migrate exports exactly while draining
+        self._run_control()
+        self._sweep_stale_imports()
         if self.draining.is_set():
             # no admission; shed the queue, enforce the drain budget.
             # Parked preemptees are IN-FLIGHT work: the drain budget is
@@ -565,11 +830,16 @@ class Scheduler(threading.Thread):
                 self.drained.set()
         else:
             self._pump()
+            self._bind_resumes()
             self._sweep_timeouts()
             if self.mode == "continuous":
                 self._resume_parked()
                 self._relieve_block_pressure()
                 self._maybe_preempt()
+            elif self._parked:
+                # fixed mode never preempts, but migrated-in sessions
+                # park on arrival and must still resume on the baseline
+                self._resume_parked()
             self._admit()
         # evict abandoned requests: the HTTP layer already 503'd the
         # client, so decoding the slot to its budget would burn
@@ -1431,7 +1701,8 @@ class Scheduler(threading.Thread):
         # Outcome read + done.set() are atomic under p.lock so the HTTP
         # thread's expiring wait cannot interleave (503 counted as ok).
         with p.lock:
-            outcome = ("timeout" if p.timed_out
+            outcome = ("migrated" if p.migrated is not None
+                       else "timeout" if p.timed_out
                        else "drained" if p.shed
                        else "error" if p.error else "ok")
             self.metrics.requests.labels(outcome=outcome).inc()
@@ -1588,6 +1859,14 @@ class Scheduler(threading.Thread):
     def stats(self) -> dict:
         eng = self.engine
         out = {
+            # fleet-router inputs: a stable per-process identity plus a
+            # monotonic age — the router's staleness/restart detector
+            # (a rebooted replica has a new nonce and a reset clock,
+            # and its advertised prefixes and sessions died with it)
+            "replica_id": REPLICA_ID,
+            "uptime_seconds": round(
+                time.monotonic() - self.started_at, 3
+            ),
             "live_slots": len(eng.slots),
             "free_slots": eng.free_slots(),
             "draining": self.draining.is_set(),
@@ -1604,8 +1883,14 @@ class Scheduler(threading.Thread):
             "prefixes": len(eng.prefixes),
             "prefix_hits": eng.prefix_hits,
             "prefix_tokens_saved": eng.prefix_tokens_saved,
-            "radix": (eng.radix_stats()
-                      if hasattr(eng, "radix_stats") else {}),
+            # the radix block gains "digest": hashed hot-prefix chains
+            # the fleet router shadow-indexes for prefix-affine routing
+            "radix": dict(
+                (eng.radix_stats()
+                 if hasattr(eng, "radix_stats") else {}),
+                **({"digest": eng.radix_digest()}
+                   if hasattr(eng, "radix_digest") else {}),
+            ),
             "mode": self.mode,
             "overlap": self.overlap,
             "engine": {
@@ -1628,6 +1913,15 @@ class Scheduler(threading.Thread):
             "resumed": self.resumed,
             "parked_shed": self.parked_shed,
             "slo_misses": self.slo_misses,
+            # live-migration ledger (router + bench reconcile on it)
+            "sessions": {
+                "exported": getattr(eng, "exported_total", 0),
+                "imported": getattr(eng, "imported_total", 0),
+                "migrated_out": self.migrated_out,
+                "migrated_in": self.migrated_in,
+                "migrate_preempts": self.migrate_preempts,
+                "imports_pending": len(self._imports),
+            },
             "kv": eng.kv_stats(),
             "tenant_classes": {
                 name: s.tenant_class for name, s in self.tenants.items()

@@ -64,6 +64,7 @@ SLOW_MODULES = {
     "test_engine_hotpath",  # batched prefill / fast-path / overlap compiles
     "test_radix",         # radix prefix cache over the jax engine
     "test_spec_decode",   # rejection-sampling spec decode compiles
+    "test_router",        # fleet router + live migration over jax engines
 }
 
 

@@ -14,6 +14,9 @@ import os
 import subprocess
 import sys
 
+import jax
+import pytest
+
 from conftest import free_port
 
 from instaslice_tpu.agent.handoff import slice_env
@@ -27,6 +30,20 @@ from instaslice_tpu.topology.placement import legal_placements
 from instaslice_tpu.topology.profiles import parse_profile_name
 
 LOCAL_DEVICES = 4  # virtual CPU devices per process ("chips" per host)
+
+#: environment-bound (known set, not regressions): the two-process
+#: tiers form a REAL multi-process mesh, and the jax 0.4.x CPU backend
+#: refuses cross-process computations outright — every worker dies with
+#: "Multiprocess computations aren't implemented on the CPU backend".
+#: jax >= 0.5 (or real TPU hosts) runs them; marked explicitly so
+#: tier output separates this known set from genuine regressions.
+two_process_mesh = pytest.mark.skipif(
+    tuple(int(x) for x in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="environment-bound: jax 0.4.x CPU backend cannot run a "
+           "multi-process mesh (\"Multiprocess computations aren't "
+           "implemented on the CPU backend\") — needs jax >= 0.5 or "
+           "real TPU hosts",
+)
 
 
 def _worker_envs():
@@ -101,6 +118,7 @@ def _spawn_workers(module: str, extra_env=None, timeout=240):
 
 
 class TestDcnRendezvous:
+    @two_process_mesh
     def test_two_process_psum(self):
         outs = _spawn_workers("instaslice_tpu.parallel.dcn_smoke",
                               timeout=180)
@@ -118,6 +136,7 @@ class TestDcnRendezvous:
 
 
 class TestDcnServing:
+    @two_process_mesh
     def test_two_process_tensor_parallel_decode(self):
         """The serving engine running SPMD over a DCN-spanning mesh:
         both workers execute the identical op stream and must produce
@@ -149,6 +168,7 @@ class TestDcnServing:
         want = ref.decode_block(8)[rid]
         assert outs[0]["tokens"] == want
 
+    @two_process_mesh
     def test_two_process_oplog_driver_follower(self):
         """Dynamic traffic over the driver/follower op stream: worker 0
         drives ragged admissions + an external budget cut; worker 1
@@ -199,6 +219,7 @@ class TestDcnServing:
 
 
 class TestServeCliMultiHost:
+    @two_process_mesh
     def test_from_env_two_worker_serve(self):
         """The product path end-to-end: ``tpuslice-serve --from-env``
         in BOTH worker pods of a two-host grant. Worker 0 rendezvouses,

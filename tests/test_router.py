@@ -1,0 +1,685 @@
+"""Fleet serving tier (serving/router.py) + live KV session migration.
+
+Four stories (docs/SERVING.md "Fleet router & session migration"):
+
+- the **session wire format**: export→import on a fresh engine is
+  token-identical to the uninterrupted run — greedy AND sampled
+  (the RNG key rides the blob) — and a version/model/sampling mismatch
+  is REJECTED, never resumed as garbage;
+- the **/v1/stats fleet inputs**: ``replica_id`` (stable per-process
+  nonce) + monotonic ``uptime_seconds`` + the ``sessions`` ledger;
+- the **router's routing policy** (session affinity → prefix-cache
+  affinity via the shadow digest index → least-loaded weighted by KV
+  pressure), restart detection, and per-replica circuit breaking —
+  pure unit tests over hand-fed stats, no engines;
+- the **HTTP migration flow** end-to-end: two live replicas behind a
+  router, a mid-stream session exported off one and spliced onto the
+  other with zero re-prefill, token-identical to the oracle, with
+  clean block/lock ledgers afterwards.
+
+Plus the loadgen trace record/replay satellite (identical request
+streams across bench arms).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.serving import ServingEngine
+from instaslice_tpu.serving.api_server import ApiServer
+from instaslice_tpu.serving.kvcache import (
+    SESSION_WIRE_VERSION,
+    granule_hash,
+    tree_to_wire,
+    wire_to_tree,
+)
+from instaslice_tpu.serving.router import NoReplica, Replica, Router
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+def greedy_reference(model, params, prompt, n_new):
+    toks = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = model.apply(params, jnp.asarray(toks, jnp.int32)[None])
+        t = int(jnp.argmax(logits[0, -1]))
+        out.append(t)
+        toks.append(t)
+    return out
+
+
+def make_engine(model, **kw):
+    m, params = model
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("prefill_len", 8)
+    return ServingEngine(m, params, **kw)
+
+
+def migrate_once(src: ServingEngine, dst: ServingEngine,
+                 interrupt_at: int, total: int, prompt):
+    """Decode ``interrupt_at`` tokens on ``src``, export→import the
+    session, finish on ``dst``; returns the full stitched chain of
+    ``total`` tokens. (A parked request carries interrupt_at + 1
+    tokens: ``generated[-1]`` is the sampled-but-unwritten pending
+    token — preempt_slot's documented shape.)"""
+    rid = src.add_request(list(prompt))
+    got = list(src.decode_block(interrupt_at)[rid])
+    slot = next(s for s, r in src.slots.items()
+                if r.request_id == rid)
+    src.preempt_slot(slot)
+    blob = src.export_session(rid)
+    # the wire format must be JSON-clean END TO END: what crosses the
+    # DCN path is exactly what a peer imports
+    blob = json.loads(json.dumps(blob))
+    src.drop_parked(rid)
+    rid2 = dst.import_session(blob)
+    parked_gen = list(dst.parked[rid2].req.generated)
+    assert parked_gen[:interrupt_at] == got
+    assert len(parked_gen) == interrupt_at + 1
+    dst.resume_request(rid2)
+    dst.decode_block(total - interrupt_at - 1)
+    req = next(r for r in dst.slots.values()
+               if r.request_id == rid2)
+    out = list(req.generated)
+    assert out[:interrupt_at] == got
+    return out
+
+
+class TestSessionWireFormat:
+    def test_greedy_roundtrip_token_identical(self, model):
+        m, params = model
+        oracle = greedy_reference(m, params, [5, 9, 2, 7], 12)
+        src = make_engine(model)
+        dst = make_engine(model)
+        rid = src.add_request([5, 9, 2, 7])
+        src.decode_block(5)
+        slot = next(s for s, r in src.slots.items()
+                    if r.request_id == rid)
+        src.preempt_slot(slot)
+        blob = src.export_session(rid)
+        assert blob["version"] == SESSION_WIRE_VERSION
+        assert src.exported_total == 1
+        # the blob is pure JSON — ship-ready with no pickle anywhere
+        blob = json.loads(json.dumps(blob))
+        src.drop_parked(rid)
+        rid2 = dst.import_session(blob)
+        assert dst.imported_total == 1
+        dst.resume_request(rid2)
+        # parked state already carries 6 tokens (5 decoded + the
+        # pending one); 6 more resumed steps complete the 12
+        dst.decode_block(6)
+        req = next(r for r in dst.slots.values()
+                   if r.request_id == rid2)
+        assert list(req.generated) == oracle
+
+    def test_sampled_roundtrip_replays_source_stream(self, model):
+        """temperature > 0: the RNG key rides the blob, so the
+        migrated continuation equals the UNINTERRUPTED sampled run on
+        the source — even though the destination engine was built with
+        a different seed."""
+        uninterrupted = make_engine(model, temperature=0.8, seed=3)
+        rid = uninterrupted.add_request([5, 9, 2, 7])
+        oracle = list(uninterrupted.decode_block(12)[rid])
+        src = make_engine(model, temperature=0.8, seed=3)
+        dst = make_engine(model, temperature=0.8, seed=99)
+        chain = migrate_once(src, dst, 5, 12, [5, 9, 2, 7])
+        assert chain == oracle
+
+    def test_version_mismatch_rejected(self, model):
+        src = make_engine(model)
+        dst = make_engine(model)
+        rid = src.add_request([5, 9, 2, 7])
+        src.decode_block(3)
+        src.preempt_slot(next(iter(src.slots)))
+        blob = src.export_session(rid)
+        bad = dict(blob, version=SESSION_WIRE_VERSION + 1)
+        with pytest.raises(ValueError, match="wire version"):
+            dst.import_session(bad)
+        # model-shape mismatch: a differently-shaped replica must
+        # refuse the stripe outright
+        small = make_engine(model, max_len=64)
+        with pytest.raises(ValueError, match="incompatible"):
+            small.import_session(blob)
+        # sampling mismatch: resuming under a different distribution
+        # would silently change the output
+        hot = make_engine(model, temperature=1.5, seed=1)
+        with pytest.raises(ValueError, match="sampling"):
+            hot.import_session(blob)
+        assert dst.imported_total == 0
+
+    def test_import_is_parked_and_droppable(self, model):
+        """An imported session holds pool blocks like any parked
+        request — and drop_parked releases every one of them."""
+        src = make_engine(model)
+        dst = make_engine(model)
+        rid = src.add_request([5, 9, 2, 7])
+        src.decode_block(3)
+        src.preempt_slot(next(iter(src.slots)))
+        blob = src.export_session(rid)
+        free0 = dst.kv.free_blocks()
+        rid2 = dst.import_session(blob)
+        assert dst.kv.free_blocks() < free0
+        assert rid2 in dst.parked
+        dst.drop_parked(rid2)
+        assert dst.kv.free_blocks() == free0
+
+    def test_tree_wire_roundtrip_structure(self):
+        import numpy as np
+
+        tree = {
+            "k": (np.arange(6, dtype=np.float32).reshape(2, 3),
+                  np.ones((1, 2), np.int32)),
+            "nested": [{"v": np.zeros((2,), np.float32)}],
+            "scalar": 3,
+        }
+        back = wire_to_tree(json.loads(json.dumps(tree_to_wire(tree))))
+        assert isinstance(back["k"], tuple)          # tuples survive
+        assert np.array_equal(back["k"][0], tree["k"][0])
+        assert back["k"][0].dtype == np.float32
+        assert np.array_equal(back["nested"][0]["v"],
+                              tree["nested"][0]["v"])
+        assert back["scalar"] == 3
+
+
+class TestStatsFleetInputs:
+    def test_replica_id_and_uptime(self, model):
+        from instaslice_tpu.serving.scheduler import REPLICA_ID
+
+        eng = make_engine(model)
+        with ApiServer(eng, block_size=4) as srv:
+            s1 = json.loads(urllib.request.urlopen(
+                srv.url + "/v1/stats", timeout=10).read())
+            time.sleep(0.05)
+            s2 = json.loads(urllib.request.urlopen(
+                srv.url + "/v1/stats", timeout=10).read())
+        assert s1["replica_id"] == s2["replica_id"] == REPLICA_ID
+        assert len(s1["replica_id"]) == 12
+        # monotonic: the router's staleness/restart detector
+        assert s2["uptime_seconds"] > s1["uptime_seconds"] >= 0
+        ledger = s1["sessions"]
+        assert ledger == {
+            "exported": 0, "imported": 0, "migrated_out": 0,
+            "migrated_in": 0, "migrate_preempts": 0,
+            "imports_pending": 0,
+        }
+        assert "digest" in s1["radix"]
+
+
+def fed_replica(url="http://stub:1", queued=0, live=0, parked=0,
+                kv_free=100, kv_total=100, max_batch=8, chains=(),
+                granule=8, replica_id="r", uptime=10.0,
+                tenant_classes=None) -> Replica:
+    """A Replica fed a hand-built /v1/stats poll (no HTTP anywhere)."""
+    rep = Replica(url)
+    rep.adopt_stats({
+        "replica_id": replica_id, "uptime_seconds": uptime,
+        "queued": queued, "live_slots": live, "parked": parked,
+        "max_batch": max_batch,
+        "kv": {"free": kv_free, "total": kv_total},
+        "radix": {"digest": {"granule": granule,
+                             "paths": [list(c) for c in chains]}},
+        "tenant_classes": tenant_classes or {},
+    })
+    return rep
+
+
+def unstarted_router(*reps: Replica, **kw) -> Router:
+    """A Router that never opens sockets to anything: replicas are
+    injected post-construction with their stats already adopted."""
+    r = Router(port=0, **kw)
+    for rep in reps:
+        r._replicas[rep.url] = rep
+    # close the (never-started) HTTP socket so tests don't leak fds
+    r._srv.server_close()
+    return r
+
+
+def chain_for(prompt, granule):
+    return [granule_hash(tuple(prompt[i * granule:(i + 1) * granule]))
+            for i in range(len(prompt) // granule)]
+
+
+class TestRoutingPolicy:
+    def test_policy_order_session_beats_prefix_beats_load(self):
+        prompt = list(range(1, 17))
+        g = 8
+        idle = fed_replica("http://idle:1", replica_id="a")
+        cached = fed_replica("http://cached:1", replica_id="b",
+                             chains=[chain_for(prompt, g)], queued=3)
+        r = unstarted_router(idle, cached)
+        # no session, no prefix → least-loaded picks the idle one
+        rep, policy = r.route([99, 98, 97], "", "")
+        assert (rep.url, policy) == ("http://idle:1", "least-loaded")
+        # prefix affinity beats load: cached replica is busier but
+        # holds the prompt's granule chain
+        rep, policy = r.route(prompt, "", "")
+        assert (rep.url, policy) == ("http://cached:1", "prefix")
+        # session affinity beats both
+        r.pin_session("conv", "http://idle:1")
+        rep, policy = r.route(prompt, "", "conv")
+        assert (rep.url, policy) == ("http://idle:1", "session")
+
+    def test_prefix_match_longest_chain_wins(self):
+        g = 8
+        p = list(range(1, 25))               # 3 granules
+        short = fed_replica("http://s:1", replica_id="a",
+                            chains=[chain_for(p[:8], g)])
+        long = fed_replica("http://l:1", replica_id="b",
+                           chains=[chain_for(p, g)], queued=5)
+        r = unstarted_router(short, long)
+        rep, policy = r.route(p, "", "")
+        assert (rep.url, policy) == ("http://l:1", "prefix")
+        # sub-granule prompts can't match anything → least-loaded
+        rep, policy = r.route(p[:4], "", "")
+        assert policy == "least-loaded"
+
+    def test_kv_pressure_and_tenant_class_weighting(self):
+        # same queue depth; the KV-starved replica loses
+        starved = fed_replica("http://starved:1", replica_id="a",
+                              kv_free=5, kv_total=100)
+        roomy = fed_replica("http://roomy:1", replica_id="b",
+                            kv_free=95, kv_total=100)
+        r = unstarted_router(starved, roomy)
+        rep, _ = r.route([1, 2, 3], "", "")
+        assert rep.url == "http://roomy:1"
+        # latency-class tenants penalize queue depth harder
+        q = fed_replica("http://queued:1", replica_id="c", queued=4,
+                        kv_free=100,
+                        tenant_classes={"gold": "latency"})
+        busy = fed_replica("http://busy:1", replica_id="d", live=6,
+                           kv_free=60, kv_total=100)
+        assert (q.load_score("latency") > q.load_score("standard"))
+
+    def test_restart_detection_drops_affinity(self):
+        rep = fed_replica("http://a:1", replica_id="one", uptime=50.0)
+        r = unstarted_router(rep)
+        r.pin_session("conv", rep.url)
+        # same nonce, clock moved forward: no restart
+        assert not rep.adopt_stats({"replica_id": "one",
+                                    "uptime_seconds": 60.0})
+        # new nonce = restarted process (cache and sessions died)
+        assert rep.adopt_stats({"replica_id": "two",
+                                "uptime_seconds": 1.0})
+        # uptime going BACKWARDS under one nonce is also a restart
+        # signal (nonce collision after a crash-loop respawn)
+        assert rep.adopt_stats({"replica_id": "two",
+                                "uptime_seconds": 0.2})
+
+    def test_breaker_and_draining_drop_out(self):
+        a = fed_replica("http://a:1", replica_id="a")
+        b = fed_replica("http://b:1", replica_id="b")
+        r = unstarted_router(a, b)
+        for _ in range(a.breaker.threshold):
+            a.breaker.fail()
+        rep, _ = r.route([1], "", "")
+        assert rep.url == "http://b:1"
+        b.draining = True
+        with pytest.raises(NoReplica):
+            r.route([1], "", "")
+
+    def test_stale_poll_drops_out(self):
+        a = fed_replica("http://a:1", replica_id="a")
+        r = unstarted_router(a, stale_after=0.05)
+        time.sleep(0.08)
+        with pytest.raises(NoReplica):
+            r.route([1], "", "")
+
+    def test_migration_destinations_prefer_prefix(self):
+        g = 8
+        p = list(range(1, 17))
+        cached = fed_replica("http://cached:1", replica_id="a",
+                             chains=[chain_for(p, g)], queued=5)
+        idle = fed_replica("http://idle:1", replica_id="b")
+        src = fed_replica("http://src:1", replica_id="c")
+        r = unstarted_router(cached, idle, src)
+        dests = r.migration_destinations(exclude=["http://src:1"],
+                                         prompt=p)
+        assert [d.url for d in dests] == ["http://cached:1",
+                                          "http://idle:1"]
+
+
+def post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        f"{url}/v1/completions",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def stream_tokens(url, payload, result, timeout=120):
+    req = urllib.request.Request(
+        f"{url}/v1/completions",
+        data=json.dumps(dict(payload, stream=True)).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    toks = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        buf = b""
+        while True:
+            chunk = resp.read1(65536)
+            if not chunk:
+                result["error"] = "stream ended without [DONE]"
+                return
+            buf += chunk
+            while b"\n\n" in buf:
+                ev, buf = buf.split(b"\n\n", 1)
+                line = ev.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data == "[DONE]":
+                    result["tokens"] = toks
+                    return
+                p = json.loads(data)
+                if "error" in p:
+                    result["error"] = p["error"]
+                    return
+                for c in p.get("choices", []):
+                    toks.extend(c.get("token_ids") or [])
+
+
+class TestRouterHttpE2E:
+    @pytest.fixture()
+    def fleet(self, model):
+        servers = [ApiServer(make_engine(model), block_size=4).start()
+                   for _ in range(2)]
+        router = Router([s.url for s in servers],
+                        poll_interval=0.1).start()
+        yield router, servers
+        router.stop()
+        for s in servers:
+            s.stop()
+
+    def wait_live(self, servers, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for s in servers:
+                if s.scheduler.stats()["live_slots"]:
+                    return s
+            time.sleep(0.01)
+        raise AssertionError("no replica ever held a live slot")
+
+    def test_routed_completion_matches_oracle(self, model, fleet):
+        m, params = model
+        router, _servers = fleet
+        oracle = greedy_reference(m, params, [1, 2, 3, 4], 10)
+        code, out = post(router.url, {"prompt": [1, 2, 3, 4],
+                                      "max_tokens": 10})
+        assert code == 200
+        assert out["choices"][0]["token_ids"] == oracle
+        # the outcome is counted AFTER the response reaches the
+        # client, on the router's handler thread — wait for it
+        deadline = time.monotonic() + 5
+        while (router.requests.get("ok") is None
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert router.requests.get("ok") == 1
+
+    def test_midstream_migration_token_identical(self, model, fleet):
+        """The tentpole flow: a streaming request's session is
+        exported off its replica mid-decode; the router imports it
+        into the peer and splices the resumed stream — the client
+        sees ONE continuous, oracle-exact completion."""
+        m, params = model
+        router, servers = fleet
+        oracle = greedy_reference(m, params, [7, 8, 9], 60)
+        result: dict = {}
+        t = threading.Thread(target=stream_tokens, args=(
+            router.url, {"prompt": [7, 8, 9], "max_tokens": 60},
+            result))
+        t.start()
+        victim = self.wait_live(servers)
+        # trigger the export through the replica's own endpoint
+        req = urllib.request.Request(
+            victim.url + "/v1/sessions/export", data=b"{}",
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        moved = json.loads(urllib.request.urlopen(
+            req, timeout=10).read())
+        assert moved["migrated"] == 1
+        t.join(timeout=120)
+        assert "error" not in result, result
+        assert result["tokens"] == oracle
+        assert router.migrations.get("resumed", 0) >= 1
+        # the outcome is counted AFTER the terminal [DONE] reaches the
+        # client, on the router's handler thread — wait for it
+        deadline = time.monotonic() + 5
+        while (router.requests.get("ok-migrated") is None
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert router.requests.get("ok-migrated") == 1
+        # ledgers: exported on one replica, imported on the other,
+        # nothing parked or leaked anywhere after quiesce
+        stats = [s.scheduler.stats() for s in servers]
+        assert sum(s["sessions"]["exported"] for s in stats) == 1
+        assert sum(s["sessions"]["imported"] for s in stats) == 1
+        for s in servers:
+            st = s.scheduler.stats()
+            assert st["live_slots"] == 0 and st["parked"] == 0
+            assert st["sessions"]["imports_pending"] == 0
+            # every still-used block belongs to the radix tree (no
+            # leaked tables), and no request pins a tree path anymore
+            eng = s.scheduler.engine
+            assert not eng._radix_locks
+            assert eng.kv.used_blocks() == eng.radix.pool_blocks()
+
+    def test_remove_replica_drains_without_503(self, model, fleet):
+        m, params = model
+        router, servers = fleet
+        oracle = greedy_reference(m, params, [11, 12], 60)
+        result: dict = {}
+        t = threading.Thread(target=stream_tokens, args=(
+            router.url, {"prompt": [11, 12], "max_tokens": 60},
+            result))
+        t.start()
+        victim = self.wait_live(servers)
+        out = router.remove_replica(victim.url)
+        assert out["removed"] and out["migrated"] == 1
+        t.join(timeout=120)
+        assert "error" not in result, result
+        assert result["tokens"] == oracle
+        assert len(router.replicas()) == 1
+
+    def test_sync_migration_token_identical(self, model, fleet):
+        """Non-streaming requests migrate too: the sync terminal
+        carries the blob and the router merges the resumed tokens."""
+        m, params = model
+        router, servers = fleet
+        oracle = greedy_reference(m, params, [3, 1, 4], 60)
+        result: dict = {}
+
+        def go():
+            code, out = post(router.url, {"prompt": [3, 1, 4],
+                                          "max_tokens": 60})
+            result["code"], result["out"] = code, out
+
+        t = threading.Thread(target=go)
+        t.start()
+        victim = self.wait_live(servers)
+        req = urllib.request.Request(
+            victim.url + "/v1/sessions/export", data=b"{}",
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        moved = json.loads(urllib.request.urlopen(
+            req, timeout=10).read())
+        assert moved["migrated"] == 1
+        t.join(timeout=120)
+        assert result["code"] == 200, result
+        assert result["out"]["choices"][0]["token_ids"] == oracle
+        assert result["out"]["usage"]["completion_tokens"] == 60
+
+    def test_failed_export_parks_instead_of_stranding(self, model,
+                                                      fleet):
+        """Review-pass regression: export_session failing AFTER the
+        preempt landed must degrade to ordinary parked state (the
+        request resumes on this replica) — never a stranded client
+        whose stripe the engine holds but nobody will resume."""
+        m, params = model
+        router, servers = fleet
+        oracle = greedy_reference(m, params, [9, 9, 1], 60)
+        result: dict = {}
+        t = threading.Thread(target=stream_tokens, args=(
+            router.url, {"prompt": [9, 9, 1], "max_tokens": 60},
+            result))
+        t.start()
+        victim = self.wait_live(servers)
+        eng = victim.scheduler.engine
+
+        def boom(rid):
+            raise RuntimeError("injected export failure")
+
+        eng.export_session = boom
+        req = urllib.request.Request(
+            victim.url + "/v1/sessions/export", data=b"{}",
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        moved = json.loads(urllib.request.urlopen(
+            req, timeout=10).read())
+        assert moved["migrated"] == 0
+        t.join(timeout=120)
+        assert "error" not in result, result
+        assert result["tokens"] == oracle
+        st = victim.scheduler.stats()
+        assert st["live_slots"] == 0 and st["parked"] == 0
+        assert st["sessions"]["migrated_out"] == 0
+
+    def test_malformed_import_releases_pool_blocks(self, model):
+        """Review-pass regression: a blob that passes the signature
+        checks but carries a corrupt payload must not leak the blocks
+        import allocated before deserialization failed."""
+        src = make_engine(model)
+        dst = make_engine(model)
+        rid = src.add_request([5, 9, 2, 7])
+        src.decode_block(3)
+        src.preempt_slot(next(iter(src.slots)))
+        blob = src.export_session(rid)
+        free0 = dst.kv.free_blocks()
+        bad = dict(blob)
+        del bad["stripe"]
+        with pytest.raises(ValueError, match="malformed"):
+            dst.import_session(bad)
+        assert dst.kv.free_blocks() == free0
+        bad2 = dict(blob)
+        bad2["stripe"] = {"__nd__": True, "dtype": "float32",
+                          "shape": [2, 2], "data": "!!notb64!!"}
+        with pytest.raises(ValueError, match="malformed"):
+            dst.import_session(bad2)
+        assert dst.kv.free_blocks() == free0
+        # the good blob still imports after the failed attempts
+        rid2 = dst.import_session(blob)
+        assert rid2 in dst.parked
+
+    def test_client_resume_field_is_stripped(self, model, fleet):
+        """Review-pass regression: ``resume`` is the ROUTER'S protocol
+        field — a client sending it through the router must not be
+        able to claim a pending imported session on some replica."""
+        router, servers = fleet
+        code, out = post(router.url, {"resume": 0})
+        # with the field stripped this is just a promptless completion
+        assert code == 400, out
+        assert "prompt" in out["error"]
+
+    def test_import_version_mismatch_is_http_400(self, model, fleet):
+        router, servers = fleet
+        req = urllib.request.Request(
+            servers[0].url + "/v1/sessions/import",
+            data=json.dumps({"session": {
+                "version": SESSION_WIRE_VERSION + 7}}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        assert "wire version" in json.loads(ei.value.read())["error"]
+
+
+class TestLoadgenTraceReplay:
+    def test_record_then_replay_identical_stream(self, tmp_path):
+        """The satellite's whole point: a replayed trace regenerates
+        byte-identical prompts/budgets/tenants in the same arrival
+        order — no live server needed to prove it (the stream is
+        deterministic before any HTTP happens)."""
+        from instaslice_tpu.serving.loadgen import (
+            _prompt_from,
+            _read_trace,
+            _write_trace,
+        )
+
+        records = [
+            {"i": 0, "t": 0.0, "tenant": "gold", "pseed": 123,
+             "prompt_len": 6, "max_tokens": 4, "pick": 1},
+            {"i": 1, "t": 0.02, "tenant": "", "pseed": 456,
+             "prompt_len": 3, "max_tokens": 2, "pick": None},
+        ]
+        pool = [[9, 9, 9, 9], [8, 8, 8, 8]]
+        path = str(tmp_path / "t.jsonl")
+        _write_trace(path, 64, pool, records)
+        vocab, pool2, recs2 = _read_trace(path)
+        assert (vocab, pool2) == (64, pool)
+        assert recs2 == records
+        p0 = pool[1] + _prompt_from(123, 6, 64)
+        assert len(p0) == 10
+        # regeneration is deterministic
+        assert _prompt_from(123, 6, 64) == _prompt_from(123, 6, 64)
+
+    def test_version_mismatch_rejected(self, tmp_path):
+        from instaslice_tpu.serving.loadgen import (
+            TRACE_VERSION,
+            _read_trace,
+        )
+
+        path = tmp_path / "bad.jsonl"
+        path.write_text(json.dumps(
+            {"trace_version": TRACE_VERSION + 1, "vocab": 64}
+        ) + "\n" + json.dumps({"i": 0}) + "\n")
+        with pytest.raises(ValueError, match="version"):
+            _read_trace(str(path))
+
+    def test_live_record_replay_roundtrip(self, model):
+        """Record against a live replica, replay the file: same
+        request count, zero errors, and the trace survives its own
+        round-trip (arrival offsets sorted, pool carried)."""
+        from instaslice_tpu.serving.loadgen import _read_trace, run
+        import tempfile
+
+        eng = make_engine(model)
+        with ApiServer(eng, block_size=4) as srv, \
+                tempfile.NamedTemporaryFile(suffix=".jsonl") as f:
+            rec = run(srv.url, 6, 3, 10, 4, 64, False, 60.0, seed=5,
+                      jitter=0.5, prefix_pool="2:16",
+                      record_trace=f.name)
+            assert rec["ok"] == 6
+            assert rec["trace"] == {"recorded": f.name, "requests": 6}
+            vocab, pool, recs = _read_trace(f.name)
+            assert len(recs) == 6 and len(pool) == 2
+            assert [r["t"] for r in recs] == sorted(
+                r["t"] for r in recs)
+            rep = run(srv.url, 999, 3, 999, 999, 999, False, 60.0,
+                      seed=777, replay_trace=f.name)
+            assert rep["ok"] == 6 and rep["errors"] == 0
+            assert rep["trace"] == {"replayed": f.name, "requests": 6}
+            # identical stream: the prefix-pool reuse fraction (a pure
+            # function of the picks) must match the recorded run's
+            assert rep["prefix_pool"]["reused"] == \
+                rec["prefix_pool"]["reused"]

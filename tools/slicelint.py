@@ -136,6 +136,9 @@ RAW_HTTP_ALLOW = (
     "instaslice_tpu/kube/real.py",
     "instaslice_tpu/kube/httptest.py",
     "instaslice_tpu/serving/loadgen.py",
+    # the fleet router IS a transport: per-replica breaker + bounded
+    # retry live in serving/router.py itself
+    "instaslice_tpu/serving/router.py",
     "instaslice_tpu/device/cloudtpu.py",
     "instaslice_tpu/device/cloudtpu_mock.py",
     "instaslice_tpu/cli/tpuslicectl.py",
