@@ -44,6 +44,7 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
+	$(MAKE) chaos-crash-smoke
 	$(MAKE) bench-smoke
 	$(MAKE) bench-defrag-smoke
 	$(MAKE) bench-serving-smoke
@@ -51,6 +52,10 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 	$(MAKE) bench-prefix-smoke
 	$(MAKE) bench-spec-smoke
 	$(MAKE) bench-router-smoke
+
+.PHONY: chaos-crash-smoke
+chaos-crash-smoke:  ## <60 s crash-consistency gate (docs/RECOVERY.md): one controller kill mid-fan-out + one agent kill mid-realize + one serving-replica kill mid-stream, each under load — every pod granted, zero double-allocations, zero orphaned device slices, zero hung requests, chains legal across restart epochs
+	JAX_PLATFORMS=cpu timeout -k 10 300 $(PY) -m pytest tests/test_crash_chaos.py -q -k "smoke" -p no:cacheprovider
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
@@ -135,14 +140,15 @@ test-e2e-kind:  ## Real-cluster e2e on KinD (skips cleanly without docker/kind)
 	./deploy/e2e_kind.sh
 
 .PHONY: chaos
-chaos:  ## Control-plane + serving chaos tiers across 3 seeds (hung tests dump all thread stacks via faulthandler before the outer timeout kills them). TPUSLICE_LOCKCHECK=1 arms the lock-order race detector: any ABBA cycle observed during the run fails the session (docs/STATIC_ANALYSIS.md)
+chaos:  ## Control-plane + serving + crash-consistency chaos tiers across 3 seeds (hung tests dump all thread stacks via faulthandler before the outer timeout kills them). The crash arm kill-loops every crash point (docs/RECOVERY.md). TPUSLICE_LOCKCHECK=1 arms the lock-order race detector: any ABBA cycle observed during the run fails the session (docs/STATIC_ANALYSIS.md)
 	@set -e; for seed in 1 2 3; do \
 	  echo "=== chaos seed $$seed ==="; \
 	  CHAOS_SEED=$$seed CHAOS_DURATION=$${CHAOS_DURATION:-8} \
 	  PYTEST_FAULTHANDLER_SESSION_TIMEOUT=330 \
 	  JAX_PLATFORMS=cpu \
 	  timeout -k 10 360 $(PY) -m pytest \
-	    tests/test_chaos.py tests/test_serving_chaos.py -q; \
+	    tests/test_chaos.py tests/test_serving_chaos.py \
+	    tests/test_crash_chaos.py -q; \
 	done
 
 .PHONY: bench

@@ -15,7 +15,7 @@ Differences by design:
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import Optional, Set
 
 from instaslice_tpu.api import (
     PreparedDetails,
@@ -23,13 +23,30 @@ from instaslice_tpu.api import (
     TpuSlice,
     TpuSliceSpec,
 )
-from instaslice_tpu.device.backend import DeviceBackend, NodeInventory
+from instaslice_tpu.api.constants import REASON_ORPHAN_REAPED
+from instaslice_tpu.device.backend import (
+    DeviceBackend,
+    DeviceError,
+    NodeInventory,
+    SliceNotFound,
+)
 from instaslice_tpu.kube.client import KubeClient, NotFound, update_with_retry
+from instaslice_tpu.obs.journal import get_journal
 from instaslice_tpu.topology.grid import coord_to_id, get_generation, id_to_coord
 from instaslice_tpu.topology.placement import Box
 from instaslice_tpu.topology.profiles import profile_catalog
 
 log = logging.getLogger("instaslice_tpu.agent")
+
+
+def _owned_alloc_id(suid: str) -> Optional[str]:
+    """The allocation id a ``slice_uuid_for``-shaped reservation handle
+    derives from, or None for a foreign (non-instaslice) handle."""
+    if suid.startswith("sl-mh-"):
+        return suid[len("sl-mh-"):]
+    if suid.startswith("sl-"):
+        return suid[len("sl-"):]
+    return None
 
 
 def _dangling_box(chip_ids, host_bounds, offset=(0, 0, 0)) -> str:
@@ -67,16 +84,76 @@ def build_tpuslice(
     return ts
 
 
+def _sweep_orphans(ts: TpuSlice, backend) -> Set[str]:
+    """Restart reconciliation, device side (docs/RECOVERY.md): slice
+    handles shaped like ours (``sl-``/``sl-mh-``) whose allocation id
+    exists in NO CR epoch are orphans — a crashed agent reserved them
+    (or a stale dangling adoption outlived its record) and the durable
+    truth never claimed them. They are reaped (released + journaled
+    ``OrphanReaped``), never adopted: adopting would strand the chips
+    occupied forever with no owner to ever tear them down. Foreign
+    handles keep the reference's adopt-as-dangling behavior — they are
+    not ours to kill. Removes matching stale dangling prepared entries
+    from ``ts`` in place; returns the orphan handle set (the caller
+    releases them AFTER the CR write lands, so a lost write never
+    races a freed device)."""
+    orphans: Set[str] = set()
+    for suid in list(ts.spec.prepared):
+        prep = ts.spec.prepared[suid]
+        aid = _owned_alloc_id(suid)
+        if aid is None or prep.pod_uuid:
+            continue
+        if aid not in ts.spec.allocations:
+            del ts.spec.prepared[suid]
+            orphans.add(suid)
+    try:
+        reservations = backend.list_reservations()
+    except DeviceError as e:
+        log.warning("orphan sweep: list_reservations failed: %s", e)
+        return orphans
+    for r in reservations:
+        aid = _owned_alloc_id(r.slice_uuid)
+        if aid is not None and aid not in ts.spec.allocations:
+            orphans.add(r.slice_uuid)
+    return orphans
+
+
+def _reap_orphans(backend, node_name: str, orphans: Set[str]) -> None:
+    for suid in sorted(orphans):
+        try:
+            backend.release(suid)
+        except SliceNotFound:
+            pass  # stale prepared entry with no live reservation
+        except DeviceError as e:
+            # the next boot's sweep retries; the CR no longer counts
+            # the chips, so worst case is a transiently over-reserved
+            # device registry, never a double-placement
+            log.warning("%s: orphan release %s failed: %s",
+                        node_name, suid, e)
+            continue
+        get_journal().emit(
+            f"agent-{node_name}",
+            reason=REASON_ORPHAN_REAPED,
+            object_ref=f"slice/{suid}",
+            message=(f"released orphaned device slice {suid}: no CR "
+                     "epoch claims it"),
+        )
+        log.warning("%s: reaped orphaned device slice %s", node_name,
+                    suid)
+
+
 def _adopt_dangling(ts, backend, host_bounds, node_name,
-                    host_offset=(0, 0, 0)) -> None:
+                    host_offset=(0, 0, 0), skip: Optional[Set[str]] = None,
+                    ) -> None:
     """Device reservations with no prepared record become dangling
     prepared entries (podUUID="") so the placement engine counts their
-    chips as occupied (reference: instaslice_controller.go:312-320)."""
+    chips as occupied (reference: instaslice_controller.go:312-320).
+    ``skip`` excludes orphans the restart sweep is about to reap."""
     known = {
         part.device_handle or uid
         for uid, p in ts.spec.prepared.items()
         for part in p.parts.values()
-    } | set(ts.spec.prepared)
+    } | set(ts.spec.prepared) | (skip or set())
     for r in backend.list_reservations():
         if r.slice_uuid in known:
             continue
@@ -107,12 +184,24 @@ def discover_node(
     node_name: str,
     namespace: str,
 ) -> TpuSlice:
-    """Create or refresh this node's CR. Safe to run on every boot."""
+    """Create or refresh this node's CR. Safe to run on every boot —
+    and the restart-reconciliation entry point: device truth is swept
+    against the CR's allocations, and orphaned slices (device has
+    them, no CR epoch claims them) are reaped after the CR write
+    lands (docs/RECOVERY.md)."""
     inv = backend.discover()
     fresh = build_tpuslice(node_name, namespace, inv, backend)
+    orphans: Set[str] = set()
     try:
-        existing = client.get("TpuSlice", namespace, node_name)
+        client.get("TpuSlice", namespace, node_name)
     except NotFound:
+        # NO sweep on the create path: a fresh CR carries no history,
+        # so "no epoch claims it" is vacuous here — and the CR may be
+        # missing because an operator deleted it under LIVE workloads
+        # (etcd restore), where releasing their chips would turn a
+        # control-plane object loss into data-plane disruption. Adopt
+        # everything as dangling (the reference behavior); the NEXT
+        # boot's refresh sweep reaps what still has no claiming epoch.
         created = client.create("TpuSlice", fresh.to_manifest())
         log.info(
             "created TpuSlice %s/%s: %d chips, %d profiles",
@@ -130,9 +219,13 @@ def discover_node(
         ts.spec.chips = fresh.spec.chips
         ts.spec.profiles = fresh.spec.profiles
         hb = get_generation(inv.generation).host_bounds
-        _adopt_dangling(ts, backend, hb, node_name, inv.host_offset)
+        orphans.clear()
+        orphans.update(_sweep_orphans(ts, backend))
+        _adopt_dangling(ts, backend, hb, node_name, inv.host_offset,
+                        skip=orphans)
         ts.status.processed = True
         return ts.to_manifest()
 
     out = update_with_retry(client, "TpuSlice", namespace, node_name, refresh)
+    _reap_orphans(backend, node_name, orphans)
     return TpuSlice.from_manifest(out)

@@ -26,6 +26,7 @@ from instaslice_tpu.api.constants import (
     REASON_REALIZE_FAILED,
     REASON_TORN_DOWN,
 )
+from instaslice_tpu.faults import maybe_crash
 from instaslice_tpu.obs.journal import emit_pod_event, get_journal
 from instaslice_tpu.agent.discovery import discover_node
 from instaslice_tpu.agent.handoff import configmap_manifest, slice_env
@@ -207,6 +208,10 @@ class NodeAgent:
             return
         if self.metrics:
             self.metrics.reserve_seconds.observe(time.monotonic() - t0)
+        # crash point (docs/RECOVERY.md): the chips are reserved on the
+        # device but the CR knows nothing yet — a death here is what
+        # the restart orphan sweep + the stuck-grant watchdog recover
+        maybe_crash("agent.realize")
 
         # Device handoff + node pinning for every pod this node serves.
         for pod in alloc.pods_on_node(self.node_name):
@@ -252,6 +257,13 @@ class NodeAgent:
                     parts={},
                 )
                 cur.spec.prepared[suid] = prep
+            elif not prep.pod_uuid:
+                # a crashed-realize reservation the boot sweep adopted
+                # as dangling: this IS its allocation — claim it so the
+                # record stops reading as ownerless
+                prep.pod_uuid = a.pods[0].pod_uuid if a.pods else ""
+                prep.profile = a.profile
+                prep.box = a.box
             prep.parts[self.node_name] = part
             # Note: the agent never flips CREATING→CREATED. Each agent
             # reports realized_on only in its own CR copy; the controller
@@ -323,6 +335,10 @@ class NodeAgent:
                 self.metrics.device_errors.inc()
             self.manager.queue.add(self.node_name, delay=1.0)
             return
+        # crash point (docs/RECOVERY.md): chips released, CR still
+        # carries the DELETED record + our realized_on — the restart
+        # re-drives this teardown and release() is idempotent
+        maybe_crash("agent.teardown")
         for pod in alloc.pods_on_node(self.node_name):
             try:
                 self.client.delete("ConfigMap", pod.namespace, pod.handoff)

@@ -139,6 +139,18 @@ REASON_PREEMPTED = "RequestPreempted"
 REASON_RESUMED = "RequestResumed"
 REASON_SLO_MISSED = "SLOMissed"
 
+# crash-consistent recovery (docs/RECOVERY.md). CrashRecovered marks a
+# restarted component adopting durable state a dead predecessor left
+# mid-flight (also the epoch boundary `validate_events --epochs` splits
+# chains on); OrphanReaped is the agent startup sweep releasing a
+# device slice no CR epoch claims; MigrationAborted is the repacker
+# watchdog rolling back a stuck migration; GrantDeadlineExceeded is the
+# controller watchdog rolling back an allocation stuck in `creating`.
+REASON_CRASH_RECOVERED = "CrashRecovered"
+REASON_ORPHAN_REAPED = "OrphanReaped"
+REASON_MIGRATION_ABORTED = "MigrationAborted"
+REASON_GRANT_DEADLINE = "GrantDeadlineExceeded"
+
 # fleet serving tier (serving/router.py + live KV session migration):
 # a session exported off a replica (drain/rebalance) and the matching
 # import+resume on its destination — both under the request's trace id
@@ -171,6 +183,8 @@ EVENT_REASONS = frozenset({
     REASON_DRAIN_BEGIN, REASON_DRAIN_END, REASON_SHED, REASON_DRAINED,
     REASON_PREEMPTED, REASON_RESUMED, REASON_SLO_MISSED,
     REASON_SESSION_EXPORTED, REASON_SESSION_IMPORTED,
+    REASON_CRASH_RECOVERED, REASON_ORPHAN_REAPED,
+    REASON_MIGRATION_ABORTED, REASON_GRANT_DEADLINE,
 })
 
 # ------------------------------------------------------- labels / leases

@@ -69,6 +69,11 @@ _ALLOCATION_PROPS = {
             "required": ["status", "ts"],
         },
     },
+    # crash consistency: the placement-attempt epoch (docs/RECOVERY.md)
+    # — a restarted controller re-places with epoch+1 so half-landed
+    # copies from a crashed writer are distinguishable; pruning it
+    # would silently merge stale epochs back into the cluster truth
+    "attemptEpoch": {"type": "integer"},
 }
 
 _PREPARED_PART_PROPS = {

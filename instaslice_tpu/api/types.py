@@ -162,6 +162,14 @@ class AllocationDetails:
     # so "why did this allocation end up here" survives controller
     # restarts (recorded by set_status, the transition choke point)
     transitions: List[dict] = dataclasses.field(default_factory=list)
+    # crash consistency (docs/RECOVERY.md): which placement attempt this
+    # record belongs to. A controller that dies mid-fan-out can leave an
+    # old epoch's copy on one CR while its successor re-places the same
+    # alloc_id at a new box — the merged view must never union
+    # realized_on/status across epochs (a crashed writer's half-landed
+    # state is NOT a concurrent writer). 0 = pre-epoch record (legacy
+    # CRs), merged like epoch 0.
+    attempt_epoch: int = 0
 
     def to_dict(self) -> dict:
         return {
@@ -182,6 +190,8 @@ class AllocationDetails:
             **({"traceId": self.trace_id} if self.trace_id else {}),
             **({"transitions": [dict(t) for t in self.transitions]}
                if self.transitions else {}),
+            **({"attemptEpoch": self.attempt_epoch}
+               if self.attempt_epoch else {}),
         }
 
     @staticmethod
@@ -203,6 +213,7 @@ class AllocationDetails:
             deletion_requested_at=float(d.get("deletionRequestedAt", 0.0)),
             trace_id=d.get("traceId", ""),
             transitions=[dict(t) for t in d.get("transitions", [])],
+            attempt_epoch=int(d.get("attemptEpoch", 0)),
         )
 
     def global_box(self) -> Box:
@@ -225,6 +236,10 @@ class AllocationDetails:
                            message: str) -> None:
         from instaslice_tpu.obs.journal import get_journal
 
+        extra = (
+            {"attempt_epoch": self.attempt_epoch}
+            if self.attempt_epoch else {}
+        )
         ev = get_journal().emit(
             "allocation",
             reason=TRANSITION_REASONS[status.value],
@@ -232,6 +247,7 @@ class AllocationDetails:
             message=message,
             trace_id=self.trace_id,
             status=status.value,
+            **extra,
         )
         # the trail entry shares the journal event's timestamp, so the
         # describe-pod timeline dedupes the two surfaces exactly
@@ -280,11 +296,14 @@ class AllocationDetails:
         now: Optional[float] = None,
         trace_id: str = "",
         note: str = "",
+        attempt_epoch: int = 0,
     ) -> "AllocationDetails":
         """``note`` is appended to the seed transition's message — the
         repacker stamps its re-grants with it so a migration epoch is
         distinguishable from an original grant in the audit trail and
-        the ``describe pod`` timeline."""
+        the ``describe pod`` timeline. ``attempt_epoch`` stamps the
+        placement attempt (crash recovery re-places with the prior
+        epoch + 1 so stale half-landed copies are distinguishable)."""
         if not pods:
             raise ValueError("allocation needs at least one pod")
         alloc = AllocationDetails(
@@ -300,6 +319,7 @@ class AllocationDetails:
             status=AllocationStatus.CREATING,
             created_at=time.time() if now is None else now,
             trace_id=trace_id,
+            attempt_epoch=max(0, int(attempt_epoch)),
         )
         # seed the audit trail: a freshly placed allocation IS the
         # creating transition (set_status only sees later edges)

@@ -58,12 +58,14 @@ from typing import Dict, List, Optional, Tuple
 
 from instaslice_tpu.api import AllocationDetails, AllocationStatus
 from instaslice_tpu.api.constants import (
+    REASON_MIGRATION_ABORTED,
     REASON_REPACK_DONE,
     REASON_REPACK_FAILED,
     REASON_REPACK_MIGRATING,
     REASON_REPACK_PLANNED,
     REPACK_OPTOUT_ANNOTATION,
 )
+from instaslice_tpu.faults import maybe_crash
 from instaslice_tpu.controller.reconciler import INDEX_SLICE_GROUP
 from instaslice_tpu.obs.journal import emit_pod_event, get_journal
 from instaslice_tpu.topology.placement import (
@@ -105,6 +107,19 @@ class Migration:
     attempts: int = 0
     started: float = 0.0
     warned_stuck: bool = False
+    #: attempt epoch the fresh record is stamped with (old epoch + 1)
+    epoch: int = 0
+    #: monotonic time of the last phase transition — the stuck
+    #: watchdog's idle clock (warn at ``stuck_warn_seconds``, abort at
+    #: ``stuck_abort_seconds``)
+    last_progress: float = 0.0
+
+    def progress(self) -> None:
+        """Record forward motion: re-arms the stall warning (a
+        migration that un-sticks can warn again on a later stall) and
+        resets the abort clock."""
+        self.last_progress = time.monotonic()
+        self.warned_stuck = False
 
 
 class Repacker:
@@ -121,6 +136,7 @@ class Repacker:
         max_moves: int = 4,
         stuck_warn_seconds: float = 60.0,
         frag_threshold: Optional[float] = None,
+        stuck_abort_seconds: Optional[float] = None,
     ) -> None:
         self.controller = controller
         self.interval = interval
@@ -128,6 +144,19 @@ class Repacker:
         self.cooldown = cooldown
         self.max_moves = max(1, int(max_moves))
         self.stuck_warn_seconds = stuck_warn_seconds
+        # self-healing watchdog (docs/RECOVERY.md): a migration idle in
+        # one phase this long is ABORTED — a realizing epoch is rolled
+        # back via _mark_deleted (bounded: one abort, then the
+        # migration is surrendered), a stuck drain/rollback is handed
+        # to the controller's stuck-grant machinery. 0 disables (the
+        # warn-only pre-PR-15 behavior).
+        if stuck_abort_seconds is None:
+            from instaslice_tpu.utils.envutil import env_float
+
+            stuck_abort_seconds = env_float(
+                "TPUSLICE_STUCK_MIGRATION_DEADLINE", 300.0)
+        self.stuck_abort_seconds = stuck_abort_seconds
+        self.migrations_aborted = 0
         # proactive repacking (ROADMAP item 1 headroom): when a group's
         # stranded-capacity fraction (topology/frag.py) exceeds this,
         # plan a consolidation for the largest currently-unplaceable
@@ -173,9 +202,19 @@ class Repacker:
             self._thread = None
 
     def _loop(self) -> None:
+        from instaslice_tpu.faults import InjectedCrash
+
         while not self._stop.wait(self.interval):
             try:
                 self.run_once()
+            except InjectedCrash as e:
+                # a crash point fired: the repacker is dead mid-
+                # migration, exactly like the process dying — the
+                # restarted controller's orphan recovery adopts the
+                # half-finished lifecycle (docs/RECOVERY.md)
+                log.warning("repacker: %s — thread dying", e)
+                self._stop.set()
+                return
             except Exception:
                 # one bad tick must not kill the loop; the next tick
                 # re-reads everything from the caches
@@ -338,6 +377,8 @@ class Repacker:
                             pods=list(alloc.pods),
                             trace_id=new_trace_id(),
                             started=time.monotonic(),
+                            epoch=alloc.attempt_epoch + 1,
+                            last_progress=time.monotonic(),
                         )
                         # reserve the destination BEFORE the drain: the
                         # overlay entry keeps the pending pod and every
@@ -566,16 +607,17 @@ class Repacker:
             c._mark_deleted(alloc)
 
     def _advance(self, mig: Migration) -> None:
-        if (
-            not mig.warned_stuck
-            and time.monotonic() - mig.started > self.stuck_warn_seconds
-        ):
+        idle = time.monotonic() - (mig.last_progress or mig.started)
+        if not mig.warned_stuck and idle > self.stuck_warn_seconds:
             mig.warned_stuck = True
             log.warning(
                 "migration %s stuck in %s for %.0fs (old %s dest %s)",
-                mig.alloc_id, mig.phase,
-                time.monotonic() - mig.started, mig.old_box, mig.dest_box,
+                mig.alloc_id, mig.phase, idle, mig.old_box,
+                mig.dest_box,
             )
+        if 0 < self.stuck_abort_seconds < idle:
+            self._abort_stuck(mig, idle)
+            return
         if mig.phase == "evicting":
             if self._record_gone(mig):
                 self._place_migrated(mig)
@@ -644,6 +686,7 @@ class Repacker:
             mig.dest_box = None
             mig.attempts += 1
             mig.phase = "evicting"
+            mig.progress()
             with c._placement_lock:
                 c._inflight.pop(mig.alloc_id, None)
             return
@@ -653,6 +696,51 @@ class Repacker:
             mig.phase = "evicting"
             mig.rollback = True
             mig.dest_box = None
+            mig.progress()
+
+    def _abort_stuck(self, mig: Migration, idle: float) -> None:
+        """Watchdog escalation past the warn (docs/RECOVERY.md): a
+        migration idle beyond ``stuck_abort_seconds`` stops holding a
+        concurrency slot and a destination reservation. A first-time
+        stuck *realizing* epoch is rolled back through ``_mark_deleted``
+        (the one bounded abort — the rollback machinery re-places the
+        victim on its freed chips); a stuck drain, or a rollback that
+        is itself stuck, means a dead agent owns the next move: the
+        migration is surrendered and the controller's stuck-grant /
+        orphan-recovery watchdogs own the record from here."""
+        c = self.controller
+        self.migrations_aborted += 1
+        get_journal().emit(
+            COMPONENT, reason=REASON_MIGRATION_ABORTED,
+            object_ref=f"alloc/{mig.alloc_id}",
+            message=(f"migration stuck in {mig.phase} {idle:.0f}s "
+                     f"(> {self.stuck_abort_seconds:g}s deadline); "
+                     + ("rolling back" if mig.phase == "realizing"
+                        and not mig.rollback
+                        else "surrendering to controller watchdogs")),
+            trace_id=mig.trace_id,
+        )
+        if mig.phase == "realizing" and not mig.rollback:
+            for ts in c._slices_inf.by_index(
+                INDEX_SLICE_GROUP, mig.group_id, transformed=True
+            ):
+                a = ts.spec.allocations.get(mig.alloc_id)
+                if a is not None and a.status != AllocationStatus.DELETED:
+                    c._mark_deleted(a)
+                    break
+            mig.rollback = True
+            mig.dest_box = None
+            mig.attempts += 1
+            mig.phase = "evicting"
+            mig.progress()
+            with c._placement_lock:
+                c._inflight.pop(mig.alloc_id, None)
+            return
+        self._finish(
+            mig, ok=False,
+            msg=(f"stuck in {mig.phase} {idle:.0f}s; aborted — "
+                 "controller watchdogs own the record now"),
+        )
 
     def _record_gone(self, mig: Migration) -> bool:
         c = self.controller
@@ -685,6 +773,11 @@ class Repacker:
         choice (in-memory) happens under the placement lock; the CR
         fan-out happens outside it, like every controller grant."""
         c = self.controller
+        # crash point (docs/RECOVERY.md): the victim's record is erased,
+        # its chips are free, the re-grant has not landed — a death here
+        # leaves an ungated pod with NO allocation, exactly what the
+        # controller's _recover_ungated_orphan adopts on restart
+        maybe_crash("repacker.migrate")
         if not all(self._live_pod(p) is not None for p in mig.pods):
             self._finish(mig, ok=False,
                          msg="pod gone mid-migration; not re-granting")
@@ -765,6 +858,7 @@ class Repacker:
                 placement, mig.pods, alloc_id=mig.alloc_id,
                 trace_id=mig.trace_id,
                 note="repack rollback" if mig.rollback else "repack",
+                attempt_epoch=mig.epoch or 1,
             )
             try:
                 placed = c._write_allocation(new_alloc)
@@ -782,6 +876,7 @@ class Repacker:
             mig.attempts += 1
             return
         mig.phase = "realizing"
+        mig.progress()
 
     # ------------------------------------------------------------ completion
 

@@ -25,7 +25,9 @@ from typing import Dict, List, Optional, Tuple
 from instaslice_tpu import FINALIZER, GATE_NAME, KIND, LEGACY_GATE_NAME
 from instaslice_tpu.api.constants import (
     REASON_ADMITTED,
+    REASON_CRASH_RECOVERED,
     REASON_DEGRADED,
+    REASON_GRANT_DEADLINE,
     REASON_HEALED,
     REASON_HEALTH_EVICTED,
     REASON_NO_CAPACITY,
@@ -34,7 +36,8 @@ from instaslice_tpu.api.constants import (
     REASON_RETRYING,
     REASON_UNGATED,
 )
-from instaslice_tpu.obs.journal import emit_pod_event
+from instaslice_tpu.faults import maybe_crash
+from instaslice_tpu.obs.journal import emit_pod_event, get_journal
 from instaslice_tpu.api import (
     AllocationDetails,
     AllocationStatus,
@@ -135,11 +138,21 @@ class Controller:
         workers: Optional[int] = None,
         use_cache: bool = True,
         shard_lease: Optional[dict] = None,
+        stuck_grant_deadline: Optional[float] = None,
     ) -> None:
         """``fence``: optional ``() -> bool`` leadership check; when it
         turns False every subsequent CR/pod write raises ``Fenced`` so a
         deposed leader cannot race its successor (update_with_retry
         re-checks it on every conflict retry).
+
+        ``stuck_grant_deadline``: the self-healing watchdog bound
+        (docs/RECOVERY.md) — an allocation stuck in ``creating`` this
+        many seconds is rolled back and re-placed
+        (``GrantDeadlineExceeded``), and a ``deleted`` record no agent
+        erased within the same bound stops blocking its pod: the
+        controller re-places under a fresh attempt epoch and leaves the
+        stale copy for the (dead) agent's restart to reap. Default:
+        ``TPUSLICE_STUCK_GRANT_DEADLINE`` or 300 s.
 
         ``workers``: reconcile concurrency (key-hash sharded; per-key
         ordering preserved). Default: ``TPUSLICE_RECONCILE_WORKERS`` or
@@ -163,6 +176,12 @@ class Controller:
         )
         self.grace = deletion_grace_seconds
         self.no_capacity_requeue = no_capacity_requeue
+        if stuck_grant_deadline is None:
+            from instaslice_tpu.utils.envutil import env_float
+
+            stuck_grant_deadline = env_float(
+                "TPUSLICE_STUCK_GRANT_DEADLINE", 300.0)
+        self.stuck_grant_deadline = stuck_grant_deadline
         self.metrics = metrics
         self._pending_lock = named_lock("controller.pending")
         self._pending: set = set()
@@ -434,7 +453,14 @@ class Controller:
         """Locate an allocation by pod uid (or ns/name key) and every CR
         holding a copy, returning a MERGED view: each agent reports
         ``realized_on`` / status only in its own CR copy, so the union
-        (and worst status) across copies is the cluster truth."""
+        (and worst status) across copies is the cluster truth.
+
+        Crash consistency (docs/RECOVERY.md): only copies of the
+        NEWEST ``attempt_epoch`` merge. A crashed writer's half-landed
+        older epoch (e.g. a DELETED copy a dead agent never erased)
+        must not pollute the live epoch's realized_on/status — without
+        the epoch fence, one stale DELETED copy would pin the merged
+        status at DELETED forever and wedge the pod."""
         if self._cache_ready():
             # alloc-pod secondary index: only the holder CRs, not a
             # cluster-wide scan per reconcile
@@ -459,10 +485,12 @@ class Controller:
                         break
         if not copies:
             return None
+        top_epoch = max(c.attempt_epoch for c in copies)
+        live = [c for c in copies if c.attempt_epoch == top_epoch]
         realized = set()
         messages = []
         status = AllocationStatus.CREATING
-        for c in copies:
+        for c in live:
             realized.update(c.realized_on)
             if c.message:
                 messages.append(c.message)
@@ -470,11 +498,11 @@ class Controller:
                 c.status
             ) < self._STATUS_PRECEDENCE.index(status):
                 status = c.status
-        # Fresh object: copies[0] is the live parsed spec inside
-        # holders[0]; writing the synthetic merged view onto it would
+        # Fresh object: live[0] is the live parsed spec inside a
+        # holder; writing the synthetic merged view onto it would
         # persist it if a holder were ever serialized after the merge.
         merged = dataclasses.replace(
-            copies[0],
+            live[0],
             realized_on=sorted(realized),
             status=status,
             message="; ".join(messages),
@@ -508,6 +536,11 @@ class Controller:
         pod_uid = md.get("uid", "")
         slices = self._load_slices()
         existing = self._find_allocation(slices, pod_uid=pod_uid)
+        #: crash recovery: >0 when a stale deleted epoch is being
+        #: superseded — the fresh placement carries this attempt epoch
+        #: and avoids the nodes still holding the unerased copy
+        reuse_epoch = 0
+        reuse_avoid: frozenset = frozenset()
 
         if existing is not None:
             alloc, holders = existing
@@ -553,6 +586,7 @@ class Controller:
                     for ts in holders
                     for a in ts.spec.allocations.values()
                     if a.alloc_id == alloc.alloc_id
+                    and a.attempt_epoch == alloc.attempt_epoch
                     and a.status == AllocationStatus.FAILED
                 } or set(alloc.parts)
                 now = time.monotonic()
@@ -580,7 +614,35 @@ class Controller:
                 # our pod-ungate write must have been lost; redo it
                 self._ungate_all(alloc)
                 return None
-            return self.no_capacity_requeue  # CREATING/DELETED: wait
+            if (
+                alloc.status == AllocationStatus.CREATING
+                and self._grant_overdue(alloc)
+            ):
+                # stuck-grant watchdog (docs/RECOVERY.md): agents that
+                # never realized within the deadline — a crashed agent,
+                # a wedged device API — roll the epoch back and re-place
+                # away from the laggards
+                return self._grant_deadline_rollback(alloc)
+            if self._stuck_deleted(alloc):
+                # the teardown landed in the CR but no agent erased it
+                # within the deadline (the agent died): stop waiting —
+                # re-place under a fresh attempt epoch, avoiding the
+                # nodes still holding the stale copy (its box stays in
+                # occupancy, so the dead node's chips are never handed
+                # out twice; the agent's restart reaps the copy)
+                reuse_epoch = alloc.attempt_epoch + 1
+                reuse_avoid = frozenset(
+                    ts.name for ts in holders
+                    if alloc.alloc_id in ts.spec.allocations
+                )
+                log.warning(
+                    "allocation %s: deleted epoch %d unerased past "
+                    "deadline; re-placing as epoch %d (avoiding %s)",
+                    alloc.alloc_id, alloc.attempt_epoch, reuse_epoch,
+                    sorted(reuse_avoid),
+                )
+            else:
+                return self.no_capacity_requeue  # CREATING/DELETED: wait
 
         # ----- new allocation -----
         try:
@@ -661,7 +723,7 @@ class Controller:
             )
             return None
 
-        avoid = self._avoid_nodes_for(pod_uid)
+        avoid = self._avoid_nodes_for(pod_uid) | reuse_avoid
         # Admission into the allocation pipeline: mint THE trace id for
         # this grant. It is persisted on the allocation record, so the
         # agent's realize/teardown spans, the device-layer spans, and
@@ -723,21 +785,31 @@ class Controller:
                 if self._cache_ready():
                     # recheck behind the lock: a peer worker may have
                     # granted this allocation after our stale top-of-
-                    # reconcile read (write-through makes it visible)
-                    if self._find_allocation(
+                    # reconcile read (write-through makes it visible).
+                    # A stuck deleted epoch does NOT count as granted —
+                    # superseding it is exactly why we are here.
+                    rechecked = self._find_allocation(
                         slices, pod_uid=pod_uid
-                    ) is not None:
+                    )
+                    if rechecked is not None and not self._stuck_deleted(
+                        rechecked[0]
+                    ):
                         sp.drop = psp.drop = True
                         return 0.05
                     # fresh cache view under the lock (the list read
                     # at the top of the reconcile predates it)
                     slices = self._load_slices()
                 placement = self._place(profile, slices, avoid=avoid)
-                if placement is None and avoid:
+                if placement is None and avoid - reuse_avoid:
                     # nothing fits elsewhere — the failed node may be
                     # the only capacity (single-node cluster): retry in
-                    # place rather than starving the pod
-                    placement = self._place(profile, slices)
+                    # place rather than starving the pod. Stale-epoch
+                    # holders stay avoided: their CR slot is occupied
+                    # by the unerased record, so a placement there is
+                    # GUARANTEED to bounce off the epoch fence — the
+                    # fallback would only buy a re-place/teardown loop
+                    placement = self._place(profile, slices,
+                                            avoid=reuse_avoid)
                 if placement is not None:
                     self._inflight[aid] = (
                         placement.box,
@@ -777,8 +849,22 @@ class Controller:
                 return self.no_capacity_requeue
             self._set_pending(pod_key, False)
             sp.attrs["box"] = placement.box.key()
+            if reuse_epoch:
+                # the epoch marker precedes the fresh creating
+                # transition, so `validate_events --epochs` splits the
+                # chain exactly here
+                get_journal().emit(
+                    "controller", reason=REASON_CRASH_RECOVERED,
+                    object_ref=f"alloc/{aid}",
+                    message=(f"stale deleted epoch unerased past "
+                             f"deadline; re-placing as attempt epoch "
+                             f"{reuse_epoch}"),
+                    trace_id=trace_id,
+                )
             alloc = AllocationDetails.from_placement(
-                placement, pod_refs, alloc_id=aid, trace_id=trace_id
+                placement, pod_refs, alloc_id=aid, trace_id=trace_id,
+                attempt_epoch=reuse_epoch or 1,
+                note="crash recovery" if reuse_epoch else "",
             )
             try:
                 for p in pods:
@@ -827,6 +913,71 @@ class Controller:
             trace_id,
         )
         return self.no_capacity_requeue  # check progress even if events drop
+
+    # ------------------------------------------------ stuck-grant watchdog
+
+    def _grant_overdue(self, alloc: AllocationDetails) -> bool:
+        """True when a ``creating`` allocation blew the realize
+        deadline (wall clock off the persisted ``created_at``, so the
+        verdict survives controller restarts)."""
+        return (
+            self.stuck_grant_deadline > 0
+            and alloc.created_at > 0
+            and time.time() - alloc.created_at > self.stuck_grant_deadline
+        )
+
+    def _stuck_deleted(self, alloc: AllocationDetails) -> bool:
+        """True when a ``deleted`` record sat unerased past the
+        deadline — the owning agent is dead, and waiting for its erase
+        would wedge the pod forever."""
+        return (
+            alloc.status == AllocationStatus.DELETED
+            and self.stuck_grant_deadline > 0
+            and alloc.deletion_requested_at > 0
+            and time.time() - alloc.deletion_requested_at
+            > self.stuck_grant_deadline
+        )
+
+    def _grant_deadline_rollback(self, alloc: AllocationDetails) -> float:
+        """Stuck-grant watchdog action: journal, blame the nodes that
+        never realized, roll the epoch back. The re-place happens on
+        the next reconcile (through the FAILED-retry machinery's
+        avoid set)."""
+        age = time.time() - alloc.created_at
+        laggards = sorted(
+            set(alloc.parts) - set(alloc.realized_on)
+        ) or sorted(alloc.parts)
+        log.warning(
+            "allocation %s stuck in creating %.0fs (> %.0fs); rolling "
+            "back (unrealized on %s)",
+            alloc.alloc_id, age, self.stuck_grant_deadline, laggards,
+        )
+        get_journal().emit(
+            "controller", reason=REASON_GRANT_DEADLINE,
+            object_ref=f"alloc/{alloc.alloc_id}",
+            message=(f"stuck in creating {age:.0f}s (deadline "
+                     f"{self.stuck_grant_deadline:g}s); rolling back "
+                     f"(unrealized on {laggards})"),
+            trace_id=alloc.trace_id,
+        )
+        now = time.monotonic()
+        deadline = now + self.failed_node_avoid_seconds
+        with self._failed_nodes_lock:
+            for ref in alloc.pods:
+                avoid = self._failed_nodes.setdefault(ref.pod_uuid, {})
+                for node in laggards:
+                    avoid[node] = deadline
+        for ref in alloc.pods:
+            emit_pod_event(
+                self.client, ref.namespace, ref.pod_name,
+                reason=REASON_GRANT_DEADLINE,
+                message=(f"grant stuck {age:.0f}s waiting on "
+                         f"{laggards}; rolling back for re-placement"),
+                component="controller", pod_uid=ref.pod_uuid,
+                trace_id=alloc.trace_id, event_type="Warning",
+            )
+        self._mark_deleted(alloc)
+        return 0.5
 
     @staticmethod
     def _group_alloc_id(namespace: str, gid: str) -> str:
@@ -1067,12 +1218,24 @@ class Controller:
         )
         ok = True
         for node in alloc.parts:
+            # crash point (docs/RECOVERY.md): between per-node fan-out
+            # writes — firing on call 1 dies before anything landed, on
+            # call 2+ with a half-landed multi-node fan-out
+            maybe_crash("controller.write_allocation")
             conflict = [False]
 
             def mut(obj: dict, _c=conflict) -> Optional[dict]:
                 ts = TpuSlice.from_manifest(obj)
                 _c[0] = False  # conflict retry re-reads fresh state
-                if alloc.alloc_id in ts.spec.allocations:
+                held = ts.spec.allocations.get(alloc.alloc_id)
+                if held is not None:
+                    if held.attempt_epoch < alloc.attempt_epoch:
+                        # a stale epoch's copy still occupies the slot
+                        # (one record per alloc_id per CR): the write
+                        # cannot land here until the agent erases it —
+                        # surface as a conflict so the caller re-places
+                        # instead of believing the epoch was written
+                        _c[0] = True
                     return None
                 for other in ts.spec.allocations.values():
                     if Box.from_key(other.box).overlaps(new_box):
@@ -1100,12 +1263,47 @@ class Controller:
         self, alloc: AllocationDetails, slices: List[TpuSlice]
     ) -> None:
         """A crash between fan-out writes leaves some CRs without the
-        allocation record; complete it idempotently."""
-        have = {
-            ts.name
-            for ts in slices
-            if alloc.alloc_id in ts.spec.allocations
-        }
+        allocation record; complete it idempotently. Copies from an
+        OLDER attempt epoch (the crashed writer's half-landed state)
+        are marked deleted so their agents release and erase them —
+        they are exactly what a restart must clean up, never what it
+        repairs."""
+        have = set()
+        stale_nodes: List[str] = []
+        for ts in slices:
+            held = ts.spec.allocations.get(alloc.alloc_id)
+            if held is None:
+                continue
+            if held.attempt_epoch == alloc.attempt_epoch:
+                have.add(ts.name)
+            elif (
+                held.attempt_epoch < alloc.attempt_epoch
+                and held.status != AllocationStatus.DELETED
+            ):
+                stale_nodes.append(ts.name)
+        for node in stale_nodes:
+            def mut(obj: dict) -> Optional[dict]:
+                ts = TpuSlice.from_manifest(obj)
+                a = ts.spec.allocations.get(alloc.alloc_id)
+                if (
+                    a is None
+                    or a.attempt_epoch >= alloc.attempt_epoch
+                    or a.status == AllocationStatus.DELETED
+                ):
+                    return None
+                a.set_status(
+                    AllocationStatus.DELETED,
+                    f"stale attempt epoch {a.attempt_epoch} superseded "
+                    f"by {alloc.attempt_epoch}",
+                )
+                a.deletion_requested_at = time.time()
+                return ts.to_manifest()
+
+            try:
+                self._apply_cr(node, mut)
+            except NotFound:
+                log.warning("CR %s gone while reaping stale epoch of "
+                            "%s", node, alloc.alloc_id)
         missing = set(alloc.parts) - have
         if missing:
             self._write_allocation(alloc)
@@ -1199,6 +1397,10 @@ class Controller:
             except NotFound:
                 continue
 
+        # crash point (docs/RECOVERY.md): gates removed, CREATED→UNGATED
+        # status edge not yet written — the restart's ungated-pod pass
+        # (_maybe_finish_ungate) completes exactly this
+        maybe_crash("controller.ungate")
         granted_at = time.time()
 
         def mutate(a: AllocationDetails) -> bool:
@@ -1244,20 +1446,209 @@ class Controller:
     def _maybe_finish_ungate(self, pod: dict) -> Optional[float]:
         """Pod already ungated/running: make sure the allocation status
         caught up (covers a crash between pod update and CR write), then
-        reconcile slice health for the granted allocation."""
+        reconcile slice health for the granted allocation.
+
+        Restart reconciliation (docs/RECOVERY.md): this path also
+        adopts lifecycles a dead component abandoned mid-flight — an
+        ungated pod whose record is still ``creating`` (a crashed
+        repacker's re-grant, a crash-recovery re-place) is driven
+        through promote→ungate here, and an ungated pod with NO record
+        at all (death between the repacker's drain and re-grant) is
+        re-granted via :meth:`_recover_ungated_orphan`."""
         md = pod["metadata"]
         slices = self._load_slices()
         found = self._find_allocation(slices, pod_uid=md.get("uid", ""))
         if found is None:
-            return None
-        alloc, _ = found
+            return self._recover_ungated_orphan(pod)
+        alloc, holders = found
+        if alloc.status == AllocationStatus.CREATING:
+            self._repair_fanout(alloc, slices)
+            if alloc.fully_realized():
+                self._promote_created(alloc)
+                alloc.status = AllocationStatus.CREATED
+            elif self._grant_overdue(alloc):
+                return self._grant_deadline_rollback(alloc)
+            else:
+                return self.no_capacity_requeue  # agents realizing
         if alloc.status == AllocationStatus.CREATED:
             self._ungate_all(alloc)
+        if alloc.status == AllocationStatus.FAILED:
+            # an adopted in-flight epoch failed to realize: tear it
+            # down; the pod stays ungated and the DELETED→erase→
+            # _recover_ungated_orphan loop re-places it
+            self._mark_deleted(alloc)
+            return 0.5
+        if self._stuck_deleted(alloc):
+            # dead agent never erased the teardown: the orphan-recovery
+            # pass cannot fire until the record is gone, so supersede
+            # it the same way the gated path does — re-grant fresh
+            return self._recover_ungated_orphan(
+                pod, supersede=alloc,
+                stale_nodes=frozenset(
+                    ts.name for ts in holders
+                    if alloc.alloc_id in ts.spec.allocations
+                ),
+            )
         if alloc.status in (
             AllocationStatus.CREATED, AllocationStatus.UNGATED
         ):
             self._reconcile_slice_health(alloc, slices)
         return None
+
+    def _recover_ungated_orphan(
+        self, pod: dict,
+        supersede: Optional[AllocationDetails] = None,
+        stale_nodes: frozenset = frozenset(),
+    ) -> Optional[float]:
+        """Adopt a grant a dead component abandoned chip-less: an
+        UNGATED pod carrying our finalizer whose allocation record is
+        gone (the repacker died between drain and re-grant — its erase
+        landed, its re-grant never did) or sits in an unerased stale
+        deleted epoch (``supersede``). Re-place and re-grant under a
+        fresh attempt epoch, journaled ``CrashRecovered``; the pod was
+        never re-gated, so the eventual ungate is a pure status edge —
+        exactly the repacker's own contract (docs/RECOVERY.md)."""
+        md = pod.get("metadata", {})
+        if md.get("deletionTimestamp"):
+            return None
+        if FINALIZER not in (md.get("finalizers") or []):
+            return None  # never granted by us: nothing to recover
+        if pod.get("status", {}).get("phase", "") in (
+            "Succeeded", "Failed"
+        ):
+            return None
+        try:
+            profile = extract_profile(pod)
+            gid, size = pod_group(pod)
+        except ValueError:
+            return None
+        if profile is None:
+            return None
+        pods = [pod]
+        if gid:
+            # group members are all UNGATED here, so the gated-group
+            # index cannot serve them; this path is rare (one crashed
+            # migration), so a live list is fine
+            namespace = md.get("namespace", "")
+            peers = [
+                p for p in self.client.list("Pod", namespace=namespace)
+                if (p.get("metadata", {}).get("annotations") or {}).get(
+                    GROUP_ANNOTATION
+                ) == gid
+                and not p.get("metadata", {}).get("deletionTimestamp")
+            ]
+            peers.sort(key=lambda p: p["metadata"]["name"])
+            if len(peers) < size:
+                return None  # partial group: let deletion/reap settle
+            pods = peers[:size]
+        if len(pods) != profile.hosts_needed():
+            return None
+        pod_refs = [
+            PodRef(
+                pod_uuid=p["metadata"].get("uid", ""),
+                pod_name=p["metadata"]["name"],
+                namespace=p["metadata"].get("namespace", ""),
+                worker_id=i,
+                handoff_name=(
+                    p["metadata"].get("annotations") or {}
+                ).get(HANDOFF_ANNOTATION, ""),
+            )
+            for i, p in enumerate(
+                sorted(pods, key=lambda p: p["metadata"]["name"])
+            )
+        ]
+        if gid:
+            aid = self._group_alloc_id(pod_refs[0].namespace, gid)
+        else:
+            aid = pod_refs[0].pod_uuid
+        epoch = (supersede.attempt_epoch + 1) if supersede is not None \
+            else 1
+        trace_id = new_trace_id()
+        pod_key = self._pod_key(pod)
+        with self.tracer.span(
+            "controller.allocate", trace_id=trace_id,
+            pod=pod_key, profile=profile.name, recovery="true",
+        ) as sp:
+            with self.tracer.span("controller.place") as psp, \
+                    self._placement_lock:
+                if aid in self._inflight:
+                    # a live repacker (or a peer worker's recovery)
+                    # owns this very allocation right now
+                    sp.drop = psp.drop = True
+                    return 0.1
+                slices = self._load_slices()
+                rechecked = self._find_allocation(
+                    slices, pod_uid=md.get("uid", "")
+                )
+                if rechecked is not None and not self._stuck_deleted(
+                    rechecked[0]
+                ):
+                    sp.drop = psp.drop = True
+                    return 0.05  # someone re-granted already
+                # honor the failed-node memory exactly like the gated
+                # path: the stuck-grant watchdog may have just blamed a
+                # wedged node, and recovery must not re-place straight
+                # back onto it while other capacity exists. Stale-epoch
+                # holders are NEVER retried in place even as a
+                # fallback: the unerased record occupies their CR slot,
+                # so the epoch fence in _write_allocation would refuse
+                # the write every time — when they hold the only
+                # capacity, the right move is the quiet requeue below
+                # until the dead agent restarts and reaps the copy
+                blamed = self._avoid_nodes_for(md.get("uid", ""))
+                placement = self._place(profile, slices,
+                                        avoid=blamed | stale_nodes)
+                if placement is None and blamed:
+                    placement = self._place(profile, slices,
+                                            avoid=stale_nodes)
+                if placement is not None:
+                    self._inflight[aid] = (
+                        placement.box,
+                        frozenset(placement.node_names),
+                        placement.group_id,
+                    )
+            if placement is None:
+                sp.attrs["placed"] = "false"
+                return self.no_capacity_requeue
+            sp.attrs["box"] = placement.box.key()
+            get_journal().emit(
+                "controller", reason=REASON_CRASH_RECOVERED,
+                object_ref=f"alloc/{aid}",
+                message=(f"adopting abandoned grant for ungated pod "
+                         f"{pod_key}: re-granting {profile.name} at "
+                         f"{placement.box.key()} (attempt epoch "
+                         f"{epoch})"),
+                trace_id=trace_id,
+            )
+            for ref in pod_refs:
+                emit_pod_event(
+                    self.client, ref.namespace, ref.pod_name,
+                    reason=REASON_CRASH_RECOVERED,
+                    message=(f"allocation lost mid-lifecycle (crashed "
+                             f"component); re-granting {profile.name} "
+                             f"at {placement.box.key()}"),
+                    component="controller", pod_uid=ref.pod_uuid,
+                    trace_id=trace_id,
+                )
+            alloc = AllocationDetails.from_placement(
+                placement, pod_refs, alloc_id=aid, trace_id=trace_id,
+                attempt_epoch=epoch, note="crash recovery",
+            )
+            try:
+                placed = self._write_allocation(alloc)
+            finally:
+                with self._placement_lock:
+                    self._inflight.pop(aid, None)
+            if not placed:
+                sp.attrs["placed"] = "conflict"
+                self._mark_deleted(alloc)
+                return 0.2
+        log.info(
+            "crash recovery: re-granted %s for ungated pod %s at %s "
+            "(epoch %d, trace %s)",
+            aid, pod_key, alloc.box, epoch, trace_id,
+        )
+        return 0.5  # drive promote→ungate promptly
 
     def _reconcile_slice_health(
         self, alloc: AllocationDetails, slices: List[TpuSlice]

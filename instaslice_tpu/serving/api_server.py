@@ -115,9 +115,29 @@ _Scheduler = Scheduler
 class _Handler(BaseHTTPRequestHandler):
     scheduler: _Scheduler = None  # type: ignore[assignment]
     request_timeout: float = 300.0
+    #: live client sockets, tracked so ApiServer.kill() can sever them
+    #: the way a dying process's RSTs would (crash-chaos tier); bound
+    #: per server via the BoundHandler subclass
+    connections: Optional[set] = None
+    connections_lock = None
 
     def log_message(self, *a):  # quiet
         pass
+
+    def setup(self) -> None:
+        super().setup()
+        if self.connections is not None:
+            with self.connections_lock:
+                self.connections.add(self.connection)
+
+    def finish(self) -> None:
+        if self.connections is not None:
+            with self.connections_lock:
+                self.connections.discard(self.connection)
+        try:
+            super().finish()
+        except (OSError, ValueError):
+            pass  # socket already severed by kill()
 
     def _send(self, code: int, payload: dict,
               retry_after: Optional[float] = None,
@@ -783,13 +803,22 @@ class ApiServer:
                                     tenants=tenants, mode=mode,
                                     preempt_margin=preempt_margin,
                                     overlap=overlap)
+        from instaslice_tpu.utils.lockcheck import named_lock
+
+        self._conns: set = set()
+        self._conns_lock = named_lock("serve.conns")
         handler = type("BoundHandler", (_Handler,),
                        {"scheduler": self.scheduler,
-                        "request_timeout": request_timeout})
+                        "request_timeout": request_timeout,
+                        "connections": self._conns,
+                        "connections_lock": self._conns_lock})
         self._srv = ThreadingHTTPServer((host, port), handler)
         self._thread = threading.Thread(
             target=self._srv.serve_forever, name="serve-http", daemon=True
         )
+        #: an InjectedCrash on the scheduler thread kills the whole
+        #: replica: sever clients mid-stream, no drain, no terminals
+        self.scheduler.on_fatal = self.kill
 
     @property
     def url(self) -> str:
@@ -817,6 +846,37 @@ class ApiServer:
         self.scheduler.stop_flag.set()
         self._srv.shutdown()
         self._srv.server_close()
+        self._thread.join(timeout=5)
+
+    def kill(self) -> None:
+        """Abrupt process-death emulation (crash-chaos tier,
+        docs/RECOVERY.md): no drain, no terminal responses. The
+        scheduler stops dead (in-flight engine state is abandoned),
+        the listener closes, and every live client connection is
+        severed — streaming clients observe a truncated stream
+        (loadgen outcome ``stream-truncated``), sync clients a dropped
+        connection. What a fresh replica can recover is exactly the
+        durable truth a real crash leaves: nothing in this process."""
+        import socket as _socket
+
+        self.scheduler.stop_flag.set()
+        try:
+            self._srv.shutdown()
+            self._srv.server_close()
+        except OSError:
+            log.warning("kill: listener close raised", exc_info=True)
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass  # already closing
+            try:
+                conn.close()
+            except OSError:
+                pass
         self._thread.join(timeout=5)
 
     def __enter__(self) -> "ApiServer":

@@ -46,17 +46,41 @@ def _percentile(xs: List[float], q: float) -> float:
 #: stay ZERO for a healthy server is "hung": the client's own timeout
 #: expired, i.e. the server never produced a terminal response — the
 #: exact failure mode the drain/shed machinery exists to eliminate.
-OUTCOMES = ("ok", "shed-429", "timeout-503", "transport-error", "hung")
+#: "stream-truncated" is a mid-stream disconnect AFTER the first token
+#: (a replica killed under the router, a severed proxy): its own class
+#: so the crash-chaos tier can reconcile the ledger exactly — those
+#: requests received real tokens, so lumping them into
+#: "transport-error" (which promises zero delivery) would lie.
+OUTCOMES = ("ok", "shed-429", "timeout-503", "stream-truncated",
+            "transport-error", "hung")
 
 
-def _classify(err: Optional[str], code: Optional[int]) -> str:
+#: in-band SSE error messages that mean "the stream was CUT", not "the
+#: server terminated with an error": the raw upstream-died signature
+#: plus the router's relayed forms (serving/router.py writes these when
+#: the replica it was proxying dies mid-stream) — a router-side
+#: mid-stream disconnect must classify exactly like a direct one
+_TRUNCATION_SIGNATURES = (
+    "stream ended without [DONE]",
+    "replica stream died",
+    "replica stream ended early",
+)
+
+
+def _classify(err: Optional[str], code: Optional[int],
+              tokens: int = 0) -> str:
     """Outcome class for one finished request. 429 = the server shed
     load (backpressure working as designed); 503 = a terminal timeout/
     drain response; a client-side timeout means the request HUNG —
-    no terminal response ever arrived. Other HTTP errors (a clean 500
-    from engine recovery, a 400) also land in "transport-error" — the
-    report's ``status_counts`` breakdown separates those terminal
-    server responses from genuine transport failures (code None)."""
+    no terminal response ever arrived. A severed stream after >= 1
+    delivered token — a transport failure (code None), or an in-band
+    truncation signature relayed by the router — is
+    "stream-truncated" (the crash-chaos signature of a killed
+    replica). Clean in-band terminal errors after tokens (an engine
+    recovery losing the slot) stay "transport-error": the server was
+    alive and said so. The report's ``status_counts`` breakdown
+    separates terminal server responses from genuine transport
+    failures (code None)."""
     if err is None:
         return "ok"
     if code == 429:
@@ -67,6 +91,11 @@ def _classify(err: Optional[str], code: Optional[int]) -> str:
         "timed out" in err or "TimeoutError" in err
     ):
         return "hung"
+    if tokens > 0 and (
+        code is None
+        or any(sig in err for sig in _TRUNCATION_SIGNATURES)
+    ):
+        return "stream-truncated"
     return "transport-error"
 
 
@@ -95,6 +124,11 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
         method="POST",
     )
     t0 = time.monotonic()
+    # initialized OUTSIDE the try: a mid-stream failure must report the
+    # tokens already delivered (outcome classification distinguishes a
+    # truncated stream from a request that never got anything)
+    ttft = None
+    toks = 0
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             if not stream:
@@ -102,8 +136,6 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
                 dt = time.monotonic() - t0
                 toks = sum(len(c["token_ids"]) for c in out["choices"])
                 return dt, None, toks, None, r.status
-            ttft = None
-            toks = 0
             buf = b""
             while True:
                 chunk = r.read1(65536)
@@ -145,14 +177,17 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
         # the client deadline expired with NO terminal response: the
         # request is HUNG — the one outcome a robust server must never
         # produce (classified separately so runs can assert on it)
-        return (time.monotonic() - t0, None, 0,
+        return (time.monotonic() - t0, ttft, toks,
                 f"TimeoutError: {e or 'timed out'}", None)
     except Exception as e:  # slicelint: disable=broad-except
         # ACCOUNT for every failure (IncompleteRead from a dropped
-        # body, JSONDecodeError from a proxy's HTML error page, …);
+        # body, JSONDecodeError from a proxy's HTML error page,
+        # ConnectionResetError from a killed replica mid-stream, …);
         # an uncaught exception would kill the worker thread silently
-        # and the run would report fewer requests with zero errors
-        return (time.monotonic() - t0, None, 0,
+        # and the run would report fewer requests with zero errors.
+        # Tokens already streamed ride along so classification can
+        # tell a truncated stream from a dead-on-arrival request.
+        return (time.monotonic() - t0, ttft, toks,
                 f"{type(e).__name__}: {e}", None)
 
 
@@ -383,13 +418,14 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
                 tenant=tenant_of[i],
             )
             with lock:
-                outcomes[_classify(err, code)] += 1
+                outcome = _classify(err, code, toks)
+                outcomes[outcome] += 1
                 key = str(code) if code is not None else "none"
                 status_counts[key] = status_counts.get(key, 0) + 1
                 t = tenant_of[i]
                 if t:
                     t_outcomes.setdefault(t, {k: 0 for k in OUTCOMES})
-                    t_outcomes[t][_classify(err, code)] += 1
+                    t_outcomes[t][outcome] += 1
                 if err is None:
                     lat.append(dt)
                     tokens[0] += toks

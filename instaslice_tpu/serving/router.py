@@ -375,9 +375,13 @@ class _ProxyContext:
             h["X-Tenant"] = self.tenant
         return h
 
-    def _open(self, rep: Replica, payload: dict):
+    def _open(self, rep: Replica, payload: dict,
+              timeout: Optional[float] = None):
         """POST a completion to ``rep``; returns the live response
-        object (streaming reads follow). Breaker-audited."""
+        object (streaming reads follow). Breaker-audited. ``timeout``
+        overrides the default socket deadline (migration hops use the
+        shorter ``migrate_timeout`` so a wedged destination falls back
+        to a survivor instead of holding the client)."""
         rep.breaker.check()
         req = urllib.request.Request(
             rep.url + "/v1/completions",
@@ -386,7 +390,7 @@ class _ProxyContext:
         )
         try:
             resp = urllib.request.urlopen(
-                req, timeout=self.r.request_timeout
+                req, timeout=timeout or self.r.request_timeout
             )
         except urllib.error.HTTPError:
             raise                       # terminal HTTP status: not a
@@ -575,11 +579,23 @@ class _ProxyContext:
                 code, imp = self.r.http_json(
                     "POST", dest, "/v1/sessions/import",
                     {"session": blob},
+                    timeout=self.r.migrate_timeout,
                 )
                 if code != 200:
                     continue
                 payload = {"resume": imp["rid"], "stream": self.stream}
-                resp = self._open(dest, payload)
+                resp = self._open(dest, payload,
+                                  timeout=self.r.migrate_timeout)
+                # the hop timeout bounded the handshake; the RESUMED
+                # stream gets the normal request deadline back — a
+                # migrated session legitimately parked/queued on its
+                # destination between tokens is not a wedged hop
+                try:
+                    resp.fp.raw._sock.settimeout(
+                        self.r.request_timeout
+                    )
+                except AttributeError:
+                    log.debug("could not widen resumed-stream timeout")
             except (urllib.error.HTTPError, *_TRANSPORT_EXC,
                     CircuitOpen) as e:
                 log.warning("migration to %s failed: %s", dest.url, e)
@@ -732,12 +748,33 @@ class Router:
                  stale_after: float = 3.0, request_timeout: float = 300.0,
                  max_retries: int = 2, session_ttl: float = 600.0,
                  breaker_threshold: int = 3,
-                 breaker_cooldown: float = 2.0, metrics=None) -> None:
+                 breaker_cooldown: float = 2.0, metrics=None,
+                 migrate_timeout: Optional[float] = None) -> None:
         self.poll_interval = poll_interval
         self.stale_after = stale_after
         self.request_timeout = request_timeout
         self.max_retries = max_retries
         self.session_ttl = session_ttl
+        # self-healing watchdog (docs/RECOVERY.md): bound on EACH
+        # migration hop (import POST + resume handshake). Without it a
+        # destination that accepted the import and then wedged (crashed
+        # scheduler thread) would hold the client the full
+        # request_timeout; with it the hop times out, the next survivor
+        # is tried, and the re-prefill fallback terminates the request
+        # with the right tokens. The orphaned import on the wedged
+        # replica is swept engine-side after its import TTL.
+        # 0 (or negative) = disabled: hops get the full
+        # request_timeout — normalized HERE so every consumer sees one
+        # semantic (a raw 0 reaching urlopen would mean non-blocking
+        # sockets and instantly failing imports).
+        from instaslice_tpu.utils.envutil import env_float
+
+        if migrate_timeout is None:
+            migrate_timeout = env_float(
+                "TPUSLICE_ROUTER_MIGRATE_TIMEOUT", 15.0)
+        if migrate_timeout <= 0:
+            migrate_timeout = request_timeout
+        self.migrate_timeout = migrate_timeout
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
         self._lock = named_lock("router.state")
@@ -1119,6 +1156,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--session-ttl", type=float, default=600.0,
                     help="seconds of inactivity before a session "
                          "affinity entry expires")
+    ap.add_argument("--migrate-timeout", type=float, default=None,
+                    help="seconds each migration hop (session import + "
+                         "resume handshake) may take before the next "
+                         "survivor / the re-prefill fallback is tried; "
+                         "0 disables (hops get the full request "
+                         "timeout) (env: "
+                         "TPUSLICE_ROUTER_MIGRATE_TIMEOUT; default 15)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="Prometheus /metrics port (0 = off)")
     return ap
@@ -1135,6 +1179,7 @@ def main(argv=None) -> int:
         poll_interval=args.poll_interval, stale_after=args.stale_after,
         request_timeout=args.request_timeout,
         max_retries=args.max_retries, session_ttl=args.session_ttl,
+        migrate_timeout=args.migrate_timeout,
     ).start()
     if args.metrics_port:
         from instaslice_tpu.metrics.metrics import start_metrics_server

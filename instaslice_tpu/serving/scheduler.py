@@ -75,6 +75,7 @@ from instaslice_tpu.api.constants import (
     REASON_SHED,
     REASON_SLO_MISSED,
 )
+from instaslice_tpu.faults import maybe_crash
 from instaslice_tpu.obs.journal import get_journal
 from instaslice_tpu.serving.engine import (
     AdmissionRequest,
@@ -376,9 +377,16 @@ class Scheduler(threading.Thread):
         #: metadata (remaining budget, streamed-token watermark, tenant)
         #: from the blob; a ``resume`` completion claims it. Swept
         #: after ``import_ttl`` so an orphaned import cannot hold KV
-        #: blocks forever.
+        #: blocks forever (env: TPUSLICE_IMPORT_TTL).
+        from instaslice_tpu.utils.envutil import env_float
+
         self._imports: Dict[int, dict] = {}
-        self.import_ttl = 60.0
+        self.import_ttl = env_float("TPUSLICE_IMPORT_TTL", 60.0)
+        #: crash hook: called (once) when an InjectedCrash kills this
+        #: scheduler thread, so the owning ApiServer can sever its
+        #: client connections like a dying process would
+        #: (ApiServer.kill, docs/RECOVERY.md)
+        self.on_fatal = None
         self.migrated_out = 0         # sessions exported off this
         self.migrated_in = 0          # replica / resumed onto it
         self.migrate_preempts = 0     # exports that parked a LIVE slot
@@ -629,6 +637,11 @@ class Scheduler(threading.Thread):
             blob["tenant"] = p.tenant
             blob["want_logprobs"] = p.want_logprobs
             blob["trace_id"] = p.trace_id
+            # crash point (docs/RECOVERY.md): the blob exists but the
+            # source copy still holds the session — a death here loses
+            # the in-flight response; the router's migration timeout
+            # falls the client back to re-prefill on a survivor
+            maybe_crash("serve.export")
             # copy-then-delete: the blob exists (and is about to ride
             # the terminal response) before the source copy drops
             eng.drop_parked(rid)
@@ -786,9 +799,26 @@ class Scheduler(threading.Thread):
     # ------------------------------------------------------------- loop
 
     def run(self) -> None:
+        from instaslice_tpu.faults import InjectedCrash
+
         while not self.stop_flag.is_set():
             try:
                 self._round()
+            except InjectedCrash as e:
+                # a crash point fired: this replica is dead — no drain,
+                # no terminal responses. Tell the owning server to
+                # sever its client connections (a dying process RSTs
+                # them; clients classify the truncation) and die.
+                log.warning("scheduler: %s — replica dying", e)
+                self.stop_flag.set()
+                hook, self.on_fatal = self.on_fatal, None
+                if hook is not None:
+                    try:
+                        hook()
+                    except Exception:  # noqa: BLE001 - dying anyway
+                        log.warning("on_fatal hook raised",
+                                    exc_info=True)
+                return
             except Exception as e:  # noqa: BLE001 - keep serving
                 # one bad round (injected fault, transient device error
                 # outside the decode guard) must never kill the

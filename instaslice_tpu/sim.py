@@ -152,6 +152,8 @@ class SimCluster:
         repack_max_concurrent: int = 2,
         repack_cooldown: float = 1.0,
         repack_frag_threshold: Optional[float] = None,
+        repack_stuck_abort: Optional[float] = None,
+        stuck_grant_deadline: Optional[float] = None,
     ) -> None:
         """``transport="inproc"`` wires every component straight to the
         in-process FakeKube. ``transport="http"`` puts the store behind
@@ -244,6 +246,8 @@ class SimCluster:
         self.namespace = namespace
         self.generation = generation
         self.bind_latency = max(0.0, bind_latency)
+        self._metrics = metrics
+        self._health_interval = health_interval
         gen = get_generation(generation)
         hb = gen.host_bounds
         self.backends: Dict[str, FakeTpuBackend] = {}
@@ -323,16 +327,20 @@ class SimCluster:
                 metrics=metrics,
                 wrap_backend=self._wrap_backend,
             )
-        self.controller = Controller(
-            self._client_for(),
+        #: constructor args remembered so restart_controller() can
+        #: build a FRESH instance (crash-chaos driver, docs/RECOVERY.md)
+        self._ctl_opts = dict(
             namespace=namespace,
             policy=policy,
             deletion_grace_seconds=deletion_grace_seconds,
             metrics=metrics,
             workers=workers,
             use_cache=use_cache,
+            stuck_grant_deadline=stuck_grant_deadline,
         )
+        self.controller = Controller(self._client_for(), **self._ctl_opts)
         self.repacker = None
+        self._repack_opts = None
         if repack:
             if not use_cache:
                 raise ValueError(
@@ -341,13 +349,14 @@ class SimCluster:
                 )
             from instaslice_tpu.controller.defrag import Repacker
 
-            self.repacker = Repacker(
-                self.controller,
+            self._repack_opts = dict(
                 interval=repack_interval,
                 max_concurrent=repack_max_concurrent,
                 cooldown=repack_cooldown,
                 frag_threshold=repack_frag_threshold,
+                stuck_abort_seconds=repack_stuck_abort,
             )
+            self.repacker = Repacker(self.controller, **self._repack_opts)
         # Optional fake-kubelet tier: a per-node SlicePluginManager serving
         # real gRPC device plugins over unix sockets; the sim scheduler
         # plays kubelet (GetPreferredAllocation → Allocate) when binding
@@ -466,6 +475,76 @@ class SimCluster:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # ------------------------------------------------- crash-chaos driver
+
+    def restart_controller(self) -> None:
+        """Kill-and-restart the controller (and its repacker, when
+        configured) against the durable CR state — the crash-chaos
+        driver's primitive (docs/RECOVERY.md). The dead instance's
+        in-memory state (placement overlay, pending set, coalesced
+        writes, active migrations) dies with it; the fresh instance
+        adopts everything from the API server exactly as a restarted
+        process would. Safe after an InjectedCrash already
+        crash-stopped the old manager."""
+        from instaslice_tpu.api.constants import REASON_CRASH_RECOVERED
+        from instaslice_tpu.obs.journal import get_journal
+
+        if self.repacker is not None:
+            try:
+                self.repacker.stop()
+            except Exception:
+                log.warning("crashed repacker stop raised", exc_info=True)
+        try:
+            self.controller.stop()
+        except Exception:
+            log.warning("crashed controller stop raised", exc_info=True)
+        self.controller = Controller(self._client_for(), **self._ctl_opts)
+        if self._repack_opts is not None:
+            from instaslice_tpu.controller.defrag import Repacker
+
+            self.repacker = Repacker(self.controller, **self._repack_opts)
+        get_journal().emit(
+            "sim", reason=REASON_CRASH_RECOVERED,
+            object_ref="component/controller",
+            message="controller restarted (crash-chaos driver)",
+        )
+        self.controller.start()
+        if self.repacker is not None:
+            self.repacker.start()
+
+    def restart_agent(self, node: str) -> None:
+        """Kill-and-restart one node agent. Its device backend is NOT
+        reset — device reservations are per-node durable truth, which
+        is exactly what the restart's discovery sweep reconciles
+        against the CR (orphan reaping, re-driven realizes)."""
+        from instaslice_tpu.api.constants import REASON_CRASH_RECOVERED
+        from instaslice_tpu.obs.journal import get_journal
+
+        agent = self.agents.get(node)
+        if agent is None:
+            raise ValueError(
+                f"no per-node agent for {node!r} (fleet_agents mode "
+                "restarts are not supported)"
+            )
+        try:
+            agent.stop()
+        except Exception:
+            log.warning("crashed agent stop raised", exc_info=True)
+        self.agents[node] = NodeAgent(
+            self._client_for(),
+            self._wrap_backend(self.backends[node]),
+            node,
+            self.namespace,
+            metrics=self._metrics,
+            health_interval=self._health_interval,
+        )
+        get_journal().emit(
+            "sim", reason=REASON_CRASH_RECOVERED,
+            object_ref=f"component/agent-{node}",
+            message=f"agent {node} restarted (crash-chaos driver)",
+        )
+        self.agents[node].start()
 
     # ------------------------------------------------------ pod submission
 

@@ -23,6 +23,7 @@ import traceback
 import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
+from instaslice_tpu.faults import InjectedCrash
 from instaslice_tpu.kube.informer import Informer
 from instaslice_tpu.utils.lockcheck import named_condition
 
@@ -351,6 +352,17 @@ class Manager:
                     f"{self.name}.reconcile", key=key, shard=shard
                 ):
                     requeue = self.reconcile(key)
+            except InjectedCrash as e:
+                # a crash point fired on this worker: the whole
+                # component is dead, not just this thread — crash-stop
+                # the manager (no joins: we ARE a worker) so the other
+                # workers wind down like a killed process's threads,
+                # and let the driver restart a fresh instance against
+                # the durable state (docs/RECOVERY.md)
+                log.warning("%s: %s — crash-stopping the manager",
+                            self.name, e)
+                self.halt()
+                return
             except Exception:
                 self._error_counts[shard] += 1
                 log.warning(
@@ -374,13 +386,26 @@ class Manager:
             w.start()
             self._threads.append(w)
 
+    def halt(self) -> None:
+        """Crash-stop: signal everything down WITHOUT joining worker
+        threads — callable from a worker (a crash point fires on the
+        thread it kills). Leases are deliberately NOT released: a
+        killed process doesn't release its leases either; expiry hands
+        them over. The manager is dead afterwards — restart means a
+        fresh instance."""
+        self._stop.set()
+        self.queue.close()
+        for inf in self._informers.values():
+            inf.stop(timeout=0)
+
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self.queue.close()
         for inf in self._informers.values():
             inf.stop(timeout=timeout)
         for t in self._threads:
-            t.join(timeout=timeout)
+            if t is not threading.current_thread():
+                t.join(timeout=timeout)
         for elector in self._electors.values():
             try:
                 elector.release()

@@ -616,3 +616,48 @@ class TestProactiveRepack:
             ["--repack", "--repack-frag-threshold", "0.4"]
         )
         assert args.repack_frag_threshold == 0.4
+
+    def test_record_vanishing_mid_migration_finishes_failed(self):
+        """Regression for the `_advance` record-vanished path: a pod
+        force-deleted mid-migration erases the allocation under the
+        repacker — the migration must finish failed (journaled, slot
+        and destination reservation released), never spin or re-grant
+        a dead pod."""
+        from instaslice_tpu.api import PodRef
+        from instaslice_tpu.api.constants import REASON_REPACK_FAILED
+        from instaslice_tpu.controller.defrag import Migration
+        from instaslice_tpu.topology.placement import Box
+
+        with self._sim() as c:
+            c.submit("seed-pod", profile="v5e-1x1")
+            assert c.wait_phase("seed-pod", "Running", timeout=30)
+            rep = c.repacker
+            rep.stop()  # drive ticks by hand
+            mig = Migration(
+                alloc_id="ghost-alloc", group_id="sim-torus-0",
+                profile="v5e-1x1", old_box="0,0,0+1x1x1",
+                dest_box="1,0,0+1x1x1", target_box="0,0,0+2x2x1",
+                pending_profile="v5e-2x2",
+                pods=[PodRef(pod_uuid="uid-gone", pod_name="gone",
+                             namespace="default")],
+                trace_id="t-vanish", started=time.monotonic(),
+                phase="realizing",
+            )
+            rep._active[mig.alloc_id] = mig
+            with c.controller._placement_lock:
+                c.controller._inflight[mig.alloc_id] = (
+                    Box.from_key(mig.dest_box), frozenset({"node-0"}),
+                    mig.group_id,
+                )
+            failed_before = rep.migrations_failed
+            rep.run_once()
+            assert rep.migrations_failed == failed_before + 1
+            assert mig.alloc_id not in rep._active
+            with c.controller._placement_lock:
+                assert mig.alloc_id not in c.controller._inflight
+            evs = get_journal().events(reason=REASON_REPACK_FAILED)
+            assert any("vanished" in e.message for e in evs), (
+                [e.message for e in evs]
+            )
+            # the unrelated granted pod is untouched
+            assert c.pod_phase("seed-pod") == "Running"

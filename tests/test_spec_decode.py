@@ -414,6 +414,76 @@ class TestOverlappedSpecRounds:
         assert eng.spec_step_finish() == {}
 
 
+class TestRecoverEdges:
+    """Crash-consistency edge coverage for ``recover()``
+    (docs/RECOVERY.md): parked spec-draft stripes plus a pending
+    overlapped dispatch — today only the plain parked path is
+    covered elsewhere."""
+
+    def _engine(self, model):
+        m, params = model
+        return ServingEngine(m, params, max_batch=2, max_len=64,
+                             prefill_len=8, draft_model=m,
+                             draft_params=params, spec_k=3)
+
+    def test_recover_with_parked_draft_and_pending_spec(self, model):
+        from instaslice_tpu.faults import poison_cache
+
+        eng = self._engine(model)
+        r1 = eng.add_request([5, 9, 2, 7])
+        eng.spec_step()
+        slot = next(s for s, r in eng.slots.items()
+                    if r.request_id == r1)
+        eng.preempt_slot(slot)
+        assert eng.parked[r1].draft_stripe is not None
+        parked_used = eng.kv.used_blocks()
+        r2 = eng.add_request([11, 4])
+        assert eng.spec_step_start()      # overlapped round in flight
+        assert eng._pending_spec is not None
+        poison_cache(eng)
+        assert eng.cache_poisoned()
+        lost = eng.recover()
+        # the live slot is lost, its blocks returned; no stale
+        # dispatch survives the recovery
+        assert lost == [r2]
+        assert eng._pending_spec is None
+        assert eng._pending_block is None
+        assert not eng.cache_poisoned()
+        assert r1 in eng.parked
+        assert eng.kv.used_blocks() == parked_used  # zero leak
+        # the parked session (draft stripe included) resumes and
+        # decodes on the rebuilt caches
+        eng.resume_request(r1)
+        out = eng.spec_step()
+        assert out.get(r1)
+        # full teardown returns the pool to empty
+        for s in list(eng.slots):
+            eng.evict_slot(s)
+        eng.radix.reclaim(10 ** 6)
+        assert eng.kv.used_blocks() == 0
+
+    def test_recover_with_pending_decode_block(self, model):
+        from instaslice_tpu.faults import poison_cache
+
+        eng = self._engine(model)
+        r1 = eng.add_request([5, 9, 2, 7])
+        eng.spec_step()
+        eng.preempt_slot(next(s for s, r in eng.slots.items()
+                              if r.request_id == r1))
+        r2 = eng.add_request([3, 1, 4])
+        assert eng.decode_block_start(4)  # overlapped decode in flight
+        assert eng._pending_block is not None
+        poison_cache(eng)
+        lost = eng.recover()
+        assert lost == [r2]
+        assert eng._pending_block is None
+        assert eng._pending_spec is None
+        assert r1 in eng.parked and r1 in eng._tables
+        assert set(eng._tables) == {r1}
+        eng.resume_request(r1)
+        assert eng.decode_block(2)[r1]
+
+
 class TestTokenIdentityUnderSpec:
     def test_preempt_resume_token_identity(self, model):
         """Park + resume mid-spec must keep the chain on the exact

@@ -51,6 +51,7 @@ if REPO not in sys.path:  # run as tools/validate_events.py
 from instaslice_tpu.api.constants import (  # noqa: E402
     EVENT_REASONS,
     REASON_ADMITTED,
+    REASON_CRASH_RECOVERED,
     REASON_DRAIN_BEGIN,
     REASON_DRAIN_END,
     TRANSITION_REASONS,
@@ -152,9 +153,113 @@ def check_chains(events: List[dict], strict: bool = True) -> List[str]:
     return errors
 
 
-def validate(path: str, strict: bool = True) -> dict:
+def check_epochs(events: List[dict]) -> List[str]:
+    """Crash-chaos chain invariants (``--epochs``, docs/RECOVERY.md):
+    transition chains must stay legal *across restart epochs*.
+
+    Chains are split on two boundaries: the ``attempt_epoch`` attr the
+    transition choke point stamps (the precise placement-epoch fence)
+    and ``CrashRecovered`` markers (component restarts and recovery
+    re-grants emit them; they split an alloc's chain for events
+    predating the attr). Within a group the walk is lenient the way
+    ``check_chains(strict=False)`` is — crash journals are full of
+    catch-up re-writes and stale-read phantoms — but two invariants
+    are strict:
+
+    - each restart epoch's transitions must be reachable (legal from
+      the previous status or some earlier status of the same group);
+    - no grant chain may be ABANDONED: every superseded attempt epoch
+      must end ``deleted``, and the final attempt epoch must end
+      ``ungated`` (granted) or ``deleted`` (cleanly torn down).
+    """
+    errors: List[str] = []
+    legal = _legal_edges()
+    global_marks = sorted(
+        r.get("seq", 0) for r in events
+        if r.get("reason") == REASON_CRASH_RECOVERED
+        and str(r.get("objectRef", "")).startswith("component/")
+    )
+    alloc_marks: Dict[str, List[int]] = {}
+    by_alloc: Dict[str, List[dict]] = {}
+    for rec in events:
+        ref = str(rec.get("objectRef", ""))
+        if not ref.startswith("alloc/"):
+            continue
+        if rec.get("reason") == REASON_CRASH_RECOVERED:
+            alloc_marks.setdefault(ref, []).append(rec.get("seq", 0))
+        elif rec.get("reason") in TRANSITION_STATUS:
+            by_alloc.setdefault(ref, []).append(rec)
+
+    for ref, recs in sorted(by_alloc.items()):
+        recs.sort(key=lambda r: r.get("seq", 0))
+        marks = sorted(set(global_marks) | set(alloc_marks.get(ref, [])))
+
+        def group_of(rec) -> int:
+            attr = (rec.get("attrs") or {}).get("attempt_epoch")
+            if attr is not None:
+                return int(attr)
+            # pre-attr events: the count of markers before this seq is
+            # its restart-epoch ordinal (kept distinct from real
+            # attempt epochs by the negative sign)
+            seq = rec.get("seq", 0)
+            return -sum(1 for m in marks if m < seq) - 1
+
+        groups: Dict[int, List[dict]] = {}
+        for rec in recs:
+            groups.setdefault(group_of(rec), []).append(rec)
+        # order groups chronologically by their first seq
+        ordered = sorted(
+            groups.items(), key=lambda kv: kv[1][0].get("seq", 0)
+        )
+        final_statuses: List[str] = []
+        for gid, grecs in ordered:
+            seen: set = set()
+            prev: Optional[str] = None
+            for rec in grecs:
+                st = TRANSITION_STATUS[rec["reason"]]
+                if prev is None or st == "creating":
+                    # a fresh creating restarts the sub-chain (retry
+                    # re-placement inside one attempt epoch)
+                    seen = {st}
+                    prev = st
+                    continue
+                if st == prev:
+                    continue
+                if st in legal[prev] or any(
+                    st in legal[s] for s in seen
+                ):
+                    seen.add(st)
+                    prev = st
+                    continue
+                errors.append(
+                    f"{ref} attempt-epoch group {gid}: illegal "
+                    f"transition {prev!r} -> {st!r}"
+                )
+                seen.add(st)
+                prev = st
+            final_statuses.append(prev or "")
+        for st in final_statuses[:-1]:
+            if st != "deleted":
+                errors.append(
+                    f"{ref}: superseded attempt epoch abandoned in "
+                    f"{st!r} (must end 'deleted')"
+                )
+        if final_statuses and final_statuses[-1] not in (
+            "ungated", "deleted"
+        ):
+            errors.append(
+                f"{ref}: grant chain abandoned in "
+                f"{final_statuses[-1]!r} without a terminal reason"
+            )
+    return errors
+
+
+def validate(path: str, strict: bool = True,
+             epochs: bool = False) -> dict:
     """Structural + chain validation of one JSONL file. ``errors`` must
-    stay empty for the file to pass."""
+    stay empty for the file to pass. ``epochs=True`` swaps the chain
+    check for :func:`check_epochs` (crash-chaos journals: chains legal
+    across restart epochs, no abandoned grants)."""
     errors: List[str] = []
     events: List[dict] = []
     with open(path) as f:
@@ -187,7 +292,10 @@ def validate(path: str, strict: bool = True) -> dict:
         dupes = sorted({s for s in seqs if seqs.count(s) > 1})
         errors.append(f"duplicate seq values: {dupes[:10]}")
     events.sort(key=lambda r: r["seq"])
-    errors.extend(check_chains(events, strict=strict))
+    if epochs:
+        errors.extend(check_epochs(events))
+    else:
+        errors.extend(check_chains(events, strict=strict))
 
     reasons: Dict[str, int] = {}
     for rec in events:
@@ -345,11 +453,18 @@ def main(argv=None) -> int:
     ap.add_argument("--lenient", action="store_true",
                     help="tolerate stale-read phantom transitions "
                          "(chaos-grade files)")
+    ap.add_argument("--epochs", action="store_true",
+                    help="crash-chaos mode: split chains on "
+                         "attempt-epoch stamps / CrashRecovered "
+                         "markers, require each restart epoch legal "
+                         "and no grant chain abandoned without a "
+                         "terminal reason (docs/RECOVERY.md)")
     args = ap.parse_args(argv)
     granted_text = faulted_text = ""
     if args.drive:
         granted_text, faulted_text = drive(args.file)
-    report = validate(args.file, strict=not args.lenient)
+    report = validate(args.file, strict=not args.lenient,
+                      epochs=args.epochs)
     if args.drive:
         check_drive_expectations(report, granted_text, faulted_text)
     print(json.dumps({
