@@ -45,6 +45,7 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 	$(MAKE) trace-check
 	$(MAKE) events-check
 	$(MAKE) chaos-crash-smoke
+	$(MAKE) chaos-partition-smoke
 	$(MAKE) bench-smoke
 	$(MAKE) bench-defrag-smoke
 	$(MAKE) bench-serving-smoke
@@ -56,6 +57,10 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 .PHONY: chaos-crash-smoke
 chaos-crash-smoke:  ## <60 s crash-consistency gate (docs/RECOVERY.md): one controller kill mid-fan-out + one agent kill mid-realize + one serving-replica kill mid-stream, each under load — every pod granted, zero double-allocations, zero orphaned device slices, zero hung requests, chains legal across restart epochs
 	JAX_PLATFORMS=cpu timeout -k 10 300 $(PY) -m pytest tests/test_crash_chaos.py -q -k "smoke" -p no:cacheprovider
+
+.PHONY: chaos-partition-smoke
+chaos-partition-smoke:  ## <60 s partition-tolerance gate (docs/RECOVERY.md "Partitions & gray failures"): partition the controller -> failover -> heal -> converge with zero double-allocations; agent static mode across a cut; eject a 100%-success gray replica on latency EWMA -> sessions migrate -> re-admit after heal — nemesis invariant checker strict, zero hung requests
+	JAX_PLATFORMS=cpu timeout -k 10 300 $(PY) -m pytest tests/test_partition_chaos.py -q -k "smoke" -p no:cacheprovider
 
 .PHONY: bench-smoke
 bench-smoke:  ## <60 s shrunken scale run (sharded workers + informer plane on a fleet sim): asserts a grants/sec floor and zero reconcile errors (TPUSLICE_SMOKE_FLOOR/NODES/PODS to tune)
@@ -148,7 +153,7 @@ chaos:  ## Control-plane + serving + crash-consistency chaos tiers across 3 seed
 	  JAX_PLATFORMS=cpu \
 	  timeout -k 10 360 $(PY) -m pytest \
 	    tests/test_chaos.py tests/test_serving_chaos.py \
-	    tests/test_crash_chaos.py -q; \
+	    tests/test_crash_chaos.py tests/test_partition_chaos.py -q; \
 	done
 
 .PHONY: bench

@@ -20,8 +20,11 @@ from typing import Dict, List, Optional
 
 from instaslice_tpu import POD_RESOURCE_PREFIX
 from instaslice_tpu.api.constants import (
+    REASON_APISERVER_UNREACHABLE,
     REASON_CHIP_HEALED,
     REASON_CHIP_UNHEALTHY,
+    REASON_DEGRADED_ENTERED,
+    REASON_DEGRADED_EXITED,
     REASON_REALIZED,
     REASON_REALIZE_FAILED,
     REASON_TORN_DOWN,
@@ -54,6 +57,7 @@ from instaslice_tpu.kube.client import (
 )
 from instaslice_tpu.topology.grid import coord_to_id, get_generation
 from instaslice_tpu.topology.placement import Box
+from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.reconcile import Manager
 from instaslice_tpu.utils.trace import get_tracer
 
@@ -97,6 +101,15 @@ class NodeAgent:
         self.namespace = namespace
         self.metrics = metrics
         self.health_interval = health_interval
+        #: static/degraded mode (docs/RECOVERY.md "Partitions & gray
+        #: failures"): set when the apiserver becomes unreachable at the
+        #: transport level. Realized slices keep serving (the device
+        #: plane needs no apiserver), every kube mutation is suppressed,
+        #: and each requeue re-probes; the first successful probe runs a
+        #: boot-style sweep against durable truth before reconciling.
+        self.degraded = False
+        self._degraded_lock = named_lock("agent.degraded")
+        self.degraded_retry_s = 1.0
         self._owns_manager = manager is None
         self.manager = manager or Manager(
             name=f"agent-{node_name}",
@@ -144,6 +157,22 @@ class NodeAgent:
     # ----------------------------------------------------------- reconcile
 
     def reconcile(self, key: str) -> Optional[float]:
+        """Transport-aware wrapper: a connection-level apiserver failure
+        anywhere in the reconcile flips the agent into static/degraded
+        mode instead of crashing the loop; every requeue re-probes and
+        the first success heals (boot-style sweep, then normal
+        reconcile). Injected API errors (503s etc.) are NOT degraded
+        triggers — they keep their existing retry semantics."""
+        try:
+            return self._reconcile_checked(key)
+        except (ConnectionError, TimeoutError) as e:
+            return self._enter_degraded(e)
+
+    def _reconcile_checked(self, key: str) -> Optional[float]:
+        if self.degraded:
+            # any kube call below doubles as the heal probe; _heal
+            # raises (→ _enter_degraded requeue) while still cut off
+            self._heal()
         if key == HEALTH_KEY:
             return self._health_sweep()
         if key != self.node_name:
@@ -165,6 +194,61 @@ class NodeAgent:
             elif alloc.status == AllocationStatus.DELETED:
                 self._teardown(ts, alloc)
         return None
+
+    # ------------------------------------------------- degraded/static mode
+
+    def _enter_degraded(self, exc: BaseException) -> float:
+        """Record (once) that the apiserver is unreachable and schedule
+        the re-probe. Journaling is local — the journal needs no
+        apiserver."""
+        with self._degraded_lock:
+            first = not self.degraded
+            self.degraded = True
+        if first:
+            log.warning(
+                "%s: apiserver unreachable (%s); entering static mode — "
+                "realized slices keep serving, mutations suppressed",
+                self.node_name, exc,
+            )
+            j = get_journal()
+            j.emit(
+                f"agent-{self.node_name}",
+                reason=REASON_APISERVER_UNREACHABLE,
+                object_ref=f"node/{self.node_name}",
+                message=f"apiserver unreachable: {exc}",
+            )
+            j.emit(
+                f"agent-{self.node_name}",
+                reason=REASON_DEGRADED_ENTERED,
+                object_ref=f"node/{self.node_name}",
+                message="static mode: serving frozen device state, "
+                        "kube mutations suppressed",
+            )
+        return self.degraded_retry_s
+
+    def _heal(self) -> None:
+        """Probe the apiserver and, on success, leave degraded mode via
+        a boot-style sweep (discovery + orphan reap against durable
+        truth — the partition may have deleted allocations we still hold
+        reservations for). Raises the transport error while the
+        partition persists, which re-enters degraded mode upstream."""
+        # boot() == discover_node: its first kube call is the probe, and
+        # its sweep is exactly the restart reconciliation docs/RECOVERY.md
+        # prescribes for rejoining a cluster whose state moved on
+        self.boot()
+        with self._degraded_lock:
+            self.degraded = False
+        log.info("%s: apiserver reachable again; leaving static mode",
+                 self.node_name)
+        get_journal().emit(
+            f"agent-{self.node_name}",
+            reason=REASON_DEGRADED_EXITED,
+            object_ref=f"node/{self.node_name}",
+            message="healed: boot sweep reconciled durable truth",
+        )
+        # the health sweep stopped publishing while degraded — catch up
+        if self.health_interval > 0:
+            self.manager.queue.add(HEALTH_KEY)
 
     # ------------------------------------------------------------- realize
 

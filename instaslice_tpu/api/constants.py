@@ -158,6 +158,23 @@ REASON_GRANT_DEADLINE = "GrantDeadlineExceeded"
 REASON_SESSION_EXPORTED = "SessionExported"
 REASON_SESSION_IMPORTED = "SessionImported"
 
+# partition tolerance (docs/RECOVERY.md "Partitions & gray failures").
+# ApiServerUnreachable marks a transport-level loss of the apiserver;
+# DegradedModeEntered/Exited bracket an agent's static mode (keep
+# realized slices serving, suppress mutations, reconcile durable truth
+# on heal — `validate_events --nemesis` asserts the pairing);
+# WriteFenced is a mutating commit refused because the writer's lease
+# epoch went stale (a deposed, partitioned leader's in-flight batch);
+# ReplicaEjected/ReplicaReadmitted bracket the router's gray-failure
+# ejection of a slow-but-alive replica (latency EWMA past threshold)
+# and its re-admission once the EWMA recovers.
+REASON_APISERVER_UNREACHABLE = "ApiServerUnreachable"
+REASON_DEGRADED_ENTERED = "DegradedModeEntered"
+REASON_DEGRADED_EXITED = "DegradedModeExited"
+REASON_WRITE_FENCED = "WriteFenced"
+REASON_REPLICA_EJECTED = "ReplicaEjected"
+REASON_REPLICA_READMITTED = "ReplicaReadmitted"
+
 #: AllocationStatus value → the journal reason its transition records.
 TRANSITION_REASONS = {
     "creating": REASON_SLICE_CREATING,
@@ -185,6 +202,9 @@ EVENT_REASONS = frozenset({
     REASON_SESSION_EXPORTED, REASON_SESSION_IMPORTED,
     REASON_CRASH_RECOVERED, REASON_ORPHAN_REAPED,
     REASON_MIGRATION_ABORTED, REASON_GRANT_DEADLINE,
+    REASON_APISERVER_UNREACHABLE, REASON_DEGRADED_ENTERED,
+    REASON_DEGRADED_EXITED, REASON_WRITE_FENCED,
+    REASON_REPLICA_EJECTED, REASON_REPLICA_READMITTED,
 })
 
 # ------------------------------------------------------- labels / leases
@@ -195,3 +215,10 @@ POD_UID_LABEL = f"{GROUP}/pod-uid"
 #: Sub-second lease durations for the leader election (the integer
 #: ``spec.leaseDurationSeconds`` field truncates; see utils/election.py).
 LEASE_DURATION_MS_ANNOTATION = f"{GROUP}/lease-duration-ms"
+
+#: Lease-epoch write fencing (docs/RECOVERY.md "Partitions & gray
+#: failures"): every mutating commit from a leader-fenced component is
+#: stamped with the writer's lease epoch (the Lease's monotonically
+#: increasing ``leaseTransitions`` at acquisition), so the journal and
+#: the CR itself record WHICH leadership term landed each write.
+WRITER_EPOCH_ANNOTATION = f"{GROUP}/writer-epoch"

@@ -1190,10 +1190,11 @@ class Controller:
                 # the batch may be committed by ANOTHER shard's worker:
                 # pin the fence to THIS worker's shard lease now, so a
                 # deposed shard's mutation is refused no matter which
-                # thread lands the batch (kube/coalesce.py)
-                shard = self.manager.current_shard()
-                mgr = self.manager
-                fence = (lambda s=shard: mgr.shard_is_leader(s))
+                # thread lands the batch (kube/coalesce.py). The
+                # EpochFence carries the shard lease's epoch so the
+                # commit is stamped with (and verified against) the
+                # leadership term that enqueued it.
+                fence = self.manager.shard_fence()
             stored = self._cr_writer.apply(node, mut, fence=fence)
         else:
             stored = update_with_retry(

@@ -21,7 +21,7 @@ from instaslice_tpu.metrics.metrics import (
     start_metrics_server,
 )
 from instaslice_tpu.obs import journal as obs_journal
-from instaslice_tpu.utils.election import LeaderElector
+from instaslice_tpu.utils.election import EpochFence, LeaderElector
 from instaslice_tpu.utils.probes import ProbeServer
 
 log = logging.getLogger("instaslice_tpu.controller.runner")
@@ -93,6 +93,16 @@ class ControllerRunner:
             metrics_bind_address
         )
         self.probe_address = health_probe_bind_address
+        # Leadership fence for controller writes, epoch-aware. With
+        # per-shard leases the writing worker's own shard lease is the
+        # fence (``_shard_check`` → ``Manager.shard_is_leader``, itself
+        # epoch-verified; per-CR commits additionally pin
+        # ``Manager.shard_fence`` for epoch stamping); with the single
+        # global lease the EpochFence binds ``self.elector`` (None until
+        # run(), and forever when election is off → fence open).
+        self._fence = EpochFence(
+            lambda: self.elector, check=self._shard_check
+        )
         self.controller = Controller(
             client,
             namespace=namespace,
@@ -100,8 +110,12 @@ class ControllerRunner:
             deletion_grace_seconds=deletion_grace_seconds,
             metrics=self.metrics,
             # with election on, every controller write is fenced on the
-            # lease: a deposed leader raises Fenced instead of racing its
-            # successor's writes (tested in tests/test_runtime.py)
+            # lease — and on the lease EPOCH: a deposed leader (even one
+            # that was partitioned and never saw its own deposition)
+            # raises Fenced instead of racing its successor's writes,
+            # and committed manifests carry the writer's epoch
+            # (tested in tests/test_runtime.py, tests/
+            # test_partition_chaos.py)
             fence=self._fence,
             workers=workers,
             shard_lease=(
@@ -129,16 +143,14 @@ class ControllerRunner:
         self.probes: Optional[ProbeServer] = None
         self.elector: Optional[LeaderElector] = None
 
-    def _fence(self) -> bool:
-        """Leadership fence for controller writes. With per-shard leases
-        the writing worker's own shard lease is the fence; with the
-        single global lease it's that lease; always open when election
-        is off (single-replica / tests)."""
+    def _shard_check(self) -> bool:
+        """Local half of the controller fence: with per-shard leases the
+        writing worker's own shard lease decides (epoch-verified inside
+        ``shard_is_leader``); otherwise defer to the EpochFence's global
+        elector."""
         if self.shard_leases:
             return self.controller.manager.shard_is_leader()
-        if not self.leader_elect or self.elector is None:
-            return True
-        return self.elector.is_leader.is_set()
+        return True
 
     @classmethod
     def from_args(cls, args) -> "ControllerRunner":

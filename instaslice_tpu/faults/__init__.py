@@ -63,6 +63,19 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 from instaslice_tpu.kube.client import ApiError, KubeClient, WatchEvent
 from instaslice_tpu.utils.lockcheck import named_lock
 
+# Network nemesis layer (partitions, latency, watch dup/reorder,
+# throttling — docs/RECOVERY.md "Partitions & gray failures") lives in
+# ``faults/netchaos.py``; this module stays the one fault facade.
+from instaslice_tpu.faults.netchaos import (  # noqa: F401  (re-exports)
+    NemesisKubeClient,
+    NemesisPlan,
+    NemesisRule,
+    PartitionError,
+    get_nemesis,
+    reset_nemesis,
+    set_nemesis,
+)
+
 
 class FaultError(Exception):
     """An injected failure (distinguishable from organic ones in logs)."""

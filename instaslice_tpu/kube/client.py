@@ -140,6 +140,43 @@ class KubeClient(abc.ABC):
         point; it is not an object event."""
 
 
+def stamp_writer_epoch(obj: dict, fence) -> None:
+    """Stamp the writer's lease epoch (``fence.epoch``, when the fence
+    carries one — :class:`~instaslice_tpu.utils.election.EpochFence`)
+    onto the manifest about to be committed, so the CR records which
+    leadership term landed the write. No-op for plain boolean fences
+    and fences that never held a lease."""
+    epoch = getattr(fence, "epoch", None)
+    if epoch is None:
+        return
+    from instaslice_tpu.api.constants import WRITER_EPOCH_ANNOTATION
+
+    meta = obj.setdefault("metadata", {})
+    ann = meta.get("annotations")
+    if ann is None:
+        ann = meta["annotations"] = {}
+    ann[WRITER_EPOCH_ANNOTATION] = str(epoch)
+
+
+def _journal_fenced(kind: str, namespace: str, name: str, fence) -> None:
+    """A fence refused a commit: journal it (the nemesis invariant
+    checker pairs these against the successor's epoch to prove the
+    deposed writer landed nothing)."""
+    from instaslice_tpu.api.constants import REASON_WRITE_FENCED
+    from instaslice_tpu.obs.journal import get_journal
+
+    epoch = getattr(fence, "epoch", None)
+    get_journal().emit(
+        "kube",
+        reason=REASON_WRITE_FENCED,
+        object_ref=f"{kind}/{namespace}/{name}",
+        message=(
+            f"stale writer refused (lease epoch "
+            f"{'?' if epoch is None else epoch})"
+        ),
+    )
+
+
 def update_with_retry(
     client: KubeClient,
     kind: str,
@@ -160,16 +197,24 @@ def update_with_retry(
     conflict retries: a leader deposed mid-retry-loop raises
     :class:`Fenced` instead of landing a write after the new leader has
     acted (the election-handover race the reference inherits unguarded
-    from controller-runtime's default non-fenced client).
+    from controller-runtime's default non-fenced client). A fence
+    carrying a lease ``.epoch`` (:class:`~instaslice_tpu.utils.
+    election.EpochFence`) additionally stamps the committed manifest
+    with the writer's epoch, and refusals are journaled as
+    ``WriteFenced`` so the nemesis invariant checker can prove a
+    deposed partitioned leader never landed a write
+    (docs/RECOVERY.md "Partitions & gray failures").
     """
     last: Optional[ApiError] = None
     for attempt in range(attempts):
         if fence is not None and not fence():
+            _journal_fenced(kind, namespace, name, fence)
             raise Fenced(f"deposed: refusing {kind} {namespace}/{name}")
         obj = client.get(kind, namespace, name)
         mutated = mutate(obj)
         if mutated is None:
             return None
+        stamp_writer_epoch(mutated, fence)
         try:
             return client.update(kind, mutated)
         except Conflict as e:

@@ -28,7 +28,11 @@ import logging
 import threading
 from typing import Callable, Dict, List, Optional
 
-from instaslice_tpu.kube.client import update_with_retry
+from instaslice_tpu.kube.client import (
+    _journal_fenced,
+    stamp_writer_epoch,
+    update_with_retry,
+)
 from instaslice_tpu.utils.lockcheck import named_lock
 
 log = logging.getLogger("instaslice_tpu")
@@ -137,6 +141,10 @@ class CoalescedWriter:
                     cur = out
                     op.applied = True
                     any_applied = True
+                    # epoch-stamp per applied op (last writer's epoch
+                    # wins — they all hold live leases or they would
+                    # have fenced above)
+                    stamp_writer_epoch(cur, op.fence)
             return cur if any_applied else None
 
         try:
@@ -155,6 +163,8 @@ class CoalescedWriter:
         self.commits += 1
         for op in batch:
             if op.fenced:
+                _journal_fenced(self.kind, self.namespace, name,
+                                op.fence)
                 op.exc = Fenced(
                     f"deposed: refusing {self.kind} "
                     f"{self.namespace}/{name}"

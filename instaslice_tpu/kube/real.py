@@ -86,10 +86,16 @@ class CircuitBreaker:
 
     ``threshold`` consecutive :meth:`fail` calls open the circuit for
     ``cooldown`` seconds; while open, :meth:`check` raises
-    :class:`CircuitOpen`. Past the cooldown one caller becomes the
-    half-open probe (the failure count sits one short of the
-    threshold, so a failed probe re-opens immediately and a
-    successful :meth:`ok` resets). Thread-safe."""
+    :class:`CircuitOpen`. Past the cooldown EXACTLY ONE caller becomes
+    the half-open probe — concurrent callers keep fast-failing until
+    the probe resolves via :meth:`ok`/:meth:`fail` (or its claim
+    expires after another ``cooldown``, covering a probe thread that
+    died without resolving). Without the single-probe claim, N worker
+    threads all passing :meth:`check` at cooldown expiry would
+    stampede a just-recovered server with N simultaneous "probes".
+    The failure count sits one short of the threshold while half-open,
+    so a failed probe re-opens immediately and a successful
+    :meth:`ok` resets. Thread-safe."""
 
     def __init__(self, threshold: int = 5, cooldown: float = 10.0,
                  name: str = "") -> None:
@@ -99,17 +105,35 @@ class CircuitBreaker:
         self._lock = named_lock("kube.breaker")
         self._failures = 0
         self._open_until = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
 
     def check(self) -> None:
-        """Fail fast while open."""
+        """Fail fast while open; past the cooldown, admit exactly one
+        half-open probe and fast-fail everyone else until it
+        resolves."""
         with self._lock:
-            remaining = self._open_until - time.monotonic()
+            now = time.monotonic()
+            remaining = self._open_until - now
             if remaining > 0:
                 raise CircuitOpen(
                     f"circuit open for another {remaining:.1f}s "
                     f"({self.threshold} consecutive failures "
                     f"against {self.name})"
                 )
+            if self._open_until:
+                # half-open: the circuit tripped and the cooldown has
+                # elapsed — admit one probe, everyone else stays fast-
+                # failed; a stale claim (probe never resolved) expires
+                # after another cooldown
+                if (self._probe_inflight
+                        and now - self._probe_started <= self.cooldown):
+                    raise CircuitOpen(
+                        f"half-open: probe already in flight against "
+                        f"{self.name}"
+                    )
+                self._probe_inflight = True
+                self._probe_started = now
 
     def is_open(self) -> bool:
         with self._lock:
@@ -119,6 +143,7 @@ class CircuitBreaker:
         """Record one failure; True exactly when THIS call opened the
         circuit (callers log/journal outside the lock)."""
         with self._lock:
+            self._probe_inflight = False
             self._failures += 1
             if self._failures >= self.threshold:
                 self._open_until = time.monotonic() + self.cooldown
@@ -130,6 +155,7 @@ class CircuitBreaker:
 
     def ok(self) -> None:
         with self._lock:
+            self._probe_inflight = False
             self._failures = 0
             self._open_until = 0.0
 

@@ -457,6 +457,8 @@ class RouterMetrics:
             self.migrations = _NoopMetric()
             self.replicas = _NoopMetric()
             self.breaker_opens = _NoopMetric()
+            self.replica_ejections = _NoopMetric()
+            self.replica_latency = _NoopMetric()
             self.registry = None
             return
         self.registry = registry or CollectorRegistry()
@@ -495,6 +497,21 @@ class RouterMetrics:
         self.breaker_opens = Counter(
             "tpuslice_router_breaker_open_total",
             "Per-replica circuit breaker open events",
+            registry=self.registry,
+        )
+        # gray-failure ejections (docs/RECOVERY.md "Partitions & gray
+        # failures"): replicas pulled from routing on latency EWMA
+        # alone — the breaker never fires for these
+        self.replica_ejections = Counter(
+            "tpuslice_router_replica_ejections_total",
+            "Gray-failure replica ejections (latency EWMA past "
+            "threshold at 100% success)",
+            registry=self.registry,
+        )
+        self.replica_latency = Gauge(
+            "tpuslice_router_replica_latency_ewma_seconds",
+            "Per-replica stats-poll latency EWMA p95 estimate",
+            ["replica"],
             registry=self.registry,
         )
 

@@ -143,11 +143,20 @@ class DistributedEngine:
         real multi-host its devices are gone from the mesh anyway (a
         jax.distributed failure), and the local server must keep
         serving/failing requests rather than silently dying."""
+        from instaslice_tpu.faults.netchaos import get_nemesis
+
         line = (json.dumps(op) + "\n").encode()
         dead = []
+        nemesis = get_nemesis()
         for pair in self._conns:
             conn, addr = pair
             try:
+                if nemesis is not None:
+                    # PartitionError is an OSError: a partitioned
+                    # follower takes the same drop path as a dead one
+                    nemesis.before_request("opstream", f"follower:{addr}")
+                    nemesis.throttle_sleep(
+                        "opstream", f"follower:{addr}", len(line))
                 conn.sendall(line)
             except OSError as e:
                 # addr captured at accept time: a reset socket raises

@@ -31,6 +31,8 @@ import urllib.error
 import urllib.request
 import uuid
 from typing import List, Optional
+from instaslice_tpu.faults.netchaos import (NemesisPlan, get_nemesis,
+                                            set_nemesis)
 from instaslice_tpu.utils.lockcheck import named_lock
 
 
@@ -51,8 +53,9 @@ def _percentile(xs: List[float], q: float) -> float:
 #: so the crash-chaos tier can reconcile the ledger exactly — those
 #: requests received real tokens, so lumping them into
 #: "transport-error" (which promises zero delivery) would lie.
-OUTCOMES = ("ok", "shed-429", "timeout-503", "stream-truncated",
-            "transport-error", "hung")
+OUTCOMES = ("ok", "hedged-ok", "shed-429", "timeout-503",
+            "stream-truncated", "transport-error", "replica-ejected",
+            "hung")
 
 
 #: in-band SSE error messages that mean "the stream was CUT", not "the
@@ -68,7 +71,7 @@ _TRUNCATION_SIGNATURES = (
 
 
 def _classify(err: Optional[str], code: Optional[int],
-              tokens: int = 0) -> str:
+              tokens: int = 0, hedged: bool = False) -> str:
     """Outcome class for one finished request. 429 = the server shed
     load (backpressure working as designed); 503 = a terminal timeout/
     drain response; a client-side timeout means the request HUNG —
@@ -80,9 +83,17 @@ def _classify(err: Optional[str], code: Optional[int],
     recovery losing the slot) stay "transport-error": the server was
     alive and said so. The report's ``status_counts`` breakdown
     separates terminal server responses from genuine transport
-    failures (code None)."""
+    failures (code None).
+
+    Partition-era classes: ``hedged`` marks a request that succeeded
+    only via the client-side hedge retry (first attempt hit a
+    transport fault before any token); a 503 whose body names
+    gray-ejected replicas classifies "replica-ejected" — the router
+    shrank its pool, which is distinct from ordinary shed/timeout."""
     if err is None:
-        return "ok"
+        return "hedged-ok" if hedged else "ok"
+    if "gray-ejected" in err or "replica ejected" in err:
+        return "replica-ejected"
     if code == 429:
         return "shed-429"
     if code == 503:
@@ -130,6 +141,13 @@ def _one_request(url: str, prompt: List[int], max_tokens: int,
     ttft = None
     toks = 0
     try:
+        plan = get_nemesis()
+        if plan is not None:
+            # the --nemesis-seed arm: injected latency counts against
+            # the measured request, partitions/drops raise here (a
+            # PartitionError is a ConnectionError → transport-error /
+            # hedge-retry path)
+            plan.before_request("loadgen", "server")
         with urllib.request.urlopen(req, timeout=timeout) as r:
             if not stream:
                 out = json.loads(r.read())
@@ -253,7 +271,8 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
         seed: int = 0, adapters: List[str] = (),
         tenants=None, jitter: float = 0.0,
         prefix_pool: str = "", record_trace: str = "",
-        replay_trace: str = "") -> dict:
+        replay_trace: str = "",
+        nemesis_seed: Optional[int] = None) -> dict:
     """``adapters``: multi-LoRA names assigned round-robin across
     requests ("" rides the base model) — load-tests the batched
     per-request adapter path.
@@ -282,11 +301,33 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     prefix-pool entries; replaying reconstructs the IDENTICAL request
     stream (prompts regenerated from their seeds) and paces each
     request at its recorded arrival offset, so two bench arms see the
-    same traffic instead of merely the same distribution."""
+    same traffic instead of merely the same distribution.
+
+    ``nemesis_seed``: the partition-chaos arm. Installs a seeded
+    :class:`NemesisPlan` on the loadgen→server edge (added latency
+    with jitter, a drop window, a brief mid-run partition; all timed
+    so the run ends healed) and arms a single client-side hedge retry
+    for requests that hit a transport fault before any token was
+    delivered — successes via the hedge classify "hedged-ok". Leaves
+    any pre-installed global plan (a test's) alone."""
     from instaslice_tpu.serving.scheduler import parse_tenant_specs
 
     if record_trace and replay_trace:
         raise ValueError("record_trace and replay_trace are exclusive")
+    nemesis_installed = False
+    if nemesis_seed is not None and get_nemesis() is None:
+        plan = NemesisPlan(seed=nemesis_seed)
+        nrng = random.Random(f"loadgen-nemesis:{nemesis_seed}")
+        plan.latency("loadgen", "server",
+                     delay=0.002 + nrng.random() * 0.01,
+                     jitter=0.005)
+        plan.drop("loadgen", "server", p=0.05,
+                  start=0.5 + nrng.random(), duration=2.0)
+        plan.partition("loadgen", "server",
+                       start=2.0 + nrng.random() * 2.0,
+                       duration=0.5)
+        set_nemesis(plan.start())
+        nemesis_installed = True
     rng = random.Random(seed)
     if isinstance(tenants, str):
         tenants = parse_tenant_specs(tenants) if tenants else None
@@ -417,8 +458,25 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
                 trace_id=f"lg-{seed}-{run_id}-{i}",
                 tenant=tenant_of[i],
             )
+            hedged = False
+            if (nemesis_seed is not None and err is not None
+                    and code is None and toks == 0
+                    and "TimeoutError" not in err):
+                # hedge retry (nemesis arm only): the first attempt
+                # died in transport before ANY token was delivered, so
+                # re-issuing is safe — no output can be double-counted.
+                # A success via the hedge classifies "hedged-ok".
+                dt, ttft, toks, err2, code = _one_request(
+                    url, prompts[i], budgets[i], stream, timeout,
+                    adapter=(adapters[i % len(adapters)]
+                             if adapters else ""),
+                    trace_id=f"lg-{seed}-{run_id}-{i}-hedge",
+                    tenant=tenant_of[i],
+                )
+                hedged = err2 is None
+                err = err2
             with lock:
-                outcome = _classify(err, code, toks)
+                outcome = _classify(err, code, toks, hedged=hedged)
                 outcomes[outcome] += 1
                 key = str(code) if code is not None else "none"
                 status_counts[key] = status_counts.get(key, 0) + 1
@@ -453,8 +511,14 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
                for _ in range(max(1, concurrency))]
     for t in threads:
         t.start()
-    for t in threads:
-        t.join()
+    try:
+        for t in threads:
+            t.join()
+    finally:
+        if nemesis_installed:
+            # the arm is per-run: leave the process's global plan slot
+            # the way we found it (empty)
+            set_nemesis(None)
     wall = max(time.monotonic() - t0, 1e-9)
     out = {
         "metric": "serve_request_p50_latency",
@@ -478,6 +542,10 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
     }
     if adapters:
         out["adapters"] = list(adapters)
+    if nemesis_seed is not None:
+        out["nemesis"] = {"seed": nemesis_seed,
+                          "hedged_ok": outcomes["hedged-ok"],
+                          "replica_ejected": outcomes["replica-ejected"]}
     if record_trace:
         _write_trace(record_trace, vocab,
                      pool if pool is not None else None, [
@@ -614,6 +682,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "'1,2,4,8'): run --requests at EACH level and "
                          "report the capacity curve in one JSON "
                          "(overrides --concurrency)")
+    ap.add_argument("--nemesis-seed", type=int, default=None,
+                    help="partition-chaos arm: install a seeded "
+                         "network-fault plan on the loadgen→server "
+                         "edge (latency, a drop window, a brief timed "
+                         "partition) and hedge-retry zero-token "
+                         "transport failures once; the report gains "
+                         "hedged-ok / replica-ejected outcome counts")
     return ap
 
 
@@ -692,7 +767,8 @@ def main(argv=None) -> int:
                   adapters=adapters, tenants=tenants,
                   jitter=args.jitter, prefix_pool=args.prefix_pool,
                   record_trace=args.record_trace,
-                  replay_trace=args.replay_trace)
+                  replay_trace=args.replay_trace,
+                  nemesis_seed=args.nemesis_seed)
     except (ValueError, OSError) as e:
         # bad/missing/mismatched trace file: scripted callers parse
         # stdout JSON — never a traceback

@@ -54,6 +54,8 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import math
+import random
 import threading
 import time
 import urllib.error
@@ -62,7 +64,13 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from instaslice_tpu.api.constants import (
+    REASON_REPLICA_EJECTED,
+    REASON_REPLICA_READMITTED,
+)
+from instaslice_tpu.faults.netchaos import get_nemesis
 from instaslice_tpu.kube.real import CircuitBreaker, CircuitOpen
+from instaslice_tpu.obs.journal import get_journal
 from instaslice_tpu.serving.kvcache import granule_hash
 from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.trace import TRACE_ID_SAFE, get_tracer, \
@@ -73,6 +81,26 @@ log = logging.getLogger("instaslice_tpu.serving.router")
 #: transport failures that count against a replica's breaker
 _TRANSPORT_EXC = (urllib.error.URLError, ConnectionError, TimeoutError,
                   OSError)
+
+
+def _retry_after_seconds(headers) -> Optional[float]:
+    """Parse a Retry-After header (delta-seconds form, like
+    kube/real.py honors)."""
+    raw = headers.get("Retry-After") if headers is not None else None
+    if not raw:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return None
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if n % 2:
+        return s[n // 2]
+    return 0.5 * (s[n // 2 - 1] + s[n // 2])
 
 
 def want_hashes(prompt: List[int], granule: int) -> List[str]:
@@ -98,6 +126,9 @@ class Replica:
     the shadow prefix index built from its advertised radix digest,
     and its circuit breaker."""
 
+    #: smoothing factor for the poll-latency EWMA (mean + variance)
+    EWMA_ALPHA = 0.3
+
     def __init__(self, url: str, breaker_threshold: int = 3,
                  breaker_cooldown: float = 2.0) -> None:
         self.url = url.rstrip("/")
@@ -108,6 +139,22 @@ class Replica:
         self.uptime = -1.0
         self.last_poll = 0.0          # monotonic; 0 = never
         self.draining = False         # router-side: no NEW routes
+        #: gray-failure ejection (docs/RECOVERY.md "Partitions & gray
+        #: failures"): latency EWMA past threshold with a 100% success
+        #: rate — unroutable like draining, but the router keeps
+        #: polling and re-admits when the EWMA recovers
+        self.ejected = False
+        # poll-latency EWMA (mean + variance → p95 estimate): the gray-
+        # failure signal the breaker cannot see (it only counts errors)
+        self.lat_mean = 0.0
+        self.lat_var = 0.0
+        self.lat_samples = 0
+        # stats-poll failure backoff (capped decorrelated jitter,
+        # Retry-After honored — satellite of the nemesis PR: fixed-
+        # interval re-polls stampede a just-healed replica)
+        self.poll_backoff = 0.0
+        self.poll_next = 0.0          # monotonic; 0 = poll freely
+        self.retry_after_hint: Optional[float] = None
         #: shadow prefix index: advertised hot paths as granule-hash
         #: chains, plus the granule size they were cut at
         self.granule = 0
@@ -115,10 +162,28 @@ class Replica:
 
     def alive(self, now: float, stale_after: float) -> bool:
         """Routable: polled recently, not circuit-broken, not marked
-        draining by the router."""
+        draining or gray-ejected by the router."""
         return (bool(self.stats) and not self.draining
+                and not self.ejected
                 and not self.breaker.is_open()
                 and now - self.last_poll <= stale_after)
+
+    def observe_latency(self, dt: float) -> None:
+        """Fold one successful round-trip latency into the EWMA."""
+        if self.lat_samples == 0:
+            self.lat_mean = dt
+            self.lat_var = 0.0
+        else:
+            a = self.EWMA_ALPHA
+            d = dt - self.lat_mean
+            self.lat_mean += a * d
+            # exponentially weighted variance (West 1979 form)
+            self.lat_var = (1.0 - a) * (self.lat_var + a * d * d)
+        self.lat_samples += 1
+
+    def lat_p95(self) -> float:
+        """p95 estimate from the EWMA: mean + 1.645 sigma."""
+        return self.lat_mean + 1.645 * math.sqrt(max(0.0, self.lat_var))
 
     def adopt_stats(self, stats: dict) -> bool:
         """Fold a fresh ``/v1/stats`` poll in; returns True when the
@@ -189,6 +254,9 @@ class Replica:
             "replica_id": self.replica_id,
             "uptime_seconds": self.uptime,
             "draining": self.draining,
+            "ejected": self.ejected,
+            "latency_p95_s": round(self.lat_p95(), 6),
+            "latency_samples": self.lat_samples,
             "breaker_open": self.breaker.is_open(),
             "age_s": round(time.monotonic() - self.last_poll, 3)
             if self.last_poll else None,
@@ -389,6 +457,7 @@ class _ProxyContext:
             headers=self._headers(), method="POST",
         )
         try:
+            self.r.maybe_nemesis(rep)
             resp = urllib.request.urlopen(
                 req, timeout=timeout or self.r.request_timeout
             )
@@ -512,6 +581,7 @@ class _ProxyContext:
         can still serve the whole request."""
         self._begin_stream()
         buf = b""
+        plan = get_nemesis()
         while True:
             try:
                 chunk = resp.read1(65536)
@@ -519,6 +589,10 @@ class _ProxyContext:
                 self.r.breaker_fail(rep)
                 self._client_error(502, f"replica stream died: {e}")
                 return True         # client already has a terminal
+            if plan is not None and chunk:
+                # nemesis slow-transfer throttling on the stream edge
+                plan.throttle_sleep("router", f"replica:{rep.url}",
+                                    len(chunk))
             if not chunk:
                 # upstream ended without [DONE]: surface, don't hang
                 self._write_event({"error": "replica stream ended "
@@ -743,18 +817,44 @@ class Router:
     ``metrics``: a :class:`~instaslice_tpu.metrics.metrics.
     RouterMetrics` (defaulted)."""
 
+    #: stats-poll failure backoff (capped decorrelated jitter; the
+    #: kube/real.py policy at router scale)
+    poll_backoff_base = 0.05
+    poll_backoff_cap = 2.0
+    retry_after_cap = 30.0
+
     def __init__(self, replicas=(), host: str = "127.0.0.1",
                  port: int = 0, poll_interval: float = 0.25,
                  stale_after: float = 3.0, request_timeout: float = 300.0,
                  max_retries: int = 2, session_ttl: float = 600.0,
                  breaker_threshold: int = 3,
                  breaker_cooldown: float = 2.0, metrics=None,
-                 migrate_timeout: Optional[float] = None) -> None:
+                 migrate_timeout: Optional[float] = None,
+                 eject_factor: float = 3.0,
+                 readmit_factor: float = 1.5,
+                 eject_min_samples: int = 8,
+                 eject_floor_s: float = 0.02,
+                 hedge_after: float = 0.5) -> None:
         self.poll_interval = poll_interval
         self.stale_after = stale_after
         self.request_timeout = request_timeout
         self.max_retries = max_retries
         self.session_ttl = session_ttl
+        # gray-failure ejection knobs (docs/RECOVERY.md "Partitions &
+        # gray failures"): a replica whose poll-latency EWMA p95 exceeds
+        # eject_factor × the fleet median (of the OTHER routable
+        # replicas) is ejected even at 100% success; re-admitted at
+        # readmit_factor × median (hysteresis). eject_floor_s guards
+        # microsecond-scale fleets from noise ejections;
+        # eject_factor <= 0 disables the sweep. hedge_after is the
+        # hedged-retry delay for idempotent stats polls (second request
+        # fired if the first hasn't answered; first result wins);
+        # <= 0 disables hedging.
+        self.eject_factor = eject_factor
+        self.readmit_factor = readmit_factor
+        self.eject_min_samples = eject_min_samples
+        self.eject_floor_s = eject_floor_s
+        self.hedge_after = hedge_after
         # self-healing watchdog (docs/RECOVERY.md): bound on EACH
         # migration hop (import POST + resume handshake). Without it a
         # destination that accepted the import and then wedged (crashed
@@ -785,6 +885,11 @@ class Router:
         self.requests: Dict[str, int] = {}
         self.routed: Dict[str, int] = {}
         self.migrations: Dict[str, int] = {}
+        #: gray-failure accounting: replica url → ejection count, and
+        #: hedged stats polls fired / won (won = the hedge answered
+        #: while the primary was still in flight)
+        self.ejections: Dict[str, int] = {}
+        self.hedges: Dict[str, int] = {"fired": 0, "won": 0}
         #: trace ids of requests that survived ≥1 migration — the
         #: bench's oracle-comparison hook (bounded ring)
         self.migrated_traces: List[str] = []
@@ -870,17 +975,32 @@ class Router:
         if budget is not None:
             body["budget"] = budget
         migrated = 0
-        try:
-            code, out = self.http_json("POST", rep, "/v1/drain", body)
-            migrated = int(out.get("migrated", 0)) if code == 200 else 0
-        except _TRANSPORT_EXC as e:
-            log.warning("drain of %s failed (%s): removing anyway",
-                        url, e)
+        pause = 0.0
+        for _attempt in range(3):
+            try:
+                code, out = self.http_json("POST", rep, "/v1/drain",
+                                           body)
+            except _TRANSPORT_EXC as e:
+                log.warning("drain of %s failed (%s): removing anyway",
+                            url, e)
+                break
+            if code == 200:
+                migrated = int(out.get("migrated", 0))
+                break
+            if code not in (429, 503):
+                break
+            # pushed back: honor Retry-After with jittered backoff
+            pause = self._next_backoff(pause, rep.retry_after_hint)
+            if self._stop.wait(pause):
+                break
         # wait for the replica to go idle (its exported sessions are
         # resumed elsewhere by the proxy threads; queued requests shed
-        # and retried by their own handlers)
+        # and retried by their own handlers). Jittered pacing, not a
+        # fixed tick: N concurrent removals re-polling in lockstep is
+        # exactly the stampede the backoff policy exists to break.
         deadline = time.monotonic() + deadline_s
         idle = False
+        pause = 0.0
         while time.monotonic() < deadline:
             try:
                 _code, s = self.http_json("GET", rep, "/v1/stats",
@@ -892,7 +1012,10 @@ class Router:
             except _TRANSPORT_EXC:
                 idle = True            # it already went away
                 break
-            if self._stop.wait(0.05):
+            pause = self._next_backoff(
+                min(pause, 0.2), rep.retry_after_hint
+            )
+            if self._stop.wait(pause):
                 break
         with self._lock:
             self._replicas.pop(url, None)
@@ -909,24 +1032,59 @@ class Router:
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_interval):
             self.poll_now()
+            self._gray_sweep()
             self._sweep_sessions()
 
     def poll_now(self) -> None:
         for rep in self.replicas():
             self._poll_one(rep)
 
+    def _next_backoff(self, prev: float,
+                      retry_after: Optional[float] = None) -> float:
+        """Capped decorrelated-jitter backoff, stretched to honor a
+        server Retry-After — the kube/real.py ``_backoff_sleep`` policy
+        without the sleep (poll pacing owns the wait)."""
+        delay = min(
+            self.poll_backoff_cap,
+            random.uniform(self.poll_backoff_base,
+                           max(prev, self.poll_backoff_base) * 3),
+        )
+        if retry_after is not None:
+            delay = max(delay, min(retry_after, self.retry_after_cap))
+        return delay
+
+    def _note_poll_failure(self, rep: Replica,
+                           retry_after: Optional[float]) -> None:
+        rep.poll_backoff = self._next_backoff(rep.poll_backoff,
+                                              retry_after)
+        rep.poll_next = time.monotonic() + rep.poll_backoff
+
     def _poll_one(self, rep: Replica) -> None:
         if rep.breaker.is_open():
             return
+        if time.monotonic() < rep.poll_next:
+            return  # backing off a recent failure (jittered, not fixed)
         try:
-            code, stats = self.http_json("GET", rep, "/v1/stats", None)
+            code, stats, lat = self._hedged_stats(rep)
         except _TRANSPORT_EXC as e:
             log.debug("poll of %s failed: %s", rep.url, e)
             self.breaker_fail(rep)
+            self._note_poll_failure(rep, None)
             return
         if code != 200:
+            # 429/503 push back: honor Retry-After before re-polling
+            self._note_poll_failure(
+                rep,
+                rep.retry_after_hint if code in (429, 503) else None,
+            )
             return
+        rep.poll_backoff = 0.0
+        rep.poll_next = 0.0
         rep.breaker.ok()
+        rep.observe_latency(lat)
+        self.metrics.replica_latency.labels(replica=rep.url).set(
+            rep.lat_p95()
+        )
         if rep.adopt_stats(stats):
             log.warning("replica %s RESTARTED: dropping its session "
                         "affinities", rep.url)
@@ -936,6 +1094,158 @@ class Router:
                     for sid, (u, ts) in self._sessions.items()
                     if u != rep.url
                 }
+
+    def _hedged_stats(self, rep: Replica):
+        """GET /v1/stats with one hedged retry: if the primary hasn't
+        answered within ``hedge_after`` seconds a second (idempotent)
+        request races it and the first result wins — a gray replica's
+        slow answer can't stall the poll loop's view of it. Returns
+        (code, payload, winner_latency_s)."""
+        if self.hedge_after <= 0:
+            t0 = time.perf_counter()
+            code, payload = self.http_json("GET", rep, "/v1/stats",
+                                           None)
+            return code, payload, time.perf_counter() - t0
+        box: dict = {}
+        done = threading.Event()
+
+        def primary():
+            t0 = time.perf_counter()
+            try:
+                code, payload = self.http_json("GET", rep, "/v1/stats",
+                                               None)
+                box["first"] = (code, payload,
+                                time.perf_counter() - t0)
+            except _TRANSPORT_EXC as e:
+                box["exc"] = e
+            done.set()
+
+        th = threading.Thread(target=primary, name="router-poll-first",
+                              daemon=True)
+        th.start()
+        if done.wait(self.hedge_after):
+            if "exc" in box:
+                raise box["exc"]
+            return box["first"]
+        with self._lock:
+            self.hedges["fired"] += 1
+        t0 = time.perf_counter()
+        try:
+            code, payload = self.http_json("GET", rep, "/v1/stats",
+                                           None)
+            hedge = (code, payload, time.perf_counter() - t0)
+        except _TRANSPORT_EXC:
+            # hedge died too: fall back to whatever the primary does
+            done.wait(self.request_timeout)
+            if "first" in box:
+                return box["first"]
+            raise
+        if done.is_set() and "first" in box:
+            return box["first"]  # primary got there first after all
+        with self._lock:
+            self.hedges["won"] += 1
+        self.count_request("hedged-ok")
+        return hedge
+
+    # ------------------------------------------------- gray-failure eject
+
+    def _gray_sweep(self) -> None:
+        """Eject replicas whose latency EWMA p95 degrades past
+        ``eject_factor`` × the fleet median even at 100% success (the
+        gray failure a circuit breaker never sees), drain their
+        sessions through the live-migration path, and re-admit at
+        ``readmit_factor`` × median once the EWMA recovers
+        (hysteresis). Never ejects below 2 routable peers."""
+        if self.eject_factor <= 0:
+            return
+        seasoned = [r for r in self.replicas()
+                    if r.lat_samples >= self.eject_min_samples
+                    and not r.breaker.is_open() and not r.draining]
+        healthy = [r for r in seasoned if not r.ejected]
+        for rep in seasoned:
+            others = [h.lat_p95() for h in healthy if h is not rep]
+            if not others:
+                continue
+            med = _median(others)
+            p95 = rep.lat_p95()
+            if not rep.ejected:
+                if (len(healthy) >= 2
+                        and p95 > max(self.eject_floor_s,
+                                      self.eject_factor * med)):
+                    self._eject(rep, p95, med)
+                    healthy.remove(rep)
+            elif p95 <= max(self.eject_floor_s,
+                            self.readmit_factor * med):
+                self._readmit(rep, p95, med)
+                healthy.append(rep)
+
+    def _eject(self, rep: Replica, p95: float, med: float) -> None:
+        rep.ejected = True
+        with self._lock:
+            self.ejections[rep.url] = self.ejections.get(rep.url, 0) + 1
+            # its radix cache will be cold-ish on return and its
+            # sessions are about to migrate out: drop the affinities now
+            self._sessions = {
+                sid: (u, ts) for sid, (u, ts) in self._sessions.items()
+                if u != rep.url
+            }
+        self.metrics.replica_ejections.inc()
+        log.warning(
+            "replica %s gray-EJECTED: latency p95 %.4fs > %.1fx fleet "
+            "median %.4fs (success rate untouched); draining sessions",
+            rep.url, p95, self.eject_factor, med,
+        )
+        get_journal().emit(
+            "router",
+            reason=REASON_REPLICA_EJECTED,
+            object_ref=f"replica/{rep.url}",
+            message=(f"latency p95 {p95:.4f}s vs fleet median "
+                     f"{med:.4f}s; sessions draining via migration"),
+        )
+        # drain (migrate) off the poll thread: a gray replica answers
+        # SLOWLY, and the sweep must not stall behind it
+        threading.Thread(
+            target=self._drain_ejected, args=(rep,),
+            name="router-eject-drain", daemon=True,
+        ).start()
+
+    def _drain_ejected(self, rep: Replica) -> None:
+        pause = 0.0
+        for _ in range(3):
+            try:
+                code, _out = self.http_json(
+                    "POST", rep, "/v1/drain", {"migrate": True},
+                    timeout=self.migrate_timeout,
+                )
+            except _TRANSPORT_EXC as e:
+                log.warning("drain of ejected %s failed: %s",
+                            rep.url, e)
+                return
+            if code not in (429, 503):
+                return
+            pause = self._next_backoff(pause, rep.retry_after_hint)
+            if self._stop.wait(pause):
+                return
+
+    def _readmit(self, rep: Replica, p95: float, med: float) -> None:
+        try:
+            # lift the replica-side drain so it admits again
+            self.http_json("DELETE", rep, "/v1/drain", {})
+        except _TRANSPORT_EXC as e:
+            log.warning("undrain of %s failed (%s); retrying next "
+                        "sweep", rep.url, e)
+            return
+        rep.ejected = False
+        log.info("replica %s re-admitted: latency p95 %.4fs back "
+                 "within %.1fx fleet median %.4fs", rep.url, p95,
+                 self.readmit_factor, med)
+        get_journal().emit(
+            "router",
+            reason=REASON_REPLICA_READMITTED,
+            object_ref=f"replica/{rep.url}",
+            message=(f"latency p95 {p95:.4f}s recovered vs fleet "
+                     f"median {med:.4f}s"),
+        )
 
     def _sweep_sessions(self) -> None:
         now = time.monotonic()
@@ -959,9 +1269,12 @@ class Router:
                  if rep.alive(now, self.stale_after)
                  and rep.url not in exclude]
         if not cands:
+            ejected = sum(1 for rep in self.replicas() if rep.ejected)
             raise NoReplica(
                 "no routable replica (all dead, draining, "
-                "circuit-broken, or already tried)"
+                "circuit-broken, or already tried"
+                + (f"; {ejected} gray-ejected" if ejected else "")
+                + ")"
             )
         # 1. session affinity: a multi-turn follow-up goes back to the
         # replica whose radix cache holds its history
@@ -1058,6 +1371,16 @@ class Router:
 
     # ---------------------------------------------------------- accounting
 
+    def maybe_nemesis(self, rep: Replica) -> None:
+        """Consult the global nemesis plan on the router→replica edge
+        (``router>replica:<url>`` — partitions raise a connection
+        error the breaker/retry machinery already handles; latency
+        rules sleep, which is exactly how a gray replica is
+        injected)."""
+        plan = get_nemesis()
+        if plan is not None:
+            plan.before_request("router", f"replica:{rep.url}")
+
     def breaker_fail(self, rep: Replica) -> None:
         """Record a transport failure against ``rep``'s breaker and —
         when THIS failure opened the circuit — log and count it. Every
@@ -1104,6 +1427,8 @@ class Router:
                 "requests": dict(self.requests),
                 "routed": dict(self.routed),
                 "migrations": dict(self.migrations),
+                "ejections": dict(self.ejections),
+                "hedges": dict(self.hedges),
                 "migrated_traces": list(self.migrated_traces),
             }
         return out
@@ -1124,9 +1449,14 @@ class Router:
             method=method,
         )
         try:
+            self.maybe_nemesis(rep)
             with urllib.request.urlopen(req, timeout=timeout) as r:
+                rep.retry_after_hint = None
                 return r.status, json.loads(r.read() or b"{}")
         except urllib.error.HTTPError as e:
+            # surface the server's pushback hint so poll/drain backoff
+            # can honor it (kube/real.py does the same for 429/503)
+            rep.retry_after_hint = _retry_after_seconds(e.headers)
             try:
                 return e.code, json.loads(e.read().decode() or "{}")
             except ValueError:
@@ -1163,6 +1493,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "0 disables (hops get the full request "
                          "timeout) (env: "
                          "TPUSLICE_ROUTER_MIGRATE_TIMEOUT; default 15)")
+    ap.add_argument("--eject-factor", type=float, default=3.0,
+                    help="gray-failure ejection: eject a replica whose "
+                         "latency-EWMA p95 exceeds this multiple of "
+                         "the fleet median (<= 0 disables)")
+    ap.add_argument("--readmit-factor", type=float, default=1.5,
+                    help="re-admit an ejected replica once its p95 "
+                         "falls back within this multiple of the "
+                         "fleet median (hysteresis)")
+    ap.add_argument("--hedge-after", type=float, default=0.5,
+                    help="seconds before an idempotent stats poll is "
+                         "hedged with a second request (first result "
+                         "wins; <= 0 disables)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="Prometheus /metrics port (0 = off)")
     return ap
@@ -1180,6 +1522,9 @@ def main(argv=None) -> int:
         request_timeout=args.request_timeout,
         max_retries=args.max_retries, session_ttl=args.session_ttl,
         migrate_timeout=args.migrate_timeout,
+        eject_factor=args.eject_factor,
+        readmit_factor=args.readmit_factor,
+        hedge_after=args.hedge_after,
     ).start()
     if args.metrics_port:
         from instaslice_tpu.metrics.metrics import start_metrics_server

@@ -141,6 +141,7 @@ class SimCluster:
         transport: str = "inproc",
         backend: str = "fake",
         fault_plan=None,
+        nemesis=None,
         nodes_per_group: Optional[int] = None,
         fleet_agents: bool = False,
         agent_workers: int = 8,
@@ -186,6 +187,18 @@ class SimCluster:
         code changes. The submit/observe client (``self.kube``) stays
         clean — tests assert through it.
 
+        ``nemesis`` (a :class:`~instaslice_tpu.faults.NemesisPlan`, or
+        by default whatever ``TPUSLICE_NEMESIS_PLAN`` describes)
+        additionally wraps each component's client in a
+        :class:`~instaslice_tpu.faults.NemesisKubeClient` with a
+        per-component identity — ``controller`` and ``agent-<node>``
+        (``agent-fleet`` for the fleet manager) — so partition rules
+        can cut ONE component off the apiserver
+        (``controller>apiserver:kind=partition,duration=5``) while
+        the rest of the cluster keeps converging
+        (docs/RECOVERY.md "Partitions & gray failures"). The observer
+        client stays clean here too.
+
         Scale-tier knobs (docs/SCALING.md):
 
         - ``nodes_per_group``: split the fleet into independent torus
@@ -213,9 +226,13 @@ class SimCluster:
             FaultPlan,
             FaultyBackend,
             FaultyKubeClient,
+            NemesisKubeClient,
+            NemesisPlan,
         )
 
         self.fault_plan = fault_plan or FaultPlan.from_env()
+        self.nemesis = nemesis if nemesis is not None \
+            else NemesisPlan.from_env()
         self.backing = FakeKube()
         self.server = None
         if transport == "http":
@@ -231,17 +248,24 @@ class SimCluster:
             self.kube = self.backing
         else:
             raise ValueError(f"unknown transport {transport!r}")
+        # components get the faulty/nemesis view; the observer stays
+        # clean. Layering (inside out): base transport → FaultyKubeClient
+        # (API-level faults) → NemesisKubeClient (network-level faults,
+        # per-component identity so partitions can be one-sided).
+        def _client_for(ident: str = "") -> "KubeClient":
+            c = self._component_client()
+            if self.fault_plan is not None:
+                c = FaultyKubeClient(c, self.fault_plan)
+            if self.nemesis is not None and ident:
+                c = NemesisKubeClient(c, self.nemesis, ident)
+            return c
+
+        self._client_for = _client_for
         if self.fault_plan is not None:
-            # components get the faulty view; the observer stays clean
-            base = self._component_client
-            self._client_for = lambda: FaultyKubeClient(
-                base(), self.fault_plan
-            )
             self._wrap_backend = lambda b: FaultyBackend(
                 b, self.fault_plan
             )
         else:
-            self._client_for = self._component_client
             self._wrap_backend = lambda b: b
         self.namespace = namespace
         self.generation = generation
@@ -314,13 +338,14 @@ class SimCluster:
             # backend; the agent drives through the faulty wrapper
             self.backends[node] = node_backend
             self.agents[node] = NodeAgent(
-                self._client_for(), self._wrap_backend(node_backend),
+                self._client_for(f"agent-{node}"),
+                self._wrap_backend(node_backend),
                 node, namespace,
                 metrics=metrics, health_interval=health_interval,
             )
         if fleet_agents:
             self.fleet = FleetAgents(
-                self._client_for(),
+                self._client_for("agent-fleet"),
                 self._fleet_backend,
                 namespace,
                 workers=agent_workers,
@@ -338,7 +363,9 @@ class SimCluster:
             use_cache=use_cache,
             stuck_grant_deadline=stuck_grant_deadline,
         )
-        self.controller = Controller(self._client_for(), **self._ctl_opts)
+        self.controller = Controller(
+            self._client_for("controller"), **self._ctl_opts
+        )
         self.repacker = None
         self._repack_opts = None
         if repack:
@@ -499,7 +526,9 @@ class SimCluster:
             self.controller.stop()
         except Exception:
             log.warning("crashed controller stop raised", exc_info=True)
-        self.controller = Controller(self._client_for(), **self._ctl_opts)
+        self.controller = Controller(
+            self._client_for("controller"), **self._ctl_opts
+        )
         if self._repack_opts is not None:
             from instaslice_tpu.controller.defrag import Repacker
 
@@ -532,7 +561,7 @@ class SimCluster:
         except Exception:
             log.warning("crashed agent stop raised", exc_info=True)
         self.agents[node] = NodeAgent(
-            self._client_for(),
+            self._client_for(f"agent-{node}"),
             self._wrap_backend(self.backends[node]),
             node,
             self.namespace,

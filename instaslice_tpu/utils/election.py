@@ -24,6 +24,7 @@ from instaslice_tpu.kube.client import (
     KubeClient,
     NotFound,
 )
+from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.timeutil import parse_timestamp, rfc3339_now
 
 log = logging.getLogger("instaslice_tpu.election")
@@ -48,6 +49,14 @@ class LeaderElector:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.is_leader = threading.Event()
+        #: the Lease's ``leaseTransitions`` value this elector wrote
+        #: when it (last) held the lease — the monotonically increasing
+        #: **lease epoch** write fencing stamps and compares
+        #: (docs/RECOVERY.md "Partitions & gray failures"); -1 = never
+        #: held
+        self.epoch = -1
+        self._epoch_verified_at = 0.0
+        self._epoch_lock = named_lock("election.epoch")
 
     # ----------------------------------------------------------- protocol
 
@@ -99,6 +108,14 @@ class LeaderElector:
             )
         )
 
+    def _note_acquired(self, transitions: int) -> None:
+        """Record a successful acquire/renew: the transitions value we
+        just wrote IS our epoch, and the write itself proves we held
+        the lease at this instant (fence verification freshness)."""
+        with self._epoch_lock:
+            self.epoch = transitions
+            self._epoch_verified_at = time.monotonic()
+
     def _try_acquire_or_renew(self) -> bool:
         try:
             lease = self.client.get("Lease", self.namespace, self.name)
@@ -107,6 +124,7 @@ class LeaderElector:
                 self.client.create(
                     "Lease", self._manifest(transitions=0)
                 )
+                self._note_acquired(0)
                 return True
             except (AlreadyExists, Conflict):
                 return False
@@ -126,9 +144,49 @@ class LeaderElector:
         )
         try:
             self.client.update("Lease", new)
+            self._note_acquired(transitions)
             return True
         except (Conflict, NotFound):
             return False
+
+    # -------------------------------------------------------- epoch fence
+
+    def verify_epoch(self, max_age: Optional[float] = None) -> bool:
+        """True iff this elector verifiably still holds the lease at
+        the epoch it acquired. Renewals refresh the verification for
+        free (each successful renew read+wrote the lease); when the
+        last proof is older than ``max_age`` (default lease/3) the
+        lease is re-read. Any failure to *prove* leadership —
+        transport down, holder changed, transitions bumped — returns
+        False: a partitioned writer must refuse, not race, its
+        successor (docs/RECOVERY.md "Partitions & gray failures")."""
+        if max_age is None:
+            max_age = max(0.05, self.lease_seconds / 3.0)
+        with self._epoch_lock:
+            epoch = self.epoch
+            fresh = (
+                time.monotonic() - self._epoch_verified_at <= max_age
+            )
+        if epoch < 0:
+            return False
+        if fresh:
+            return True
+        try:
+            lease = self.client.get("Lease", self.namespace, self.name)
+        except (ApiError, ConnectionError, TimeoutError, OSError) as e:
+            log.warning("%s: cannot verify lease epoch for %s/%s: %s",
+                        self.identity, self.namespace, self.name, e)
+            return False
+        spec = lease.get("spec", {})
+        ok = (
+            spec.get("holderIdentity") == self.identity
+            and int(spec.get("leaseTransitions", 0)) == epoch
+        )
+        if ok:
+            with self._epoch_lock:
+                if self.epoch == epoch:
+                    self._epoch_verified_at = time.monotonic()
+        return ok
 
     # ------------------------------------------------------------- public
 
@@ -191,3 +249,40 @@ class LeaderElector:
         except ApiError:
             pass
         self.is_leader.clear()
+
+
+class EpochFence:
+    """Callable write fence bound to an elector's **lease epoch**.
+
+    ``update_with_retry`` / :class:`~instaslice_tpu.kube.coalesce.
+    CoalescedWriter` call the fence before every commit attempt and
+    read ``.epoch`` to stamp the committed manifest
+    (``WRITER_EPOCH_ANNOTATION``). The fence is open only while the
+    elector verifiably holds its lease *at the epoch it acquired* —
+    a deposed, partitioned leader whose successor bumped
+    ``leaseTransitions`` gets False (→ :class:`~instaslice_tpu.kube.
+    client.Fenced`), never a racing write.
+
+    ``get_elector`` is a zero-arg callable returning the (possibly
+    not-yet-constructed) elector — None means election is off and the
+    fence stays open. ``check`` is an optional extra local predicate
+    ANDed in (e.g. a manager's shard-leadership bit)."""
+
+    def __init__(self, get_elector, check=None) -> None:
+        self._get_elector = get_elector
+        self._check = check
+
+    @property
+    def epoch(self) -> Optional[int]:
+        el = self._get_elector()
+        if el is None or el.epoch < 0:
+            return None
+        return el.epoch
+
+    def __call__(self) -> bool:
+        if self._check is not None and not self._check():
+            return False
+        el = self._get_elector()
+        if el is None:
+            return True
+        return el.is_leader.is_set() and el.verify_epoch()

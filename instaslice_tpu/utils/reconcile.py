@@ -295,11 +295,15 @@ class Manager:
         return getattr(self._local, "shard", None)
 
     def shard_is_leader(self, shard: Optional[int] = None) -> bool:
-        """True when ``shard``'s Lease is held (default: the calling
-        worker thread's shard; always True without ``shard_lease`` or
-        off a worker thread). Reconcilers use this as a write fence
-        piece: a worker whose shard Lease was lost must not land
-        writes racing the replica that took the shard over."""
+        """True when ``shard``'s Lease is verifiably held at the epoch
+        this worker acquired it (default: the calling worker thread's
+        shard; always True without ``shard_lease`` or off a worker
+        thread). Reconcilers use this as a write fence piece: a worker
+        whose shard Lease was lost — or whose lease *epoch* was
+        superseded while it was partitioned — must not land writes
+        racing the replica that took the shard over
+        (``LeaderElector.verify_epoch``, docs/RECOVERY.md "Partitions
+        & gray failures")."""
         if not self.shard_lease:
             return True
         if shard is None:
@@ -307,7 +311,27 @@ class Manager:
         if shard is None:
             return True
         elector = self._electors.get(shard)
-        return elector is None or elector.is_leader.is_set()
+        return elector is None or (
+            elector.is_leader.is_set() and elector.verify_epoch()
+        )
+
+    def shard_fence(self, shard: Optional[int] = None):
+        """An :class:`~instaslice_tpu.utils.election.EpochFence` bound
+        to ``shard``'s Lease elector (default: the calling worker's
+        shard, captured NOW — hand the result to a cross-thread
+        committer and it stays bound to the enqueueing worker's lease).
+        Open (and epoch-less) without ``shard_lease``."""
+        from instaslice_tpu.utils.election import EpochFence
+
+        if shard is None:
+            shard = self.current_shard()
+
+        def get_elector(s=shard):
+            if not self.shard_lease or s is None:
+                return None
+            return self._electors.get(s)
+
+        return EpochFence(get_elector)
 
     def _shard_elector(self, shard: int):
         from instaslice_tpu.utils.election import LeaderElector

@@ -1,0 +1,990 @@
+"""Partition-tolerant control & serving planes (docs/RECOVERY.md
+"Partitions & gray failures"): network nemesis, lease-epoch write
+fencing, degraded/static mode, and gray-failure ejection.
+
+Fast units cover the :class:`NemesisPlan` grammar/determinism/timed
+heal, the :class:`NemesisKubeClient` one-way partition semantics, the
+informer under duplicated/reordered watch deliveries + an injected 410
+(satellite: the stale-replay rv guard and index memos must hold), the
+lease-epoch fence refusing a deposed leader's writes, the circuit
+breaker's single half-open probe, the router's jittered poll backoff +
+Retry-After handling, gray ejection/readmission/hedged polls, the
+agent's static mode, and ``validate_events.check_nemesis``.
+
+The ``smoke`` tests (the ``make chaos-partition-smoke`` gate inside
+``make test``) run the two acceptance scenarios end to end: partition
+the controller → failover/heal → converge with zero double
+allocations; inject 3× latency into a 100%-success replica → EWMA
+ejection → sessions drain via migration → re-admit after heal.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import validate_events  # noqa: E402
+
+from instaslice_tpu.api.constants import (
+    REASON_APISERVER_UNREACHABLE,
+    REASON_DEGRADED_ENTERED,
+    REASON_DEGRADED_EXITED,
+    REASON_REPLICA_EJECTED,
+    REASON_REPLICA_READMITTED,
+    REASON_WRITE_FENCED,
+    WRITER_EPOCH_ANNOTATION,
+)
+from instaslice_tpu.faults.netchaos import (
+    NemesisKubeClient,
+    NemesisPlan,
+    PartitionError,
+    get_nemesis,
+    set_nemesis,
+)
+from instaslice_tpu.kube.client import Fenced, update_with_retry
+from instaslice_tpu.kube.fake import FakeKube
+from instaslice_tpu.kube.informer import Informer
+from instaslice_tpu.kube.real import CircuitBreaker, CircuitOpen
+from instaslice_tpu.obs.journal import get_journal, reset_journal
+from instaslice_tpu.serving.router import Replica, Router, _median
+from instaslice_tpu.utils.election import EpochFence, LeaderElector
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "1234"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_nemesis():
+    set_nemesis(None)
+    reset_journal()
+    yield
+    set_nemesis(None)
+    reset_journal()
+
+
+def journal_reasons():
+    return [e.reason for e in get_journal().events()]
+
+
+def wait_for(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ------------------------------------------------------------- the plan
+
+
+class TestNemesisPlan:
+    def test_env_grammar(self):
+        plan = NemesisPlan.from_env(
+            "seed=7;controller>apiserver:kind=partition,duration=2;"
+            "apiserver>agent-*:kind=dup,p=0.5;"
+            "router>replica:http://x:1:kind=latency,delay=0.1,jitter=0.05"
+        )
+        assert plan.seed == 7
+        kinds = {(r.src, r.dst): r.kind for r in plan.rules}
+        assert kinds[("controller", "apiserver")] == "partition"
+        assert kinds[("apiserver", "agent-*")] == "dup"
+        # the LAST ':' splits rule body from the link, so URL-bearing
+        # destinations survive
+        assert kinds[("router", "replica:http://x:1")] == "latency"
+        assert NemesisPlan.from_env("") is None
+        with pytest.raises(ValueError):
+            NemesisPlan.from_env("controller>apiserver:p=0.5")  # no kind
+        with pytest.raises(ValueError):
+            NemesisPlan.from_env("garbage")
+
+    def test_partition_symmetric_vs_oneway(self):
+        plan = NemesisPlan(CHAOS_SEED)
+        plan.partition("a", "b")
+        assert plan.is_partitioned("a", "b")
+        assert plan.is_partitioned("b", "a")  # symmetric severs both
+        plan.heal()
+        plan.partition_oneway("a", "b")
+        assert plan.is_partitioned("a", "b")
+        assert not plan.is_partitioned("b", "a")
+        with pytest.raises(PartitionError):
+            plan.before_request("a", "b")
+        plan.before_request("b", "a")  # reverse direction flows
+
+    def test_seeded_determinism(self):
+        def fires(seed):
+            plan = NemesisPlan(seed)
+            rule = plan.drop("a", "b", p=0.5)
+            out = []
+            for _ in range(50):
+                try:
+                    plan.before_request("a", "b")
+                    out.append(0)
+                except PartitionError:
+                    out.append(1)
+            assert rule.fired == sum(out)
+            return out
+
+        assert fires(CHAOS_SEED) == fires(CHAOS_SEED)
+        assert fires(CHAOS_SEED) != fires(CHAOS_SEED + 1)
+
+    def test_timed_heal(self):
+        plan = NemesisPlan(CHAOS_SEED)
+        plan.partition("a", "b", duration=0.15)
+        with pytest.raises(PartitionError):
+            plan.before_request("a", "b")
+        assert wait_for(lambda: not plan.is_partitioned("a", "b"),
+                        timeout=2.0)
+        plan.before_request("a", "b")  # healed: flows again
+
+    def test_force_heal_and_stats(self):
+        plan = NemesisPlan(CHAOS_SEED)
+        plan.partition("a", "b")
+        plan.drop("c", "d", p=1.0)
+        assert plan.heal("a", "b") == 1
+        assert not plan.is_partitioned("a", "b")
+        with pytest.raises(PartitionError):
+            plan.before_request("c", "d")
+        assert plan.heal() == 1  # remaining drop rule
+        links = {s["link"]: s for s in plan.stats()}
+        assert links["a>b"]["healed"] and links["c>d"]["fired"] == 1
+
+    def test_throttle_and_max_fires(self):
+        plan = NemesisPlan(CHAOS_SEED)
+        plan.throttle("a", "b", rate_bps=1e6)
+        t0 = time.monotonic()
+        plan.throttle_sleep("a", "b", 100_000)  # 0.1s at 1MB/s
+        assert time.monotonic() - t0 >= 0.09
+        plan.heal()
+        plan.drop("a", "b", p=1.0, max_fires=1)
+        with pytest.raises(PartitionError):
+            plan.before_request("a", "b")
+        plan.before_request("a", "b")  # cap exhausted
+
+
+class TestNemesisKubeClient:
+    def _client(self, plan, ident="controller"):
+        kube = FakeKube()
+        return kube, NemesisKubeClient(kube, plan, ident)
+
+    def _mk(self, kube, name, rv_churn=0):
+        kube.create("TpuSlice", {
+            "apiVersion": "v1", "kind": "TpuSlice",
+            "metadata": {"namespace": "ns", "name": name},
+            "spec": {},
+        })
+        for _ in range(rv_churn):
+            obj = kube.get("TpuSlice", "ns", name)
+            kube.update("TpuSlice", obj)
+
+    def test_oneway_partition_is_asymmetric(self):
+        plan = NemesisPlan(CHAOS_SEED)
+        kube, client = self._client(plan)
+        self._mk(kube, "n0")
+        # cut ONLY controller→apiserver: verbs fail...
+        plan.partition_oneway("controller", "apiserver")
+        with pytest.raises(PartitionError):
+            client.get("TpuSlice", "ns", "n0")
+        plan.heal()
+        # ...cut ONLY apiserver→controller: verbs flow, the watch
+        # stream is disconnected mid-flight instead
+        plan.partition_oneway("apiserver", "controller")
+        assert client.get("TpuSlice", "ns", "n0")
+        seen = list(client.watch("TpuSlice", namespace="ns",
+                                 timeout=0.1))
+        assert seen == []  # stream cut before the first delivery
+
+    def test_dup_and_expire_injection(self):
+        from instaslice_tpu.kube.client import ResourceVersionExpired
+
+        plan = NemesisPlan(CHAOS_SEED)
+        kube, client = self._client(plan)
+        self._mk(kube, "n0")
+        plan.rule("apiserver", "controller", "dup")
+        evs = [e for e, o in client.watch("TpuSlice", namespace="ns",
+                                          timeout=0.1)
+               if e != "BOOKMARK"]
+        assert len(evs) == 2  # every delivery duplicated
+        plan.heal()
+        plan.rule("apiserver", "controller", "expire", max_fires=1)
+        with pytest.raises(ResourceVersionExpired):
+            list(client.watch("TpuSlice", namespace="ns", timeout=0.1))
+
+
+# --------------------------------------------- informer under nemesis
+
+
+class TestInformerUnderNemesis:
+    def _group(self, obj):
+        return [obj.get("spec", {}).get("group", "")]
+
+    def test_dup_reorder_and_410_converge(self):
+        """Duplicated + reordered deliveries and an injected 410
+        mid-stream must not regress the rv guard (stale replays
+        ignored) or spuriously invalidate index memos of untouched
+        buckets."""
+        plan = NemesisPlan(CHAOS_SEED)
+        kube = FakeKube()
+        client = NemesisKubeClient(kube, plan, "controller")
+
+        def mk(name, group, gen=0):
+            kube.create("TpuSlice", {
+                "apiVersion": "v1", "kind": "TpuSlice",
+                "metadata": {"namespace": "ns", "name": name},
+                "spec": {"group": group, "gen": gen},
+            })
+
+        mk("stable-0", "a")
+        mk("churn-0", "b")
+        inf = Informer(client, "TpuSlice", namespace="ns",
+                       resync_period=0.5,
+                       indexers={"group": self._group}).start()
+        try:
+            assert inf.wait_synced(10)
+            v_a = inf.index_version("group", "a")
+            plan.watch_chaos("apiserver", "controller",
+                             dup_p=0.5, reorder_p=0.3)
+            plan.rule("apiserver", "controller", "expire", max_fires=1)
+            for i in range(20):
+                obj = kube.get("TpuSlice", "ns", "churn-0")
+                obj["spec"]["gen"] = i + 1
+                kube.update("TpuSlice", obj)
+            assert wait_for(
+                lambda: (inf.get("ns", "churn-0") or {})
+                .get("spec", {}).get("gen") == 20,
+                timeout=15,
+            ), (inf.get("ns", "churn-0"), plan.stats())
+            plan.heal()
+            # rv guard: a duplicated delivery of churn's final version
+            # never bumped the store again, and the untouched bucket's
+            # memo version is EXACTLY where it started — chaos on "b"
+            # didn't invalidate "a"
+            assert inf.index_version("group", "a") == v_a
+            truth = {o["metadata"]["name"]
+                     for o in kube.list("TpuSlice", namespace="ns")}
+            assert {o["metadata"]["name"]
+                    for o in inf.list()} == truth
+        finally:
+            inf.stop()
+
+    def test_disconnect_then_heal_replays_missed_events(self):
+        plan = NemesisPlan(CHAOS_SEED)
+        kube = FakeKube()
+        client = NemesisKubeClient(kube, plan, "controller")
+        kube.create("TpuSlice", {
+            "apiVersion": "v1", "kind": "TpuSlice",
+            "metadata": {"namespace": "ns", "name": "n0"},
+            "spec": {"gen": 0},
+        })
+        inf = Informer(client, "TpuSlice", namespace="ns",
+                       resync_period=30.0).start()
+        try:
+            assert inf.wait_synced(10)
+            plan.partition("controller", "apiserver", duration=0.4)
+            obj = kube.get("TpuSlice", "ns", "n0")
+            obj["spec"]["gen"] = 1
+            kube.update("TpuSlice", obj)  # emitted while cut off
+            assert wait_for(
+                lambda: (inf.get("ns", "n0") or {})
+                .get("spec", {}).get("gen") == 1,
+                timeout=15,
+            )
+        finally:
+            inf.stop()
+
+
+# --------------------------------------------------- lease-epoch fence
+
+
+class TestEpochFence:
+    def _mk_cr(self, kube):
+        kube.create("TpuSlice", {
+            "apiVersion": "v1", "kind": "TpuSlice",
+            "metadata": {"namespace": "ns", "name": "n0"},
+            "spec": {"x": 0},
+        })
+
+    def test_deposed_writer_fenced_and_epochs_stamped(self):
+        kube = FakeKube()
+        self._mk_cr(kube)
+        a = LeaderElector(kube, "ns", "ctl", "a", lease_seconds=0.2)
+        b = LeaderElector(kube, "ns", "ctl", "b", lease_seconds=0.2)
+        assert a._try_acquire_or_renew()
+        a.is_leader.set()
+        fence_a = EpochFence(lambda: a)
+        fence_b = EpochFence(lambda: b)
+
+        def bump(obj):
+            obj["spec"]["x"] += 1
+            return obj
+
+        out = update_with_retry(kube, "TpuSlice", "ns", "n0", bump,
+                                fence=fence_a)
+        assert out["metadata"]["annotations"][
+            WRITER_EPOCH_ANNOTATION] == "0"
+        # the lease expires unrenewed; the successor takes over and
+        # bumps leaseTransitions — the epoch the fence compares
+        time.sleep(0.25)
+        assert b._try_acquire_or_renew()
+        b.is_leader.set()
+        assert b.epoch == 1
+        with pytest.raises(Fenced):
+            update_with_retry(kube, "TpuSlice", "ns", "n0", bump,
+                              fence=fence_a)
+        assert REASON_WRITE_FENCED in journal_reasons()
+        # zero double-writes: the deposed attempt landed nothing
+        assert kube.get("TpuSlice", "ns", "n0")["spec"]["x"] == 1
+        out = update_with_retry(kube, "TpuSlice", "ns", "n0", bump,
+                                fence=fence_b)
+        assert out["metadata"]["annotations"][
+            WRITER_EPOCH_ANNOTATION] == "1"
+
+    def test_fence_fails_closed_when_unverifiable(self):
+        plan = NemesisPlan(CHAOS_SEED)
+        kube = FakeKube()
+        self._mk_cr(kube)
+        client = NemesisKubeClient(kube, plan, "ctl-a")
+        a = LeaderElector(client, "ns", "ctl", "a", lease_seconds=0.2)
+        assert a._try_acquire_or_renew()
+        a.is_leader.set()
+        fence = EpochFence(lambda: a)
+        assert fence() and fence.epoch == 0
+        # partitioned AND past the freshness window: the fence cannot
+        # re-prove the lease, so it must fail CLOSED
+        time.sleep(0.25)
+        plan.partition("ctl-a", "apiserver")
+        assert not fence()
+
+    def test_open_without_election(self):
+        fence = EpochFence(lambda: None)
+        assert fence() and fence.epoch is None
+        obj = {"metadata": {}}
+        from instaslice_tpu.kube.client import stamp_writer_epoch
+        stamp_writer_epoch(obj, fence)
+        assert "annotations" not in obj["metadata"]  # no-op stamp
+
+
+# --------------------------------------- breaker half-open single probe
+
+
+class TestBreakerHalfOpenProbe:
+    def _open(self, br):
+        for _ in range(br.threshold):
+            br.fail()
+        assert br.is_open()
+
+    def test_exactly_one_probe(self):
+        br = CircuitBreaker(threshold=2, cooldown=0.05, name="t")
+        self._open(br)
+        with pytest.raises(CircuitOpen):
+            br.check()
+        time.sleep(0.06)
+        br.check()  # this caller IS the half-open probe
+        with pytest.raises(CircuitOpen) as ei:
+            br.check()  # concurrent caller fast-fails
+        assert "probe already in flight" in str(ei.value)
+        br.ok()  # probe succeeded: circuit closes for everyone
+        br.check()
+
+    def test_failed_probe_reopens_immediately(self):
+        br = CircuitBreaker(threshold=2, cooldown=0.05, name="t")
+        self._open(br)
+        time.sleep(0.06)
+        br.check()
+        br.fail()  # the probe failed: count was one short → reopen
+        with pytest.raises(CircuitOpen):
+            br.check()
+
+    def test_stale_probe_claim_expires(self):
+        br = CircuitBreaker(threshold=2, cooldown=0.05, name="t")
+        self._open(br)
+        time.sleep(0.06)
+        br.check()  # probe claimed, then its thread dies silently
+        time.sleep(0.06)
+        br.check()  # claim older than a cooldown: next caller probes
+
+
+# ----------------------------------------- router poll backoff + hedging
+
+
+def unstarted_router(*reps, **kw) -> Router:
+    r = Router(port=0, **kw)
+    for rep in reps:
+        r._replicas[rep.url] = rep
+    r._srv.server_close()
+    return r
+
+
+def fed_replica(url, lat_samples=(), **stats) -> Replica:
+    rep = Replica(url)
+    rep.adopt_stats({
+        "replica_id": stats.pop("replica_id", url), "uptime_seconds": 10.0,
+        "queued": 0, "live_slots": 0, "parked": 0, "max_batch": 8,
+        "kv": {"free": 100, "total": 100},
+        "radix": {"digest": {"granule": 8, "paths": []}},
+        "tenant_classes": {},
+    })
+    for dt in lat_samples:
+        rep.observe_latency(dt)
+    return rep
+
+
+class TestPollBackoff:
+    def test_jittered_growth_and_cap(self):
+        r = unstarted_router()
+        prev, seen = 0.0, set()
+        for _ in range(64):
+            prev = r._next_backoff(prev)
+            assert r.poll_backoff_base <= prev <= r.poll_backoff_cap
+            seen.add(round(prev, 6))
+        assert prev == r.poll_backoff_cap or len(seen) > 8  # jittered
+
+    def test_retry_after_stretches_and_caps(self):
+        r = unstarted_router()
+        assert r._next_backoff(0.0, retry_after=5.0) >= 5.0
+        # a hostile/huge Retry-After cannot park the poll for an hour
+        assert r._next_backoff(0.0, retry_after=3600.0) \
+            <= r.retry_after_cap
+
+    def test_retry_after_header_parse(self):
+        from email.message import Message
+
+        from instaslice_tpu.serving.router import _retry_after_seconds
+        h = Message()
+        h["Retry-After"] = "3"
+        assert _retry_after_seconds(h) == 3.0
+        assert _retry_after_seconds(Message()) is None
+
+    def test_poll_failure_sets_jittered_gate(self):
+        r = unstarted_router()
+        rep = fed_replica("http://x:1")
+        r._note_poll_failure(rep, None)
+        assert rep.poll_next > time.monotonic() - 0.001
+        first = rep.poll_backoff
+        r._note_poll_failure(rep, None)
+        assert rep.poll_backoff <= r.poll_backoff_cap
+        assert first > 0
+
+
+class TestHedgedStats:
+    def test_hedge_wins_when_primary_stalls(self):
+        r = unstarted_router(hedge_after=0.05)
+        rep = fed_replica("http://x:1")
+        calls = {"n": 0}
+
+        def fake_http(method, rp, path, body, timeout=10.0):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.4)  # the gray primary answer
+                return 200, {"slow": True}
+            return 200, {"slow": False}
+
+        r.http_json = fake_http
+        code, payload, lat = r._hedged_stats(rep)
+        assert code == 200 and payload == {"slow": False}
+        assert r.hedges["fired"] == 1 and r.hedges["won"] == 1
+        assert r.requests.get("hedged-ok") == 1
+
+    def test_fast_primary_never_hedges(self):
+        r = unstarted_router(hedge_after=0.5)
+        rep = fed_replica("http://x:1")
+        r.http_json = lambda *a, **k: (200, {})
+        code, payload, lat = r._hedged_stats(rep)
+        assert code == 200 and r.hedges["fired"] == 0
+
+
+# ----------------------------------------------- gray-failure ejection
+
+
+class TestGrayEjection:
+    def test_median_helper(self):
+        assert _median([1.0]) == 1.0
+        assert _median([1.0, 3.0]) == 2.0
+        assert _median([1.0, 2.0, 9.0]) == 2.0
+
+    def test_ewma_p95_tracks_latency(self):
+        rep = Replica("http://x:1")
+        for _ in range(16):
+            rep.observe_latency(0.01)
+        assert 0.008 <= rep.lat_p95() <= 0.02
+        for _ in range(16):
+            rep.observe_latency(0.2)
+        assert rep.lat_p95() > 0.1
+
+    def test_eject_and_readmit_cycle(self):
+        slow = fed_replica("http://slow:1", lat_samples=[0.3] * 10,
+                           replica_id="s")
+        fast = fed_replica("http://fast:1", lat_samples=[0.004] * 10,
+                           replica_id="f")
+        r = unstarted_router(slow, fast, eject_min_samples=8)
+        r.http_json = lambda *a, **k: (200, {})  # drain/undrain stub
+        r._gray_sweep()
+        assert slow.ejected
+        assert not slow.alive(time.monotonic(), r.stale_after)
+        assert not fast.ejected
+        assert r.ejections["http://slow:1"] == 1
+        assert REASON_REPLICA_EJECTED in journal_reasons()
+        # ejected ≠ removed: the router keeps polling it, and routing
+        # skips it
+        rep, policy = r.route([1, 2, 3], "", "")
+        assert rep.url == "http://fast:1"
+        # latency recovers → hysteresis readmission
+        for _ in range(32):
+            slow.observe_latency(0.004)
+        r._gray_sweep()
+        assert wait_for(lambda: not slow.ejected, timeout=2.0)
+        assert REASON_REPLICA_READMITTED in journal_reasons()
+
+    def test_never_ejects_below_two_healthy(self):
+        only = fed_replica("http://only:1", lat_samples=[0.5] * 10)
+        r = unstarted_router(only)
+        r._gray_sweep()
+        assert not only.ejected
+
+    def test_eject_drops_session_affinity(self):
+        slow = fed_replica("http://slow:1", lat_samples=[0.3] * 10)
+        fast = fed_replica("http://fast:1", lat_samples=[0.004] * 10)
+        r = unstarted_router(slow, fast)
+        r.http_json = lambda *a, **k: (200, {})
+        r.pin_session("conv", "http://slow:1")
+        r._gray_sweep()
+        rep, policy = r.route([1, 2, 3], "", "conv")
+        assert rep.url == "http://fast:1"  # affinity dropped on eject
+
+    def test_disabled_by_zero_factor(self):
+        slow = fed_replica("http://slow:1", lat_samples=[0.5] * 10)
+        fast = fed_replica("http://fast:1", lat_samples=[0.004] * 10)
+        r = unstarted_router(slow, fast, eject_factor=0.0)
+        r._gray_sweep()
+        assert not slow.ejected
+
+
+# -------------------------------------------------- agent static mode
+
+
+class TestAgentStaticMode:
+    def _agent(self):
+        from instaslice_tpu.agent.reconciler import NodeAgent
+        from instaslice_tpu.device import FakeTpuBackend
+
+        plan = NemesisPlan(CHAOS_SEED)
+        kube = FakeKube()
+        client = NemesisKubeClient(kube, plan, "agent-n0")
+        agent = NodeAgent(client, FakeTpuBackend(generation="v5e"),
+                          "n0", "ns", health_interval=0)
+        agent.boot()
+        return plan, kube, agent
+
+    def test_partition_enters_static_mode_once(self):
+        plan, kube, agent = self._agent()
+        plan.partition("agent-n0", "apiserver")
+        out = agent.reconcile("n0")
+        assert out == agent.degraded_retry_s and agent.degraded
+        agent.reconcile("n0")  # re-probe while still partitioned
+        rs = journal_reasons()
+        assert rs.count(REASON_APISERVER_UNREACHABLE) == 1
+        assert rs.count(REASON_DEGRADED_ENTERED) == 1
+
+    def test_heal_runs_boot_sweep_and_exits(self):
+        plan, kube, agent = self._agent()
+        plan.partition("agent-n0", "apiserver")
+        agent.reconcile("n0")
+        assert agent.degraded
+        plan.heal()
+        assert agent.reconcile("n0") is None
+        assert not agent.degraded
+        assert REASON_DEGRADED_EXITED in journal_reasons()
+        # durable truth re-published by the boot sweep
+        assert kube.get("TpuSlice", "ns", "n0")
+
+    def test_injected_api_errors_do_not_trigger_static_mode(self):
+        from instaslice_tpu.faults import FaultPlan, FaultyKubeClient
+        from instaslice_tpu.kube.client import ApiError
+
+        plan, kube, agent = self._agent()
+        flaky = FaultyKubeClient(
+            agent.client,
+            FaultPlan.from_env("kube.request:p=1.0,kinds=http-503"),
+        )
+        agent.client = flaky
+        with pytest.raises(ApiError):
+            agent.reconcile("n0")
+        assert not agent.degraded  # a 5xx is not a partition
+
+
+# ------------------------------------------------- invariant checker
+
+
+def _ev(seq, component, reason, ref="", trace="", **attrs):
+    rec = {"seq": seq, "ts": float(seq), "component": component,
+           "reason": reason, "objectRef": ref, "traceId": trace}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+class TestCheckNemesis:
+    def test_clean_journal_passes(self):
+        evs = [
+            _ev(1, "agent-n0", REASON_APISERVER_UNREACHABLE, "node/n0"),
+            _ev(2, "agent-n0", REASON_DEGRADED_ENTERED, "node/n0"),
+            _ev(3, "agent-n0", REASON_DEGRADED_EXITED, "node/n0"),
+            _ev(4, "controller", "Admitted", "Pod/ns/p", trace="t1"),
+            _ev(5, "allocation", "SliceCreating", "alloc/a1", trace="t1"),
+            _ev(6, "allocation", "SliceCreated", "alloc/a1", trace="t1"),
+            _ev(7, "allocation", "SliceUngated", "alloc/a1", trace="t1"),
+        ]
+        assert validate_events.check_nemesis(evs) == []
+
+    def test_unpaired_degraded_entry_fails(self):
+        evs = [
+            _ev(1, "agent-n0", REASON_APISERVER_UNREACHABLE, "node/n0"),
+            _ev(2, "agent-n0", REASON_DEGRADED_ENTERED, "node/n0"),
+        ]
+        errs = validate_events.check_nemesis(evs)
+        assert any("never paired" in e for e in errs)
+
+    def test_exit_without_entry_fails(self):
+        errs = validate_events.check_nemesis(
+            [_ev(1, "agent-n0", REASON_DEGRADED_EXITED, "node/n0")]
+        )
+        assert any("without a matching" in e for e in errs)
+
+    def test_double_place_detected(self):
+        evs = [
+            _ev(1, "controller", "Admitted", "Pod/ns/p", trace="t1"),
+            _ev(2, "allocation", "SliceCreating", "alloc/a1", trace="t1"),
+            _ev(3, "allocation", "SliceUngated", "alloc/a1", trace="t1"),
+            # the deposed leader's parallel grant for the SAME pod
+            _ev(4, "allocation", "SliceCreating", "alloc/a2", trace="t1"),
+            _ev(5, "allocation", "SliceUngated", "alloc/a2", trace="t1"),
+        ]
+        errs = validate_events.check_nemesis(evs)
+        assert any("double-placed" in e for e in errs)
+
+    def test_retry_after_delete_is_not_double_place(self):
+        evs = [
+            _ev(1, "controller", "Admitted", "Pod/ns/p", trace="t1"),
+            _ev(2, "allocation", "SliceCreating", "alloc/a1", trace="t1"),
+            _ev(3, "allocation", "SliceUngated", "alloc/a1", trace="t1"),
+            _ev(4, "allocation", "SliceDeleted", "alloc/a1", trace="t1"),
+            _ev(5, "allocation", "SliceCreating", "alloc/a2", trace="t1"),
+            _ev(6, "allocation", "SliceUngated", "alloc/a2", trace="t1"),
+        ]
+        assert validate_events.check_nemesis(evs) == []
+
+    def test_slice_leak_detected(self):
+        evs = [
+            _ev(1, "allocation", "SliceCreating", "alloc/a1", trace="t1"),
+            _ev(2, "allocation", "SliceCreated", "alloc/a1", trace="t1"),
+        ]
+        errs = validate_events.check_nemesis(evs)
+        assert any("slice leak" in e for e in errs)
+
+    def test_write_fenced_requires_component(self):
+        errs = validate_events.check_nemesis(
+            [_ev(1, "", REASON_WRITE_FENCED, "TpuSlice/ns/n0")]
+        )
+        assert any("WriteFenced" in e for e in errs)
+
+
+class TestLoadgenClassify:
+    def test_partition_era_outcomes(self):
+        from instaslice_tpu.serving.loadgen import OUTCOMES, _classify
+
+        assert "hedged-ok" in OUTCOMES and "replica-ejected" in OUTCOMES
+        assert _classify(None, 200, 5, hedged=True) == "hedged-ok"
+        assert _classify(None, 200, 5) == "ok"
+        assert _classify(
+            "HTTPError 503: no replica accepted; 1 gray-ejected", 503
+        ) == "replica-ejected"
+        assert _classify("x", 503, 0) == "timeout-503"
+
+
+# ------------------------------------------------------------- smokes
+
+
+def _journal_dicts():
+    return [e.to_dict() for e in get_journal().events()]
+
+
+@pytest.mark.slow
+class TestPartitionSmoke:
+    def _sim(self, plan, **kw):
+        from instaslice_tpu.sim import SimCluster
+
+        defaults = dict(
+            n_nodes=2, generation="v5e", nodes_per_group=2,
+            deletion_grace_seconds=0.2, health_interval=0,
+            nemesis=plan,
+        )
+        defaults.update(kw)
+        return SimCluster(**defaults)
+
+    def test_smoke_controller_partition_heal_converges(self):
+        """Partition the controller from the apiserver mid-run: grants
+        stall (never split-brain), agents keep serving, and on heal the
+        cluster converges with zero double allocations and a clean
+        nemesis journal."""
+        from test_crash_chaos import assert_no_orphans, assert_no_overlaps
+
+        plan = NemesisPlan(CHAOS_SEED)
+        with self._sim(plan) as c:
+            c.submit("pre-partition", "v5e-1x1")
+            assert c.wait_phase("pre-partition", "Running", timeout=30)
+            plan.partition("controller", "apiserver")
+            c.submit("mid-partition", "v5e-1x1")
+            time.sleep(1.0)
+            # the cut-off controller must not have granted anything
+            assert c.pod_phase("mid-partition") != "Running"
+            plan.heal()
+            assert c.wait_phase("mid-partition", "Running", timeout=30), (
+                c.pod_phase("mid-partition"), plan.stats())
+            assert_no_overlaps(c)
+            assert_no_orphans(c)
+            errs = validate_events.check_nemesis(_journal_dicts())
+            assert not errs, errs
+            errs = validate_events.check_chains(_journal_dicts(),
+                                                strict=True)
+            assert not errs, errs
+
+    def test_smoke_agent_partition_static_mode(self):
+        """Cut an agent off mid-run: realized slices keep serving
+        (device reservations untouched), the agent journals its
+        degraded entry exactly once, and the heal-side boot sweep
+        reconciles CR truth with device truth."""
+        from test_crash_chaos import assert_no_orphans
+
+        plan = NemesisPlan(CHAOS_SEED)
+        with self._sim(plan, health_interval=0.3) as c:
+            c.submit("static-pod", "v5e-1x1")
+            assert c.wait_phase("static-pod", "Running", timeout=30)
+            held = {node: len(b.list_reservations())
+                    for node, b in c.backends.items()}
+            victim = next(n for n, count in held.items() if count)
+            plan.partition(f"agent-{victim}", "apiserver")
+            assert wait_for(
+                lambda: REASON_DEGRADED_ENTERED in journal_reasons(),
+                timeout=15,
+            ), journal_reasons()
+            # STATIC mode: the realized slice is still on the device
+            assert len(c.backends[victim].list_reservations()) \
+                == held[victim]
+            plan.heal()
+            assert wait_for(
+                lambda: REASON_DEGRADED_EXITED in journal_reasons(),
+                timeout=15,
+            ), journal_reasons()
+            c.submit("post-heal", "v5e-1x1")
+            assert c.wait_phase("post-heal", "Running", timeout=30)
+            assert_no_orphans(c)  # CR state == device state post-heal
+            errs = validate_events.check_nemesis(_journal_dicts())
+            assert not errs, errs
+
+    def test_smoke_gray_replica_ejected_and_readmitted(self):
+        """The serving-plane acceptance scenario: a replica that still
+        answers every request (100% success) but 25x slower is ejected
+        on latency EWMA alone, its sessions drain through the
+        migration path, traffic keeps flowing with zero hung requests,
+        and after the latency heals it is re-admitted."""
+        import jax
+        import jax.numpy as jnp
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine, loadgen
+        from instaslice_tpu.serving.api_server import ApiServer
+
+        cfg = ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                          n_layers=2, d_ff=64, dtype=jnp.float32,
+                          remat=False)
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+
+        def engine():
+            return ServingEngine(m, params, max_batch=4, max_len=96,
+                                 prefill_len=8)
+
+        servers = [ApiServer(engine(), block_size=4).start()
+                   for _ in range(2)]
+        plan = NemesisPlan(CHAOS_SEED)
+        set_nemesis(plan)
+        router = Router([s.url for s in servers], poll_interval=0.05,
+                        eject_min_samples=6, eject_floor_s=0.02,
+                        hedge_after=0.0).start()
+        try:
+            report = loadgen.run(router.url, requests=6, concurrency=2,
+                                 prompt_len=4, max_tokens=4, vocab=64,
+                                 stream=False, timeout=60)
+            assert report["outcomes"]["hung"] == 0, report
+            victim_url = servers[0].url.rstrip("/")
+            plan.latency("router", f"replica:{victim_url}", delay=0.5)
+            victim = router._replicas[victim_url]
+            assert wait_for(lambda: victim.ejected, timeout=20), (
+                victim.lat_p95(), plan.stats())
+            assert REASON_REPLICA_EJECTED in journal_reasons()
+            # traffic keeps flowing around the gray replica
+            report = loadgen.run(router.url, requests=6, concurrency=2,
+                                 prompt_len=4, max_tokens=4, vocab=64,
+                                 stream=False, timeout=60, seed=1)
+            assert report["outcomes"]["hung"] == 0, report
+            assert report["ok"] == 6, report
+            # every request avoided the ejected replica
+            assert all(not router._replicas[u].ejected
+                       for u, ts in router._sessions.values())
+            plan.heal()
+            assert wait_for(lambda: not victim.ejected, timeout=20), (
+                victim.lat_p95(), plan.stats())
+            assert REASON_REPLICA_READMITTED in journal_reasons()
+            # healed fleet under the loadgen nemesis arm: client-side
+            # latency/drops/partition schedule, hedge-retried, no hangs
+            set_nemesis(None)
+            report = loadgen.run(router.url, requests=6, concurrency=2,
+                                 prompt_len=4, max_tokens=4, vocab=64,
+                                 stream=False, timeout=60, seed=2,
+                                 nemesis_seed=CHAOS_SEED)
+            assert report["outcomes"]["hung"] == 0, report
+            assert report["nemesis"]["seed"] == CHAOS_SEED, report
+            assert get_nemesis() is None  # arm uninstalled its plan
+            errs = validate_events.check_nemesis(_journal_dicts())
+            assert not errs, errs
+        finally:
+            set_nemesis(None)
+            router.stop()
+            for s in servers:
+                s.stop()
+
+
+@pytest.mark.slow
+class TestGrayEjectionComparative:
+    # deliberately NOT named *smoke*: the <60s gate skips this
+    # two-arm comparison; the 3-seed `make chaos` sweep runs it
+
+    def test_gray_ejection_beats_no_ejection_baseline(self):
+        """Same replayed trace, same injected 1s gray latency on one of
+        two replicas: the arm WITH EWMA ejection must beat the
+        eject_factor=0 baseline on client p95 latency — the whole point
+        of ejecting a replica that never errors. Load is kept light
+        (the surviving replica absorbs it without queueing) so the
+        injected stall, not lost capacity, dominates the tail."""
+        import tempfile
+
+        import jax
+        import jax.numpy as jnp
+
+        from instaslice_tpu.models.lm import ModelConfig, TpuLM
+        from instaslice_tpu.serving import ServingEngine, loadgen
+        from instaslice_tpu.serving.api_server import ApiServer
+
+        cfg = ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                          n_layers=2, d_ff=64, dtype=jnp.float32,
+                          remat=False)
+        m = TpuLM(cfg)
+        params = m.init(jax.random.key(0))
+        trace = tempfile.mktemp(prefix="tpuslice-nemesis-trace.",
+                                suffix=".jsonl")
+
+        def arm(eject_factor, record=False):
+            servers = [ApiServer(
+                ServingEngine(m, params, max_batch=4, max_len=96,
+                              prefill_len=8), block_size=4).start()
+                for _ in range(2)]
+            plan = NemesisPlan(CHAOS_SEED)
+            set_nemesis(plan)
+            router = Router([s.url for s in servers],
+                            poll_interval=0.05, eject_min_samples=6,
+                            eject_floor_s=0.02, hedge_after=0.0,
+                            eject_factor=eject_factor).start()
+            try:
+                victim = servers[0].url.rstrip("/")
+                plan.latency("router", f"replica:{victim}", delay=1.0)
+                if eject_factor:
+                    # deterministic warm-up: the EWMA must trip before
+                    # the measured window starts
+                    assert wait_for(
+                        lambda: router._replicas[victim].ejected,
+                        timeout=20,
+                    ), (router._replicas[victim].lat_p95(), plan.stats())
+                else:
+                    time.sleep(2.0)  # same poll seasoning, no ejection
+                kw = dict(record_trace=trace) if record \
+                    else dict(replay_trace=trace)
+                report = loadgen.run(
+                    router.url, requests=8, concurrency=2,
+                    prompt_len=4, max_tokens=4, vocab=64,
+                    stream=False, timeout=60, **kw)
+                assert report["outcomes"]["hung"] == 0, report
+                ejected = router._replicas[victim].ejected
+                return report, ejected
+            finally:
+                set_nemesis(None)
+                router.stop()
+                for s in servers:
+                    s.stop()
+
+        try:
+            baseline, ejected0 = arm(0.0, record=True)
+            treated, ejected1 = arm(3.0)
+        finally:
+            if os.path.exists(trace):
+                os.unlink(trace)
+        assert not ejected0 and ejected1
+        # the ejection arm routes around the 1s injected stall; the
+        # baseline keeps landing ~half its requests on it
+        assert treated["p95_latency"] < baseline["p95_latency"], (
+            treated, baseline)
+
+
+@pytest.mark.slow
+class TestOpStreamNemesis:
+    def test_partitioned_follower_dropped_like_dead(self):
+        """A partition on the op-stream edge reads as a dead follower:
+        the leader drops it loudly and keeps serving (PartitionError is
+        an OSError — same path a reset socket takes)."""
+        import socket as sk
+
+        from instaslice_tpu.serving.distributed import (
+            HELLO_MAGIC,
+            DistributedEngine,
+        )
+
+        class _Eng:
+            def add_request(self, *a, **k):
+                return 1
+
+        follower = sk.socket(sk.AF_INET, sk.SOCK_STREAM)
+        follower.bind(("127.0.0.1", 0))
+        follower.listen(1)
+        port = follower.getsockname()[1]
+
+        accepted = {}
+
+        def connect():
+            conn, addr = follower.accept()
+            accepted["conn"] = conn
+
+        t = threading.Thread(target=connect, daemon=True)
+        t.start()
+        client = sk.create_connection(("127.0.0.1", port))
+        t.join(5)
+
+        d = DistributedEngine.__new__(DistributedEngine)
+        object.__setattr__(d, "engine", _Eng())
+        object.__setattr__(
+            d, "_conns", [(accepted["conn"], "peer:1")])
+        plan = NemesisPlan(CHAOS_SEED)
+        set_nemesis(plan)
+        try:
+            d._bcast({"op": "noop"})
+            assert len(d._conns) == 1  # healthy link: kept
+            plan.partition("opstream", "follower:peer:1")
+            d._bcast({"op": "noop"})
+            assert d._conns == []  # partitioned follower dropped
+        finally:
+            set_nemesis(None)
+            client.close()
+            follower.close()

@@ -51,9 +51,13 @@ if REPO not in sys.path:  # run as tools/validate_events.py
 from instaslice_tpu.api.constants import (  # noqa: E402
     EVENT_REASONS,
     REASON_ADMITTED,
+    REASON_APISERVER_UNREACHABLE,
     REASON_CRASH_RECOVERED,
+    REASON_DEGRADED_ENTERED,
+    REASON_DEGRADED_EXITED,
     REASON_DRAIN_BEGIN,
     REASON_DRAIN_END,
+    REASON_WRITE_FENCED,
     TRANSITION_REASONS,
 )
 
@@ -250,6 +254,104 @@ def check_epochs(events: List[dict]) -> List[str]:
             errors.append(
                 f"{ref}: grant chain abandoned in "
                 f"{final_statuses[-1]!r} without a terminal reason"
+            )
+    return errors
+
+
+def check_nemesis(events: List[dict]) -> List[str]:
+    """Partition-chaos invariants (``--nemesis``, docs/RECOVERY.md):
+    replay the journal across partition epochs and prove split-brain
+    safety end to end. Every nemesis scenario ends in a timed heal, so
+    the journal under inspection must describe a CONVERGED run:
+
+    - degraded-mode pairing: every ``DegradedModeEntered`` is preceded
+      by an ``ApiServerUnreachable`` from the same component and
+      followed by a heal-side ``DegradedModeExited`` (the agent's
+      durable-truth reconcile on heal emits it);
+    - fence attribution: every ``WriteFenced`` event names the
+      component whose stale-epoch write was refused;
+    - no grant double-placed: at any journal instant at most ONE
+      allocation per pod (linked through the ``Admitted`` event's
+      trace id) is in the granted state — a second simultaneous grant
+      means a deposed leader's write slipped the epoch fence;
+    - no slice leaks: every allocation chain ends granted (still
+      serving) or ``deleted`` (torn down) — an alloc abandoned
+      mid-flight past heal is a leaked chip reservation.
+    """
+    errors: List[str] = []
+
+    # degraded-mode pairing (per component, in seq order)
+    open_degraded: Dict[str, int] = {}
+    unreachable: Dict[str, int] = {}
+    for rec in events:
+        comp = str(rec.get("component", ""))
+        reason = rec.get("reason")
+        if reason == REASON_APISERVER_UNREACHABLE:
+            unreachable[comp] = unreachable.get(comp, 0) + 1
+        elif reason == REASON_DEGRADED_ENTERED:
+            if comp not in unreachable:
+                errors.append(
+                    f"{comp}: DegradedModeEntered without a preceding "
+                    "ApiServerUnreachable — the trigger is unjournaled"
+                )
+            open_degraded[comp] = open_degraded.get(comp, 0) + 1
+        elif reason == REASON_DEGRADED_EXITED:
+            if not open_degraded.get(comp):
+                errors.append(
+                    f"{comp}: DegradedModeExited without a matching "
+                    "DegradedModeEntered"
+                )
+            else:
+                open_degraded[comp] -= 1
+        elif reason == REASON_WRITE_FENCED and not comp:
+            errors.append(
+                f"seq {rec.get('seq')}: WriteFenced without a "
+                "component — the deposed writer is unattributable"
+            )
+    for comp, n in sorted(open_degraded.items()):
+        if n:
+            errors.append(
+                f"{comp}: {n} DegradedModeEntered never paired with a "
+                "heal-side DegradedModeExited — the scenario must end "
+                "healed and reconciled"
+            )
+
+    # double-place + leak sweep across partition epochs
+    trace_pod: Dict[str, str] = {}
+    for rec in events:
+        if rec.get("reason") == REASON_ADMITTED and rec.get("traceId"):
+            trace_pod[rec["traceId"]] = str(rec.get("objectRef", ""))
+    granted: Dict[str, str] = {}    # owner pod -> alloc ref holding the grant
+    status: Dict[str, str] = {}     # alloc ref -> last status
+    for rec in events:
+        st = TRANSITION_STATUS.get(rec.get("reason", ""))
+        ref = str(rec.get("objectRef", ""))
+        if st is None or not ref.startswith("alloc/"):
+            continue
+        tid = rec.get("traceId", "")
+        # an alloc without an Admitted link degrades to per-trace
+        # (then per-alloc) grouping — still catches same-grant splits
+        pod = trace_pod.get(tid, tid or ref)
+        status[ref] = st
+        if st == "ungated":
+            cur = granted.get(pod)
+            if cur is not None and cur != ref:
+                errors.append(
+                    f"{pod}: double-placed — {cur} and {ref} granted "
+                    "simultaneously (a stale-epoch write slipped the "
+                    "lease fence)"
+                )
+            granted[pod] = ref
+        elif st in ("deleted", "failed", "creating") \
+                and granted.get(pod) == ref:
+            # a grant holder leaving the granted state releases the
+            # slot (creating = a fresh retry epoch for the same id)
+            del granted[pod]
+    for ref in sorted(status):
+        if status[ref] not in ("deleted", "ungated"):
+            errors.append(
+                f"{ref}: slice leak — chain ends {status[ref]!r} "
+                "after heal (neither granted nor torn down)"
             )
     return errors
 
@@ -459,12 +561,21 @@ def main(argv=None) -> int:
                          "markers, require each restart epoch legal "
                          "and no grant chain abandoned without a "
                          "terminal reason (docs/RECOVERY.md)")
+    ap.add_argument("--nemesis", action="store_true",
+                    help="partition-chaos mode (composes with the "
+                         "chain check): degraded-mode entries pair "
+                         "with heal-side exits, WriteFenced events "
+                         "attribute the deposed writer, no grant is "
+                         "double-placed across partition epochs, no "
+                         "slice leaks past heal")
     args = ap.parse_args(argv)
     granted_text = faulted_text = ""
     if args.drive:
         granted_text, faulted_text = drive(args.file)
     report = validate(args.file, strict=not args.lenient,
                       epochs=args.epochs)
+    if args.nemesis:
+        report["errors"].extend(check_nemesis(report["_events"]))
     if args.drive:
         check_drive_expectations(report, granted_text, faulted_text)
     print(json.dumps({
