@@ -40,10 +40,11 @@ lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tole
 	$(PY) tools/slicelint.py
 
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke + bench-router-smoke floors
+test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check + telemetry-smoke observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke + bench-router-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
+	$(MAKE) telemetry-smoke
 	$(MAKE) chaos-crash-smoke
 	$(MAKE) chaos-partition-smoke
 	$(MAKE) bench-smoke
@@ -53,6 +54,14 @@ test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, 
 	$(MAKE) bench-prefix-smoke
 	$(MAKE) bench-spec-smoke
 	$(MAKE) bench-router-smoke
+
+.PHONY: telemetry-smoke
+telemetry-smoke:  ## <60 s fleet-telemetry gate (docs/OBSERVABILITY.md "Fleet telemetry"): 2-replica fleet behind the router + aggregator on a pinned clock, clean AND under one seeded delay-only fault plan — aggregator rollups reconcile EXACTLY with the loadgen client report and the journal counters, burn-rate High fires under the injected-latency arm and Clears on heal, a capacity-blocked request stitches a >=3-component timeline via the caused-by link, zero hung
+	JAX_PLATFORMS=cpu timeout -k 10 300 $(PY) tools/telemetry_smoke.py
+
+.PHONY: bench-trend
+bench-trend:  ## Bench-record trend report + regression gate: reads every BENCH*_rNN.json tier, prints the headline series, exits non-zero when the newest record of a tier regresses >10% vs the best prior record of that tier
+	$(PY) tools/bench_trend.py
 
 .PHONY: chaos-crash-smoke
 chaos-crash-smoke:  ## <60 s crash-consistency gate (docs/RECOVERY.md): one controller kill mid-fan-out + one agent kill mid-realize + one serving-replica kill mid-stream, each under load — every pod granted, zero double-allocations, zero orphaned device slices, zero hung requests, chains legal across restart epochs

@@ -78,6 +78,15 @@ KUBELET_ENV_CHIPS_ANNOTATION = f"{GROUP}/kubelet-env-chips"
 #: straight into the trace tooling (docs/OBSERVABILITY.md).
 TRACE_ID_ANNOTATION = f"{GROUP}/trace-id"
 
+#: Demand→supply causality stamp: a serving-side trace id recorded on a
+#: pod whose admission was requested ON BEHALF of a blocked request (a
+#: router/autoscaler reacting to ``NoCapacity``). The controller copies
+#: it onto the grant's ``controller.allocate`` span and the ``Admitted``
+#: journal event as a ``caused_by`` attribute, letting the telemetry
+#: plane stitch the serving trace and the grant trace that unblocked it
+#: into ONE causal timeline (docs/OBSERVABILITY.md "Fleet telemetry").
+CAUSED_BY_ANNOTATION = f"{GROUP}/caused-by"
+
 # --------------------------------------------------------------- events
 
 #: Flight-recorder ``reason`` catalog (docs/OBSERVABILITY.md). Every
@@ -158,6 +167,13 @@ REASON_GRANT_DEADLINE = "GrantDeadlineExceeded"
 REASON_SESSION_EXPORTED = "SessionExported"
 REASON_SESSION_IMPORTED = "SessionImported"
 
+# fleet telemetry plane (obs/telemetry.py): multi-window SLO burn-rate
+# monitor over federated attainment rollups. High fires when BOTH
+# windows of a pair burn error budget faster than the pair's threshold;
+# Cleared fires on the first evaluation after every pair recovers.
+REASON_SLO_BURN_HIGH = "SLOBurnRateHigh"
+REASON_SLO_BURN_CLEARED = "SLOBurnRateCleared"
+
 # partition tolerance (docs/RECOVERY.md "Partitions & gray failures").
 # ApiServerUnreachable marks a transport-level loss of the apiserver;
 # DegradedModeEntered/Exited bracket an agent's static mode (keep
@@ -200,6 +216,7 @@ EVENT_REASONS = frozenset({
     REASON_DRAIN_BEGIN, REASON_DRAIN_END, REASON_SHED, REASON_DRAINED,
     REASON_PREEMPTED, REASON_RESUMED, REASON_SLO_MISSED,
     REASON_SESSION_EXPORTED, REASON_SESSION_IMPORTED,
+    REASON_SLO_BURN_HIGH, REASON_SLO_BURN_CLEARED,
     REASON_CRASH_RECOVERED, REASON_ORPHAN_REAPED,
     REASON_MIGRATION_ABORTED, REASON_GRANT_DEADLINE,
     REASON_APISERVER_UNREACHABLE, REASON_DEGRADED_ENTERED,

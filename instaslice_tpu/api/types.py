@@ -240,6 +240,13 @@ class AllocationDetails:
             {"attempt_epoch": self.attempt_epoch}
             if self.attempt_epoch else {}
         )
+        try:
+            # chip count rides every transition so the telemetry plane
+            # can integrate chip-seconds (ungated→deleted × chips) from
+            # the journal alone, without re-resolving profiles
+            extra["chips"] = len(self.global_box().coords())
+        except (ValueError, KeyError, IndexError):
+            pass  # malformed box key: the event still records
         ev = get_journal().emit(
             "allocation",
             reason=TRANSITION_REASONS[status.value],

@@ -241,6 +241,36 @@ def _events_cmd(p, args) -> int:
                 print(json.dumps(rec), flush=True)
 
 
+def _fleet_cmd(args) -> int:
+    """``fleet``: one-shot (or ``--follow``) view of a
+    ``tpuslice-telemetry`` aggregator. Rollup mode prints the
+    ``/v1/fleet`` snapshot as one JSON object per poll; ``--trace``
+    mode prints the stitched cross-process timeline for one trace id
+    (``/v1/fleet/trace``)."""
+    import urllib.parse
+    import urllib.request
+
+    base = args.url.rstrip("/")
+    if args.trace:
+        path = "/v1/fleet/trace?" + urllib.parse.urlencode(
+            {"trace_id": args.trace}
+        )
+    else:
+        path = "/v1/fleet"
+    pacer = threading.Event()  # interruptible nap (Ctrl-C ends follow)
+    while True:
+        try:
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                out = json.loads(r.read().decode())
+        except Exception as e:  # noqa: BLE001 - CLI: message, not trace
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 1
+        print(json.dumps(out), flush=True)
+        if not args.follow:
+            return 0
+        pacer.wait(max(0.1, args.interval))
+
+
 def describe_pod(client, name: str, namespace: str = "default",
                  operator_namespace: str = "instaslice-tpu-system",
                  events_path: str = "", trace_path: str = "") -> dict:
@@ -510,6 +540,23 @@ def main(argv=None) -> int:
     ev.add_argument("--follow", action="store_true",
                     help="keep tailing the source (Ctrl-C to stop)")
 
+    fl = sub.add_parser(
+        "fleet",
+        help="fleet telemetry snapshot from a tpuslice-telemetry "
+        "aggregator's GET /v1/fleet (goodput, per-class SLO "
+        "attainment, burn-rate state, chip-hours); --follow polls, "
+        "--trace renders one stitched cross-process timeline",
+    )
+    fl.add_argument("--url", required=True,
+                    help="aggregator base URL (tpuslice-telemetry)")
+    fl.add_argument("--trace", default="",
+                    help="print the stitched timeline for this trace "
+                    "id instead of the rollup snapshot")
+    fl.add_argument("--follow", action="store_true",
+                    help="keep polling (Ctrl-C to stop)")
+    fl.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between --follow polls")
+
     de = sub.add_parser(
         "describe",
         help="one object's merged control-plane timeline: Kubernetes "
@@ -670,6 +717,12 @@ def main(argv=None) -> int:
     if args.cmd == "events":
         try:
             return _events_cmd(p, args)
+        except KeyboardInterrupt:
+            return 0  # --follow's advertised stop path, not a crash
+
+    if args.cmd == "fleet":
+        try:
+            return _fleet_cmd(args)
         except KeyboardInterrupt:
             return 0  # --follow's advertised stop path, not a crash
 

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Tuple
 
 from instaslice_tpu import FINALIZER, GATE_NAME, KIND, LEGACY_GATE_NAME
 from instaslice_tpu.api.constants import (
+    CAUSED_BY_ANNOTATION,
     REASON_ADMITTED,
     REASON_CRASH_RECOVERED,
     REASON_DEGRADED,
@@ -73,7 +74,11 @@ from instaslice_tpu.topology.placement import Box, Occupancy, Placement
 from instaslice_tpu.topology.policy import AllocationPolicy, get_policy
 from instaslice_tpu.topology.profiles import TopologyProfile
 from instaslice_tpu.utils.reconcile import Manager, default_workers
-from instaslice_tpu.utils.trace import get_tracer, new_trace_id
+from instaslice_tpu.utils.trace import (
+    TRACE_ID_SAFE,
+    get_tracer,
+    new_trace_id,
+)
 
 log = logging.getLogger("instaslice_tpu.controller")
 
@@ -736,6 +741,17 @@ class Controller:
         with self._pending_lock:
             pending_tid = self._pending_trace.get(pod_key)
         trace_id = pending_tid or new_trace_id()
+        # demand→supply causality: a pod submitted ON BEHALF of a
+        # capacity-blocked request carries the blocked serving trace id
+        # in its caused-by annotation; the grant's span and Admitted
+        # event record it so the telemetry plane can stitch the two
+        # traces into one timeline. Same sanitizer as X-Trace-Id —
+        # annotation content must not leak into JSONL files unchecked.
+        caused_by = (md.get("annotations") or {}).get(
+            CAUSED_BY_ANNOTATION, ""
+        )
+        if caused_by and not TRACE_ID_SAFE.match(caused_by):
+            caused_by = ""
         if pending_tid is None:
             # first attempt for this pod (capacity-starved requeues
             # re-enter with the pending trace id and stay silent):
@@ -747,6 +763,7 @@ class Controller:
                 message=f"admitted: profile {profile.name}",
                 component="controller", pod_uid=pod_uid,
                 trace_id=trace_id,
+                **({"caused_by": caused_by} if caused_by else {}),
             )
         pod_refs = [
             PodRef(
@@ -769,6 +786,7 @@ class Controller:
         with self.tracer.span(
             "controller.allocate", trace_id=trace_id,
             pod=pod_key, profile=profile.name,
+            **({"caused_by": caused_by} if caused_by else {}),
         ) as sp:
             # Placement critical section: in-memory only (cache +
             # overlay), never held across kube I/O — sharded workers

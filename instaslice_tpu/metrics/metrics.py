@@ -516,6 +516,97 @@ class RouterMetrics:
         )
 
 
+class FleetMetrics:
+    """Metrics for the fleet telemetry aggregator (obs/telemetry.py) —
+    rollups computed FROM every other plane's scraped registries, on
+    the aggregator's own registry (docs/OBSERVABILITY.md "Fleet
+    telemetry")."""
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None):
+        if not _PROM:
+            _warn_no_prom()
+            self.goodput = _NoopMetric()
+            self.requests = _NoopMetric()
+            self.tokens = _NoopMetric()
+            self.attainment = _NoopMetric()
+            self.burn_rate = _NoopMetric()
+            self.burning = _NoopMetric()
+            self.kv_free_fraction = _NoopMetric()
+            self.chip_seconds = _NoopMetric()
+            self.chips_live = _NoopMetric()
+            self.chip_hours_per_mreq = _NoopMetric()
+            self.scrapes = _NoopMetric()
+            self.registry = None
+            return
+        self.registry = registry or CollectorRegistry()
+        self.goodput = Gauge(
+            "tpuslice_fleet_goodput_tokens_per_sec",
+            "Fleet-wide generated tokens/sec over the last scrape "
+            "interval",
+            registry=self.registry,
+        )
+        self.requests = Gauge(
+            "tpuslice_fleet_requests_total",
+            "Fleet-wide served completion requests by outcome "
+            "(summed across replica registries)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.tokens = Gauge(
+            "tpuslice_fleet_tokens_total",
+            "Fleet-wide generated tokens (summed across replica "
+            "registries)",
+            registry=self.registry,
+        )
+        self.attainment = Gauge(
+            "tpuslice_fleet_slo_attainment",
+            "Per-tenant-class TTFT SLO attainment (1 - missed/served)",
+            ["tenant_class"],
+            registry=self.registry,
+        )
+        self.burn_rate = Gauge(
+            "tpuslice_fleet_slo_burn_rate",
+            "Error-budget burn rate per evaluation window",
+            ["tenant_class", "window"],
+            registry=self.registry,
+        )
+        self.burning = Gauge(
+            "tpuslice_fleet_slo_burning",
+            "1 while a burn-rate alert is active for the class",
+            ["tenant_class"],
+            registry=self.registry,
+        )
+        self.kv_free_fraction = Gauge(
+            "tpuslice_fleet_kv_free_fraction",
+            "Fleet KV pressure: free blocks / total blocks across "
+            "replicas",
+            registry=self.registry,
+        )
+        self.chip_seconds = Gauge(
+            "tpuslice_fleet_chip_seconds_total",
+            "Chip-seconds integrated from allocation lifecycle events "
+            "(ungated→deleted × chips; live allocations accrue to now)",
+            registry=self.registry,
+        )
+        self.chips_live = Gauge(
+            "tpuslice_fleet_chips_live",
+            "Chips currently held by ungated allocations",
+            registry=self.registry,
+        )
+        self.chip_hours_per_mreq = Gauge(
+            "tpuslice_fleet_chip_hours_per_million_requests",
+            "Chip-hours per million served-ok requests (the macro-bench "
+            "headline; 0 until the first ok request)",
+            registry=self.registry,
+        )
+        self.scrapes = Counter(
+            "tpuslice_fleet_scrapes_total",
+            "Aggregator scrape cycles by outcome",
+            ["outcome"],
+            registry=self.registry,
+        )
+
+
 _server_started = named_lock("metrics.server_start")
 
 

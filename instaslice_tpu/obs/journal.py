@@ -126,7 +126,8 @@ class Journal:
     def __init__(self, capacity: int = 4096,
                  event_file: Optional[str] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 metrics=None) -> None:
+                 metrics=None, max_mb: Optional[float] = None,
+                 keep: Optional[int] = None) -> None:
         self._lock = named_lock("journal.ring")
         self._events: deque = deque(maxlen=capacity)
         self._counts: Dict[str, int] = {}
@@ -139,6 +140,26 @@ class Journal:
         # between the check and the write
         self._file_lock = named_lock("journal.file")
         path = event_file or os.environ.get("TPUSLICE_EVENT_FILE")
+        self._path = path or None
+        # size-based sink rotation: past max_mb the sink shifts to
+        # <path>.1 … <path>.N and reopens fresh (0 = unbounded, the
+        # pre-rotation behavior; keep bounds the shifted generations)
+        if max_mb is None:
+            try:
+                max_mb = float(
+                    os.environ.get("TPUSLICE_EVENT_FILE_MAX_MB", "0")
+                )
+            except ValueError:
+                max_mb = 0.0
+        if keep is None:
+            try:
+                keep = int(
+                    os.environ.get("TPUSLICE_EVENT_FILE_KEEP", "3")
+                )
+            except ValueError:
+                keep = 3
+        self._max_bytes = int(max_mb * 1024 * 1024) if max_mb > 0 else 0
+        self._keep = max(1, keep)
         if path:
             try:
                 self._file = open(path, "a", buffering=1)
@@ -189,6 +210,9 @@ class Journal:
                 if self._file is not None:
                     try:
                         self._file.write(line)
+                        if (self._max_bytes
+                                and self._file.tell() >= self._max_bytes):
+                            self._rotate_locked()
                     except OSError as e:
                         # disk full / EROFS mid-run: drop the sink, keep
                         # the ring — and keep the control plane alive
@@ -198,6 +222,32 @@ class Journal:
                         )
                         self._file = None
         return ev
+
+    def _rotate_locked(self) -> None:
+        """Shift the sink one generation (``_file_lock`` held): the live
+        file becomes ``<path>.1``, prior generations shift up, anything
+        past ``keep`` is dropped, and a fresh live file opens. A
+        rotation failure degrades to ring-only recording — the exact
+        sink-write-failure contract, because a sink that cannot rotate
+        would otherwise grow without the bound the operator asked for."""
+        try:
+            self._file.close()
+        except OSError:
+            pass
+        self._file = None
+        try:
+            for i in range(self._keep, 0, -1):
+                src = self._path if i == 1 else f"{self._path}.{i - 1}"
+                dst = f"{self._path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, dst)
+            self._file = open(self._path, "a", buffering=1)
+        except OSError as e:
+            log.warning(
+                "event sink rotation failed (%s); disabling the JSONL "
+                "sink", e,
+            )
+            self._file = None
 
     # ------------------------------------------------------------ querying
 
@@ -318,7 +368,7 @@ def _rfc3339(ts: float) -> str:
 def emit_pod_event(client, namespace: str, pod_name: str, *, reason: str,
                    message: str, component: str, pod_uid: str = "",
                    trace_id: str = "", event_type: str = "Normal",
-                   journal: Optional[Journal] = None) -> Event:
+                   journal: Optional[Journal] = None, **attrs) -> Event:
     """Journal a pod-scoped decision AND mirror it as a Kubernetes
     ``Event`` on the pod (fake and real clients both route the ``Event``
     kind), so ``kubectl describe pod`` explains the wait. The mirror is
@@ -335,7 +385,7 @@ def emit_pod_event(client, namespace: str, pod_name: str, *, reason: str,
     ev = j.emit(
         component, reason=reason,
         object_ref=f"Pod/{namespace}/{pod_name}",
-        message=message, trace_id=trace_id,
+        message=message, trace_id=trace_id, **attrs,
     )
     manifest = {
         "apiVersion": "v1",

@@ -71,7 +71,7 @@ from instaslice_tpu.serving.scheduler import (
 )
 from instaslice_tpu.utils.trace import (
     TRACE_ID_SAFE,
-    get_tracer,
+    debug_trace_payload,
     new_trace_id,
 )
 
@@ -170,6 +170,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, {"status": "ok"})
         elif self.path.startswith("/v1/stats"):
             self._send(200, type(self).scheduler.stats())
+        elif self.path.startswith("/metrics"):
+            # the replica's OWN registry in Prometheus exposition text
+            # — the federation scrape target (obs/telemetry.py); ""
+            # when prometheus_client is absent, so scrapers degrade
+            # instead of erroring
+            from instaslice_tpu.metrics.metrics import render
+
+            body = render(type(self).scheduler.metrics).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif self.path.startswith("/v1/debug/trace"):
             self._debug_trace()
         elif self.path.startswith("/v1/debug/events"):
@@ -234,37 +248,18 @@ class _Handler(BaseHTTPRequestHandler):
         every ring span of one trace in start order (the drill-down a
         response's ``X-Trace-Id`` header points at); ``?n=`` bounds the
         recent/slowest lists (default 20)."""
-        tracer = get_tracer()
         qs = urllib.parse.parse_qs(
             urllib.parse.urlsplit(self.path).query
         )
         try:
-            n = int((qs.get("n") or ["20"])[0])
-            if n < 1:
-                raise ValueError
-        except ValueError:
-            self._send(400, {"error": "n must be a positive integer"})
+            payload = debug_trace_payload(qs)
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
             return
-        tid = (qs.get("trace_id") or [""])[0]
-        if tid:
-            spans = tracer.trace(tid)
-            if not spans:
-                self._send(404, {"error": f"no spans for trace {tid!r} "
-                                          "in the ring"})
-                return
-            self._send(200, {
-                "traceId": tid,
-                "spans": [s.to_dict() for s in spans],
-            })
+        except LookupError as e:
+            self._send(404, {"error": str(e)})
             return
-        self._send(200, {
-            "summary": tracer.summary(),
-            "slowest": [
-                s.to_dict()
-                for s in tracer.slowest(n, roots_only=True)
-            ],
-            "recent": [s.to_dict() for s in tracer.spans()[-n:]],
-        })
+        self._send(200, payload)
 
     def _debug_events(self) -> None:
         """``GET /v1/debug/events``: the process flight recorder's live

@@ -534,6 +534,10 @@ def run(url: str, requests: int, concurrency: int, prompt_len: int,
         "p99_latency": round(_percentile(lat, 0.99), 4),
         "mean_latency": round(statistics.mean(lat), 4) if lat else 0.0,
         "client_tokens_per_sec": round(tokens[0] / wall, 1),
+        # the raw token total behind the rate: the number the fleet
+        # telemetry aggregator's tpuslice_serve_tokens_total rollup
+        # must reconcile with EXACTLY (make telemetry-smoke)
+        "client_tokens": tokens[0],
         "stream": stream,
         # every request carried X-Trace-Id "<prefix><i>": paste one
         # into `tpuslice trace-summary --url ... --trace <prefix><i>`

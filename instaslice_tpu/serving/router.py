@@ -59,6 +59,7 @@ import random
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -70,11 +71,11 @@ from instaslice_tpu.api.constants import (
 )
 from instaslice_tpu.faults.netchaos import get_nemesis
 from instaslice_tpu.kube.real import CircuitBreaker, CircuitOpen
-from instaslice_tpu.obs.journal import get_journal
+from instaslice_tpu.obs.journal import debug_events_payload, get_journal
 from instaslice_tpu.serving.kvcache import granule_hash
 from instaslice_tpu.utils.lockcheck import named_lock
-from instaslice_tpu.utils.trace import TRACE_ID_SAFE, get_tracer, \
-    new_trace_id
+from instaslice_tpu.utils.trace import TRACE_ID_SAFE, \
+    debug_trace_payload, get_tracer, new_trace_id
 
 log = logging.getLogger("instaslice_tpu.serving.router")
 
@@ -308,6 +309,38 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._send(503, {"status": "no routable replica"})
         elif self.path.startswith("/v1/stats"):
             self._send(200, r.stats())
+        elif self.path.startswith("/metrics"):
+            # the router's OWN registry in Prometheus exposition text —
+            # the federation scrape target (obs/telemetry.py)
+            from instaslice_tpu.metrics.metrics import render
+
+            body = render(r.metrics).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path.startswith("/v1/debug/trace"):
+            # debug parity with the replicas (serving/api_server.py):
+            # router-side routing/migration spans, live
+            qs = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query
+            )
+            try:
+                self._send(200, debug_trace_payload(qs))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except LookupError as e:
+                self._send(404, {"error": str(e)})
+        elif self.path.startswith("/v1/debug/events"):
+            qs = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query
+            )
+            try:
+                self._send(200, debug_events_payload(qs))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
         elif self.path.rstrip("/").startswith("/v1/models"):
             # passthrough to any alive replica (they are identical)
             try:

@@ -1,8 +1,10 @@
 """healthz/readyz probe endpoints (reference:
 ``AddHealthzCheck``/``AddReadyzCheck``, ``cmd/*/main.go:143-150``),
-plus the operator-plane ``GET /v1/debug/events`` view of the process
-flight recorder (obs/journal.py) — the controller and node agent have
-no serving HTTP plane, so their journal is queryable here."""
+plus the operator-plane ``GET /v1/debug/events`` /
+``GET /v1/debug/trace`` views of the process flight recorder
+(obs/journal.py) and tracer (utils/trace.py) — the controller and node
+agent have no serving HTTP plane, so their journal and spans are
+queryable here (the fleet telemetry aggregator's collection point)."""
 
 from __future__ import annotations
 
@@ -13,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from instaslice_tpu.obs.journal import debug_events_payload
+from instaslice_tpu.utils.trace import debug_trace_payload
 
 
 class ProbeServer:
@@ -39,14 +42,22 @@ class ProbeServer:
                 pass
 
             def do_GET(self):
-                if self.path.startswith("/v1/debug/events"):
+                if self.path.startswith("/v1/debug/"):
                     qs = urllib.parse.parse_qs(
                         urllib.parse.urlsplit(self.path).query
                     )
                     try:
-                        code, payload = 200, debug_events_payload(qs)
+                        if self.path.startswith("/v1/debug/trace"):
+                            code, payload = 200, debug_trace_payload(qs)
+                        elif self.path.startswith("/v1/debug/events"):
+                            code, payload = 200, debug_events_payload(qs)
+                        else:
+                            code = 404
+                            payload = {"error": f"no route {self.path}"}
                     except ValueError as e:
                         code, payload = 400, {"error": str(e)}
+                    except LookupError as e:
+                        code, payload = 404, {"error": str(e)}
                     body = json.dumps(payload).encode()
                     self.send_response(code)
                     self.send_header("Content-Type", "application/json")

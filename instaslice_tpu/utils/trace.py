@@ -282,6 +282,45 @@ def summarize_durations(
     return out
 
 
+def debug_trace_payload(qs: Dict[str, list],
+                        tracer: Optional["Tracer"] = None) -> dict:
+    """Build the ``GET /v1/debug/trace`` response payload from parsed
+    query-string lists — shared by the serving api_server, the router,
+    and the operator probe servers so the debug surface cannot drift
+    between planes. With no ``trace_id`` filter: per-span-name
+    summaries, the slowest root spans, and the most recent spans
+    (``n`` bounds both lists, default 20). With ``?trace_id=X``: every
+    ring span of that trace in start order. Raises :class:`ValueError`
+    on a malformed ``n`` (callers map to HTTP 400) and
+    :class:`LookupError` when the requested trace has no ring spans
+    (callers map to HTTP 404)."""
+    t = tracer if tracer is not None else get_tracer()
+    try:
+        n = int((qs.get("n") or ["20"])[0])
+        if n < 1:
+            raise ValueError
+    except ValueError:
+        raise ValueError("n must be a positive integer") from None
+    tid = (qs.get("trace_id") or [""])[0]
+    if tid:
+        spans = t.trace(tid)
+        if not spans:
+            raise LookupError(
+                f"no spans for trace {tid!r} in the ring"
+            )
+        return {
+            "traceId": tid,
+            "spans": [s.to_dict() for s in spans],
+        }
+    return {
+        "summary": t.summary(),
+        "slowest": [
+            s.to_dict() for s in t.slowest(n, roots_only=True)
+        ],
+        "recent": [s.to_dict() for s in t.spans()[-n:]],
+    }
+
+
 _default: Optional[Tracer] = None
 _default_lock = named_lock("trace.default")
 

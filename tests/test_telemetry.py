@@ -1,0 +1,672 @@
+"""Fleet telemetry plane (instaslice_tpu/obs/telemetry.py): exposition
+parsing, trace stitching + the caused-by demand→supply link, chip-hours
+accounting, the multi-window burn-rate monitor, journal sink rotation,
+the router/probe debug-surface parity, and the bench-trend gate.
+
+The full-wire version (2 jax replicas behind the router, loadgen,
+exact three-way reconciliation) is ``make telemetry-smoke``
+(tools/telemetry_smoke.py); these tests pin the component contracts
+it composes."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from instaslice_tpu.api.constants import (
+    CAUSED_BY_ANNOTATION,
+    REASON_ADMITTED,
+    REASON_SLICE_DELETED,
+    REASON_SLICE_UNGATED,
+    REASON_SLO_BURN_CLEARED,
+    REASON_SLO_BURN_HIGH,
+)
+from instaslice_tpu.obs import journal as journal_mod
+from instaslice_tpu.obs.journal import Journal
+from instaslice_tpu.obs.telemetry import (
+    BurnRateMonitor,
+    ChipHoursAccountant,
+    FleetAggregator,
+    TelemetryServer,
+    TraceStitcher,
+    metric_by_label,
+    metric_sum,
+    parse_exposition,
+    span_component,
+)
+from instaslice_tpu.utils.trace import (
+    Tracer,
+    debug_trace_payload,
+    get_tracer,
+    new_trace_id,
+)
+
+EXPOSITION = """\
+# HELP tpuslice_serve_requests_total served requests
+# TYPE tpuslice_serve_requests_total counter
+tpuslice_serve_requests_total{outcome="ok"} 7.0
+tpuslice_serve_requests_total{outcome="shed"} 2.0
+tpuslice_serve_tokens_total 321.0
+tpuslice_serve_tokens_created 1.7e9
+tpuslice_serve_class_ttft_seconds_count{tenant_class="latency"} 4.0
+tpuslice_serve_class_ttft_seconds_count{tenant_class="standard"} 3.0
+tpuslice_serve_slo_missed_total{slo="ttft",tenant_class="latency"} 1.0
+tpuslice_serve_slo_missed_total{slo="tpot",tenant_class="latency"} 9.0
+garbage line that must be skipped
+tpuslice_weird{label="quo\\"te"} 1.0
+"""
+
+
+class TestExposition:
+    def test_parse_and_sum(self):
+        s = parse_exposition(EXPOSITION)
+        assert metric_sum(s, "tpuslice_serve_requests_total") == 9.0
+        assert metric_sum(s, "tpuslice_serve_requests_total",
+                          outcome="ok") == 7.0
+        assert metric_sum(s, "tpuslice_serve_tokens_total") == 321.0
+        # exact-name lookups: the _created companion series the
+        # prometheus client emits must never pollute a rollup
+        assert metric_sum(s, "tpuslice_serve_tokens") == 0.0
+
+    def test_by_label_with_match(self):
+        s = parse_exposition(EXPOSITION)
+        assert metric_by_label(
+            s, "tpuslice_serve_class_ttft_seconds_count", "tenant_class"
+        ) == {"latency": 4.0, "standard": 3.0}
+        # the slo="ttft" filter is what keeps tpot misses out of the
+        # TTFT attainment rollup
+        assert metric_by_label(
+            s, "tpuslice_serve_slo_missed_total", "tenant_class",
+            slo="ttft",
+        ) == {"latency": 1.0}
+
+    def test_escaped_label_value(self):
+        s = parse_exposition(EXPOSITION)
+        assert ("tpuslice_weird", frozenset({("label", 'quo"te')})) in s
+
+
+class TestSpanComponent:
+    @pytest.mark.parametrize("name,comp", [
+        ("controller.allocate", "controller"),
+        ("repacker.migrate", "controller"),
+        ("device.reserve", "agent"),
+        ("agent.realize", "agent"),
+        ("engine.decode", "serve"),
+        ("serve.request", "serve"),
+        ("router.route", "router"),
+        ("telemetry.scrape", "telemetry"),
+    ])
+    def test_taxonomy(self, name, comp):
+        assert span_component(name) == comp
+
+
+class TestTraceStitcher:
+    def test_dedupe_across_sources(self):
+        st = TraceStitcher()
+        span = {"name": "serve.request", "traceId": "t", "spanId": "a",
+                "start": 1.0}
+        st.add_span(span)
+        assert st.ingest_debug_payload({"recent": [dict(span)]}) == 1
+        assert len(st.spans("t")) == 1
+
+    def test_caused_by_from_span_and_event(self):
+        st = TraceStitcher()
+        st.add_span({"name": "controller.allocate", "traceId": "g1",
+                     "spanId": "s", "start": 2.0,
+                     "attrs": {"caused_by": "serve-tid"}})
+        st.add_event({"reason": REASON_ADMITTED, "traceId": "g2",
+                      "attrs": {"caused_by": "serve-tid"}})
+        assert st.caused_by("g1") == "serve-tid"
+        assert st.links_into("serve-tid") == ["g1", "g2"]
+
+    def test_timeline_merges_linked_grant(self):
+        st = TraceStitcher()
+        st.add_span({"name": "router.route", "traceId": "t",
+                     "spanId": "r", "start": 0.0})
+        st.add_span({"name": "serve.request", "traceId": "t",
+                     "spanId": "s", "start": 1.0})
+        st.add_span({"name": "controller.allocate", "traceId": "g",
+                     "spanId": "c", "start": 2.0,
+                     "attrs": {"caused_by": "t"}})
+        tl = st.timeline("t")
+        assert tl["spanCount"] == 3
+        assert tl["components"] == ["controller", "router", "serve"]
+        assert [x["traceId"] for x in tl["linked"]] == ["g"]
+        # the trace's own spans come back in start order
+        assert [s["spanId"] for s in tl["spans"]] == ["r", "s"]
+
+    def test_orphans_cross_source(self, tmp_path):
+        st = TraceStitcher()
+        child = {"name": "a.b", "traceId": "t", "spanId": "c",
+                 "parentId": "p", "start": 1.0}
+        f1 = tmp_path / "one.jsonl"
+        f1.write_text(json.dumps(child) + "\n")
+        assert st.ingest_file(str(f1)) == 1
+        assert len(st.orphans()) == 1
+        # the parent arriving from ANOTHER file resolves the orphan —
+        # the property tools/validate_trace.py --fleet exists for
+        f2 = tmp_path / "two.jsonl"
+        f2.write_text(json.dumps(
+            {"name": "a.root", "traceId": "t", "spanId": "p",
+             "start": 0.0}
+        ) + "\n")
+        st.ingest_file(str(f2))
+        assert st.orphans() == []
+
+    def test_ingest_file_tolerates_garbage(self, tmp_path):
+        f = tmp_path / "bad.jsonl"
+        f.write_text('not json\n{"name": "x.y", "traceId": "t", '
+                     '"spanId": "s", "start": 1}\n')
+        st = TraceStitcher()
+        assert st.ingest_file(str(f)) == 1
+        assert st.ingest_file(str(tmp_path / "missing.jsonl")) == 0
+
+
+class TestChipHours:
+    def test_open_close_and_live_accrual(self):
+        ch = ChipHoursAccountant(clock=lambda: 100.0)
+        ch.add_event({"reason": REASON_SLICE_UNGATED,
+                      "objectRef": "alloc/a", "ts": 10.0,
+                      "attrs": {"chips": 4}})
+        ch.add_event({"reason": REASON_SLICE_UNGATED,
+                      "objectRef": "alloc/b", "ts": 20.0,
+                      "attrs": {"chips": 8}})
+        assert ch.chips_live() == 12
+        # live allocations accrue to "now"
+        assert ch.chip_seconds(30.0) == pytest.approx(4 * 20 + 8 * 10)
+        ch.add_event({"reason": REASON_SLICE_DELETED,
+                      "objectRef": "alloc/a", "ts": 30.0})
+        assert ch.chips_live() == 8
+        # a's interval is closed at 80 chip-seconds forever
+        assert ch.chip_seconds(40.0) == pytest.approx(80 + 8 * 20)
+
+    def test_ignores_non_alloc_and_chipless(self):
+        ch = ChipHoursAccountant(clock=lambda: 0.0)
+        ch.add_event({"reason": REASON_SLICE_UNGATED,
+                      "objectRef": "pod/x", "ts": 1.0,
+                      "attrs": {"chips": 4}})
+        ch.add_event({"reason": REASON_SLICE_UNGATED,
+                      "objectRef": "alloc/x", "ts": 1.0,
+                      "attrs": {"chips": "junk"}})
+        ch.add_event({"reason": REASON_SLICE_DELETED,
+                      "objectRef": "alloc/never-opened", "ts": 2.0})
+        assert ch.chip_seconds(10.0) == 0.0
+
+
+class TestBurnRateMonitor:
+    def make(self, clk, windows=((10.0, 60.0, 2.0),), target=0.9):
+        j = Journal(clock=lambda: clk[0])
+        mon = BurnRateMonitor(target=target, windows=windows,
+                              clock=lambda: clk[0], journal=j)
+        return mon, j
+
+    def test_fire_needs_both_windows_and_clear(self):
+        clk = [1000.0]
+        mon, j = self.make(clk)
+        mon.observe("latency", 0, 100)
+        clk[0] += 30
+        mon.observe("latency", 30, 200)   # 30% errors -> burn 3 >= 2
+        out = mon.evaluate()
+        assert out["latency"]["burning"]
+        assert out["latency"]["fired"] == ["10s/1m"]
+        assert j.counts()[REASON_SLO_BURN_HIGH] == 1
+        # no new misses: the windows slide clean -> cleared once
+        clk[0] += 30
+        mon.observe("latency", 30, 300)
+        out = mon.evaluate()
+        assert not out["latency"]["burning"]
+        assert j.counts()[REASON_SLO_BURN_CLEARED] == 1
+        # steady state journals nothing more
+        clk[0] += 30
+        mon.observe("latency", 30, 400)
+        mon.evaluate()
+        assert j.counts()[REASON_SLO_BURN_CLEARED] == 1
+
+    def test_single_sample_cannot_fire(self):
+        clk = [0.0]
+        mon, j = self.make(clk)
+        mon.observe("latency", 50, 50)
+        out = mon.evaluate()
+        assert not out["latency"]["burning"]
+        assert REASON_SLO_BURN_HIGH not in j.counts()
+
+    def test_short_window_alone_does_not_fire(self):
+        # a burst that burns the short window but not the long one must
+        # stay quiet — that is the whole point of multiwindow pairs
+        clk = [0.0]
+        mon, _ = self.make(clk, windows=((10.0, 1000.0, 2.0),))
+        mon.observe("latency", 0, 1000)
+        clk[0] += 990
+        mon.observe("latency", 0, 2000)
+        clk[0] += 10
+        mon.observe("latency", 30, 2100)  # short burn 3, long burn ~0.3
+        out = mon.evaluate()
+        assert not out["latency"]["burning"]
+        rates = out["latency"]["rates"]
+        assert rates["10s"] >= 2.0 > rates["1000s"]
+
+    def test_target_validation(self):
+        with pytest.raises(ValueError):
+            BurnRateMonitor(target=1.0)
+
+
+class TestJournalRotation:
+    def emit_n(self, j, n):
+        for i in range(n):
+            j.emit("test", reason=REASON_SLICE_UNGATED,
+                   object_ref=f"alloc/{i}", message="x" * 64)
+
+    def test_rotates_and_keeps_n(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        j = Journal(event_file=path, max_mb=0.0005, keep=2)  # ~512 B
+        self.emit_n(j, 40)
+        j.close()
+        assert os.path.exists(path + ".1")
+        assert os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".3")  # keep=2 bounds it
+        # every surviving file is valid JSONL and the ring kept all 40
+        for p in (path, path + ".1", path + ".2"):
+            with open(p) as f:
+                for line in f:
+                    assert json.loads(line)["reason"] \
+                        == REASON_SLICE_UNGATED
+        assert j.counts()[REASON_SLICE_UNGATED] == 40
+
+    def test_unbounded_by_default(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        j = Journal(event_file=path)
+        self.emit_n(j, 40)
+        j.close()
+        assert not os.path.exists(path + ".1")
+        with open(path) as f:
+            assert len(f.readlines()) == 40
+
+    def test_rotation_failure_degrades_to_ring_only(self, tmp_path,
+                                                    monkeypatch):
+        path = str(tmp_path / "events.jsonl")
+        j = Journal(event_file=path, max_mb=0.0005, keep=2)
+
+        def boom(*a, **k):
+            raise OSError("disk broke")
+
+        monkeypatch.setattr(journal_mod.os, "replace", boom)
+        self.emit_n(j, 40)
+        # the sink is gone but the ring keeps recording — the same
+        # degradation contract as an unwritable TPUSLICE_EVENT_FILE
+        assert j._file is None
+        assert j.counts()[REASON_SLICE_UNGATED] == 40
+        j.close()
+
+    def test_env_knobs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPUSLICE_EVENT_FILE_MAX_MB", "2")
+        monkeypatch.setenv("TPUSLICE_EVENT_FILE_KEEP", "5")
+        j = Journal(event_file=str(tmp_path / "e.jsonl"))
+        assert j._max_bytes == 2 * 1024 * 1024
+        assert j._keep == 5
+        j.close()
+        monkeypatch.setenv("TPUSLICE_EVENT_FILE_MAX_MB", "junk")
+        j = Journal(event_file=str(tmp_path / "e2.jsonl"))
+        assert j._max_bytes == 0
+        j.close()
+
+
+class TestDebugTracePayload:
+    def test_shapes_and_errors(self):
+        t = Tracer(capacity=64)
+        with t.span("serve.request") as sp:
+            pass
+        tid = sp.trace_id
+        out = debug_trace_payload({"trace_id": [tid]}, tracer=t)
+        assert out["traceId"] == tid and out["spans"]
+        out = debug_trace_payload({"n": ["5"]}, tracer=t)
+        assert set(out) == {"summary", "slowest", "recent"}
+        with pytest.raises(ValueError):
+            debug_trace_payload({"n": ["0"]}, tracer=t)
+        with pytest.raises(LookupError):
+            debug_trace_payload({"trace_id": ["absent"]}, tracer=t)
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestRouterDebugParity:
+    def test_router_serves_metrics_trace_events(self):
+        from instaslice_tpu.serving.router import Router
+
+        router = Router(replicas=(), poll_interval=0.1)
+        router.start()
+        try:
+            with get_tracer().span("router.route"):
+                pass
+            _, trace = _get(router.url + "/v1/debug/trace?n=50")
+            assert {"summary", "slowest", "recent"} <= set(trace)
+            _, events = _get(router.url + "/v1/debug/events?n=10")
+            assert "events" in events
+            with urllib.request.urlopen(router.url + "/metrics",
+                                        timeout=5) as r:
+                body = r.read().decode()
+                assert r.headers["Content-Type"].startswith(
+                    "text/plain"
+                )
+            assert parse_exposition(body) is not None
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(router.url + "/v1/debug/trace?n=0")
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(router.url + "/v1/debug/trace?trace_id=absent")
+            assert ei.value.code == 404
+        finally:
+            router.stop()
+
+    def test_probe_server_serves_debug_surface(self):
+        from instaslice_tpu.utils.probes import ProbeServer
+
+        p = ProbeServer("127.0.0.1:0").start()
+        try:
+            port = p._srv.server_address[1]
+            base = f"http://127.0.0.1:{port}"
+            with get_tracer().span("controller.allocate"):
+                pass
+            _, trace = _get(base + "/v1/debug/trace?n=50")
+            assert {"summary", "slowest", "recent"} <= set(trace)
+            _, events = _get(base + "/v1/debug/events?n=10")
+            assert "events" in events
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(base + "/v1/debug/trace?trace_id=absent")
+            assert ei.value.code == 404
+        finally:
+            p.stop()
+
+
+class TestAggregatorOffline:
+    """The aggregator over files only — no HTTP, pinned clock."""
+
+    def make_agg(self, tmp_path, clk, spans=(), events=()):
+        tf = tmp_path / "trace.jsonl"
+        tf.write_text("".join(json.dumps(s) + "\n" for s in spans))
+        ef = tmp_path / "events.jsonl"
+        ef.write_text("".join(json.dumps(e) + "\n" for e in events))
+        return FleetAggregator(
+            trace_files=(str(tf),), event_files=(str(ef),),
+            clock=lambda: clk[0], journal=Journal(),
+        )
+
+    def test_poll_rolls_up_files(self, tmp_path):
+        clk = [100.0]
+        agg = self.make_agg(
+            tmp_path, clk,
+            spans=[{"name": "serve.request", "traceId": "t",
+                    "spanId": "s", "start": 1.0}],
+            events=[
+                {"seq": 1, "ts": 10.0, "component": "agent",
+                 "reason": REASON_SLICE_UNGATED,
+                 "objectRef": "alloc/a", "attrs": {"chips": 4}},
+                {"seq": 2, "ts": 60.0, "component": "agent",
+                 "reason": REASON_SLICE_DELETED,
+                 "objectRef": "alloc/a"},
+            ],
+        )
+        fleet = agg.poll()
+        assert fleet["traces"] == 1
+        assert fleet["chip_hours"]["chip_seconds"] \
+            == pytest.approx(200.0)
+        assert fleet["chip_hours"]["chips_live"] == 0
+        # event dedup: a second poll re-reads the same file without
+        # double-counting the interval
+        clk[0] += 10
+        fleet = agg.poll()
+        assert fleet["polls"] == 2
+        assert fleet["chip_hours"]["chip_seconds"] \
+            == pytest.approx(200.0)
+
+    def test_http_plane(self, tmp_path):
+        clk = [100.0]
+        agg = self.make_agg(tmp_path, clk, spans=[
+            {"name": "serve.request", "traceId": "t", "spanId": "s",
+             "start": 1.0},
+        ])
+        tel = TelemetryServer(agg).start()
+        try:
+            # not ready until the first poll lands
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(tel.url + "/readyz")
+            assert ei.value.code == 503
+            agg.poll()
+            assert _get(tel.url + "/readyz")[0] == 200
+            _, fleet = _get(tel.url + "/v1/fleet")
+            assert fleet["polls"] == 1
+            _, tl = _get(tel.url + "/v1/fleet/trace?trace_id=t")
+            assert tl["spanCount"] == 1
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(tel.url + "/v1/fleet/trace")
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(tel.url + "/v1/fleet/trace?trace_id=zzz")
+            assert ei.value.code == 404
+            with urllib.request.urlopen(tel.url + "/metrics",
+                                        timeout=5) as r:
+                s = parse_exposition(r.read().decode())
+            assert any(n == "tpuslice_fleet_tokens_total"
+                       for n, _ in s) or s == {}  # noop-metrics env
+        finally:
+            tel.stop()
+
+    def test_dead_endpoints_are_counted_not_raised(self, tmp_path):
+        clk = [0.0]
+        agg = FleetAggregator(
+            router_url="http://127.0.0.1:1",
+            replica_urls=("http://127.0.0.1:1",),
+            clock=lambda: clk[0], journal=Journal(),
+            http_timeout=0.2,
+        )
+        fleet = agg.poll()
+        assert fleet["scrapes"]["error"] > 0
+        assert fleet["replicas"]["http://127.0.0.1:1"]["ok"] is False
+
+
+class TestStitchedGrantE2E:
+    """router→serve demand trace + a capacity-blocked pod's grant
+    trace, linked through the caused-by annotation at admission: one
+    timeline, >= 3 components. (The full-wire version with real jax
+    replicas is ``make telemetry-smoke``.)"""
+
+    def test_capacity_blocked_grant_stitches(self):
+        from instaslice_tpu.sim import SimCluster
+
+        tid = new_trace_id()
+        tracer = get_tracer()
+        # the demand side: a routed serving request under ONE trace id
+        with tracer.span("router.route", trace_id=tid):
+            with tracer.span("serve.request"):
+                pass
+
+        with SimCluster(n_nodes=1, deletion_grace_seconds=0.2) as c:
+            # a v5e node is 2x4 = 8 chips: two 2x2 fillers exhaust it
+            c.submit("filler-a", "v5e-2x2")
+            c.submit("filler-b", "v5e-2x2")
+            assert c.wait_phase("filler-a", "Running", timeout=30)
+            assert c.wait_phase("filler-b", "Running", timeout=30)
+            c.submit("blocked", "v5e-1x1",
+                     annotations={CAUSED_BY_ANNOTATION: tid})
+            assert not c.wait_phase("blocked", "Running", timeout=1.0), \
+                "pod ran with the node full — not capacity-blocked"
+            c.delete_pod("filler-a")
+            assert c.wait_gone("filler-a", timeout=30)
+            assert c.wait_phase("blocked", "Running", timeout=30)
+
+        st = TraceStitcher()
+        st.ingest_debug_payload(
+            debug_trace_payload({"n": ["2048"]}, tracer=tracer)
+        )
+        from instaslice_tpu.obs.journal import debug_events_payload
+
+        for ev in debug_events_payload({"n": ["2000"]})["events"]:
+            st.add_event(ev)
+
+        grants = st.links_into(tid)
+        assert grants, "no grant trace linked via caused-by"
+        tl = st.timeline(tid)
+        assert len(tl["components"]) >= 3, tl["components"]
+        assert {"router", "serve", "controller"} <= set(
+            tl["components"]
+        )
+        # the grant trace's allocate span carries the stamp itself
+        grant_spans = st.spans(grants[0])
+        alloc = [s for s in grant_spans
+                 if s["name"] == "controller.allocate"]
+        assert alloc and alloc[0]["attrs"]["caused_by"] == tid
+
+    def test_malformed_caused_by_is_dropped(self):
+        from instaslice_tpu.sim import SimCluster
+
+        bad = "zz;DROP TABLE|" + "x" * 80
+        with SimCluster(n_nodes=1, deletion_grace_seconds=0.2) as c:
+            c.submit("sneaky", "v5e-1x1",
+                     annotations={CAUSED_BY_ANNOTATION: bad})
+            assert c.wait_phase("sneaky", "Running", timeout=30)
+
+        st = TraceStitcher()
+        st.ingest_debug_payload(
+            debug_trace_payload({"n": ["2048"]}, tracer=get_tracer())
+        )
+        assert st.links_into(bad) == []
+
+
+class TestBenchTrend:
+    def _load(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_trend",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            )), "tools", "bench_trend.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_headline_shapes(self):
+        bt = self._load()
+        assert bt.headline({"metric": "m", "value": 2, "unit": "u"}) \
+            == ("m", 2.0, "u")
+        assert bt.headline(
+            {"parsed": {"metric": "m", "value": 3, "unit": "u"}}
+        ) == ("m", 3.0, "u")
+        assert bt.headline(
+            {"tail": 'noise\n{"metric": "m", "value": 4, '
+                     '"unit": "u"}\n'}
+        ) == ("m", 4.0, "u")
+        assert bt.headline(
+            {"metric": "grants", "scale": {"grants_per_sec": 5}}
+        ) == ("grants", 5.0, "grants/sec")
+        assert bt.headline({"tail": "garbage only"}) is None
+
+    def _write(self, root, name, value, unit="toks/s"):
+        with open(os.path.join(root, name), "w") as f:
+            json.dump({"metric": "m", "value": value, "unit": unit}, f)
+
+    def test_regression_gate_direction(self, tmp_path):
+        bt = self._load()
+        root = str(tmp_path)
+        self._write(root, "BENCH_SERVING_r01.json", 100)
+        self._write(root, "BENCH_SERVING_r02.json", 80)  # -20%: regress
+        self._write(root, "BENCH_LAT_r01.json", 1.0, unit="seconds")
+        self._write(root, "BENCH_LAT_r02.json", 0.5, unit="seconds")
+        tiers = bt.load_records(root)
+        regs = bt.check_regressions(tiers, 0.10)
+        assert [r["tier"] for r in regs] == ["SERVING"]
+        # lower-is-better: 0.5s after 1.0s is a WIN, not a regression;
+        # and within threshold passes
+        self._write(root, "BENCH_SERVING_r03.json", 95)
+        assert bt.check_regressions(bt.load_records(root), 0.10) == []
+        assert bt.main(["--dir", root]) == 0
+        self._write(root, "BENCH_LAT_r03.json", 2.0, unit="seconds")
+        assert bt.main(["--dir", root, "--json"]) == 2
+
+    def test_unparsable_records_skipped_never_fatal(self, tmp_path):
+        bt = self._load()
+        root = str(tmp_path)
+        self._write(root, "BENCH_r01.json", 100)
+        (tmp_path / "BENCH_r02.json").write_text("{truncated")
+        tiers = bt.load_records(root)
+        assert tiers["GRANT"][1]["value"] is None
+        assert bt.check_regressions(tiers, 0.10) == []
+        assert bt.main(["--dir", root]) == 0
+
+    def test_repo_history_parses(self):
+        # the real record set must keep parsing — history stays
+        # readable even where it is ragged
+        bt = self._load()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__
+        )))
+        tiers = bt.load_records(repo)
+        assert tiers, "no BENCH records found in the repo root"
+        parseable = [e for es in tiers.values() for e in es
+                     if e["value"] is not None]
+        assert len(parseable) >= 10
+
+
+class TestValidateTraceFleet:
+    def _run(self, args):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "validate_trace",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            )), "tools", "validate_trace.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main(args)
+
+    def _span(self, name, tid, sid, parent=""):
+        s = {"name": name, "traceId": tid, "spanId": sid,
+             "start": 1.0, "durationMs": 1.0}
+        if parent:
+            s["parentId"] = parent
+        return s
+
+    def test_cross_file_parent_passes_only_with_fleet(self, tmp_path,
+                                                      capsys):
+        f1 = tmp_path / "serve.jsonl"
+        f1.write_text(json.dumps(
+            self._span("serve.request", "t", "child", parent="root")
+        ) + "\n")
+        f2 = tmp_path / "router.jsonl"
+        f2.write_text(json.dumps(
+            self._span("router.route", "t", "root")
+        ) + "\n")
+        # single-file view: a genuine orphan
+        assert self._run([str(f1)]) == 1
+        capsys.readouterr()
+        # fleet view: the parent lives in the router's file
+        assert self._run([str(f1), str(f2), "--fleet"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["fleet"]["orphans"] == 0
+        assert out["fleet"]["files"] == 2
+
+    def test_fleet_still_fails_on_true_orphan(self, tmp_path, capsys):
+        f1 = tmp_path / "a.jsonl"
+        f1.write_text(json.dumps(
+            self._span("serve.request", "t", "child", parent="gone")
+        ) + "\n")
+        f2 = tmp_path / "b.jsonl"
+        f2.write_text(json.dumps(
+            self._span("router.route", "t2", "root")
+        ) + "\n")
+        assert self._run([str(f1), str(f2), "--fleet"]) == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["fleet"]["orphans"] == 1
+
+    def test_multiple_files_require_fleet(self, tmp_path):
+        f1 = tmp_path / "a.jsonl"
+        f1.write_text("")
+        with pytest.raises(SystemExit):
+            self._run([str(f1), str(f1)])

@@ -1,0 +1,207 @@
+"""Bench-record trend report + regression gate (``make bench-trend``).
+
+The repo records one ``BENCH*_rNN.json`` per perf-bearing PR — a
+trajectory, not a point. This tool reads the whole set, groups records
+into **tiers** by filename (``BENCH_rNN`` → the grant tier,
+``BENCH_SERVING_rNN`` → serving, ``BENCH_SCALE_rNN`` → scale, ...),
+prints the headline-metric series (``serve_toks_per_sec``,
+``serve_ttft_p95``, grants/sec) in record order, and exits non-zero
+when the NEWEST record of any tier regresses more than the threshold
+(default 10%) against the best prior record of the same tier — the
+"did this PR quietly lose what an earlier PR earned" gate the
+fleet-telemetry plane's chip-hours headline will feed.
+
+Direction is inferred from the unit: ``seconds`` is lower-is-better
+(grant latency), everything else (tokens/s, grants/sec, fraction) is
+higher-is-better. Records that cannot be parsed into a headline value
+(truncated early-PR tails) are reported and skipped, never fatal —
+history must stay readable even where it is ragged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+RECORD_RE = re.compile(r"^BENCH(?:_([A-Z]+))?_r(\d+)\.json$")
+
+#: per-record keys echoed into the series report when present
+SERIES_KEYS = ("serve_toks_per_sec", "serve_ttft_p95")
+
+
+def headline(record: dict) -> Optional[Tuple[str, float, str]]:
+    """Extract ``(metric, value, unit)`` from one record, tolerating
+    every historical shape: the modern ``{"metric", "value", "unit"}``
+    form, the scale tier's nested ``scale.grants_per_sec``, and the
+    early driver-captured ``{"tail": "...jsonl..."}`` form."""
+    d = record
+    if "metric" not in d:
+        parsed = d.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            d = parsed
+        else:
+            # early records captured raw stdout; the headline is the
+            # last parseable JSON object line carrying "metric"
+            for line in reversed(
+                (d.get("tail") or "").strip().splitlines()
+            ):
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                try:
+                    cand = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(cand, dict) and "metric" in cand:
+                    d = cand
+                    break
+            else:
+                return None
+    metric = str(d.get("metric", ""))
+    unit = str(d.get("unit", ""))
+    value = d.get("value")
+    if value is None and isinstance(d.get("scale"), dict):
+        value = d["scale"].get("grants_per_sec")
+        unit = unit or "grants/sec"
+    if value is None:
+        return None
+    try:
+        return metric, float(value), unit
+    except (TypeError, ValueError):
+        return None
+
+
+def lower_is_better(unit: str) -> bool:
+    return unit == "seconds"
+
+
+def load_records(root: str) -> Dict[str, List[dict]]:
+    """``{tier: [entry, ...]}`` in record-number order. Each entry:
+    ``{file, n, metric, value, unit, series}`` (value/metric/unit may
+    be None when unparsable)."""
+    tiers: Dict[str, List[dict]] = {}
+    for name in sorted(os.listdir(root)):
+        m = RECORD_RE.match(name)
+        if not m:
+            continue
+        tier = m.group(1) or "GRANT"
+        try:
+            with open(os.path.join(root, name)) as f:
+                record = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            record = {}
+        head = headline(record) if isinstance(record, dict) else None
+        entry = {
+            "file": name,
+            "n": int(m.group(2)),
+            "metric": head[0] if head else None,
+            "value": head[1] if head else None,
+            "unit": head[2] if head else None,
+            "series": {
+                k: record.get(k) for k in SERIES_KEYS
+                if isinstance(record, dict) and record.get(k)
+                is not None
+            },
+        }
+        tiers.setdefault(tier, []).append(entry)
+    for entries in tiers.values():
+        entries.sort(key=lambda e: e["n"])
+    return tiers
+
+
+def check_regressions(tiers: Dict[str, List[dict]],
+                      threshold: float) -> List[dict]:
+    """The gate: for each tier, compare the NEWEST parseable record
+    against the best prior parseable record. A >``threshold``
+    fractional move in the losing direction is a regression."""
+    out = []
+    for tier, entries in sorted(tiers.items()):
+        parseable = [e for e in entries if e["value"] is not None]
+        if len(parseable) < 2:
+            continue
+        newest = parseable[-1]
+        prior = parseable[:-1]
+        lower = lower_is_better(newest["unit"] or "")
+        best = (min if lower else max)(
+            e["value"] for e in prior
+        )
+        if best == 0:
+            continue
+        change = (newest["value"] - best) / abs(best)
+        regressed = change > threshold if lower \
+            else change < -threshold
+        if regressed:
+            out.append({
+                "tier": tier,
+                "file": newest["file"],
+                "metric": newest["metric"],
+                "value": newest["value"],
+                "best_prior": best,
+                "change_pct": round(change * 100, 2),
+            })
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_trend")
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ),
+        help="directory holding the BENCH_*.json records "
+             "(default: the repo root)",
+    )
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression gate as a fraction (default "
+                         "0.10 = 10%%)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report")
+    args = ap.parse_args(argv)
+
+    tiers = load_records(args.dir)
+    if not tiers:
+        print(f"no BENCH_*_rNN.json records under {args.dir}",
+              file=sys.stderr)
+        return 1
+    regressions = check_regressions(tiers, args.threshold)
+
+    if args.as_json:
+        print(json.dumps({
+            "tiers": tiers,
+            "regressions": regressions,
+            "threshold": args.threshold,
+        }))
+        return 2 if regressions else 0
+
+    for tier, entries in sorted(tiers.items()):
+        print(f"tier {tier}:")
+        for e in entries:
+            if e["value"] is None:
+                print(f"  r{e['n']:02d} {e['file']:<24} "
+                      f"(no parseable headline; skipped)")
+                continue
+            extra = "".join(
+                f" {k}={v}" for k, v in sorted(e["series"].items())
+            )
+            print(f"  r{e['n']:02d} {e['file']:<24} "
+                  f"{e['metric']}={e['value']:g} {e['unit']}{extra}")
+    if regressions:
+        print(f"\nREGRESSION (> {args.threshold:.0%} vs best prior "
+              "record of the tier):")
+        for r in regressions:
+            print(f"  {r['tier']}: {r['file']} {r['metric']}="
+                  f"{r['value']:g} vs best prior {r['best_prior']:g} "
+                  f"({r['change_pct']:+.1f}%)")
+        return 2
+    print(f"\nno tier regressed > {args.threshold:.0%} "
+          "against its best prior record")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

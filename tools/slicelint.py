@@ -142,7 +142,12 @@ RAW_HTTP_ALLOW = (
     "instaslice_tpu/device/cloudtpu.py",
     "instaslice_tpu/device/cloudtpu_mock.py",
     "instaslice_tpu/cli/tpuslicectl.py",
+    # the fleet-telemetry aggregator IS a scrape transport: per-target
+    # timeout + error accounting live in obs/telemetry.py itself, and
+    # a scrape failure is counted, never retried (next poll re-reads)
+    "instaslice_tpu/obs/telemetry.py",
     "tools/serve_capacity.py",
+    "tools/telemetry_smoke.py",
 )
 
 RAW_LOCK_ALLOW = ("instaslice_tpu/utils/lockcheck.py",)

@@ -182,28 +182,87 @@ def drive(path: str) -> None:
         reset_tracer()  # close the file handle (and detach the env)
 
 
+def check_fleet(paths: List[str]) -> dict:
+    """``--fleet``: merge every collected file into ONE
+    :class:`~instaslice_tpu.obs.telemetry.TraceStitcher` store and
+    check for orphan parents ACROSS files. Per-file validation can
+    pass while the fleet view is broken — a child span's parent may
+    live in another process's file; only the merged view proves the
+    collection set is complete."""
+    from instaslice_tpu.obs.telemetry import TraceStitcher
+
+    stitcher = TraceStitcher()
+    total = 0
+    for path in paths:
+        total += stitcher.ingest_file(path)
+    orphans = stitcher.orphans()
+    return {
+        "files": len(paths),
+        "spans_ingested": total,
+        "traces": len(stitcher.trace_ids()),
+        "orphans": len(orphans),
+        "orphan_examples": [
+            {"name": s.get("name"), "traceId": s.get("traceId"),
+             "parentId": s.get("parentId")}
+            for s in orphans[:10]
+        ],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="validate_trace")
-    ap.add_argument("file", help="trace JSONL path")
+    ap.add_argument("file", nargs="+",
+                    help="trace JSONL path(s); several only with "
+                         "--fleet")
     ap.add_argument("--drive", action="store_true",
                     help="first generate the file by running the sim "
                          "+ a short serving loadgen with "
                          "TPUSLICE_TRACE_FILE set, then also check "
                          "the propagation contract")
+    ap.add_argument("--fleet", action="store_true",
+                    help="merge every given file into one stitched "
+                         "store and fail on orphan parents ACROSS "
+                         "files (the fleet-collection completeness "
+                         "check)")
     args = ap.parse_args(argv)
+    if len(args.file) > 1 and not args.fleet:
+        ap.error("multiple files need --fleet")
     if args.drive:
-        drive(args.file)
-    report = validate(args.file)
+        drive(args.file[0])
+    report = validate(args.file[0])
+    for extra in args.file[1:]:
+        sub = validate(extra)
+        report["spans"] += sub["spans"]
+        report["traces"] += sub["traces"]
+        report["errors"] += sub["errors"]
+    if args.fleet:
+        # per-file orphan findings are FALSE failures in fleet mode: a
+        # child's parent legitimately lives in another process's file;
+        # the merged store below is the authoritative orphan check
+        report["errors"] = [
+            e for e in report["errors"]
+            if not e.startswith("orphan span ")
+        ]
     if args.drive:
         check_propagation(report)
-    print(json.dumps({
-        "file": report["file"],
+    out = {
+        "file": report["file"] if len(args.file) == 1 else args.file,
         "spans": report["spans"],
         "traces": report["traces"],
         "span_names": len(report["names"]),
         "errors": report["errors"][:20],
-        "ok": not report["errors"],
-    }))
+    }
+    if args.fleet:
+        fleet = check_fleet(args.file)
+        out["fleet"] = fleet
+        if fleet["orphans"]:
+            report["errors"].append(
+                f"{fleet['orphans']} orphan parent(s) across the "
+                f"merged fleet store"
+            )
+            out["errors"] = report["errors"][:20]
+    out["ok"] = not report["errors"]
+    print(json.dumps(out))
     return 0 if not report["errors"] else 1
 
 
