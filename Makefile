@@ -39,8 +39,12 @@ native-test: native
 lint:  ## Project-invariant static analysis (docs/STATIC_ANALYSIS.md): zero tolerance — any finding fails the build
 	$(PY) tools/slicelint.py
 
+.PHONY: check
+check: lint  ## Both static gates: slicelint (per-file idiom) + slicecheck (whole-program guarded-by + dispatch hygiene, docs/STATIC_ANALYSIS.md) — zero tolerance
+	$(PY) tools/slicecheck.py
+
 .PHONY: test
-test: lint  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check + telemetry-smoke observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke + bench-router-smoke floors
+test: check  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check + telemetry-smoke observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke + bench-router-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check

@@ -427,6 +427,54 @@ def describe_pod(client, name: str, namespace: str = "default",
     return info
 
 
+def render_locks(payload: dict) -> str:
+    """Human rendering of a ``/v1/debug/locks`` body
+    (utils/lockcheck.py): live per-thread held locks first — the
+    hung-process question — then accumulated order cycles, long holds,
+    and the hottest locks by total hold time."""
+    lines = [f"lockcheck armed: {'yes' if payload.get('armed') else 'no'}"]
+    if not payload.get("armed"):
+        lines.append(
+            "  (set TPUSLICE_LOCKCHECK=1 on the component to record "
+            "held locks, ordering edges and hold times)"
+        )
+    live = payload.get("live", [])
+    lines.append(f"Held now ({len(live)} thread(s)):")
+    for t in live:
+        held = " -> ".join(
+            f"{h['name']}({h['heldSeconds']:.3f}s"
+            + (f",depth={h['depth']}" if h.get("depth", 1) > 1 else "")
+            + ")"
+            for h in t["held"]
+        )
+        lines.append(f"  {t['thread']}: {held}")
+    cycles = payload.get("cycles", [])
+    if cycles:
+        lines.append(f"Lock-order cycles ({len(cycles)}) — ABBA "
+                     "deadlocks waiting for the right interleaving:")
+        for c in cycles:
+            lines.append(f"  {' -> '.join(c['chain'])}  "
+                         f"threads={','.join(c.get('threads', []))}")
+    long_holds = payload.get("longHolds", [])
+    if long_holds:
+        lines.append(f"Long holds ({len(long_holds)}):")
+        for h in long_holds[-20:]:
+            lines.append(f"  {h['name']}  {h['seconds']}s  "
+                         f"thread={h['thread']}")
+    holds = payload.get("holds", {})
+    if holds:
+        top = sorted(holds.items(), key=lambda kv: -kv[1]["totalSeconds"])
+        lines.append("Hottest locks (by total hold time):")
+        for name, st in top[:10]:
+            lines.append(
+                f"  {name}  count={st['count']}  "
+                f"total={st['totalSeconds']}s  max={st['maxSeconds']}s"
+            )
+    edges = payload.get("edges", [])
+    lines.append(f"Ordering edges recorded: {len(edges)}")
+    return "\n".join(lines)
+
+
 def render_describe(info: dict) -> str:
     """Human rendering of :func:`describe_pod` — the "why is my pod
     still gated?" answer (README walkthrough)."""
@@ -563,8 +611,12 @@ def main(argv=None) -> int:
         "Events + CR audit trail + journal + trace spans — the 'why is "
         "my pod still gated?' answer",
     )
-    de.add_argument("kind", choices=["pod"])
-    de.add_argument("name")
+    de.add_argument("kind", choices=["pod", "locks"])
+    de.add_argument("name", nargs="?", default="")
+    de.add_argument("--url", default="",
+                    help="component base URL for `describe locks` — "
+                    "any /v1/debug surface (replica, router, or a "
+                    "controller/agent probe port)")
     de.add_argument("--namespace", default="default")
     de.add_argument("--operator-namespace",
                     default="instaslice-tpu-system",
@@ -726,9 +778,34 @@ def main(argv=None) -> int:
         except KeyboardInterrupt:
             return 0  # --follow's advertised stop path, not a crash
 
+    if args.cmd == "describe" and args.kind == "locks":
+        import urllib.request
+
+        if not args.url:
+            print(json.dumps(
+                {"error": "describe locks needs --url <component>"}
+            ))
+            return 2
+        try:
+            with urllib.request.urlopen(
+                args.url.rstrip("/") + "/v1/debug/locks", timeout=10
+            ) as r:
+                payload = json.loads(r.read().decode())
+        except Exception as e:  # noqa: BLE001 - CLI: message, not trace
+            print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+            return 1
+        if args.as_json:
+            print(json.dumps(payload))
+        else:
+            print(render_locks(payload))
+        return 0
+
     if args.cmd == "describe":
         from instaslice_tpu.kube.real import build_client
 
+        if not args.name:
+            print(json.dumps({"error": "describe pod needs a name"}))
+            return 2
         client = build_client(args.kubeconfig)
         info = describe_pod(
             client, args.name, namespace=args.namespace,

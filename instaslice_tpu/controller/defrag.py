@@ -77,6 +77,7 @@ from instaslice_tpu.topology.placement import (
 )
 from instaslice_tpu.topology.profiles import parse_profile_name
 from instaslice_tpu.utils.trace import get_tracer, new_trace_id
+from instaslice_tpu.utils.guards import requires, unguarded
 
 log = logging.getLogger("instaslice_tpu.controller.defrag")
 
@@ -126,6 +127,19 @@ class Repacker:
     """Defragmentation reconcile loop riding a :class:`Controller`'s
     informer caches, placement lock, and write machinery. Start after
     the controller; stop before it."""
+
+    # single repack thread owns all mutable state; external readers
+    # (status surfaces, tests after stop()) take GIL-atomic snapshots
+    # of counters and never mutate
+    _active: unguarded("repack-loop thread owned; shared reservations "
+                       "live in Controller._inflight under "
+                       "controller.placement, not here")
+    _cooldown_until: unguarded("repack-loop thread owned")
+    plans: unguarded("repack-loop owned counter; racy external reads")
+    proactive_plans: unguarded("repack-loop owned counter")
+    migrations_done: unguarded("repack-loop owned counter")
+    migrations_failed: unguarded("repack-loop owned counter")
+    migrations_aborted: unguarded("repack-loop owned counter")
 
     def __init__(
         self,
@@ -509,6 +523,7 @@ class Repacker:
                 return target, moves
         return None
 
+    @requires("controller.placement")
     def _movable_allocs(
         self, group, members, profile
     ) -> Dict[str, AllocationDetails]:

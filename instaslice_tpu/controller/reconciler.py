@@ -128,9 +128,18 @@ def slice_indexers():
 
 from instaslice_tpu.utils.timeutil import parse_timestamp as _parse_timestamp
 from instaslice_tpu.utils.lockcheck import named_lock
+from instaslice_tpu.utils.guards import guarded_by, requires
 
 
 class Controller:
+    # shared across the sharded reconcile workers, the repacker loop,
+    # and external callers (status endpoints, tests)
+    _pending: guarded_by("controller.pending")
+    _pending_profiles: guarded_by("controller.pending")
+    _pending_trace: guarded_by("controller.pending")
+    _failed_nodes: guarded_by("controller.failed_nodes")
+    _inflight: guarded_by("controller.placement")
+
     def __init__(
         self,
         client: KubeClient,
@@ -389,6 +398,7 @@ class Controller:
             out[gid] = (group, members)
         return out
 
+    @requires("controller.placement")
     def _occupancy(self, group: TorusGroup, members: List[TpuSlice]) -> Occupancy:
         """Union of desired (allocations) and realized (prepared) boxes,
         deduped across the member CRs an allocation is fanned out to
@@ -1120,6 +1130,7 @@ class Controller:
                 return placement
         return None
 
+    @requires("controller.placement")
     def _place_indexed(
         self, profile: TopologyProfile, avoid: frozenset
     ) -> Optional[Placement]:

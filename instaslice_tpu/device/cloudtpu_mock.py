@@ -24,6 +24,7 @@ from urllib.parse import parse_qs, urlsplit
 
 from instaslice_tpu.device.cloudtpu import CHIPS_LABEL
 from instaslice_tpu.utils.lockcheck import named_lock
+from instaslice_tpu.utils.guards import guarded_by
 
 _PATH = re.compile(
     r"^/projects/(?P<proj>[^/]+)/locations/(?P<zone>[^/]+)"
@@ -195,6 +196,10 @@ class _Handler(BaseHTTPRequestHandler):
 
 class CloudTpuMockServer:
     """The queued-resources API behind a real HTTP listener."""
+
+    # shared between the test thread arming failures and the HTTP
+    # handler threads consuming them
+    fail_next_creates: guarded_by("device.cloudtpu_mock")
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  provision_polls: int = 1,

@@ -56,6 +56,7 @@ from instaslice_tpu.deviceplugin.wire import (
 )
 from instaslice_tpu.topology.grid import Shape, get_generation, id_to_coord
 from instaslice_tpu.utils.lockcheck import named_condition, named_lock
+from instaslice_tpu.utils.guards import guarded_by, unguarded
 
 log = logging.getLogger("tpuslice.deviceplugin")
 
@@ -314,6 +315,12 @@ class TpuDevicePluginServicer:
 class TpuDevicePlugin:
     """Plugin lifecycle: serve, register, watch health, re-register."""
 
+    _server: unguarded("lifecycle slot: start()/stop() calls are "
+                       "serialized by the owner (manager loop or test)")
+    registered_count: unguarded("written only by the serialized "
+                                "register() path; external reads are "
+                                "racy snapshots")
+
     def __init__(
         self,
         backend: DeviceBackend,
@@ -563,6 +570,8 @@ class SlicePluginManager:
     (``nvidia.com/mig-1g.5gb``), which the reference kicks via a node
     label (``instaslice_daemonset.go:474-497``) instead of owning.
     """
+
+    plugins: guarded_by("deviceplugin.manager")
 
     def __init__(
         self,

@@ -27,6 +27,7 @@ from instaslice_tpu.kube.client import (
     NotFound,
 )
 from instaslice_tpu.kube.real import _KIND_INFO
+from instaslice_tpu.utils.guards import unguarded
 
 _PLURAL_TO_KIND = {
     (prefix, plural): kind
@@ -64,7 +65,11 @@ def _parse(path: str) -> Tuple[str, Optional[str], str, str]:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.0"  # close-delimited: simplest for streams
-    kube: KubeClient = None  # type: ignore[assignment]
+    # bound once on the handler subclass at server construction, before
+    # serve_forever(); request threads only read (the fake client
+    # underneath carries its own lock)
+    kube: unguarded("class attr set before the server thread starts; "
+                    "handler threads only read") = None
     #: when set, every request's Bearer token must satisfy it or 401 —
     #: lets tests exercise the client's token-refresh / exec-plugin path
     token_validator = None  # Optional[Callable[[Optional[str]], bool]]

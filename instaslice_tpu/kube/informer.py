@@ -28,6 +28,7 @@ import traceback
 from typing import Callable, Dict, List, Optional, Tuple
 
 from instaslice_tpu.utils.lockcheck import named_lock
+from instaslice_tpu.utils.guards import guarded_by, unguarded
 
 log = logging.getLogger("instaslice_tpu")
 
@@ -62,6 +63,14 @@ class Informer:
       semantics to the watch loop the reconcile :class:`Manager` always
       had (tests/test_kubeauth.py pins them), now feeding a shared store.
     """
+
+    # store + caches are shared between the watch thread and every
+    # reader (reconcile workers, placement scans)
+    _store: guarded_by("kube.informer")
+    _transformed: guarded_by("kube.informer")
+    generation: guarded_by("kube.informer")
+    _handlers: unguarded("appended only before start(); the watch "
+                         "thread afterwards only iterates")
 
     def __init__(
         self,

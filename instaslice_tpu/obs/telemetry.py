@@ -75,6 +75,7 @@ from instaslice_tpu.obs.journal import (
     debug_events_payload,
     get_journal,
 )
+from instaslice_tpu.utils.guards import guarded_by
 from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.trace import debug_trace_payload, get_tracer
 
@@ -174,6 +175,11 @@ class TraceStitcher:
     attributes (on ``controller.allocate`` spans and ``Admitted``
     journal events) build the demand→supply link map: grant trace →
     the serving trace it unblocked."""
+
+    # spans arrive from the aggregator poll thread, debug-endpoint
+    # handlers, and file ingestion — all merge under telemetry.stitch
+    _spans: guarded_by("telemetry.stitch")
+    _caused_by: guarded_by("telemetry.stitch")
 
     def __init__(self) -> None:
         self._lock = named_lock("telemetry.stitch")
@@ -527,6 +533,17 @@ class FleetAggregator:
     for offline runs. Everything tolerates a dead endpoint: a scrape
     error is counted and skipped, never raised."""
 
+    # thread model: one poll at a time (the loop thread, or a test
+    # driving poll() directly with the loop stopped) owns the scrape
+    # bookkeeping; only the published rollup crosses to the HTTP
+    # export handlers, under telemetry.fleet
+    _fleet: guarded_by("telemetry.fleet")
+    _seen_events: unguarded("poll-thread owned: ingestion only runs "
+                            "inside _poll_inner")
+    _last_tokens: unguarded("poll-thread owned: see _seen_events")
+    _scrapes: unguarded("poll-thread owned counters; the rollup "
+                        "exports a dict() copy taken on that thread")
+
     def __init__(self, router_url: Optional[str] = None,
                  replica_urls: Tuple[str, ...] = (),
                  probe_urls: Tuple[str, ...] = (),
@@ -759,9 +776,11 @@ class FleetAggregator:
         self.metrics.chips_live.set(chips_live)
         self.metrics.chip_hours_per_mreq.set(chip_hours_per_mreq)
 
+        with self._lock:
+            polls = self._fleet.get("polls", 0) + 1
         fleet = {
             "ts": round(now, 6),
-            "polls": self._fleet.get("polls", 0) + 1,
+            "polls": polls,
             "replicas": per_replica,
             "requests": {k: int(v) for k, v in sorted(
                 requests.items()

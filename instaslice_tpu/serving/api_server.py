@@ -62,6 +62,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from instaslice_tpu.obs.journal import debug_events_payload
+from instaslice_tpu.utils.lockcheck import debug_locks_payload
 from instaslice_tpu.serving.engine import ServingEngine
 from instaslice_tpu.serving.scheduler import (
     Draining,
@@ -188,6 +189,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._debug_trace()
         elif self.path.startswith("/v1/debug/events"):
             self._debug_events()
+        elif self.path.startswith("/v1/debug/locks"):
+            # lockcheck's live view (utils/lockcheck.py): per-thread
+            # held locks, the acquisition-order graph, long holds —
+            # the hung-replica triage surface
+            self._send(200, debug_locks_payload())
         elif self.path.rstrip("/").startswith("/v1/models"):
             # OpenAI-client compatibility probe: one entry describing
             # the engine's model and serving limits ("created"/

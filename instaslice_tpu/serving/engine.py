@@ -1504,6 +1504,20 @@ class ServingEngine:
                 stop_scanned=int(blob["stop_scanned"]),
                 logprobs=[float(x) for x in blob["logprobs"]],
             )
+            # missing key defaults to the base model, matching
+            # _validate_session_blob's read of the same field
+            adapter = int(blob.get("adapter", 0))
+            # adopt the source's RNG stream (see export_session):
+            # bit-exact sampled continuations on an RNG-fresh replica,
+            # distribution-preserving otherwise, and identical on
+            # op-stream followers. Parsed HERE — wrap_key_data on a
+            # truncated payload must fail before registration, like
+            # every other malformed field
+            rng_key = None
+            if blob.get("rng") is not None:
+                rng_key = jax.random.wrap_key_data(
+                    jnp.asarray(wire_to_array(blob["rng"]))
+                )
         except Exception as e:  # noqa: BLE001 - re-raised as ValueError
             # the blob passed the signature checks but its payload is
             # missing/corrupt (truncated base64, absent key): the
@@ -1518,14 +1532,9 @@ class ServingEngine:
         req.request_id = rid
         self._tables[rid] = table
         self.parked[rid] = _Parked(req, stripe, draft_stripe, length,
-                                   adapter=int(blob["adapter"]))
-        # adopt the source's RNG stream (see export_session): bit-exact
-        # sampled continuations on an RNG-fresh replica, distribution-
-        # preserving otherwise, and identical on op-stream followers
-        if blob.get("rng") is not None:
-            self._rng = jax.random.wrap_key_data(
-                jnp.asarray(wire_to_array(blob["rng"]))
-            )
+                                   adapter=adapter)
+        if rng_key is not None:
+            self._rng = rng_key
         self.imported_total += 1
         return rid
 

@@ -73,7 +73,8 @@ from instaslice_tpu.faults.netchaos import get_nemesis
 from instaslice_tpu.kube.real import CircuitBreaker, CircuitOpen
 from instaslice_tpu.obs.journal import debug_events_payload, get_journal
 from instaslice_tpu.serving.kvcache import granule_hash
-from instaslice_tpu.utils.lockcheck import named_lock
+from instaslice_tpu.utils.guards import guarded_by, unguarded
+from instaslice_tpu.utils.lockcheck import debug_locks_payload, named_lock
 from instaslice_tpu.utils.trace import TRACE_ID_SAFE, \
     debug_trace_payload, get_tracer, new_trace_id
 
@@ -341,6 +342,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._send(200, debug_events_payload(qs))
             except ValueError as e:
                 self._send(400, {"error": str(e)})
+        elif self.path.startswith("/v1/debug/locks"):
+            self._send(200, debug_locks_payload())
         elif self.path.rstrip("/").startswith("/v1/models"):
             # passthrough to any alive replica (they are identical)
             try:
@@ -856,6 +859,17 @@ class Router:
     poll_backoff_cap = 2.0
     retry_after_cap = 30.0
 
+    # ---- thread model (slicecheck-verified): replica table, session
+    # affinity, and the counters are shared between the poll loop, the
+    # HTTP handler threads, and admin calls — all under router.state
+    _replicas: guarded_by("router.state")
+    _sessions: guarded_by("router.state")
+    requests: guarded_by("router.state")
+    routed: guarded_by("router.state")
+    migrations: guarded_by("router.state")
+    ejections: guarded_by("router.state")
+    hedges: guarded_by("router.state")
+
     def __init__(self, replicas=(), host: str = "127.0.0.1",
                  port: int = 0, poll_interval: float = 0.25,
                  stale_after: float = 3.0, request_timeout: float = 300.0,
@@ -986,7 +1000,8 @@ class Router:
                 existing.draining = False
                 return existing
             self._replicas[rep.url] = rep
-        self.metrics.replicas.set(len(self._replicas))
+            n = len(self._replicas)
+        self.metrics.replicas.set(n)
         self._poll_one(rep)
         return rep
 
@@ -1056,9 +1071,10 @@ class Router:
                 sid: (u, ts) for sid, (u, ts) in self._sessions.items()
                 if u != url
             }
-        self.metrics.replicas.set(len(self._replicas))
+            n = len(self._replicas)
+        self.metrics.replicas.set(n)
         return {"removed": True, "migrated": migrated, "idle": idle,
-                "replicas": len(self._replicas)}
+                "replicas": n}
 
     # ------------------------------------------------------------- polling
 

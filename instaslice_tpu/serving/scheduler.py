@@ -77,6 +77,7 @@ from instaslice_tpu.api.constants import (
 )
 from instaslice_tpu.faults import maybe_crash
 from instaslice_tpu.obs.journal import get_journal
+from instaslice_tpu.utils.guards import guarded_by, unguarded
 from instaslice_tpu.serving.engine import (
     AdmissionRequest,
     GenerationResult,
@@ -177,6 +178,16 @@ class Draining(Exception):
 
 
 class Pending:
+    #: write-protocol (see __init__ comment at ``lock``): the HTTP
+    #: thread flags a timeout and the scheduler decides the outcome
+    #: under ``serve.pending``; plain reads are advisory GIL-atomic
+    #: snapshots the authoritative path re-checks under the lock
+    timed_out: guarded_by("serve.pending", reads="racy")
+    results: unguarded(
+        "scheduler thread fills results before done.set(); waiters "
+        "read only after done (Event ordering), streamers via stream_q"
+    )
+
     def __init__(self, prompt: List[int], max_tokens: int,
                  prefix_op: str = "", stream: bool = False,
                  stop: Optional[List[List[int]]] = None,
@@ -293,6 +304,30 @@ class Scheduler(threading.Thread):
     #: Retry-After hint on a 429 shed: one block decode is the natural
     #: re-try grain — by then the queue has moved
     shed_retry_after = 1.0
+
+    # ---- thread model (slicecheck-verified): the run loop owns the
+    # engine and ALL scheduling state below; the only cross-thread
+    # writers come through queue/_control (both internally locked) or
+    # the serve.submit critical section. External reads (stats(),
+    # tests) are racy len()/int snapshots by design.
+    _seq: guarded_by("serve.submit")
+    _by_rid: unguarded("scheduler-thread owned (run loop owns the "
+                       "engine); stats() reads are racy snapshots")
+    _budget: unguarded("scheduler-thread owned; see _by_rid")
+    _ready: unguarded("scheduler-thread owned; see _by_rid")
+    _parked: unguarded("scheduler-thread owned; see _by_rid")
+    _imports: unguarded("scheduler-thread owned: written only by "
+                        "control ops drained on the run loop")
+    preempted: unguarded("scheduler-thread ledger counter; external "
+                         "reads are diagnostics")
+    resumed: unguarded("scheduler-thread ledger counter")
+    parked_shed: unguarded("scheduler-thread ledger counter")
+    slo_misses: unguarded("scheduler-thread ledger counter")
+    migrated_in: unguarded("scheduler-thread ledger counter")
+    drain_deadline: unguarded(
+        "single float written by drain() then read by the run loop; "
+        "GIL-atomic, and draining.is_set() orders the handoff"
+    )
 
     def __init__(self, engine: ServingEngine, block_size: int = 16,
                  metrics=None, max_queue: int = 0,

@@ -67,6 +67,12 @@ _holds: Dict[str, List[float]] = {}
 #: long-hold incidents: (name, seconds, thread)
 _long_holds: List[Tuple[str, float, str]] = []
 _tls = threading.local()
+#: thread -> its held-stack list, registered on the thread's first
+#: instrumented acquire. The list itself is mutated lock-free by its
+#: owner; :func:`live` reads racy GIL-consistent snapshots (debug
+#: surface — a momentarily stale view is fine). Guarded by _state_lock
+#: for membership only; dead threads are pruned on read.
+_thread_stacks: Dict[threading.Thread, list] = {}
 
 
 class LockOrderError(AssertionError):
@@ -164,6 +170,45 @@ def report() -> dict:
         }
 
 
+def live() -> dict:
+    """Currently-held locks, per live thread — the lock-triage view a
+    hung process exposes on ``GET /v1/debug/locks``: which thread holds
+    what, in acquisition order, and for how long. Entries are racy
+    GIL-consistent snapshots (each stack is owned by its thread); a
+    thread with nothing held is omitted."""
+    now = time.monotonic()
+    with _state_lock:
+        dead = [t for t in _thread_stacks if not t.is_alive()]
+        for t in dead:
+            del _thread_stacks[t]
+        stacks = [(t, list(st)) for t, st in _thread_stacks.items()]
+    threads = []
+    for t, st in stacks:
+        held = [
+            {
+                "name": e[0],
+                "heldSeconds": round(now - e[2], 6),
+                "depth": int(e[3]),
+            }
+            for e in st
+        ]
+        if held:
+            threads.append({"thread": t.name, "held": held})
+    threads.sort(key=lambda d: d["thread"])
+    return {"armed": _armed, "threads": threads}
+
+
+def debug_locks_payload(qs: Optional[dict] = None) -> dict:
+    """``GET /v1/debug/locks`` body: live per-thread held locks plus
+    the accumulated acquisition-order graph, cycles, hold-time stats
+    and long-hold incidents. Everything is empty while disarmed
+    (``armed: false`` tells the caller to set TPUSLICE_LOCKCHECK=1) —
+    the endpoint itself stays cheap either way."""
+    payload = report()
+    payload["live"] = live()["threads"]
+    return payload
+
+
 def assert_clean() -> None:
     """Raise :class:`LockOrderError` if any ABBA cycle was observed.
     The chaos tier calls this at session end, turning every chaos seed
@@ -186,6 +231,9 @@ def _held() -> list:
     st = getattr(_tls, "stack", None)
     if st is None:
         st = _tls.stack = []
+        me = threading.current_thread()
+        with _state_lock:
+            _thread_stacks[me] = st
     return st
 
 

@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from instaslice_tpu.obs.journal import debug_events_payload
+from instaslice_tpu.utils.lockcheck import debug_locks_payload
 from instaslice_tpu.utils.trace import debug_trace_payload
 
 
@@ -51,6 +52,8 @@ class ProbeServer:
                             code, payload = 200, debug_trace_payload(qs)
                         elif self.path.startswith("/v1/debug/events"):
                             code, payload = 200, debug_events_payload(qs)
+                        elif self.path.startswith("/v1/debug/locks"):
+                            code, payload = 200, debug_locks_payload(qs)
                         else:
                             code = 404
                             payload = {"error": f"no route {self.path}"}

@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from instaslice_tpu.faults import InjectedCrash
 from instaslice_tpu.kube.informer import Informer
 from instaslice_tpu.utils.lockcheck import named_condition
+from instaslice_tpu.utils.guards import unguarded
 
 log = logging.getLogger("instaslice_tpu")
 
@@ -192,6 +193,14 @@ class Manager:
     docs/SCALING.md). :meth:`shard_is_leader` exposes the calling
     worker's leadership for write fencing.
     """
+
+    queue: unguarded("ShardedQueue synchronizes internally "
+                     "(per-shard named_condition)")
+    _reconcile_counts: unguarded("per-worker slots: worker i writes "
+                                 "only index i; readers sum racily")
+    _error_counts: unguarded("per-worker slots, see _reconcile_counts")
+    _electors: unguarded("per-shard slots: each worker assigns only "
+                         "its own shard key, once, at startup")
 
     def __init__(
         self,

@@ -1,0 +1,30 @@
+"""A correctly-disciplined concurrent class: every access to the
+guarded field is under its named lock (including via the
+receiver-typed ``with self._lock:`` match, since ``_lock`` is an
+attribute name shared with ``racy_class.py``'s different lock). Zero
+findings."""
+
+from __future__ import annotations
+
+import threading
+
+from instaslice_tpu.utils.guards import guarded_by
+from instaslice_tpu.utils.lockcheck import named_lock
+
+
+class CleanCounter:
+    clean_hits: guarded_by("fixture.clean")
+
+    def __init__(self) -> None:
+        self._lock = named_lock("fixture.clean")
+        self.clean_hits = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self) -> None:
+        with self._lock:
+            self.clean_hits += 1
+
+    def snapshot(self) -> int:
+        with self._lock:
+            return self.clean_hits

@@ -1,0 +1,41 @@
+"""Seeded dispatch-hygiene violations (hot-path module: lives under
+``models/``) for tests/test_slicecheck.py.
+
+- ``drive`` syncs the host three times per iteration: ``.item()``,
+  ``jax.device_get`` and ``float(jnp.sum(...))`` — THREE
+  ``host-sync-in-loop`` findings.
+- ``attend_fast`` jits a function whose ``attend_len`` parameter is
+  shape-bearing but not static: ONE ``nonstatic-shape-arg``.
+  ``attend_static`` shows the fix and must NOT be flagged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def decode_step(state):
+    return state, jnp.argmax(state)
+
+
+step = jax.jit(decode_step)
+
+
+def attend(x, attend_len):
+    return x[:attend_len]
+
+
+attend_fast = jax.jit(attend)                             # flagged
+attend_static = jax.jit(attend, static_argnames=("attend_len",))
+
+
+def drive(state, n):
+    outs = []
+    for _ in range(n):
+        state, tok = step(state)
+        outs.append(tok.item())           # host-sync-in-loop
+        mirror = jax.device_get(state)    # host-sync-in-loop
+        total = float(jnp.sum(state))     # host-sync-in-loop
+        del mirror, total
+    return outs

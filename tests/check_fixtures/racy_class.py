@@ -1,0 +1,56 @@
+"""Seeded guarded-by violations for tests/test_slicecheck.py.
+
+One class, three distinct defects:
+
+- ``hits`` is declared ``guarded_by("fixture.racy")`` but touched
+  lock-free in ``_loop`` (write) and ``snapshot`` (read): exactly TWO
+  ``guarded-field`` findings.
+- ``shared_log`` is written from the worker thread and drained from a
+  public method with no declaration at all: ONE ``undeclared-shared``.
+- ``ghost`` names a lock no factory registers: ONE
+  ``guard-unknown-lock``.
+
+``noted`` shows the escape hatch: an ``unguarded(reason)`` declaration
+keeps a deliberately racy field out of the report.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from instaslice_tpu.utils.guards import guarded_by, unguarded
+from instaslice_tpu.utils.lockcheck import named_lock
+
+
+class RacyCounter:
+    hits: guarded_by("fixture.racy")
+    ghost: guarded_by("fixture.ghost")
+    noted: unguarded("fixture: deliberately racy counter")
+
+    def __init__(self) -> None:
+        self._lock = named_lock("fixture.racy")
+        self.hits = 0
+        self.ghost = 0
+        self.noted = 0
+        self.shared_log = []
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def _loop(self) -> None:
+        while True:
+            self.hits += 1          # guarded-field: write, no lock
+            self.shared_log.append(1)
+            self.noted += 1         # declared unguarded: no finding
+
+    def bump(self) -> None:
+        with self._lock:
+            self.hits += 1          # correct: no finding
+
+    def snapshot(self) -> int:
+        return self.hits            # guarded-field: read, no lock
+
+    def drain(self) -> list:
+        with self._lock:
+            # the lock is held, but shared_log carries NO declaration:
+            # undeclared-shared (reachable from _loop + external)
+            return list(self.shared_log)

@@ -1,0 +1,16 @@
+"""Seeded reason-catalog rot for tests/test_slicecheck.py.
+
+This file IS the corpus's catalog (it assigns ``EVENT_REASONS``).
+``REASON_USED`` is emitted by ``emitter.py``; ``REASON_IN_CONTAINER``
+is live because the container it sits in is referenced elsewhere;
+``REASON_DEAD`` has no emit site anywhere in the corpus — exactly ONE
+``dead-reason`` finding.
+"""
+
+REASON_USED = "FixtureUsed"
+REASON_DEAD = "FixtureDead"
+REASON_IN_CONTAINER = "FixtureContained"
+
+FIXTURE_TRANSITIONS = (REASON_IN_CONTAINER,)
+
+EVENT_REASONS = {REASON_USED, REASON_DEAD, REASON_IN_CONTAINER}

@@ -1,0 +1,24 @@
+"""Seeded compile-budget violations (engine module: the path carries
+``serving/engine``) for tests/test_slicecheck.py.
+
+``compile_budget`` declares the bounded program set; ``_decode`` is
+accounted, ``_rogue`` is a jit attribute the budget never mentions, and
+``extra`` is a jit program not even bound to a ``self._X`` slot — TWO
+``unbudgeted-jit`` findings.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def compile_budget():
+    return {"decode": 1, "prefill": 1}
+
+
+class MiniEngine:
+    def __init__(self, fns) -> None:
+        self._decode = jax.jit(fns.decode)      # accounted: no finding
+        self._rogue = jax.jit(fns.rogue)        # unbudgeted-jit
+        extra = jax.jit(fns.extra)              # unbudgeted-jit
+        self._extra = extra

@@ -1,0 +1,25 @@
+# slicecheck: disable-file=guard-unknown-lock
+"""The same defect shapes as the seeded fixtures, every one carrying a
+justified suppression: line-level ``disable=`` for the lock-free
+access, file-level ``disable-file=`` (header line above) for the
+unregistered lock name. Zero findings — and the suppressions are
+rule-scoped, which ``test_suppression_is_per_rule`` pins."""
+
+from __future__ import annotations
+
+from instaslice_tpu.utils.guards import guarded_by
+from instaslice_tpu.utils.lockcheck import named_lock
+
+
+class SuppressedCounter:
+    sup_hits: guarded_by("fixture.sup")
+    sup_ghost: guarded_by("fixture.phantom")
+
+    def __init__(self) -> None:
+        self._lock = named_lock("fixture.sup")
+        self.sup_hits = 0
+        self.sup_ghost = 0
+
+    def bump(self) -> None:
+        # justified: fixture exercises the line-level escape hatch
+        self.sup_hits += 1  # slicecheck: disable=guarded-field

@@ -1,0 +1,39 @@
+"""Seeded paired-resource leak for tests/test_slicecheck.py.
+
+``leaky_import`` opens (``allocate``) and closes (``release``) on the
+same receiver but raises between the two with the close not in a
+``finally``: exactly ONE ``unbalanced-pair`` finding. The other two
+functions are the legal shapes — close in ``finally``, and a raise
+inside the open's own failure handler (nothing was allocated, nothing
+can leak).
+"""
+
+from __future__ import annotations
+
+
+def leaky_import(pool, blob):
+    table = pool.allocate(4)
+    if not blob:
+        # unbalanced-pair: this exit skips pool.release(table)
+        raise ValueError("bad blob")
+    pool.release(table)
+    return table
+
+
+def balanced_import(pool, blob):
+    table = pool.allocate(4)
+    try:
+        if not blob:
+            raise ValueError("bad blob")
+        return table
+    finally:
+        pool.release(table)
+
+
+def open_failure_is_not_a_leak(pool):
+    try:
+        table = pool.allocate(4)
+    except MemoryError:
+        # allocate itself failed — there is no table to release
+        raise RuntimeError("pool exhausted") from None
+    pool.release(table)

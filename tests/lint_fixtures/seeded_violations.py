@@ -85,6 +85,19 @@ def raw_lock_via_from_import():
     return _AliasedLock()                      # raw-lock (aliased)
 
 
+def raw_lock_bare_reference():
+    # uncalled factory references manufacture raw locks at a distance
+    make = threading.Lock                      # raw-lock (bare ref)
+    pool = list(map(_AliasedLock, range(2)))   # raw-lock (bare aliased)
+    return make, pool
+
+
+def raw_lock_annotation_ok(lock: threading.Lock) -> threading.RLock:
+    # naming the type is NOT making a lock: no finding here
+    held: threading.Condition = lock
+    return held
+
+
 def event_reason_literal_violation(journal, client):
     journal.emit("controller", reason="MadeUpReason")   # event-reason-literal
     emit_pod_event(                            # event-reason-literal

@@ -294,3 +294,147 @@ class TestDisarmed:
         with r:
             with r:
                 assert r.locked()
+
+
+class TestLiveView:
+    """`lc.live()` and the `/v1/debug/locks` surface it feeds."""
+
+    def test_live_shows_held_stack_then_empties(self):
+        a = lc.named_lock("fixture.live-a")
+        b = lc.named_lock("fixture.live-b")
+        holding = threading.Event()
+        done = threading.Event()
+
+        def holder():
+            with a:
+                with b:
+                    holding.set()
+                    assert done.wait(10.0)
+
+        t = threading.Thread(target=holder, name="live-holder", daemon=True)
+        t.start()
+        assert holding.wait(10.0)
+        try:
+            snap = lc.live()
+            assert snap["armed"] is True
+            mine = [
+                th for th in snap["threads"] if th["thread"] == "live-holder"
+            ]
+            assert len(mine) == 1
+            held = mine[0]["held"]
+            assert [h["name"] for h in held] == [
+                "fixture.live-a", "fixture.live-b",
+            ]
+            assert held[0]["heldSeconds"] >= 0.0
+            # depth is per-lock reentrancy, not stack position
+            assert held[0]["depth"] == 1 and held[1]["depth"] == 1
+        finally:
+            done.set()
+            t.join(timeout=10.0)
+        assert not t.is_alive()
+        after = lc.live()
+        assert all(
+            th["thread"] != "live-holder" or th["held"] == []
+            for th in after["threads"]
+        )
+
+    def test_debug_payload_merges_report_and_live(self):
+        with lc.named_lock("fixture.payload"):
+            payload = lc.debug_locks_payload()
+        # report() keys stay present alongside the live view
+        assert "cycles" in payload and "edges" in payload
+        assert "holds" in payload and "armed" in payload
+        assert isinstance(payload["live"], list)
+        names = {
+            h["name"] for th in payload["live"] for h in th["held"]
+        }
+        assert "fixture.payload" in names  # snapshot taken while held
+        after = {
+            h["name"]
+            for th in lc.debug_locks_payload()["live"]
+            for h in th["held"]
+        }
+        assert "fixture.payload" not in after
+
+    def test_probe_server_serves_locks_endpoint(self):
+        import json
+        import urllib.request
+
+        from instaslice_tpu.utils.probes import ProbeServer
+
+        srv = ProbeServer("127.0.0.1:0")
+        srv.start()
+        try:
+            gate = threading.Event()
+            done = threading.Event()
+
+            def holder():
+                with lc.named_lock("fixture.http-held"):
+                    gate.set()
+                    assert done.wait(10.0)
+
+            t = threading.Thread(
+                target=holder, name="http-holder", daemon=True
+            )
+            t.start()
+            assert gate.wait(10.0)
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/v1/debug/locks", timeout=10
+                ) as resp:
+                    assert resp.status == 200
+                    payload = json.loads(resp.read())
+            finally:
+                done.set()
+                t.join(timeout=10.0)
+            held = {
+                h["name"]
+                for th in payload["live"]
+                if th["thread"] == "http-holder"
+                for h in th["held"]
+            }
+            assert held == {"fixture.http-held"}
+            assert "edges" in payload
+        finally:
+            srv.stop()
+
+    def test_ctl_describe_locks_renders_live_state(self, capsys):
+        from instaslice_tpu.cli.tpuslicectl import main
+
+        from instaslice_tpu.utils.probes import ProbeServer
+
+        srv = ProbeServer("127.0.0.1:0")
+        srv.start()
+        try:
+            gate = threading.Event()
+            done = threading.Event()
+
+            def holder():
+                with lc.named_lock("fixture.ctl-held"):
+                    gate.set()
+                    assert done.wait(10.0)
+
+            t = threading.Thread(
+                target=holder, name="ctl-holder", daemon=True
+            )
+            t.start()
+            assert gate.wait(10.0)
+            try:
+                rc = main([
+                    "describe", "locks",
+                    "--url", f"http://127.0.0.1:{srv.port}",
+                ])
+            finally:
+                done.set()
+                t.join(timeout=10.0)
+            out = capsys.readouterr().out
+            assert rc == 0
+            assert "ctl-holder" in out
+            assert "fixture.ctl-held" in out
+        finally:
+            srv.stop()
+
+    def test_ctl_describe_locks_requires_url(self):
+        from instaslice_tpu.cli.tpuslicectl import main
+
+        assert main(["describe", "locks"]) == 2

@@ -590,6 +590,34 @@ class TestRouterHttpE2E:
         rid2 = dst.import_session(blob)
         assert rid2 in dst.parked
 
+    def test_late_payload_failure_releases_pool_blocks(self, model):
+        """slicecheck regression: adapter/rng parsing used to run AFTER
+        the block table landed in ``_tables`` — a corrupt rng payload
+        (or a blob with no adapter key at all, which validation accepts
+        as 0) raised mid-registration and permanently shrank the
+        destination pool on every retry."""
+        src = make_engine(model)
+        dst = make_engine(model)
+        rid = src.add_request([5, 9, 2, 7])
+        src.decode_block(3)
+        src.preempt_slot(next(iter(src.slots)))
+        blob = src.export_session(rid)
+        free0 = dst.kv.free_blocks()
+        bad = dict(blob)
+        bad["rng"] = {"__nd__": True, "dtype": "uint32",
+                      "shape": [4], "data": "!!notb64!!"}
+        with pytest.raises(ValueError, match="malformed"):
+            dst.import_session(bad)
+        assert dst.kv.free_blocks() == free0
+        assert not dst.parked and not dst._tables
+        # no adapter key: validation reads .get("adapter", 0), so the
+        # import must land as the base model — not KeyError halfway
+        # through registration
+        ok = dict(blob)
+        ok.pop("adapter", None)
+        rid2 = dst.import_session(ok)
+        assert dst.parked[rid2].adapter == 0
+
     def test_client_resume_field_is_stripped(self, model, fleet):
         """Review-pass regression: ``resume`` is the ROUTER'S protocol
         field — a client sending it through the router must not be

@@ -44,7 +44,9 @@ class TestSeededViolations:
             "sleep-in-loop": 2,   # incl. the from-import alias
             "span-leak": 1,
             "mutable-default": 2,
-            "raw-lock": 4,        # incl. the from-import alias
+            "raw-lock": 6,        # call + from-import alias + 2 bare
+                                  # (uncalled) factory references;
+                                  # annotations stay exempt
             "event-reason-literal": 2,  # journal.emit + emit_pod_event
         }, by_rule
 

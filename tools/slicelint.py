@@ -258,6 +258,23 @@ class _Linter:
         for node in ast.walk(tree):
             for child in ast.iter_child_nodes(node):
                 self.parents[child] = node
+        # type annotations reference threading.Lock legitimately (and
+        # under PEP 563 they are never evaluated at all) — collect
+        # their subtrees so the bare-reference rule can skip them
+        self._ann_nodes: set = set()
+        for node in ast.walk(tree):
+            anns = []
+            if isinstance(node, ast.AnnAssign):
+                anns.append(node.annotation)
+            elif isinstance(node, ast.arg) and node.annotation:
+                anns.append(node.annotation)
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.returns:
+                anns.append(node.returns)
+            for a in anns:
+                for sub in ast.walk(a):
+                    self._ann_nodes.add(id(sub))
         # alias map so `from threading import Lock` / `import
         # urllib.request as ur` cannot smuggle a policed call past the
         # dotted-name match: local binding -> canonical dotted origin
@@ -275,6 +292,9 @@ class _Linter:
         for node in ast.walk(tree):
             if isinstance(node, ast.Call):
                 self._check_call(node)
+            elif isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                self._check_bare_lock_ref(node)
             elif isinstance(node, ast.ExceptHandler):
                 self._check_except(node)
             elif isinstance(node, ast.Constant) and isinstance(
@@ -315,6 +335,24 @@ class _Linter:
         ):
             self.emit(node, "span-leak")
         self._check_event_reason(node, dotted)
+
+    def _check_bare_lock_ref(self, node) -> None:
+        """An UNCALLED reference to ``threading.Lock``/``RLock``/
+        ``Condition`` — ``defaultdict(threading.Lock)``, a
+        ``factory=Lock`` default, ``locks = [Lock() for ...]``'s
+        comprehension cousin ``map(Lock, range(n))`` — manufactures raw
+        locks at a distance, past the call-site rule. Type annotations
+        are exempt (naming the type is not making a lock)."""
+        parent = self.parents.get(node)
+        if isinstance(parent, ast.Call) and parent.func is node:
+            return  # the direct-call form: _check_call owns it
+        if isinstance(parent, ast.Attribute):
+            return  # interior of a longer dotted chain
+        if id(node) in self._ann_nodes:
+            return
+        dotted = self._resolve(_dotted(node))
+        if dotted in _RAW_LOCK_CALLS and not self._allowed(RAW_LOCK_ALLOW):
+            self.emit(node, "raw-lock")
 
     def _check_event_reason(self, node: ast.Call, dotted: str) -> None:
         """Journal emission (``<journal>.emit(...)`` /
