@@ -44,11 +44,12 @@ check: lint  ## Both static gates: slicelint (per-file idiom) + slicecheck (whol
 	$(PY) tools/slicecheck.py
 
 .PHONY: test
-test: check  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check + telemetry-smoke observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke + bench-router-smoke floors
+test: check  ## Fast tier (~2 min): slicelint gate, control plane, device, kube, topology — then the trace-check + events-check + telemetry-smoke + profile-smoke observability gates and the bench-smoke + bench-defrag-smoke + bench-serving-smoke + bench-engine-smoke + bench-prefix-smoke + bench-spec-smoke + bench-router-smoke floors
 	$(PY) -m pytest tests/ -x -q -m "not slow"
 	$(MAKE) trace-check
 	$(MAKE) events-check
 	$(MAKE) telemetry-smoke
+	$(MAKE) profile-smoke
 	$(MAKE) chaos-crash-smoke
 	$(MAKE) chaos-partition-smoke
 	$(MAKE) bench-smoke
@@ -62,6 +63,10 @@ test: check  ## Fast tier (~2 min): slicelint gate, control plane, device, kube,
 .PHONY: telemetry-smoke
 telemetry-smoke:  ## <60 s fleet-telemetry gate (docs/OBSERVABILITY.md "Fleet telemetry"): 2-replica fleet behind the router + aggregator on a pinned clock, clean AND under one seeded delay-only fault plan — aggregator rollups reconcile EXACTLY with the loadgen client report and the journal counters, burn-rate High fires under the injected-latency arm and Clears on heal, a capacity-blocked request stitches a >=3-component timeline via the caused-by link, zero hung
 	JAX_PLATFORMS=cpu timeout -k 10 300 $(PY) tools/telemetry_smoke.py
+
+.PHONY: profile-smoke
+profile-smoke:  ## <60 s continuous-profiler gate (docs/OBSERVABILITY.md "Profiling"): serve + loadgen with the profiler armed — tok/s >= 0.95x the unprofiled arm, profiler ring == scheduler round counter == profile_rounds metric with zero ring growth after quiesce, exported Chrome trace valid with >=1 full round lane, >=1 request waterfall stitched, zero mid-traffic CompileObserved after warmup
+	JAX_PLATFORMS=cpu timeout -k 10 300 $(PY) tools/profile_smoke.py
 
 .PHONY: bench-trend
 bench-trend:  ## Bench-record trend report + regression gate: reads every BENCH*_rNN.json tier, prints the headline series, exits non-zero when the newest record of a tier regresses >10% vs the best prior record of that tier

@@ -592,11 +592,16 @@ def bench_serving(
     from instaslice_tpu.models.lm import ModelConfig, TpuLM
     from instaslice_tpu.obs.journal import Journal, get_journal, \
         reset_journal
+    from instaslice_tpu.obs.profiler import Profiler, get_profiler, \
+        reset_profiler
     from instaslice_tpu.serving import ServingEngine
     from instaslice_tpu.serving.api_server import ApiServer
     from instaslice_tpu.serving.loadgen import run as loadgen_run
 
     reset_journal(Journal(capacity=65536))
+    # per-arm profile artifact (tools/bench_trend.py learns per-segment
+    # p95 keys from it): an armed, arm-private profiler ring
+    reset_profiler(Profiler(armed=True))
     # heavy enough that a decode STEP costs real compute relative to a
     # dispatch — the regime real serving lives in (decode is HBM/FLOP
     # bound at batch); a micro-model would make wasted slot-steps look
@@ -666,6 +671,9 @@ def bench_serving(
                 tenants=SERVING_TENANTS, jitter=jitter,
             )
             warm_stats = srv.scheduler.stats()
+            # the artifact reports the MEASURED window: drop the
+            # warm-up burst's round records
+            get_profiler().clear()
             t = threading.Thread(target=sampler, daemon=True)
             t.start()
             t0 = time.monotonic()
@@ -704,9 +712,11 @@ def bench_serving(
                 and eng.kv.used_blocks() == eng.radix.pool_blocks()
                 and not eng._radix_locks
             )
+            profile_summary = get_profiler().segment_summary()
     finally:
         stop.set()
         reset_journal()
+        reset_profiler()
     kv_util = [s[0] for s in samples]
     gold = report["tenants"]["gold"]
     bronze = report["tenants"]["bronze"]
@@ -768,6 +778,9 @@ def bench_serving(
         "parked_shed": stats["parked_shed"],
         "slo_misses": stats["slo_misses"],
         "ledger_ok": ledger_ok,
+        # round-anatomy segment summary for the measured window
+        # (obs/profiler.py): bench_trend gates per-segment p95 from it
+        "profile": profile_summary,
     }
 
 

@@ -148,6 +148,12 @@ REASON_PREEMPTED = "RequestPreempted"
 REASON_RESUMED = "RequestResumed"
 REASON_SLO_MISSED = "SLOMissed"
 
+# continuous profiler (obs/profiler.py): a jit program compiled OUTSIDE
+# the warm_* window (and past the traffic grace) — the "cold mid-run
+# compile polluted p95" bug class self-announces with the program name,
+# the dispatch shape key, and the compile wall ms.
+REASON_COMPILE_OBSERVED = "CompileObserved"
+
 # crash-consistent recovery (docs/RECOVERY.md). CrashRecovered marks a
 # restarted component adopting durable state a dead predecessor left
 # mid-flight (also the epoch boundary `validate_events --epochs` splits
@@ -215,6 +221,7 @@ EVENT_REASONS = frozenset({
     REASON_BREAKER_OPEN, REASON_BACKOFF, REASON_WATCH_RECONNECT,
     REASON_DRAIN_BEGIN, REASON_DRAIN_END, REASON_SHED, REASON_DRAINED,
     REASON_PREEMPTED, REASON_RESUMED, REASON_SLO_MISSED,
+    REASON_COMPILE_OBSERVED,
     REASON_SESSION_EXPORTED, REASON_SESSION_IMPORTED,
     REASON_SLO_BURN_HIGH, REASON_SLO_BURN_CLEARED,
     REASON_CRASH_RECOVERED, REASON_ORPHAN_REAPED,

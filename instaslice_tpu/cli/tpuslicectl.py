@@ -133,6 +133,122 @@ def _trace_summary(p, args) -> int:
     return 0
 
 
+def _get_json(url: str, timeout: float = 10):
+    """GET url → parsed JSON; (None, error-string) style return:
+    ``(payload, "")`` on success, ``(None, message)`` on any failure."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode()), ""
+    except Exception as e:  # slicelint: disable=broad-except
+        # CLI surface: the message IS the report (printed by callers)
+        return None, f"{type(e).__name__}: {e}"
+
+
+def _profile_cmd(args) -> int:
+    """``profile``: the continuous profiler's export surface. Without
+    ``--out``: per-segment p50/p95 summary rows (one JSON line each,
+    the trace-summary idiom). With ``--out trace.json``: fetch the
+    round records + timeline events (``GET /v1/debug/profile``) and
+    the tracer's recent spans (``GET /v1/debug/trace``), interleave
+    them into Chrome trace-event JSON (obs/profiler.py
+    ``chrome_trace``), and write the file — open it in Perfetto or
+    ``chrome://tracing``."""
+    from instaslice_tpu.obs.profiler import chrome_trace
+
+    base = args.url.rstrip("/")
+    profile, err = _get_json(f"{base}/v1/debug/profile?n={args.last}")
+    if profile is None:
+        print(json.dumps({"error": err}))
+        return 1
+    if not args.out:
+        print(json.dumps({
+            "armed": profile.get("armed"),
+            "rounds": profile.get("rounds"),
+            "events": profile.get("events"),
+            "compileWallMs": profile.get("compileWallMs"),
+        }))
+        for name, row in sorted(
+            (profile.get("segments") or {}).items()
+        ):
+            print(json.dumps({"segment": name, **row}))
+        for c in profile.get("compiles") or []:
+            print(json.dumps({"compile": c}))
+        return 0
+    # spans ride along on the same timeline; a trace-less component
+    # (or a scrape error) degrades to rounds + events only
+    trace, _terr = _get_json(f"{base}/v1/debug/trace?n={args.last}")
+    spans = (trace or {}).get("recent") or []
+    doc = chrome_trace(
+        rounds=profile.get("recent") or [],
+        events=profile.get("recentEvents") or [],
+        spans=spans,
+    )
+    with open(args.out, "w") as f:
+        json.dump(doc, f)
+    print(json.dumps({
+        "out": args.out,
+        "traceEvents": len(doc["traceEvents"]),
+        "rounds": len(profile.get("recent") or []),
+        "events": len(profile.get("recentEvents") or []),
+        "spans": len(spans),
+    }))
+    return 0
+
+
+def render_waterfall(w: dict) -> str:
+    """ASCII latency waterfall: one bar row per stage on a shared
+    [0, totalMs] axis, then the journal markers."""
+    width = 40
+    total = max(float(w.get("totalMs") or 0.0), 0.001)
+    lines = [
+        f"request {w['rid']}  trace={w['traceId']}  "
+        f"outcome={w['outcome'] or '?'}  total={w['totalMs']}ms  "
+        f"preemptions={w['preemptions']}"
+    ]
+    for s in w.get("stages", []):
+        start = float(s["startMs"])
+        dur = float(s["durationMs"])
+        left = min(width, int(round(start / total * width)))
+        span = max(1, int(round(dur / total * width)))
+        span = min(span, width - left) or 1
+        bar = " " * left + "█" * span
+        lines.append(
+            f"  {s['stage']:<14} {bar:<{width}}  "
+            f"{start:>9.2f}ms +{dur:.2f}ms"
+        )
+    for m in w.get("markers", []):
+        lines.append(
+            f"  ◆ {m['atMs']:>9.2f}ms  {m['reason']}: {m['message']}"
+        )
+    return "\n".join(lines)
+
+
+def _waterfall_cmd(args) -> int:
+    """``waterfall``: one request's queue→admission→prefill→rounds→
+    (preempt/resume)→finish timeline, stitched server-side from round
+    records + journal + trace (``GET /v1/debug/profile?rid=...``)."""
+    base = args.url.rstrip("/")
+    import urllib.parse
+
+    w, err = _get_json(
+        f"{base}/v1/debug/profile?"
+        + urllib.parse.urlencode({"rid": args.rid})
+    )
+    if w is None:
+        print(json.dumps({"error": err}))
+        return 1
+    if w.get("error"):
+        print(json.dumps(w))
+        return 1
+    if args.as_json:
+        print(json.dumps(w))
+    else:
+        print(render_waterfall(w))
+    return 0
+
+
 def _parse_jsonl_line(line: str):
     """One parsed JSONL record, or None for blank/malformed lines — a
     live, half-written tail must never crash a reader. The ONE
@@ -605,6 +721,40 @@ def main(argv=None) -> int:
     fl.add_argument("--interval", type=float, default=2.0,
                     help="seconds between --follow polls")
 
+    pr = sub.add_parser(
+        "profile",
+        help="continuous-profiler export from a live component's GET "
+        "/v1/debug/profile: per-segment p50/p95 summary rows, or "
+        "--out trace.json for a Perfetto-loadable Chrome trace-event "
+        "timeline (rounds + engine events + tracer spans interleaved)",
+    )
+    pr.add_argument("--url", required=True,
+                    help="live base url (tpuslice-serve replica, "
+                         "router, probe port, or telemetry server)")
+    pr.add_argument("--out", default="",
+                    help="write Chrome trace-event JSON here (open in "
+                         "Perfetto / chrome://tracing) instead of "
+                         "printing the segment summary")
+    pr.add_argument("-n", type=int, default=512, dest="last",
+                    metavar="N",
+                    help="how many recent rounds/events/spans to "
+                         "export (default 512, bounded by the rings)")
+
+    wf = sub.add_parser(
+        "waterfall",
+        help="one request's latency waterfall (queue → admission → "
+        "prefill → decode/spec rounds → preempt/resume → finish), "
+        "stitched from round records + journal + trace by rid or "
+        "trace id (GET /v1/debug/profile?rid=...)",
+    )
+    wf.add_argument("rid",
+                    help="engine request id (the integer in stream "
+                         "payloads) or a trace id (X-Trace-Id header)")
+    wf.add_argument("--url", required=True,
+                    help="the serving replica's base url")
+    wf.add_argument("--json", action="store_true", dest="as_json",
+                    help="raw payload instead of the ASCII waterfall")
+
     de = sub.add_parser(
         "describe",
         help="one object's merged control-plane timeline: Kubernetes "
@@ -777,6 +927,12 @@ def main(argv=None) -> int:
             return _fleet_cmd(args)
         except KeyboardInterrupt:
             return 0  # --follow's advertised stop path, not a crash
+
+    if args.cmd == "profile":
+        return _profile_cmd(args)
+
+    if args.cmd == "waterfall":
+        return _waterfall_cmd(args)
 
     if args.cmd == "describe" and args.kind == "locks":
         import urllib.request

@@ -230,6 +230,8 @@ class ServingMetrics:
             self.spec_proposed = _NoopMetric()
             self.spec_accepted = _NoopMetric()
             self.spec_acceptance = _NoopMetric()
+            self.profile_rounds = _NoopMetric()
+            self.round_segment_seconds = _NoopMetric()
             self.registry = None
             return
         self.registry = registry or CollectorRegistry()
@@ -441,6 +443,28 @@ class ServingMetrics:
             "Per-round draft acceptance rate (accepted / proposed)",
             buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
                      1.0),
+            registry=self.registry,
+        )
+        # --- continuous profiler (obs/profiler.py, docs/
+        # OBSERVABILITY.md "Profiling") --- only populated while
+        # profiling is armed (TPUSLICE_PROFILE=1 / --profile); the
+        # round count reconciles exactly with the scheduler's
+        # rounds_total ledger and the profiler ring's recorded count
+        self.profile_rounds = Counter(
+            "tpuslice_serve_profile_rounds_total",
+            "Scheduler rounds recorded by the armed profiler",
+            registry=self.registry,
+        )
+        # segment ∈ admission | resume | preempt | prefill | dispatch
+        # | readback | host — one observation per segment per recorded
+        # round (the per-round segment sums; a round's segments sum to
+        # at most its wall time)
+        self.round_segment_seconds = Histogram(
+            "tpuslice_serve_round_segment_seconds",
+            "Per-round scheduler time by anatomy segment (armed only)",
+            ["segment"],
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                     0.01, 0.025, 0.05, 0.1, 0.25, 1),
             registry=self.registry,
         )
 

@@ -75,6 +75,7 @@ from instaslice_tpu.obs.journal import (
     debug_events_payload,
     get_journal,
 )
+from instaslice_tpu.obs.profiler import debug_profile_payload
 from instaslice_tpu.utils.guards import guarded_by
 from instaslice_tpu.utils.lockcheck import named_lock
 from instaslice_tpu.utils.trace import debug_trace_payload, get_tracer
@@ -145,6 +146,24 @@ def metric_by_label(samples: Dict[Tuple[str, frozenset], float],
         d = dict(labels)
         if label in d:
             out[d[label]] = out.get(d[label], 0.0) + v
+    return out
+
+
+def merge_profile_summaries(summaries: List[dict]) -> Dict[str, dict]:
+    """Conservative fleet merge of per-replica profiler segment
+    summaries (obs/profiler.py ``segment_summary`` shape): counts sum;
+    p50/p95/max take the max across replicas — a percentile of
+    percentiles is not a percentile, so the fleet view reports the
+    honest upper bound per segment instead of a fabricated quantile."""
+    out: Dict[str, dict] = {}
+    for summ in summaries:
+        for name, row in (summ or {}).items():
+            cur = out.setdefault(name, {
+                "count": 0, "p50Ms": 0.0, "p95Ms": 0.0, "maxMs": 0.0,
+            })
+            cur["count"] += int(row.get("count", 0) or 0)
+            for k in ("p50Ms", "p95Ms", "maxMs"):
+                cur[k] = max(cur[k], float(row.get(k, 0.0) or 0.0))
     return out
 
 
@@ -652,12 +671,15 @@ class FleetAggregator:
         class_served: Dict[str, float] = {}
         class_missed: Dict[str, float] = {}
         kv_free = kv_total = 0.0
+        profile_summaries: List[dict] = []
+        profile_armed = 0
 
         for url in replicas:
             samples = self._scrape_exposition(url)
             stats = self._scrape_json(url, "/v1/stats")
             trace = self._scrape_json(url, "/v1/debug/trace?n=512")
             events = self._scrape_json(url, "/v1/debug/events?n=1000")
+            profile = self._scrape_json(url, "/v1/debug/profile?n=1")
             alive = samples is not None or stats is not None
             per_replica[url] = {
                 "ok": alive,
@@ -693,6 +715,10 @@ class FleetAggregator:
                 self.stitcher.ingest_debug_payload(trace)
             if events is not None:
                 self._ingest_events(events.get("events") or [])
+            if profile is not None:
+                if profile.get("armed"):
+                    profile_armed += 1
+                profile_summaries.append(profile.get("segments") or {})
 
         router_trace = router_events = None
         if self.router_url:
@@ -806,6 +832,14 @@ class FleetAggregator:
             },
             "traces": len(self.stitcher.trace_ids()),
             "scrapes": dict(self._scrapes),
+            # fleet-merged profiler rollup: only replicas serving
+            # GET /v1/debug/profile contribute; disarmed replicas
+            # contribute empty summaries (armed_replicas says how many
+            # actually record)
+            "profile": {
+                "armed_replicas": profile_armed,
+                "segments": merge_profile_summaries(profile_summaries),
+            },
         }
         with self._lock:
             self._fleet = fleet
@@ -905,6 +939,15 @@ class _TelemetryHandler(BaseHTTPRequestHandler):
                 self._send(200, debug_events_payload(qs))
             except ValueError as e:
                 self._send(400, {"error": str(e)})
+        elif self.path.startswith("/v1/debug/profile"):
+            # debug parity with replicas/router/probes: the telemetry
+            # process's OWN profiler ring (fleet rollup is /v1/fleet)
+            try:
+                self._send(200, debug_profile_payload(qs))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except LookupError as e:
+                self._send(404, {"error": str(e)})
         else:
             self._send(404, {"error": f"no route {self.path}"})
 

@@ -62,6 +62,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from instaslice_tpu.obs.journal import debug_events_payload
+from instaslice_tpu.obs.profiler import (
+    debug_profile_payload,
+    get_profiler,
+)
 from instaslice_tpu.utils.lockcheck import debug_locks_payload
 from instaslice_tpu.serving.engine import ServingEngine
 from instaslice_tpu.serving.scheduler import (
@@ -189,6 +193,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._debug_trace()
         elif self.path.startswith("/v1/debug/events"):
             self._debug_events()
+        elif self.path.startswith("/v1/debug/profile"):
+            self._debug_profile()
         elif self.path.startswith("/v1/debug/locks"):
             # lockcheck's live view (utils/lockcheck.py): per-thread
             # held locks, the acquisition-order graph, long holds —
@@ -279,6 +285,25 @@ class _Handler(BaseHTTPRequestHandler):
             payload = debug_events_payload(qs)
         except ValueError as e:
             self._send(400, {"error": str(e)})
+            return
+        self._send(200, payload)
+
+    def _debug_profile(self) -> None:
+        """``GET /v1/debug/profile``: the continuous profiler's live
+        view (obs/profiler.py) — armed state, per-segment p50/p95
+        summaries, recent round records and timeline events; ``?n=``
+        bounds the recent lists (default 20) and ``?rid=X`` returns
+        one request's latency waterfall (engine rid or trace id)."""
+        qs = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query
+        )
+        try:
+            payload = debug_profile_payload(qs)
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        except LookupError as e:
+            self._send(404, {"error": str(e)})
             return
         self._send(200, payload)
 
@@ -1053,6 +1078,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="multi-host grants: TCP port for the driver/"
                          "follower op stream (worker 0 serves HTTP and "
                          "broadcasts; other workers replay)")
+    ap.add_argument("--profile", action="store_true",
+                    help="arm the continuous profiler (round anatomy "
+                         "ring + engine timeline events; GET "
+                         "/v1/debug/profile, tpuslice profile/"
+                         "waterfall). Equivalent to TPUSLICE_PROFILE=1; "
+                         "overhead is bounded by the profile-smoke "
+                         "gate (docs/OBSERVABILITY.md \"Profiling\")")
     return ap
 
 
@@ -1235,6 +1267,10 @@ def main(argv=None) -> int:
     except TpuBusyError as e:
         log.error("%s", e)
         return 3
+    if args.profile:
+        # arm BEFORE build_engine so warm_* compiles land inside the
+        # CompileWatch baseline, not as CompileObserved noise
+        get_profiler().arm()
     engine = build_engine(args)
     mesh, quantized = engine.mesh, args.quantize
     if args.from_env:

@@ -89,6 +89,7 @@ from instaslice_tpu.serving.sampling import (
     speculative_accept,
     token_logprob,
 )
+from instaslice_tpu.obs.profiler import get_profiler
 from instaslice_tpu.utils.trace import get_tracer
 
 log = logging.getLogger("instaslice_tpu.serving.engine")
@@ -423,6 +424,13 @@ class ServingEngine:
         #: drains it first so engine state can never be touched with a
         #: block half-landed
         self._pending_block: Optional[dict] = None
+        #: time.monotonic() stamp of the most recent dispatch's
+        #: device_get landing (decode_block_finish / spec_step_finish /
+        #: step).  The scheduler anchors its dispatch-gap accounting
+        #: here instead of "after finish() returned" so host
+        #: bookkeeping inside finish (chain stitching, EMA ladder,
+        #: _sync_tables) is charged to the host, not the device.
+        self.last_dispatch_landed: Optional[float] = None
 
         self.draft_model = draft_model
         self.spec_k = spec_k
@@ -1585,6 +1593,7 @@ class ServingEngine:
         # an in-flight block's outputs died with the old cache's lineage
         self._pending_block = None
         self._pending_spec = None
+        self.last_dispatch_landed = None
         lost = [r.request_id for r in self.slots.values()]
         for rid in lost:
             self._release_table(rid)
@@ -2530,6 +2539,7 @@ class ServingEngine:
         # one combined host round-trip (int(toks[slot]) per slot would
         # sync the device once per live slot)
         toks_h, lps_h = jax.device_get((toks, lps))
+        self.last_dispatch_landed = time.monotonic()
         out: Dict[int, int] = {}
         for slot, req in list(self.slots.items()):
             t = int(toks_h[slot])
@@ -2655,6 +2665,10 @@ class ServingEngine:
             "toks": toks, "lps": lps, "n_steps": n_steps,
             "batch": len(self.slots), "t0": time.perf_counter(),
         }
+        get_profiler().event(
+            "dispatch", "decode_block",
+            n_steps=n_steps, batch=len(self.slots),
+        )
         return True
 
     def decode_block_finish(self) -> Dict[int, List[int]]:
@@ -2669,6 +2683,12 @@ class ServingEngine:
         # single host round-trip for the block's tokens AND logprobs
         block, block_lp = jax.device_get((pending["toks"],
                                           pending["lps"]))
+        self.last_dispatch_landed = time.monotonic()
+        get_profiler().event(
+            "readback", "decode_block",
+            dur_ms=(time.perf_counter() - pending["t0"]) * 1e3,
+            n_steps=pending["n_steps"], batch=pending["batch"],
+        )
         out: Dict[int, List[int]] = {}
         for slot, req in list(self.slots.items()):
             seq = [int(t) for t in block[:, slot]]
@@ -2841,6 +2861,9 @@ class ServingEngine:
             "accepted": accepted, "out": out, "lps": lps, "k": k,
             "batch": len(self.slots), "t0": time.perf_counter(),
         }
+        get_profiler().event(
+            "dispatch", "spec_round", k=k, batch=len(self.slots),
+        )
         return True
 
     def spec_step_finish(self) -> Dict[int, List[int]]:
@@ -2855,6 +2878,12 @@ class ServingEngine:
         self._pending_spec = None
         a_h, out_h, lp_h = jax.device_get(
             (pending["accepted"], pending["out"], pending["lps"])
+        )
+        self.last_dispatch_landed = time.monotonic()
+        get_profiler().event(
+            "readback", "spec_round",
+            dur_ms=(time.perf_counter() - pending["t0"]) * 1e3,
+            k=pending["k"], batch=pending["batch"],
         )
         k = pending["k"]
         out: Dict[int, List[int]] = {}

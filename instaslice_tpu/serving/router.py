@@ -72,6 +72,10 @@ from instaslice_tpu.api.constants import (
 from instaslice_tpu.faults.netchaos import get_nemesis
 from instaslice_tpu.kube.real import CircuitBreaker, CircuitOpen
 from instaslice_tpu.obs.journal import debug_events_payload, get_journal
+from instaslice_tpu.obs.profiler import (
+    debug_profile_payload,
+    get_profiler,
+)
 from instaslice_tpu.serving.kvcache import granule_hash
 from instaslice_tpu.utils.guards import guarded_by, unguarded
 from instaslice_tpu.utils.lockcheck import debug_locks_payload, named_lock
@@ -342,6 +346,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._send(200, debug_events_payload(qs))
             except ValueError as e:
                 self._send(400, {"error": str(e)})
+        elif self.path.startswith("/v1/debug/profile"):
+            # router-side profiler ring: proxy/migration lane events
+            # (no scheduler rounds — the replicas own those)
+            qs = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query
+            )
+            try:
+                self._send(200, debug_profile_payload(qs))
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except LookupError as e:
+                self._send(404, {"error": str(e)})
         elif self.path.startswith("/v1/debug/locks"):
             self._send(200, debug_locks_payload())
         elif self.path.rstrip("/").startswith("/v1/models"):
@@ -524,6 +540,12 @@ class _ProxyContext:
                 "router.route", (time.perf_counter() - t0) * 1e3,
                 trace_id=self.trace_id, replica=rep.url,
                 policy=policy, attempt=attempt,
+            )
+            get_profiler().event(
+                "proxy", "route",
+                dur_ms=(time.perf_counter() - t0) * 1e3,
+                replica=rep.url, policy=policy, attempt=attempt,
+                trace_id=self.trace_id,
             )
             self.r.count_routed(policy)
             self.tried.append(rep.url)
@@ -717,6 +739,12 @@ class _ProxyContext:
                 dest=dest.url, mode="resume",
                 tokens_in=len(blob.get("generated", [])),
             )
+            get_profiler().event(
+                "migrate", "resume",
+                dur_ms=(time.perf_counter() - t0) * 1e3,
+                source=source.url, dest=dest.url,
+                trace_id=self.trace_id,
+            )
             self.r.count_migration("resumed")
             self.r.note_migrated_trace(self.trace_id)
             self.tried.append(dest.url)
@@ -802,6 +830,12 @@ class _ProxyContext:
                 trace_id=self.trace_id, source=source.url,
                 dest=dest.url, mode="reprefill",
                 tokens_in=len(generated),
+            )
+            get_profiler().event(
+                "migrate", "reprefill",
+                dur_ms=(time.perf_counter() - t0) * 1e3,
+                source=source.url, dest=dest.url,
+                trace_id=self.trace_id,
             )
             self.r.count_migration("fallback")
             with resp:

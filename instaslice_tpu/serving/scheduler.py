@@ -65,6 +65,7 @@ import uuid
 from typing import Dict, List, Optional
 
 from instaslice_tpu.api.constants import (
+    REASON_COMPILE_OBSERVED,
     REASON_DRAIN_BEGIN,
     REASON_DRAIN_END,
     REASON_DRAINED,
@@ -77,6 +78,11 @@ from instaslice_tpu.api.constants import (
 )
 from instaslice_tpu.faults import maybe_crash
 from instaslice_tpu.obs.journal import get_journal
+from instaslice_tpu.obs.profiler import (
+    NOOP_TIMER,
+    CompileWatch,
+    get_profiler,
+)
 from instaslice_tpu.utils.guards import guarded_by, unguarded
 from instaslice_tpu.serving.engine import (
     AdmissionRequest,
@@ -328,6 +334,14 @@ class Scheduler(threading.Thread):
         "single float written by drain() then read by the run loop; "
         "GIL-atomic, and draining.is_set() orders the handoff"
     )
+    rounds_total: unguarded("scheduler-thread ledger counter (dispatch "
+                            "rounds; the profiler ring reconciles "
+                            "against it)")
+    _round_timer: unguarded("scheduler-thread owned: the in-flight "
+                            "round's anatomy timer (NOOP when the "
+                            "profiler is disarmed)")
+    _compile_watch: unguarded("scheduler-thread owned: polled at round "
+                              "end only")
 
     def __init__(self, engine: ServingEngine, block_size: int = 16,
                  metrics=None, max_queue: int = 0,
@@ -458,6 +472,21 @@ class Scheduler(threading.Thread):
         #: same delta discipline for the speculative-decoding ledger
         self._spec_exported = {"rounds": 0, "proposed": 0,
                                "accepted": 0}
+        # ---- continuous profiler (obs/profiler.py, docs/
+        # OBSERVABILITY.md "Profiling") ----
+        self.profiler = get_profiler()
+        #: dispatch rounds executed (idle wait-loops excluded) — the
+        #: ledger the profiler ring + profile_rounds metric reconcile
+        #: against
+        self.rounds_total = 0
+        #: the CURRENT round's anatomy timer; _admit_one/_admit_batch
+        #: charge their prefill segments through it. NOOP between
+        #: rounds and whenever the profiler is disarmed.
+        self._round_timer = NOOP_TIMER
+        #: mid-traffic jit-compile detector (CompileObserved journal
+        #: reason); baselined against the warm_* caches, grace-windowed
+        #: for the lazy first-dispatch compiles
+        self._compile_watch = CompileWatch(engine)
 
     @property
     def _head(self) -> Optional[Pending]:
@@ -877,63 +906,81 @@ class Scheduler(threading.Thread):
         eng = self.engine
         if self.fault_hook is not None:
             self.fault_hook()   # may raise (injected); run() recovers
+        # round-anatomy timer (obs/profiler.py): NOOP unless the
+        # profiler is armed; _admit_one/_admit_batch charge prefill
+        # time through self._round_timer
+        pt = self.profiler.round_timer()
+        self._round_timer = pt
         # migration control ops first, drain rounds included: a
         # drain-with-migrate exports exactly while draining
-        self._run_control()
-        self._sweep_stale_imports()
+        with pt.seg("host"):
+            self._run_control()
+            self._sweep_stale_imports()
         if self.draining.is_set():
             # no admission; shed the queue, enforce the drain budget.
             # Parked preemptees are IN-FLIGHT work: the drain budget is
             # theirs too, so resume them into freeing slots instead of
             # letting resumable KV sit until the deadline 503
-            self._shed_queued()
+            with pt.seg("admission"):
+                self._shed_queued()
             if self.mode == "continuous":
-                self._resume_parked()
+                with pt.seg("resume"):
+                    self._resume_parked()
             if time.monotonic() >= self.drain_deadline:
                 self._evict_for_drain()
             if not self._by_rid:
                 self.drained.set()
         else:
-            self._pump()
-            self._bind_resumes()
-            self._sweep_timeouts()
+            with pt.seg("host"):
+                self._pump()
+                self._bind_resumes()
+                self._sweep_timeouts()
             if self.mode == "continuous":
-                self._resume_parked()
-                self._relieve_block_pressure()
-                self._maybe_preempt()
+                with pt.seg("resume"):
+                    self._resume_parked()
+                with pt.seg("preempt"):
+                    self._relieve_block_pressure()
+                    self._maybe_preempt()
             elif self._parked:
                 # fixed mode never preempts, but migrated-in sessions
                 # park on arrival and must still resume on the baseline
-                self._resume_parked()
-            self._admit()
-        # evict abandoned requests: the HTTP layer already 503'd the
-        # client, so decoding the slot to its budget would burn
-        # batch capacity producing tokens nobody reads
-        for slot, req in list(eng.slots.items()):
-            p = self._by_rid.get(req.request_id)
-            if p is not None and p.timed_out:
-                eng.evict_slot(slot)
-                self._by_rid.pop(req.request_id, None)
-                self._budget.pop(req.request_id, None)
-                self._maybe_complete(p)
-        for rid, p in list(self._parked.items()):
-            if p.timed_out:
-                self._drop_parked(rid, p, "timed out while parked")
-        # budget enforcement BEFORE decoding (add_request already
-        # produced one token, so a max_tokens=1 arrival is done on
-        # admission — decoding first would waste a batch-wide step
-        # whose tokens get truncated away; same ordering rationale
-        # as ServingEngine.generate())
-        for slot, req in list(eng.slots.items()):
-            b = self._budget.get(req.request_id)
-            if b is not None and len(req.generated) >= b:
-                eng.finish_slot(slot, n_keep=b)
-        self._deliver()
-        self._export_kv_gauges()
+                with pt.seg("resume"):
+                    self._resume_parked()
+            with pt.seg("admission"):
+                self._admit()
+        with pt.seg("host"):
+            # evict abandoned requests: the HTTP layer already 503'd
+            # the client, so decoding the slot to its budget would burn
+            # batch capacity producing tokens nobody reads
+            for slot, req in list(eng.slots.items()):
+                p = self._by_rid.get(req.request_id)
+                if p is not None and p.timed_out:
+                    eng.evict_slot(slot)
+                    self._by_rid.pop(req.request_id, None)
+                    self._budget.pop(req.request_id, None)
+                    self._maybe_complete(p)
+            for rid, p in list(self._parked.items()):
+                if p.timed_out:
+                    self._drop_parked(rid, p, "timed out while parked")
+            # budget enforcement BEFORE decoding (add_request already
+            # produced one token, so a max_tokens=1 arrival is done on
+            # admission — decoding first would waste a batch-wide step
+            # whose tokens get truncated away; same ordering rationale
+            # as ServingEngine.generate())
+            for slot, req in list(eng.slots.items()):
+                b = self._budget.get(req.request_id)
+                if b is not None and len(req.generated) >= b:
+                    eng.finish_slot(slot, n_keep=b)
+            self._deliver()
+            self._export_kv_gauges()
         if not eng.slots:
             self._last_dispatch_end = None   # no dispatch to gap against
+            # idle wait-loop, not a dispatch round: drop the timer so
+            # quiesced serving leaks zero ring entries
+            self._round_timer = NOOP_TIMER
             self.stop_flag.wait(0.005)
             return
+        self.rounds_total += 1
         n = self._select_steps()
         spec = eng.draft_model is not None
         phase = "spec" if spec else "decode"
@@ -958,25 +1005,34 @@ class Scheduler(threading.Thread):
                     # same seam as decode_block_start/finish: the
                     # draft+verify chain computes (and its outputs
                     # stream back) while the host pumps the queue
-                    eng.spec_step_start(k=spec_k)
-                    self._overlap_host_work()
-                    eng.spec_step_finish()
+                    with pt.seg("dispatch"):
+                        eng.spec_step_start(k=spec_k)
+                    with pt.seg("host"):
+                        self._overlap_host_work()
+                    self._finish_dispatch(pt, eng.spec_step_finish)
                 else:
-                    eng.spec_step(k=spec_k)
+                    self._finish_dispatch(
+                        pt, lambda: eng.spec_step(k=spec_k),
+                        seg="dispatch",
+                    )
             elif n >= 1:
                 if use_overlap:
                     # host/device overlap: the block computes (and its
                     # token copy streams back) while the host does the
                     # next round's queue-pump/timeout planning — then
                     # block on the tokens
-                    eng.decode_block_start(n)
-                    self._overlap_host_work()
-                    eng.decode_block_finish()
+                    with pt.seg("dispatch"):
+                        eng.decode_block_start(n)
+                    with pt.seg("host"):
+                        self._overlap_host_work()
+                    self._finish_dispatch(pt, eng.decode_block_finish)
                 else:
-                    eng.decode_block(n)
+                    self._finish_dispatch(
+                        pt, lambda: eng.decode_block(n),
+                        seg="dispatch",
+                    )
             else:
-                eng.step()
-            self._last_dispatch_end = time.monotonic()
+                self._finish_dispatch(pt, eng.step, seg="dispatch")
         except Exception as e:  # noqa: BLE001 - recover, keep serving
             log.exception("decode failed: %s", e)
             self._last_dispatch_end = None
@@ -991,7 +1047,75 @@ class Scheduler(threading.Thread):
                 phase, time.monotonic() - t_step,
                 spec_k + 1 if spec else n, round_rids,
             )
+            self._finish_profile_round(pt, phase, spec, spec_k, n,
+                                       round_rids)
+            self._round_timer = NOOP_TIMER
         self._deliver()
+
+    def _finish_dispatch(self, pt, fn, seg: str = "readback") -> None:
+        """Run the blocking half of an engine dispatch and split its
+        wall time at the device_get landing (engine
+        ``last_dispatch_landed``): device-bound time goes to ``seg``,
+        the host bookkeeping AFTER the tokens landed (chain stitching,
+        spec EMA/ladder, _sync_tables) goes to ``host``. The landing —
+        not fn's return — also anchors ``_last_dispatch_end``, so
+        dispatch_gap_seconds measures true device idleness on the
+        decode AND spec paths alike."""
+        eng = self.engine
+        t0 = time.monotonic()
+        fn()
+        t1 = time.monotonic()
+        landed = eng.last_dispatch_landed
+        if landed is None or not (t0 <= landed <= t1):
+            landed = t1   # no readback this call (e.g. empty slots)
+        pt.add(seg, t0, landed - t0)
+        pt.add("host", landed, t1 - landed)
+        self._last_dispatch_end = landed
+
+    def _finish_profile_round(self, pt, phase: str, spec: bool,
+                              spec_k: int, n: int,
+                              round_rids: List[int]) -> None:
+        """Close the round's anatomy record into the profiler ring
+        (armed rounds only), feed the per-segment histograms, then poll
+        the compile watch — a mid-traffic jit compile journals itself
+        with this round's dispatch shape key."""
+        pt.note(
+            batch=len(round_rids),
+            n_steps=(spec_k + 1 if spec else n),
+            k=spec_k,
+            rids=list(round_rids),
+            trace_ids=[
+                (p.trace_id if (p := self._by_rid.get(r)) is not None
+                 else "")
+                for r in round_rids
+            ],
+        )
+        rec = self.profiler.finish_round(pt, phase=phase)
+        if rec is not None:
+            self.metrics.profile_rounds.inc()
+            for name, total_ms in rec.seg_totals().items():
+                self.metrics.round_segment_seconds.labels(
+                    segment=name
+                ).observe(total_ms / 1e3)
+        shape_key = (f"phase={phase} k={spec_k}" if spec
+                     else f"phase={phase} n_steps={n}")
+        for c in self._compile_watch.check():
+            get_journal().emit(
+                "scheduler",
+                reason=REASON_COMPILE_OBSERVED,
+                object_ref=c["program"],
+                message=(f"jit program {c['program']} compiled "
+                         f"mid-traffic ({shape_key}, "
+                         f"{c['wall_ms']:.0f} ms compile wall)"),
+                program=c["program"],
+                shape_key=shape_key,
+                wall_ms=c["wall_ms"],
+                count=c["count"],
+            )
+            self.profiler.event(
+                "compile", c["program"], dur_ms=c["wall_ms"],
+                shape_key=shape_key, count=c["count"],
+            )
 
     def _observe_dispatch_gap(self, t_dispatch: float) -> None:
         """Device-idle seam between consecutive engine dispatches: all
@@ -1373,10 +1497,11 @@ class Scheduler(threading.Thread):
                     start=p.t0_wall,
                 )
         try:
-            rid_lists = eng.add_requests([
-                AdmissionRequest(p.prompt, p.n, p.stop, p.adapter)
-                for p in batch
-            ])
+            with self._round_timer.seg("prefill"):
+                rid_lists = eng.add_requests([
+                    AdmissionRequest(p.prompt, p.n, p.stop, p.adapter)
+                    for p in batch
+                ])
         except Exception as e:  # noqa: BLE001 - keep serving
             # the all-or-nothing burst failed (device error, injected
             # fault): recover any poisoned cache, then retry each
@@ -1400,6 +1525,8 @@ class Scheduler(threading.Thread):
         # admission prefill IS an engine dispatch: anchor the gap here
         # or the whole burst's device compute would read as host idle
         self._last_dispatch_end = time.monotonic()
+        self._compile_watch.mark_traffic()
+        self._round_timer.bump("admitted", len(batch))
         self.metrics.step_seconds.labels(phase="prefill").observe(dt)
         self.metrics.phase_seconds.labels(phase="prefill").inc(dt)
         self._drain_prefill_occupancy()
@@ -1468,7 +1595,7 @@ class Scheduler(threading.Thread):
                 "serve.prefill", trace_id=p.trace_id or None,
                 parent_id=p.span_id or None,
                 tokens=len(p.prompt), n=p.n,
-            ):
+            ), self._round_timer.seg("prefill"):
                 rids = eng.add_request_n(p.prompt, p.n,
                                          stop=p.stop,
                                          adapter=p.adapter)
@@ -1476,6 +1603,8 @@ class Scheduler(threading.Thread):
             p.first_token_at = time.monotonic()
             # admission prefill is an engine dispatch (gap anchor)
             self._last_dispatch_end = p.first_token_at
+            self._compile_watch.mark_traffic()
+            self._round_timer.bump("admitted")
             self.metrics.step_seconds.labels(
                 phase="prefill"
             ).observe(dt_admit)
@@ -1990,6 +2119,15 @@ class Scheduler(threading.Thread):
             "kv": eng.kv_stats(),
             "tenant_classes": {
                 name: s.tenant_class for name, s in self.tenants.items()
+            },
+            # continuous-profiler ledger: rounds_total counts every
+            # dispatch round; armed rounds land in the profiler ring
+            # (rounds_recorded) — equal while armed from round 0
+            "profile": {
+                "armed": self.profiler.armed,
+                "rounds_total": self.rounds_total,
+                "rounds_recorded": self.profiler.rounds_recorded,
+                "events_recorded": self.profiler.events_recorded,
             },
         }
         return out

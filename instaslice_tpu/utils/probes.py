@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 from instaslice_tpu.obs.journal import debug_events_payload
+from instaslice_tpu.obs.profiler import debug_profile_payload
 from instaslice_tpu.utils.lockcheck import debug_locks_payload
 from instaslice_tpu.utils.trace import debug_trace_payload
 
@@ -52,6 +53,9 @@ class ProbeServer:
                             code, payload = 200, debug_trace_payload(qs)
                         elif self.path.startswith("/v1/debug/events"):
                             code, payload = 200, debug_events_payload(qs)
+                        elif self.path.startswith("/v1/debug/profile"):
+                            code = 200
+                            payload = debug_profile_payload(qs)
                         elif self.path.startswith("/v1/debug/locks"):
                             code, payload = 200, debug_locks_payload(qs)
                         else:

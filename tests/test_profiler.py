@@ -1,0 +1,384 @@
+"""Continuous profiler: round-timer/ring mechanics, CompileWatch grace
+semantics, the scheduler's profiler ledger reconciling with its own
+round counter and the profile_rounds metric, Chrome trace-event export
+validity, and per-request waterfalls across every terminal outcome
+(docs/OBSERVABILITY.md "Profiling")."""
+
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from instaslice_tpu.api.constants import (
+    REASON_DRAINED,
+    REASON_SESSION_EXPORTED,
+    REASON_SHED,
+)
+from instaslice_tpu.metrics.metrics import ServingMetrics, render
+from instaslice_tpu.models.lm import ModelConfig, TpuLM
+from instaslice_tpu.obs.journal import Journal, get_journal, reset_journal
+from instaslice_tpu.obs.profiler import (
+    NOOP_TIMER,
+    SEGMENTS,
+    CompileWatch,
+    Profiler,
+    RoundTimer,
+    chrome_trace,
+    debug_profile_payload,
+    get_profiler,
+    reset_profiler,
+    waterfall_payload,
+)
+from instaslice_tpu.serving import ServingEngine
+from instaslice_tpu.serving.api_server import ApiServer
+from instaslice_tpu.utils.trace import Tracer, reset_tracer
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = ModelConfig(
+        vocab_size=64, d_model=32, n_heads=2, n_layers=2, d_ff=64,
+        dtype=jnp.float32, remat=False,
+    )
+    m = TpuLM(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+@pytest.fixture(autouse=True)
+def fresh_rings():
+    reset_profiler()
+    reset_tracer()
+    reset_journal()
+    yield
+    reset_profiler()
+    reset_tracer()
+    reset_journal()
+
+
+def post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        f"{url}/v1/completions", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def seg_sum_ms(rec) -> float:
+    return sum(d for _n, _s, d in rec.segs)
+
+
+class TestRoundTimer:
+    def test_segments_bounded_by_wall(self):
+        p = Profiler(armed=True)
+        t = p.round_timer()
+        with t.seg("admission"):
+            time.sleep(0.002)
+            with t.seg("prefill"):    # nested: parent excludes child
+                time.sleep(0.002)
+        with t.seg("dispatch"):
+            time.sleep(0.004)
+        mark = time.monotonic()
+        time.sleep(0.001)
+        t.add("readback", mark, time.monotonic() - mark)
+        rec = p.finish_round(t, phase="decode")
+        assert rec is not None
+        # the segment ledger can never exceed the round wall (each
+        # segment is a sub-interval of [t0, finish]; rounding is ms/3)
+        assert seg_sum_ms(rec) <= rec.wall_ms + 0.01 * len(rec.segs)
+        totals = rec.seg_totals()
+        assert totals["dispatch"] >= 3.0
+        assert set(totals) <= set(SEGMENTS)
+
+    def test_add_skips_nonpositive(self):
+        t = RoundTimer()
+        t.add("readback", time.monotonic(), 0.0)
+        t.add("readback", time.monotonic(), -1.0)
+        assert t.segs == []
+
+    def test_note_and_bump(self):
+        t = RoundTimer()
+        t.note(batch=3, rids=[7])
+        t.bump("admitted")
+        t.bump("admitted", 2)
+        assert t.meta == {"batch": 3, "rids": [7], "admitted": 3}
+
+    def test_noop_timer_records_nothing(self):
+        p = Profiler(armed=False)
+        with NOOP_TIMER.seg("dispatch"):
+            pass
+        NOOP_TIMER.add("host", 0.0, 1.0)
+        NOOP_TIMER.bump("admitted")
+        assert p.finish_round(NOOP_TIMER, phase="decode") is None
+        assert p.rounds_recorded == 0 and p.rounds() == []
+
+    def test_disarmed_round_timer_is_shared_noop(self):
+        p = Profiler(armed=False)
+        assert p.round_timer() is NOOP_TIMER
+        p.arm()
+        assert p.round_timer() is not NOOP_TIMER
+        p.disarm()
+        assert p.round_timer() is NOOP_TIMER
+
+
+class TestProfilerRing:
+    def test_capacity_bound_and_counters(self):
+        p = Profiler(capacity=16, armed=True)
+        for i in range(40):
+            t = p.round_timer()
+            t.note(i=i)
+            p.finish_round(t, phase="decode")
+        assert p.rounds_recorded == 40
+        assert len(p.rounds()) == 16     # ring bounded
+        assert p.rounds()[-1].meta["i"] == 39
+        for i in range(40):
+            p.event("dispatch", "decode_block", n_steps=4)
+        assert p.events_recorded == 40
+        assert len(p.events()) == 16
+        p.clear()
+        assert p.rounds() == [] and p.events() == []
+        # counters survive clear: they are ledgers, not ring views
+        assert p.rounds_recorded == 40
+
+    def test_event_disarmed_is_noop(self):
+        p = Profiler(armed=False)
+        p.event("dispatch", "decode_block")
+        assert p.events_recorded == 0
+
+
+class _FakeCompileEngine:
+    def __init__(self):
+        self.programs = {"_decode": 1}
+
+    def compiled_programs(self):
+        return dict(self.programs)
+
+
+class TestCompileWatch:
+    def test_silent_before_traffic(self):
+        eng = _FakeCompileEngine()
+        w = CompileWatch(eng, grace=0.0)
+        eng.programs["_decode"] = 5
+        assert w.check() == []       # warm window: never reported
+
+    def test_growth_after_grace_reported_once(self):
+        eng = _FakeCompileEngine()
+        w = CompileWatch(eng, grace=0.0)
+        w.mark_traffic()
+        eng.programs["_decode"] = 3
+        eng.programs["_prefill_16"] = 1
+        out = w.check()
+        assert [(c["program"], c["count"]) for c in out] == [
+            ("_decode", 2), ("_prefill_16", 1),
+        ]
+        # re-baselined: the same growth is not re-reported
+        assert w.check() == []
+
+    def test_growth_inside_grace_rebaselines_silently(self):
+        eng = _FakeCompileEngine()
+        w = CompileWatch(eng, grace=60.0)
+        w.mark_traffic()
+        eng.programs["_decode_block_8"] = 1   # lazy first dispatch
+        assert w.check() == []
+        # and it stays baselined once the grace window closes
+        w._traffic_t0 -= 120.0
+        assert w.check() == []
+        eng.programs["_decode_block_8"] = 2   # genuine mid-run compile
+        assert [c["program"] for c in w.check()] == ["_decode_block_8"]
+
+
+class TestSchedulerLedger:
+    def test_rounds_reconcile_and_ring_quiesces(self, model):
+        """Armed end to end over HTTP: the profiler ring, the
+        scheduler's rounds_total, and the profile_rounds metric are ONE
+        ledger; idle wait-loops after quiesce leak zero records; every
+        record's segment sum fits its wall; a completed request
+        waterfalls with outcome ok."""
+        m, params = model
+        prof = Profiler(armed=True)
+        reset_profiler(prof)
+        eng = ServingEngine(m, params, max_batch=2, max_len=64,
+                            prefill_len=8, kv_block_size=8)
+        metrics = ServingMetrics()
+        with ApiServer(eng, block_size=4, metrics=metrics,
+                       request_timeout=60) as srv:
+            sched = srv.scheduler
+            assert sched.profiler is prof
+            for i in range(3):
+                code, out = post(srv.url, {"prompt": [1 + i, 2, 3],
+                                           "max_tokens": 4})
+                assert code == 200
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and (
+                eng.slots or sched.queue.qsize()
+            ):
+                time.sleep(0.01)
+            assert not eng.slots
+            settle = prof.rounds_recorded
+            time.sleep(0.2)     # idle wait-loop rounds must not record
+            assert prof.rounds_recorded == settle
+            assert prof.rounds_recorded == sched.rounds_total > 0
+            stats = sched.stats()
+            assert stats["profile"]["armed"] is True
+            assert stats["profile"]["rounds_total"] == sched.rounds_total
+            body = render(metrics)
+            if body:
+                assert (f"tpuslice_serve_profile_rounds_total "
+                        f"{float(sched.rounds_total)}") in body
+                assert "tpuslice_serve_round_segment_seconds" in body
+            for rec in prof.rounds():
+                assert seg_sum_ms(rec) <= rec.wall_ms + 0.01 * len(rec.segs)
+                assert {n for n, _s, _d in rec.segs} <= set(SEGMENTS)
+            # dispatch/readback actually split (satellite: the gap
+            # anchor lands at device_get, not after host bookkeeping)
+            dispatched = [r for r in prof.rounds()
+                          if r.meta.get("batch")]
+            assert dispatched
+            rids = []
+            for rec in dispatched:
+                rids.extend(rec.meta.get("rids") or [])
+            w = waterfall_payload(str(rids[-1]))
+            assert w["outcome"] == "ok"
+            assert any(s["stage"].endswith("round") for s in w["stages"])
+            assert w["rounds"]
+            # the HTTP surface serves the same payload
+            with urllib.request.urlopen(
+                srv.url + f"/v1/debug/profile?rid={rids[-1]}", timeout=5
+            ) as r:
+                assert json.loads(r.read())["traceId"] == w["traceId"]
+
+
+class TestWaterfallOutcomes:
+    """Every terminal outcome stitches: ok, shed, drained,
+    preempted-resumed, migrated."""
+
+    def _rings(self):
+        return Profiler(armed=True), Tracer(), Journal()
+
+    def test_ok(self):
+        p, t, j = self._rings()
+        t.record("serve.queue", 1.0, trace_id="t1", start=100.0)
+        t.record("serve.prefill", 2.0, trace_id="t1", start=100.001)
+        t.record("serve.decode_round", 3.0, trace_id="t1",
+                 start=100.003, phase="decode")
+        t.record("serve.request", 6.0, trace_id="t1", start=100.0,
+                 outcome="ok")
+        w = waterfall_payload("t1", profiler=p, tracer=t, journal=j)
+        assert w["outcome"] == "ok" and w["preemptions"] == 0
+        assert [s["stage"] for s in w["stages"]] == [
+            "queue", "prefill", "decode round"]
+        assert w["totalMs"] == 6.0
+
+    def test_preempted_resumed(self):
+        p, t, j = self._rings()
+        t.record("serve.preempt", 0.5, trace_id="t2", start=100.0)
+        t.record("serve.resume", 0.5, trace_id="t2", start=100.01)
+        t.record("serve.request", 20.0, trace_id="t2", start=100.0,
+                 outcome="ok")
+        w = waterfall_payload("t2", profiler=p, tracer=t, journal=j)
+        assert w["outcome"] == "preempted-resumed"
+        assert w["preemptions"] == 1
+
+    @pytest.mark.parametrize("reason,outcome", [
+        (REASON_SHED, "shed"),
+        (REASON_DRAINED, "drained"),
+        (REASON_SESSION_EXPORTED, "migrated"),
+    ])
+    def test_terminal_journal_outcomes(self, reason, outcome):
+        """No root span recorded (the request never finished on this
+        replica) — the journal's terminal event names the outcome."""
+        p, t, j = self._rings()
+        j.emit("scheduler", reason=reason, object_ref="rid:9",
+               message="x", trace_id="t3")
+        w = waterfall_payload("t3", profiler=p, tracer=t, journal=j)
+        assert w["outcome"] == outcome
+        assert w["markers"][0]["reason"] == reason
+
+    def test_rid_maps_through_round_meta(self):
+        p, t, j = self._rings()
+        timer = p.round_timer()
+        timer.note(rids=[42], trace_ids=["tX"])
+        p.finish_round(timer, phase="decode")
+        t.record("serve.request", 4.0, trace_id="tX", outcome="ok")
+        w = waterfall_payload("42", profiler=p, tracer=t, journal=j)
+        assert w["traceId"] == "tX" and w["outcome"] == "ok"
+        assert len(w["rounds"]) == 1
+
+    def test_unknown_rid_raises(self):
+        p, t, j = self._rings()
+        with pytest.raises(LookupError):
+            waterfall_payload("no-such-request", profiler=p, tracer=t,
+                              journal=j)
+
+
+class TestChromeTrace:
+    def test_structure_lanes_and_clock_shift(self):
+        rounds = [{
+            "idx": 1, "ts": 100.0, "wallMs": 5.0, "phase": "spec",
+            "segs": [["dispatch", 0.5, 3.0], ["host", 3.5, 1.0]],
+            "meta": {"batch": 2},
+        }]
+        events = [
+            {"kind": "readback", "name": "spec_round", "ts": 100.004,
+             "durMs": 3.0, "attrs": {"k": "2"}},
+            {"kind": "dispatch", "name": "spec_round", "ts": 100.0005,
+             "durMs": 0.0, "attrs": {}},
+        ]
+        spans = [{"name": "serve.request", "start": 100.0,
+                  "durationMs": 5.0, "traceId": "t1",
+                  "attrs": {"outcome": "ok"}}]
+        doc = chrome_trace(rounds=rounds, events=events, spans=spans)
+        doc = json.loads(json.dumps(doc))
+        evs = doc["traceEvents"]
+        assert doc["displayTimeUnit"] == "ms"
+        procs = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert {"scheduler", "engine", "serve"} <= procs
+        rnd = next(e for e in evs if e.get("cat") == "round")
+        assert rnd["name"] == "round/spec" and rnd["ph"] == "X"
+        assert rnd["dur"] == 5000.0 and rnd["args"]["batch"] == "2"
+        seg = next(e for e in evs if e.get("cat") == "segment"
+                   and e["name"] == "dispatch")
+        assert seg["ts"] == rnd["ts"] + 500.0 and seg["dur"] == 3000.0
+        # a duration event is stamped at its END: shifted back by dur
+        rb = next(e for e in evs if e.get("cat") == "readback")
+        assert rb["ph"] == "X"
+        assert rb["ts"] == pytest.approx(1000.0, abs=1.0)
+        inst = next(e for e in evs if e.get("cat") == "dispatch")
+        assert inst["ph"] == "i" and "dur" not in inst
+        for e in evs:
+            assert e["ts"] >= 0
+
+    def test_empty_inputs(self):
+        assert chrome_trace()["traceEvents"] == []
+
+
+class TestDebugPayload:
+    def test_default_payload_keys(self):
+        p = Profiler(armed=True)
+        timer = p.round_timer()
+        p.finish_round(timer, phase="decode")
+        p.event("dispatch", "decode_block")
+        out = debug_profile_payload({}, profiler=p)
+        assert out["armed"] is True
+        assert out["rounds"] == 1 and out["events"] == 1
+        assert out["recent"][0]["phase"] == "decode"
+        assert out["recentEvents"][0]["kind"] == "dispatch"
+        assert "round" in out["segments"]
+
+    def test_bad_n_raises_valueerror(self):
+        for bad in (["0"], ["-3"], ["x"]):
+            with pytest.raises(ValueError):
+                debug_profile_payload({"n": bad},
+                                      profiler=Profiler(armed=True))
+
+    def test_process_default_singleton(self):
+        assert get_profiler() is get_profiler()
+        mine = Profiler(armed=True)
+        reset_profiler(mine)
+        assert get_profiler() is mine
+        reset_profiler()
+        assert get_profiler() is not mine

@@ -79,6 +79,36 @@ def lower_is_better(unit: str) -> bool:
     return unit == "seconds"
 
 
+def profile_p95s(record: dict) -> Dict[str, float]:
+    """Per-segment p95 ms learned from any ``profile`` block in the
+    record (bench.py serving arms emit obs/profiler.py
+    ``segment_summary`` under ``"profile"``; the driver may nest arms
+    arbitrarily). The keys are DYNAMIC — a segment added by a later PR
+    starts gating as soon as two records carry it, without this tool
+    changing. When several arms carry profiles, the max per segment is
+    kept (conservative)."""
+    out: Dict[str, float] = {}
+
+    def walk(d: dict) -> None:
+        prof = d.get("profile")
+        if isinstance(prof, dict):
+            for seg, row in prof.items():
+                if not isinstance(row, dict):
+                    continue
+                try:
+                    v = float(row.get("p95Ms"))
+                except (TypeError, ValueError):
+                    continue
+                out[seg] = max(out.get(seg, 0.0), v)
+        for v in d.values():
+            if isinstance(v, dict):
+                walk(v)
+
+    if isinstance(record, dict):
+        walk(record)
+    return out
+
+
 def load_records(root: str) -> Dict[str, List[dict]]:
     """``{tier: [entry, ...]}`` in record-number order. Each entry:
     ``{file, n, metric, value, unit, series}`` (value/metric/unit may
@@ -106,6 +136,7 @@ def load_records(root: str) -> Dict[str, List[dict]]:
                 if isinstance(record, dict) and record.get(k)
                 is not None
             },
+            "profile_p95": profile_p95s(record),
         }
         tiers.setdefault(tier, []).append(entry)
     for entries in tiers.values():
@@ -143,6 +174,33 @@ def check_regressions(tiers: Dict[str, List[dict]],
                 "best_prior": best,
                 "change_pct": round(change * 100, 2),
             })
+    # per-segment round-anatomy gate: a single segment regressing
+    # (e.g. host bookkeeping creeping up) must fail the trend even
+    # when the headline tok/s hides it behind device-time savings
+    for tier, entries in sorted(tiers.items()):
+        with_prof = [e for e in entries if e.get("profile_p95")]
+        if len(with_prof) < 2:
+            continue
+        newest = with_prof[-1]
+        prior = with_prof[:-1]
+        for seg, v in sorted(newest["profile_p95"].items()):
+            prior_vals = [e["profile_p95"][seg] for e in prior
+                          if seg in e["profile_p95"]]
+            if not prior_vals:
+                continue   # a NEW segment has no baseline yet
+            best = min(prior_vals)
+            if best <= 0:
+                continue
+            change = (v - best) / best
+            if change > threshold:
+                out.append({
+                    "tier": tier,
+                    "file": newest["file"],
+                    "metric": f"profile_p95.{seg}",
+                    "value": v,
+                    "best_prior": best,
+                    "change_pct": round(change * 100, 2),
+                })
     return out
 
 

@@ -1,0 +1,271 @@
+"""Continuous-profiler CI smoke (``make profile-smoke``, < 60 s).
+
+Stands up one CPU-sized serving replica and proves the contracts
+docs/OBSERVABILITY.md "Profiling" promises:
+
+1. **Bounded overhead** — the same loadgen workload runs twice in one
+   process (shared jit caches): once with the profiler disarmed, once
+   armed. The armed arm must keep >= 95% of the unprofiled arm's
+   client tokens/sec (retries absorb CPU-scheduler noise and residual
+   cold jit shapes in CI).
+2. **Ledger reconciliation** — over the armed window, the scheduler's
+   ``rounds_total`` delta equals the profiler's ``rounds_recorded``
+   and the ``tpuslice_serve_profile_rounds_total`` counter; after
+   quiesce the ring stops growing (idle wait-loops leak zero records).
+3. **Valid Chrome trace export** — ``chrome_trace`` over the armed
+   window's rounds/events/spans round-trips through JSON and contains
+   at least one full round lane (a ``round/*`` slice plus its segment
+   slices) for Perfetto to render.
+4. **Waterfall** — at least one request's waterfall stitches from the
+   rings with a terminal outcome and at least one stage.
+5. **No mid-traffic compiles** — with ``TPUSLICE_COMPILE_GRACE``
+   pinned low and a warm-up burst of the same traffic shape, the
+   armed measured window journals zero ``CompileObserved`` events
+   (a cold mid-run compile would both fail this gate and wreck the
+   overhead bound — the two assertions back each other up).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # run as tools/profile_smoke.py
+    sys.path.insert(0, REPO)
+
+# the lazy first-dispatch decode compiles ride the warm-up burst; the
+# grace window must close BEFORE the measured arms so a compile there
+# would be loudly journaled (set before any scheduler is constructed)
+GRACE_S = 2.0
+os.environ["TPUSLICE_COMPILE_GRACE"] = str(GRACE_S)
+
+#: the profile-smoke gate: armed tok/s >= OVERHEAD_FLOOR x unprofiled
+OVERHEAD_FLOOR = 0.95
+
+LOADGEN = dict(requests=24, concurrency=6, prompt_len=12,
+               max_tokens=16, vocab=64, stream=True, timeout=60)
+
+
+def check(cond: bool, msg: str, **ctx) -> None:
+    if not cond:
+        raise AssertionError(
+            f"{msg}" + (f" | {json.dumps(ctx, default=str)}" if ctx
+                        else "")
+        )
+
+
+def quiesce(sched, timeout: float = 10.0) -> None:
+    import threading
+
+    pacer = threading.Event()
+    deadline = time.monotonic() + timeout
+    eng = sched.engine
+    while time.monotonic() < deadline and (
+        eng.slots or sched.queue.qsize() or sched._ready
+    ):
+        pacer.wait(0.02)
+    check(not eng.slots, "engine never quiesced",
+          slots=len(eng.slots))
+
+
+def run_arm(url: str, seed: int) -> dict:
+    from instaslice_tpu.serving import loadgen
+
+    report = loadgen.run(url, seed=seed, **LOADGEN)
+    check(report["outcomes"]["hung"] == 0, "hung requests",
+          outcomes=report["outcomes"])
+    check(report["ok"] == LOADGEN["requests"],
+          "not every request succeeded",
+          report={k: report[k] for k in ("ok", "outcomes", "errors")})
+    return report
+
+
+def validate_chrome_trace(doc: dict) -> None:
+    """Structural Chrome-trace-event validity + >= 1 full round lane:
+    a ``round/*`` complete slice and segment slices under the same
+    scheduler pid."""
+    evs = doc.get("traceEvents")
+    check(isinstance(evs, list) and evs, "traceEvents missing/empty")
+    for ev in evs:
+        check(ev.get("ph") in ("X", "i", "M"),
+              "unknown trace-event phase", event=ev)
+        check("pid" in ev and "ts" in ev, "trace event missing pid/ts",
+              event=ev)
+        if ev["ph"] == "X":
+            check("dur" in ev and "tid" in ev and "name" in ev,
+                  "complete event missing dur/tid/name", event=ev)
+    rounds = [e for e in evs if e.get("ph") == "X"
+              and str(e.get("name", "")).startswith("round/")]
+    check(len(rounds) >= 1, "no round/* slice in the trace")
+    round_pids = {e["pid"] for e in rounds}
+    segs = [e for e in evs if e.get("cat") == "segment"
+            and e.get("pid") in round_pids]
+    check(len(segs) >= 1, "round lane has no segment slices")
+    # dispatch must appear: a trace without the decode/spec dispatch
+    # segment is a rounds-only skeleton, not a timeline
+    check(any(e.get("name") == "dispatch" for e in segs),
+          "no dispatch segment in any round",
+          names=sorted({e.get("name") for e in segs}))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from instaslice_tpu.api.constants import REASON_COMPILE_OBSERVED
+    from instaslice_tpu.models.lm import ModelConfig, TpuLM
+    from instaslice_tpu.obs.journal import get_journal
+    from instaslice_tpu.obs.profiler import (
+        Profiler,
+        chrome_trace,
+        debug_profile_payload,
+        reset_profiler,
+        waterfall_payload,
+    )
+    from instaslice_tpu.serving import ServingEngine
+    from instaslice_tpu.serving.api_server import ApiServer
+
+    t_start = time.time()
+    cfg = ModelConfig(vocab_size=64, d_model=64, n_heads=2, n_layers=2,
+                      d_ff=128, dtype=jnp.float32, remat=False)
+    model = TpuLM(cfg)
+    params = model.init(jax.random.key(0))
+    eng = ServingEngine(model, params, max_batch=8, max_len=64,
+                        prefill_len=8)
+    # the warm window: prefill buckets (and spec shapes, none here)
+    # compile before traffic; lazy decode shapes ride the warm-up burst
+    eng.warm_prefill_buckets()
+    eng.warm_spec_programs()
+
+    prof = Profiler(armed=False)
+    reset_profiler(prof)
+    journal = get_journal()
+    try:
+        with ApiServer(eng, block_size=8, request_timeout=60) as srv:
+            sched = srv.scheduler
+            check(sched.profiler is prof,
+                  "scheduler did not pick up the process profiler")
+
+            # ---- warm-up: same traffic shape as the measured arms,
+            # then wait out the compile grace window
+            run_arm(srv.url, seed=7)
+            quiesce(sched)
+            time.sleep(GRACE_S + 0.3)
+
+            ratio = 0.0
+            for attempt in (1, 2, 3):
+                # ---- arm A: unprofiled
+                prof.disarm()
+                rep_off = run_arm(srv.url, seed=8 + attempt)
+                quiesce(sched)
+                off_tps = rep_off["client_tokens_per_sec"]
+
+                # ---- arm B: armed (fresh ring; ledger from here)
+                rounds0 = sched.rounds_total
+                rec0 = prof.rounds_recorded
+                compiles0 = journal.counts().get(
+                    REASON_COMPILE_OBSERVED, 0)
+                prof.arm()
+                rep_on = run_arm(srv.url, seed=20 + attempt)
+                quiesce(sched)
+                prof.disarm()
+                on_tps = rep_on["client_tokens_per_sec"]
+
+                ratio = on_tps / off_tps if off_tps else 0.0
+                if ratio >= OVERHEAD_FLOOR:
+                    break
+                print(json.dumps({"retry": attempt, "ratio":
+                                  round(ratio, 4)}), flush=True)
+            check(ratio >= OVERHEAD_FLOOR,
+                  f"armed arm kept < {OVERHEAD_FLOOR:.0%} of "
+                  "unprofiled tok/s",
+                  off_tps=off_tps, on_tps=on_tps,
+                  ratio=round(ratio, 4))
+
+            # ---- ledger reconciliation over the armed window
+            recorded = prof.rounds_recorded - rec0
+            rounds_delta = sched.rounds_total - rounds0
+            check(recorded > 0, "armed arm recorded no rounds")
+            check(recorded == rounds_delta,
+                  "profiler ring != scheduler round counter",
+                  recorded=recorded, rounds_total_delta=rounds_delta)
+            counter = sched.metrics.profile_rounds
+            metric_val = getattr(counter, "_value", None)
+            if metric_val is not None:   # real prometheus counter
+                check(int(metric_val.get()) == prof.rounds_recorded,
+                      "profile_rounds metric != ring recorded",
+                      metric=metric_val.get(),
+                      recorded=prof.rounds_recorded)
+            # zero ring entries leaked after quiesce: idle wait-loops
+            # must not record
+            settle = prof.rounds_recorded
+            time.sleep(0.25)
+            check(prof.rounds_recorded == settle,
+                  "ring grew while idle",
+                  before=settle, after=prof.rounds_recorded)
+
+            # ---- zero mid-traffic compiles after warm-up
+            compiles = journal.counts().get(
+                REASON_COMPILE_OBSERVED, 0) - compiles0
+            check(compiles == 0,
+                  "CompileObserved during the measured window",
+                  events=[e.to_dict() for e in journal.events(
+                      reason=REASON_COMPILE_OBSERVED)])
+
+            # ---- chrome trace export round-trips and renders a lane
+            payload = debug_profile_payload({"n": ["512"]})
+            doc = chrome_trace(rounds=payload["recent"],
+                               events=payload["recentEvents"])
+            doc = json.loads(json.dumps(doc))   # must survive JSON
+            validate_chrome_trace(doc)
+
+            # ---- >= 1 waterfall from a recorded round's rid
+            rids = []
+            for rec in prof.rounds():
+                rids.extend(rec.meta.get("rids") or [])
+            check(rids, "no rids in any round record")
+            w = waterfall_payload(str(rids[-1]))
+            check(w["outcome"] != "", "waterfall has no outcome",
+                  waterfall=w)
+            check(len(w["stages"]) >= 1, "waterfall has no stages",
+                  waterfall=w)
+            check(len(w["rounds"]) >= 1,
+                  "waterfall joined no round records", waterfall=w)
+
+            # ---- the HTTP surface serves the same payloads
+            import urllib.request
+
+            with urllib.request.urlopen(
+                srv.url + "/v1/debug/profile?n=4", timeout=5
+            ) as r:
+                served = json.loads(r.read())
+            check(served["rounds"] == prof.rounds_recorded,
+                  "/v1/debug/profile drifted from the ring")
+            with urllib.request.urlopen(
+                srv.url + f"/v1/debug/profile?rid={rids[-1]}",
+                timeout=5,
+            ) as r:
+                served_w = json.loads(r.read())
+            check(served_w["traceId"] == w["traceId"],
+                  "HTTP waterfall != in-process waterfall")
+
+            print(json.dumps({
+                "profile_smoke": "ok",
+                "off_tokens_per_sec": off_tps,
+                "on_tokens_per_sec": on_tps,
+                "ratio": round(ratio, 4),
+                "rounds_recorded": recorded,
+                "trace_events": len(doc["traceEvents"]),
+                "waterfall_outcome": w["outcome"],
+                "wall_s": round(time.time() - t_start, 1),
+            }))
+            return 0
+    finally:
+        reset_profiler()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,7 @@ RAW_HTTP_ALLOW = (
     "instaslice_tpu/obs/telemetry.py",
     "tools/serve_capacity.py",
     "tools/telemetry_smoke.py",
+    "tools/profile_smoke.py",
 )
 
 RAW_LOCK_ALLOW = ("instaslice_tpu/utils/lockcheck.py",)
